@@ -1,0 +1,26 @@
+"""jepsen_tpu — a TPU-native distributed-systems testing framework.
+
+A ground-up rebuild of Jepsen (reference: /root/reference, Clojure) with the
+checker phase designed TPU-first: histories are packed into dense SoA tensors,
+linearizability search (Wing–Gong–Lowe) runs as a jit-compiled beam over
+linearization prefixes, and transactional-anomaly detection (Elle-style) runs
+as batched dense-reachability kernels on the MXU.  The run-time harness
+(generators, interpreter, control layer, nemeses, storage, CLI, web) is
+host-side Python, mirroring the reference's semantics
+(jepsen/src/jepsen/core.clj:2-14) without porting its JVM architecture.
+
+Layer map (cf. SURVEY.md §1):
+
+  L0 control/    remote execution (ssh subprocess / docker / dummy)
+  L1 os/, db     environment automation
+  L2 nemesis/    fault injection
+  L3 client      client protocol + reconnect wrapper
+  L4 generator/  pure scheduling DSL
+  L5 generator.interpreter  concurrency runtime
+  L6 core        orchestration (run / analyze)
+  L7 checker/    analysis — the TPU-accelerated layer (ops/ holds kernels)
+  L8 store/      persistence
+  L9 cli, web    presentation
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,348 @@
+"""Operation histories: the central data model.
+
+A *history* is the ordered record of everything clients and the nemesis did
+during a test.  The reference represents it as a vector of Clojure maps with
+keys ``:type :process :f :value :time`` plus a post-hoc ``:index``
+(jepsen/src/jepsen/generator.clj:330-343, core.clj:228 — which calls
+``knossos.history/index``).  This rebuild keeps that record view for the
+host-side harness, but makes a dense packed struct-of-arrays form
+(``PackedHistory``) a first-class citizen, because the TPU checker kernels
+(jepsen_tpu.ops) consume `(type, process, f, value, time)` int tensors, not
+Python dicts.
+
+Op ``type`` life-cycle (client.clj:9-27, generator/interpreter.clj:142-157):
+
+  invoke  — a client began an operation
+  ok      — it definitely happened
+  fail    — it definitely did not happen
+  info    — indeterminate (client crashed / timed out); the op may take
+            effect at *any* later time, so it stays concurrent with the
+            entire remainder of the history.  Unmatched invokes at the end
+            of a history are implicitly indeterminate too.
+
+Processes are integers; the nemesis is the special process ``NEMESIS``
+(reference uses the keyword ``:nemesis``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Op records
+# ---------------------------------------------------------------------------
+
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+#: Sentinel process id for nemesis ops (reference: the keyword :nemesis).
+NEMESIS = "nemesis"
+
+#: Packed uint8 codes for op types.
+TYPE_CODES = {INVOKE: 0, OK: 1, FAIL: 2, INFO: 3}
+TYPE_NAMES = [INVOKE, OK, FAIL, INFO]
+
+#: Packed int32 for "no value" (reference: nil).  Chosen far outside any
+#: realistic register value so model kernels can branch on it.
+NIL = np.int32(np.iinfo(np.int32).min)
+#: Packed int32 process id for the nemesis.
+NEMESIS_PID = np.int32(-1)
+#: Packed int32 "no partner" marker in pair indices.
+NO_PAIR = np.int32(-1)
+
+
+def op(type: str, process, f, value=None, time: int | None = None, **extra):
+    """Construct an op dict. Mirrors the reference's op maps."""
+    o = {"type": type, "process": process, "f": f, "value": value}
+    if time is not None:
+        o["time"] = time
+    o.update(extra)
+    return o
+
+
+def invoke_op(process, f, value=None, **kw):
+    return op(INVOKE, process, f, value, **kw)
+
+
+def is_invoke(o) -> bool:
+    """knossos.op/invoke? equivalent."""
+    return o["type"] == INVOKE
+
+
+def is_ok(o) -> bool:
+    """knossos.op/ok? equivalent."""
+    return o["type"] == OK
+
+
+def is_fail(o) -> bool:
+    """knossos.op/fail? equivalent."""
+    return o["type"] == FAIL
+
+
+def is_info(o) -> bool:
+    """knossos.op/info? equivalent."""
+    return o["type"] == INFO
+
+
+def is_client_op(o) -> bool:
+    """True iff this op was performed by a client process (an integer), not
+    the nemesis (control.clj worker model; checkers usually filter on this)."""
+    return isinstance(o["process"], int)
+
+
+# ---------------------------------------------------------------------------
+# Indexing & pairing
+# ---------------------------------------------------------------------------
+
+
+def index(history: Sequence[dict]) -> list[dict]:
+    """Add a monotone ``index`` key to each op, returning a new list.
+
+    Equivalent to ``knossos.history/index`` as called by the orchestrator
+    before checking (core.clj:228).  Idempotent: ops that already carry an
+    index keep it if the whole history is consistently indexed.
+    """
+    out = []
+    for i, o in enumerate(history):
+        if o.get("index") != i:
+            o = {**o, "index": i}
+        out.append(o)
+    return out
+
+
+def pair_index(history: Sequence[dict]) -> np.ndarray:
+    """``pair[i]`` = index of op i's invoke/completion partner, or NO_PAIR.
+
+    Equivalent to ``knossos.history/pair-index`` (used by e.g. the counter
+    checker, checker.clj:759).  Matching walks per-process: an invoke by
+    process p pairs with the next non-invoke op by p.  Nemesis ops pair the
+    same way (start/stop style ops often don't pair; unmatched → NO_PAIR).
+    """
+    n = len(history)
+    pair = np.full(n, NO_PAIR, dtype=np.int32)
+    open_by_process: dict[Any, int] = {}
+    for i, o in enumerate(history):
+        p = o["process"]
+        if is_invoke(o):
+            open_by_process[p] = i
+        else:
+            j = open_by_process.pop(p, None)
+            if j is not None:
+                pair[j] = i
+                pair[i] = j
+    return pair
+
+
+def complete(history: Sequence[dict]) -> list[dict]:
+    """Fill invoke ops' values from their ok completions.
+
+    Equivalent to ``knossos.history/complete``: a read is invoked with value
+    nil and completes with the observed value; checkers that fold over
+    invocations want the completed value on the invoke.  Ops whose completion
+    is ``info`` get ``{"indeterminate": True}`` semantics — we leave the
+    invoke value as-is and do not alter types.
+    """
+    pairs = pair_index(history)
+    out = list(history)
+    for i, o in enumerate(history):
+        j = int(pairs[i])
+        if is_invoke(o) and j != -1 and history[j]["type"] == OK:
+            comp_v = history[j].get("value")
+            if comp_v is not None and o.get("value") != comp_v:
+                out[i] = {**o, "value": comp_v}
+    return out
+
+
+def crashed_invokes(history: Sequence[dict]) -> list[int]:
+    """Indices of invoke ops that never definitively completed: their
+    completion is ``info`` or missing.  These stay concurrent with the whole
+    rest of the history (the worst-case branching driver — SURVEY.md §5
+    'long-context' note)."""
+    pairs = pair_index(history)
+    out = []
+    for i, o in enumerate(history):
+        if is_invoke(o) and is_client_op(o):
+            j = int(pairs[i])
+            if j == -1 or history[j]["type"] == INFO:
+                out.append(i)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Value encoding
+# ---------------------------------------------------------------------------
+
+
+def encode_register_value(f, value) -> tuple[int, int]:
+    """Default value encoder for register-family workloads.
+
+    read/write values are ints (or None → NIL); cas carries ``[old, new]``.
+    Returns an ``(v1, v2)`` int pair for the packed columns.
+    """
+    if value is None:
+        return int(NIL), int(NIL)
+    if isinstance(value, (list, tuple)):
+        a = int(NIL) if value[0] is None else int(value[0])
+        b = int(NIL) if len(value) < 2 or value[1] is None else int(value[1])
+        return a, b
+    if isinstance(value, (int, np.integer)):
+        return int(value), int(NIL)
+    raise TypeError(f"register value encoder can't pack {value!r}")
+
+
+def decode_register_value(f, v1: int, v2: int):
+    if v1 == NIL and v2 == NIL:
+        return None
+    if v2 == NIL:
+        return int(v1)
+    return [None if v1 == NIL else int(v1), None if v2 == NIL else int(v2)]
+
+
+# ---------------------------------------------------------------------------
+# Packed (SoA) histories
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PackedHistory:
+    """Dense struct-of-arrays history — the TPU-native representation.
+
+    Columns (all length n, aligned with op index):
+
+      type_    uint8   TYPE_CODES
+      process  int32   client pid, or NEMESIS_PID
+      f        int32   index into ``f_names``
+      v1, v2   int32   encoded value columns (NIL = absent)
+      time     int64   relative nanoseconds (0 if the op had no time)
+      pair     int32   partner index (NO_PAIR if none)
+
+    ``f_names`` maps f codes back to names.  Checker kernels take these
+    arrays directly; jnp.asarray is zero-copy from the numpy columns on CPU
+    and a single H2D transfer on TPU.
+    """
+
+    type_: np.ndarray
+    process: np.ndarray
+    f: np.ndarray
+    v1: np.ndarray
+    v2: np.ndarray
+    time: np.ndarray
+    pair: np.ndarray
+    f_names: list[str]
+
+    def __len__(self) -> int:
+        return len(self.type_)
+
+    @property
+    def n(self) -> int:
+        return len(self.type_)
+
+    def f_code(self, name) -> int:
+        return self.f_names.index(name)
+
+    def unpack(self, decode_value: Callable = decode_register_value) -> list[dict]:
+        """Inverse of ``pack`` (loses non-standard op keys)."""
+        out = []
+        for i in range(len(self)):
+            fname = self.f_names[int(self.f[i])]
+            p = int(self.process[i])
+            out.append(
+                {
+                    "index": i,
+                    "type": TYPE_NAMES[int(self.type_[i])],
+                    "process": NEMESIS if p == NEMESIS_PID else p,
+                    "f": fname,
+                    "value": decode_value(fname, int(self.v1[i]), int(self.v2[i])),
+                    "time": int(self.time[i]),
+                }
+            )
+        return out
+
+
+def pack(
+    history: Sequence[dict],
+    encode_value: Callable = encode_register_value,
+    f_names: Sequence[str] | None = None,
+) -> PackedHistory:
+    """Pack a record history into a ``PackedHistory``.
+
+    ``f_names`` fixes the f-code vocabulary (useful to share codes across a
+    batch of histories); by default it is built in order of first appearance.
+    """
+    n = len(history)
+    type_ = np.zeros(n, dtype=np.uint8)
+    process = np.zeros(n, dtype=np.int32)
+    f = np.zeros(n, dtype=np.int32)
+    v1 = np.full(n, NIL, dtype=np.int32)
+    v2 = np.full(n, NIL, dtype=np.int32)
+    time = np.zeros(n, dtype=np.int64)
+    names = list(f_names) if f_names is not None else []
+    codes: dict[Any, int] = {nm: i for i, nm in enumerate(names)}
+    for i, o in enumerate(history):
+        type_[i] = TYPE_CODES[o["type"]]
+        p = o["process"]
+        process[i] = NEMESIS_PID if p == NEMESIS else p
+        fv = o["f"]
+        if fv not in codes:
+            if f_names is not None:
+                raise KeyError(f"op f {fv!r} not in fixed f_names {names}")
+            codes[fv] = len(names)
+            names.append(fv)
+        f[i] = codes[fv]
+        a, b = encode_value(fv, o.get("value"))
+        v1[i], v2[i] = a, b
+        time[i] = o.get("time", 0) or 0
+    return PackedHistory(
+        type_=type_,
+        process=process,
+        f=f,
+        v1=v1,
+        v2=v2,
+        time=time,
+        pair=pair_index(history),
+        f_names=names,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Derived metrics
+# ---------------------------------------------------------------------------
+
+
+def history_to_latencies(history: Sequence[dict]) -> list[dict]:
+    """Annotate completions with ``latency`` (ns between invoke and
+    completion).  Mirrors ``jepsen.util/history->latencies``
+    (util.clj:700-735) but keyed off the pair index rather than a scan."""
+    pairs = pair_index(history)
+    out = list(history)
+    for i, o in enumerate(history):
+        j = int(pairs[i])
+        if not is_invoke(o) and j != -1:
+            inv = history[j]
+            if "time" in inv and "time" in o:
+                out[i] = {**o, "latency": o["time"] - inv["time"]}
+    return out
+
+
+def processes(history: Sequence[dict]) -> list:
+    """Distinct client processes in order of first appearance."""
+    seen = {}
+    for o in history:
+        p = o["process"]
+        if isinstance(p, int) and p not in seen:
+            seen[p] = True
+    return list(seen)
+
+
+def iter_pairs(history: Sequence[dict]) -> Iterator[tuple[dict, dict | None]]:
+    """Yield (invoke, completion-or-None) pairs in invoke order."""
+    pairs = pair_index(history)
+    for i, o in enumerate(history):
+        if is_invoke(o):
+            j = int(pairs[i])
+            yield o, (history[j] if j != -1 else None)

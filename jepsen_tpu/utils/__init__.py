@@ -1,0 +1,217 @@
+"""Small shared utilities (reference: jepsen/src/jepsen/util.clj, 945 LoC).
+
+Only the pieces the rebuild actually needs; concurrency helpers follow the
+reference's semantics (real-pmap's "most interesting exception" selection,
+util.clj:65-77) on Python threads.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import random
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Sequence
+
+
+class JepsenTimeout(Exception):
+    """Raised when `timeout` expires (reference: util.clj:370 returns a
+    default instead; we raise and let callers catch)."""
+
+
+def real_pmap(f: Callable, xs: Sequence) -> list:
+    """Apply ``f`` to every element on its own thread and wait for all.
+
+    Mirrors ``jepsen.util/real-pmap`` (util.clj:65-77): unlike a pooled map,
+    every element gets a real thread (node fan-out must not deadlock behind a
+    small pool).  If several threads throw, the "most interesting" exception
+    wins: the first non-interrupt error, matching the reference's
+    real-pmap-helper selection.
+    """
+    if not xs:
+        return []
+    results: list[Any] = [None] * len(xs)
+    errors: list[BaseException | None] = [None] * len(xs)
+
+    def run(i, x):
+        try:
+            results[i] = f(x)
+        except BaseException as e:  # noqa: BLE001 - must capture to re-raise
+            errors[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, x), daemon=True) for i, x in enumerate(xs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    interesting = [e for e in errors if e is not None and not isinstance(e, KeyboardInterrupt)]
+    if interesting:
+        raise interesting[0]
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+def bounded_pmap(f: Callable, xs: Sequence, limit: int | None = None) -> list:
+    """Pooled parallel map (dom-top bounded-pmap equivalent; used by
+    independent/checker, independent.clj:285-307)."""
+    if not xs:
+        return []
+    limit = limit or max(2, (len(xs) + 1) // 2)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=limit) as ex:
+        return list(ex.map(f, xs))
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n (util.clj:84): majority(5) = 3, majority(4) = 3."""
+    return n // 2 + 1
+
+
+def random_nonempty_subset(coll: Sequence, rng: random.Random | None = None) -> list:
+    """A random non-empty subset (util.clj:45)."""
+    rng = rng or random
+    coll = list(coll)
+    k = rng.randint(1, len(coll))
+    return rng.sample(coll, k)
+
+
+# ---------------------------------------------------------------------------
+# Time
+# ---------------------------------------------------------------------------
+
+_relative_origin = threading.local()
+
+
+def linear_time_nanos() -> int:
+    """Monotonic nanoseconds (util.clj:328)."""
+    return _time.monotonic_ns()
+
+
+class relative_time:
+    """Context manager establishing a nanosecond time origin for a test run
+    (util.clj:337-348 with-relative-time).  Process-global, like the
+    reference's var."""
+
+    origin: int | None = None
+
+    def __enter__(self):
+        relative_time.origin = linear_time_nanos()
+        return self
+
+    def __exit__(self, *exc):
+        relative_time.origin = None
+        return False
+
+
+def relative_time_nanos() -> int:
+    origin = relative_time.origin
+    if origin is None:
+        raise RuntimeError("relative_time_nanos called outside relative_time scope")
+    return linear_time_nanos() - origin
+
+
+def timeout(seconds: float, f: Callable, *args, default=JepsenTimeout):
+    """Run ``f`` with a wall-clock budget on a helper thread (util.clj:370).
+
+    Returns ``f()``'s value, or ``default`` if it is not the JepsenTimeout
+    class, else raises JepsenTimeout.  The worker thread is abandoned (Python
+    threads can't be killed), matching the reference's interrupt-besteffort
+    semantics closely enough for harness use.
+    """
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(f, *args)
+        try:
+            return fut.result(timeout=seconds)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            if default is JepsenTimeout:
+                raise JepsenTimeout(f"timed out after {seconds}s") from None
+            return default
+
+
+def await_fn(
+    f: Callable,
+    retry_interval: float = 1.0,
+    log_interval: float = 10.0,
+    timeout_s: float = 60.0,
+    log_message: str | None = None,
+):
+    """Invoke ``f`` until it stops throwing, then return its value
+    (util.clj:383-424).  Raises JepsenTimeout when the budget expires."""
+    deadline = _time.monotonic() + timeout_s
+    last_log = _time.monotonic()
+    while True:
+        try:
+            return f()
+        except Exception as e:  # noqa: BLE001
+            now = _time.monotonic()
+            if now + retry_interval > deadline:
+                raise JepsenTimeout(f"await_fn timed out: {e}") from e
+            if log_message and now - last_log >= log_interval:
+                last_log = now
+            _time.sleep(retry_interval)
+
+
+def with_retry(f: Callable, retries: int = 5, backoff: float = 0.1):
+    """Call ``f`` with up to ``retries`` retries and fixed backoff
+    (dom-top with-retry as used by control/retry.clj:15-33)."""
+    err: Exception | None = None
+    for _ in range(retries + 1):
+        try:
+            return f()
+        except Exception as e:  # noqa: BLE001
+            err = e
+            _time.sleep(backoff)
+    raise err  # type: ignore[misc]
+
+
+def fixed_point(f: Callable, x):
+    """Iterate f until a fixed point (util.clj:927)."""
+    while True:
+        x2 = f(x)
+        if x2 == x:
+            return x
+        x = x2
+
+
+# ---------------------------------------------------------------------------
+# History-adjacent helpers
+# ---------------------------------------------------------------------------
+
+
+def nemesis_intervals(history: Iterable[dict], start_fs=("start",), stop_fs=("stop",)) -> list[tuple]:
+    """Pair nemesis start/stop completions into [start-op, stop-op] intervals
+    (util.clj:736-783).  Open intervals get a None stop."""
+    from jepsen_tpu import history as h
+
+    intervals: list[tuple] = []
+    open_ops: list[dict] = []
+    for o in history:
+        if o["process"] != h.NEMESIS or o["type"] != h.INFO and o["type"] != h.OK:
+            continue
+        if o["f"] in start_fs:
+            open_ops.append(o)
+        elif o["f"] in stop_fs:
+            for s in open_ops:
+                intervals.append((s, o))
+            open_ops = []
+    intervals.extend((s, None) for s in open_ops)
+    return intervals
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Compact string for an integer set: #{1-3 5} (util.clj:629)."""
+    xs = sorted(set(xs))
+    if not xs:
+        return "#{}"
+    parts = []
+    lo = prev = xs[0]
+    for x in xs[1:]:
+        if x == prev + 1:
+            prev = x
+            continue
+        parts.append(f"{lo}" if lo == prev else f"{lo}-{prev}")
+        lo = prev = x
+    parts.append(f"{lo}" if lo == prev else f"{lo}-{prev}")
+    return "#{" + " ".join(parts) + "}"

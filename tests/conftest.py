@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding logic is tested on
+`--xla_force_host_platform_device_count=8` CPU devices (the same way the
+driver's dryrun validates multi-chip compilation).  Note the axon TPU plugin
+overrides the JAX_PLATFORMS env var, so we must also set the config flag
+before any backend initialization.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

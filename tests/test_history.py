@@ -1,0 +1,119 @@
+"""History core tests — literal-history golden tests in the style of the
+reference's checker_test.clj (pure unit tests on hand-written op vectors)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import history as h
+
+
+def cas_history():
+    # A tiny concurrent CAS-register history: p0 writes 0, p1 reads 0,
+    # p2's cas crashes (info), p0 reads.
+    return h.index(
+        [
+            h.op(h.INVOKE, 0, "write", 0, time=0),
+            h.op(h.INVOKE, 1, "read", None, time=10),
+            h.op(h.OK, 0, "write", 0, time=20),
+            h.op(h.OK, 1, "read", 0, time=30),
+            h.op(h.INVOKE, 2, "cas", [0, 5], time=40),
+            h.op(h.INFO, 2, "cas", [0, 5], time=50),
+            h.op(h.INVOKE, 0, "read", None, time=60),
+            h.op(h.OK, 0, "read", 0, time=70),
+        ]
+    )
+
+
+def test_index_assigns_monotone_indices():
+    hist = cas_history()
+    assert [o["index"] for o in hist] == list(range(8))
+    # idempotent
+    assert h.index(hist) == hist
+
+
+def test_predicates():
+    hist = cas_history()
+    assert h.is_invoke(hist[0]) and h.is_ok(hist[2])
+    assert h.is_info(hist[5])
+    assert all(h.is_client_op(o) for o in hist)
+    nem = h.op(h.INFO, h.NEMESIS, "start", None)
+    assert not h.is_client_op(nem)
+
+
+def test_pair_index():
+    hist = cas_history()
+    pairs = h.pair_index(hist)
+    assert pairs[0] == 2 and pairs[2] == 0
+    assert pairs[1] == 3 and pairs[3] == 1
+    assert pairs[4] == 5 and pairs[5] == 4
+    assert pairs[6] == 7 and pairs[7] == 6
+
+
+def test_pair_index_unmatched_invoke():
+    hist = [h.op(h.INVOKE, 0, "read", None)]
+    assert h.pair_index(hist)[0] == h.NO_PAIR
+
+
+def test_complete_fills_read_values():
+    hist = cas_history()
+    comp = h.complete(hist)
+    assert comp[1]["value"] == 0  # read invoke gets observed value
+    assert comp[6]["value"] == 0
+    assert comp[0]["value"] == 0  # write unchanged
+
+
+def test_crashed_invokes():
+    hist = cas_history()
+    assert h.crashed_invokes(hist) == [4]
+    # unmatched invoke counts as crashed
+    hist2 = [h.op(h.INVOKE, 0, "write", 1)]
+    assert h.crashed_invokes(hist2) == [0]
+
+
+def test_pack_roundtrip():
+    hist = cas_history()
+    packed = h.pack(hist)
+    assert len(packed) == 8
+    assert packed.f_names == ["write", "read", "cas"]
+    assert packed.type_.dtype == np.uint8
+    assert packed.v1[4] == 0 and packed.v2[4] == 5  # cas [0, 5]
+    assert packed.v1[1] == h.NIL  # read invoke has nil value
+    un = packed.unpack()
+    for orig, back in zip(hist, un):
+        assert back["type"] == orig["type"]
+        assert back["process"] == orig["process"]
+        assert back["f"] == orig["f"]
+        assert back["time"] == orig["time"]
+        if orig["value"] is None:
+            assert back["value"] is None
+        elif isinstance(orig["value"], list):
+            assert back["value"] == orig["value"]
+        else:
+            assert back["value"] == orig["value"]
+
+
+def test_pack_nemesis_process():
+    hist = [h.op(h.INFO, h.NEMESIS, "start", None)]
+    packed = h.pack(hist)
+    assert packed.process[0] == h.NEMESIS_PID
+    assert packed.unpack()[0]["process"] == h.NEMESIS
+
+
+def test_pack_fixed_f_names():
+    hist = [h.op(h.INVOKE, 0, "read", None)]
+    packed = h.pack(hist, f_names=["write", "read", "cas"])
+    assert packed.f[0] == 1
+    with pytest.raises(KeyError):
+        h.pack([h.op(h.INVOKE, 0, "bizarre", None)], f_names=["read"])
+
+
+def test_latencies():
+    hist = cas_history()
+    lat = h.history_to_latencies(hist)
+    assert lat[2]["latency"] == 20
+    assert lat[3]["latency"] == 20
+    assert "latency" not in lat[0]
+
+
+def test_processes():
+    assert h.processes(cas_history()) == [0, 1, 2]

@@ -1,0 +1,93 @@
+import time
+
+import pytest
+
+from jepsen_tpu import utils as u
+from jepsen_tpu import history as h
+
+
+def test_real_pmap():
+    assert u.real_pmap(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+    assert u.real_pmap(lambda x: x, []) == []
+
+
+def test_real_pmap_raises_interesting_exception():
+    def f(x):
+        if x == 2:
+            raise ValueError("boom")
+        return x
+
+    with pytest.raises(ValueError):
+        u.real_pmap(f, [1, 2, 3])
+
+
+def test_bounded_pmap():
+    assert u.bounded_pmap(lambda x: x + 1, list(range(10)), limit=3) == list(range(1, 11))
+
+
+def test_majority():
+    assert u.majority(1) == 1
+    assert u.majority(2) == 2
+    assert u.majority(3) == 2
+    assert u.majority(4) == 3
+    assert u.majority(5) == 3
+
+
+def test_timeout_returns_value():
+    assert u.timeout(5.0, lambda: 42) == 42
+
+
+def test_timeout_expires():
+    with pytest.raises(u.JepsenTimeout):
+        u.timeout(0.05, lambda: time.sleep(2))
+    assert u.timeout(0.05, lambda: time.sleep(2), default="d") == "d"
+
+
+def test_relative_time():
+    with u.relative_time():
+        t1 = u.relative_time_nanos()
+        t2 = u.relative_time_nanos()
+        assert 0 <= t1 <= t2
+    with pytest.raises(RuntimeError):
+        u.relative_time_nanos()
+
+
+def test_with_retry():
+    calls = []
+
+    def f():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("flaky")
+        return "ok"
+
+    assert u.with_retry(f, retries=5, backoff=0) == "ok"
+    assert len(calls) == 3
+
+
+def test_await_fn_times_out():
+    with pytest.raises(u.JepsenTimeout):
+        u.await_fn(lambda: 1 / 0, retry_interval=0.01, timeout_s=0.05)
+
+
+def test_integer_interval_set_str():
+    assert u.integer_interval_set_str([]) == "#{}"
+    assert u.integer_interval_set_str([1, 2, 3, 5]) == "#{1-3 5}"
+    assert u.integer_interval_set_str([7]) == "#{7}"
+
+
+def test_nemesis_intervals():
+    hist = [
+        h.op(h.INFO, h.NEMESIS, "start", None),
+        h.op(h.INVOKE, 0, "read", None),
+        h.op(h.INFO, h.NEMESIS, "stop", None),
+        h.op(h.INFO, h.NEMESIS, "start", None),
+    ]
+    ivals = u.nemesis_intervals(hist)
+    assert len(ivals) == 2
+    assert ivals[0][0]["f"] == "start" and ivals[0][1]["f"] == "stop"
+    assert ivals[1][1] is None
+
+
+def test_fixed_point():
+    assert u.fixed_point(lambda x: min(x + 1, 10), 0) == 10
