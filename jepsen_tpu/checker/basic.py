@@ -1,0 +1,401 @@
+"""The reference's fold-style checkers: set, set-full, queue, total-queue,
+unique-ids, counter, log-file-pattern (checker.clj:218-881).
+
+These are cheap O(n) host-side folds; they pin the result-map vocabulary the
+TPU checkers must also speak.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from collections import Counter as Multiset
+from pathlib import Path
+from typing import Any
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models
+from jepsen_tpu.checker import Checker, UNKNOWN, merge_valid
+from jepsen_tpu.utils import integer_interval_set_str, real_pmap
+
+
+class SetChecker(Checker):
+    """:add ops followed by a final :read of the whole set
+    (checker.clj:240-291): every acknowledged add must be present, and
+    nothing may appear that was never attempted."""
+
+    def check(self, test, history, opts):
+        attempts = {o["value"] for o in history if h.is_invoke(o) and o["f"] == "add"}
+        adds = {o["value"] for o in history if h.is_ok(o) and o["f"] == "add"}
+        final_read = None
+        for o in history:
+            if h.is_ok(o) and o["f"] == "read":
+                final_read = o["value"]
+        if final_read is None:
+            return {"valid?": UNKNOWN, "error": "Set was never read"}
+        final = set(final_read)
+        ok = final & attempts
+        unexpected = final - attempts
+        lost = adds - final
+        recovered = ok - adds
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": integer_interval_set_str(ok),
+            "lost": integer_interval_set_str(lost),
+            "unexpected": integer_interval_set_str(unexpected),
+            "recovered": integer_interval_set_str(recovered),
+        }
+
+
+def set_checker() -> Checker:
+    return SetChecker()
+
+
+# ---------------------------------------------------------------------------
+# set-full: per-element lifecycle analysis (checker.clj:294-592)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Element:
+    """Lifecycle state of one element (checker.clj:313-338):
+    known = the op that first proved the element exists (add completion or
+    first observing read); last_present/last_absent = the latest read
+    *invocations* that did/didn't observe it."""
+
+    element: Any
+    known: dict | None = None
+    last_present: dict | None = None
+    last_absent: dict | None = None
+
+    def on_add_complete(self, op):
+        if op["type"] == h.OK and self.known is None:
+            self.known = op
+
+    def on_read_present(self, inv, op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or self.last_present["index"] < inv["index"]:
+            self.last_present = inv
+
+    def on_read_absent(self, inv, op):
+        if self.last_absent is None or self.last_absent["index"] < inv["index"]:
+            self.last_absent = inv
+
+
+def _idx(op, default=-1):
+    return op["index"] if op is not None else default
+
+
+def _element_results(e: _Element) -> dict:
+    """checker.clj:346-405: classify one element as stable/lost/never-read
+    and compute its stabilization/loss latency."""
+    stable = e.last_present is not None and _idx(e.last_absent) < _idx(e.last_present)
+    lost = (
+        e.known is not None
+        and e.last_absent is not None
+        and _idx(e.last_present) < _idx(e.last_absent)
+        and _idx(e.known) < _idx(e.last_absent)
+    )
+    known_time = e.known["time"] if e.known else None
+    stable_time = (e.last_absent["time"] + 1 if e.last_absent else 0) if stable else None
+    lost_time = (e.last_present["time"] + 1 if e.last_present else 0) if lost else None
+    to_ms = lambda ns: int(ns // 1_000_000)
+    return {
+        "element": e.element,
+        "outcome": "stable" if stable else ("lost" if lost else "never-read"),
+        "stable-latency": to_ms(max(0, stable_time - known_time)) if stable else None,
+        "lost-latency": to_ms(max(0, lost_time - known_time)) if lost else None,
+        "known": e.known,
+        "last-absent": e.last_absent,
+    }
+
+
+def frequency_distribution(points, values) -> dict | None:
+    """Percentiles (0–1) of a collection (checker.clj:407-419)."""
+    s = sorted(values)
+    if not s:
+        return None
+    n = len(s)
+    return {p: s[min(n - 1, int(math.floor(n * p)))] for p in points}
+
+
+class SetFullChecker(Checker):
+    """Rigorous per-element set analysis (checker.clj:421-592).
+
+    Tracks, for every added element, when it became known, the last read
+    that saw it and the last that didn't; classifies each as stable / lost /
+    never-read and reports stabilization latency quantiles.  With
+    ``linearizable=True`` stale (eventually-visible) elements also fail.
+
+    Note: the reference's duplicate detection (checker.clj:560-566) compares
+    ``(< v 1)`` and so never fires; we implement the evident intent
+    (multiplicity > 1 in a single read)."""
+
+    def __init__(self, linearizable: bool = False):
+        self.linearizable = linearizable
+
+    def check(self, test, history, opts):
+        elements: dict[Any, _Element] = {}
+        reads: dict[Any, dict] = {}  # process -> read invocation
+        dups: dict[Any, int] = {}
+        for op in history:
+            if not h.is_client_op(op):
+                continue
+            f, v, p = op["f"], op["value"], op["process"]
+            if f == "add":
+                if h.is_invoke(op):
+                    elements.setdefault(v, _Element(v))
+                elif v in elements:
+                    elements[v].on_add_complete(op)
+            elif f == "read":
+                t = op["type"]
+                if t == h.INVOKE:
+                    reads[p] = op
+                elif t == h.FAIL:
+                    reads.pop(p, None)
+                elif t == h.OK:
+                    inv = reads.get(p)
+                    if inv is None:
+                        continue
+                    counts = Multiset(v)
+                    for k, c in counts.items():
+                        if c > 1:
+                            dups[k] = max(dups.get(k, 0), c)
+                    present = set(v)
+                    for el, state in elements.items():
+                        if el in present:
+                            state.on_read_present(inv, op)
+                        else:
+                            state.on_read_absent(inv, op)
+        rs = [_element_results(e) for _, e in sorted(elements.items(), key=lambda kv: str(kv[0]))]
+        outcomes: dict[str, list] = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stable = outcomes.get("stable", [])
+        lost = outcomes.get("lost", [])
+        never_read = outcomes.get("never-read", [])
+        stale = [r for r in stable if r["stable-latency"] and r["stable-latency"] > 0]
+        if lost:
+            valid = False
+        elif not stable:
+            valid = UNKNOWN
+        elif self.linearizable and stale:
+            valid = False
+        else:
+            valid = True
+        points = [0, 0.5, 0.95, 0.99, 1]
+        out = {
+            "valid?": (valid if not dups else False),
+            "attempt-count": len(rs),
+            "stable-count": len(stable),
+            "lost-count": len(lost),
+            "lost": sorted(r["element"] for r in lost),
+            "never-read-count": len(never_read),
+            "never-read": sorted(r["element"] for r in never_read),
+            "stale-count": len(stale),
+            "stale": sorted(r["element"] for r in stale),
+            "worst-stale": sorted(stale, key=lambda r: -r["stable-latency"])[:8],
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items())),
+        }
+        sl = [r["stable-latency"] for r in rs if r["stable-latency"] is not None]
+        ll = [r["lost-latency"] for r in rs if r["lost-latency"] is not None]
+        if sl:
+            out["stable-latencies"] = frequency_distribution(points, sl)
+        if ll:
+            out["lost-latencies"] = frequency_distribution(points, ll)
+        return out
+
+
+def set_full(linearizable: bool = False) -> Checker:
+    return SetFullChecker(linearizable)
+
+
+# ---------------------------------------------------------------------------
+# Queues
+# ---------------------------------------------------------------------------
+
+
+class QueueChecker(Checker):
+    """Fold a queue model over enqueue-invokes + dequeue-oks
+    (checker.clj:218-238): every dequeue must come from somewhere."""
+
+    def __init__(self, model: models.Model):
+        self.model = model
+
+    def check(self, test, history, opts):
+        m = self.model
+        for op in history:
+            take = (h.is_invoke(op) if op["f"] == "enqueue" else h.is_ok(op) if op["f"] == "dequeue" else False)
+            if take:
+                m = m.step(op)
+                if models.is_inconsistent(m):
+                    return {"valid?": False, "error": m.msg}
+        return {"valid?": True, "final-queue": m}
+
+
+def queue(model: models.Model) -> Checker:
+    return QueueChecker(model)
+
+
+def expand_queue_drain_ops(history) -> list:
+    """Expand ok :drain ops (value = list of elements) into synthetic
+    dequeue invoke/ok pairs (checker.clj:594-626)."""
+    out = []
+    for op in history:
+        if op["f"] != "drain":
+            out.append(op)
+        elif h.is_invoke(op) or h.is_fail(op):
+            continue
+        elif h.is_ok(op):
+            for element in op["value"]:
+                out.append({**op, "type": h.INVOKE, "f": "dequeue", "value": None})
+                out.append({**op, "type": h.OK, "f": "dequeue", "value": element})
+        else:
+            raise ValueError(f"can't handle a crashed drain operation: {op!r}")
+    return out
+
+
+class TotalQueueChecker(Checker):
+    """What goes in must come out — multiset accounting over enqueues and
+    dequeues, requires a draining read (checker.clj:628-687)."""
+
+    def check(self, test, history, opts):
+        history = expand_queue_drain_ops(history)
+        attempts = Multiset(o["value"] for o in history if h.is_invoke(o) and o["f"] == "enqueue")
+        enqueues = Multiset(o["value"] for o in history if h.is_ok(o) and o["f"] == "enqueue")
+        dequeues = Multiset(o["value"] for o in history if h.is_ok(o) and o["f"] == "dequeue")
+        ok = dequeues & attempts
+        unexpected = Multiset({k: c for k, c in dequeues.items() if k not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum(ok.values()),
+            "unexpected-count": sum(unexpected.values()),
+            "duplicated-count": sum(duplicated.values()),
+            "lost-count": sum(lost.values()),
+            "recovered-count": sum(recovered.values()),
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+        }
+
+
+def total_queue() -> Checker:
+    return TotalQueueChecker()
+
+
+class UniqueIdsChecker(Checker):
+    """A unique-id generator must emit distinct values (checker.clj:689-734)."""
+
+    def check(self, test, history, opts):
+        attempted = sum(1 for o in history if h.is_invoke(o) and o["f"] == "generate")
+        acks = [o["value"] for o in history if h.is_ok(o) and o["f"] == "generate"]
+        counts = Multiset(acks)
+        dups = {k: c for k, c in counts.items() if c > 1}
+        rng = [min(acks), max(acks)] if acks else [None, None]
+        worst = dict(sorted(dups.items(), key=lambda kv: -kv[1])[:48])
+        return {
+            "valid?": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": len(acks),
+            "duplicated-count": len(dups),
+            "duplicated": worst,
+            "range": rng,
+        }
+
+
+def unique_ids() -> Checker:
+    return UniqueIdsChecker()
+
+
+class CounterChecker(Checker):
+    """Monotonic counter bounds check (checker.clj:737-795): every read must
+    fall between the sum of acknowledged adds (lower) and the sum of
+    attempted adds (upper) as they stood over the read's window."""
+
+    def check(self, test, history, opts):
+        pairs = h.pair_index(history)
+        lower = 0
+        upper = 0
+        pending_reads: dict[Any, list] = {}  # process -> [lower, read-value]
+        reads = []
+        for i, op in enumerate(history):
+            f, t, p = op["f"], op["type"], op["process"]
+            if f == "read":
+                if t == h.INVOKE:
+                    # Value observed at completion (the reference pre-fills
+                    # it via knossos.history/complete; we use the pair index).
+                    j = int(pairs[i])
+                    v = history[j]["value"] if j != -1 and history[j]["type"] == h.OK else None
+                    pending_reads[p] = [lower, v]
+                elif t == h.OK:
+                    r = pending_reads.pop(p, None)
+                    if r is not None:
+                        reads.append([r[0], r[1], upper])
+            elif f == "add":
+                if t == h.INVOKE:
+                    assert op["value"] >= 0, "counter checker assumes non-negative adds"
+                    # Skip adds that definitely failed (reference drops
+                    # :fails? invocations after history/complete).
+                    j = int(pairs[i])
+                    if not (j != -1 and history[j]["type"] == h.FAIL):
+                        upper += op["value"]
+                elif t == h.OK:
+                    lower += op["value"]
+        errors = [r for r in reads if not (r[0] <= r[1] <= r[2])]
+        return {"valid?": not errors, "reads": reads, "errors": errors}
+
+
+def counter() -> Checker:
+    return CounterChecker()
+
+
+class LogFilePattern(Checker):
+    """Grep each node's downloaded log for a pattern; matches fail the test
+    (checker.clj:839-881).  Searches ``<store-dir>/<node>/<filename>``;
+    the store directory comes from ``test["dir"]`` or ``opts["dir"]``."""
+
+    def __init__(self, pattern: str, filename: str):
+        self.pattern = re.compile(pattern)
+        self.filename = filename
+
+    def check(self, test, history, opts):
+        base = opts.get("dir") or test.get("dir")
+        if base is None:
+            from jepsen_tpu import store
+
+            base = store.test_path(test)
+        matches = []
+
+        def search(node):
+            path = Path(base) / str(node) / self.filename
+            if not path.exists():
+                return []
+            found = []
+            with open(path, errors="replace") as fh:
+                for line in fh:
+                    if self.pattern.search(line):
+                        found.append({"node": node, "line": line.rstrip("\n")})
+            return found
+
+        for result in real_pmap(search, list(test.get("nodes", []))):
+            matches.extend(result)
+        return {"valid?": not matches, "count": len(matches), "matches": matches}
+
+
+def log_file_pattern(pattern: str, filename: str) -> Checker:
+    return LogFilePattern(pattern, filename)

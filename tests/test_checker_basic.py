@@ -1,0 +1,220 @@
+"""Fold-checker tests on literal histories (reference: checker_test.clj)."""
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import history as h
+from jepsen_tpu import models
+from jepsen_tpu.checker import basic
+
+
+def idx(hist):
+    return h.index(hist)
+
+
+# -- set ---------------------------------------------------------------------
+
+
+def test_set_all_good():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 0), h.op(h.OK, 0, "add", 0),
+        h.op(h.INVOKE, 1, "add", 1), h.op(h.OK, 1, "add", 1),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", [0, 1]),
+    ])
+    r = basic.set_checker().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2 and r["lost-count"] == 0
+
+
+def test_set_lost_and_unexpected():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 0), h.op(h.OK, 0, "add", 0),
+        h.op(h.INVOKE, 1, "add", 1), h.op(h.INFO, 1, "add", 1),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", [1, 99]),
+    ])
+    r = basic.set_checker().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost"] == "#{0}"          # acked add 0 missing
+    assert r["unexpected"] == "#{99}"   # never attempted
+    assert r["recovered"] == "#{1}"     # indeterminate add observed
+
+
+def test_set_never_read():
+    hist = idx([h.op(h.INVOKE, 0, "add", 0), h.op(h.OK, 0, "add", 0)])
+    r = basic.set_checker().check({}, hist, {})
+    assert r["valid?"] == c.UNKNOWN
+
+
+# -- set-full ----------------------------------------------------------------
+
+
+def test_set_full_stable_and_lost():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 0, time=0), h.op(h.OK, 0, "add", 0, time=10),
+        h.op(h.INVOKE, 1, "add", 1, time=20), h.op(h.OK, 1, "add", 1, time=30),
+        # read sees both
+        h.op(h.INVOKE, 2, "read", None, time=40), h.op(h.OK, 2, "read", [0, 1], time=50),
+        # later read loses element 1
+        h.op(h.INVOKE, 2, "read", None, time=60), h.op(h.OK, 2, "read", [0], time=70),
+    ])
+    r = basic.set_full().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost"] == [1]
+    assert r["stable-count"] == 1
+
+
+def test_set_full_unknown_when_nothing_stable():
+    hist = idx([h.op(h.INVOKE, 0, "add", 0, time=0), h.op(h.INFO, 0, "add", 0, time=1)])
+    r = basic.set_full().check({}, hist, {})
+    assert r["valid?"] == c.UNKNOWN
+    assert r["never-read"] == [0]
+
+
+def test_set_full_stale_linearizable():
+    ms = 1_000_000  # times are nanoseconds; latencies are reported in ms
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 7, time=0), h.op(h.OK, 0, "add", 7, time=10 * ms),
+        # read after the add completes but misses it (stale)
+        h.op(h.INVOKE, 1, "read", None, time=20 * ms), h.op(h.OK, 1, "read", [], time=30 * ms),
+        # later read sees it
+        h.op(h.INVOKE, 1, "read", None, time=40 * ms), h.op(h.OK, 1, "read", [7], time=50 * ms),
+    ])
+    relaxed = basic.set_full(linearizable=False).check({}, hist, {})
+    strict = basic.set_full(linearizable=True).check({}, hist, {})
+    assert relaxed["valid?"] is True
+    assert relaxed["stale"] == [7]
+    assert strict["valid?"] is False
+
+
+def test_set_full_duplicates():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 3, time=0), h.op(h.OK, 0, "add", 3, time=10),
+        h.op(h.INVOKE, 1, "read", None, time=20), h.op(h.OK, 1, "read", [3, 3], time=30),
+    ])
+    r = basic.set_full().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["duplicated"] == {3: 2}
+
+
+# -- queue / total-queue -----------------------------------------------------
+
+
+def test_queue_checker_ok():
+    hist = idx([
+        h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1),
+        h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", 1),
+    ])
+    r = basic.queue(models.UnorderedQueue()).check({}, hist, {})
+    assert r["valid?"] is True
+
+
+def test_queue_checker_dequeue_from_nowhere():
+    hist = idx([h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", 9)])
+    r = basic.queue(models.UnorderedQueue()).check({}, hist, {})
+    assert r["valid?"] is False
+    assert "dequeue" in r["error"]
+
+
+def test_total_queue_lost_and_duplicated():
+    hist = idx([
+        h.op(h.INVOKE, 0, "enqueue", "a"), h.op(h.OK, 0, "enqueue", "a"),
+        h.op(h.INVOKE, 0, "enqueue", "b"), h.op(h.OK, 0, "enqueue", "b"),
+        h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", "a"),
+        h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", "a"),
+    ])
+    r = basic.total_queue().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost"] == {"b": 1}
+    assert r["duplicated"] == {"a": 1}
+
+
+def test_total_queue_drain_expansion():
+    hist = idx([
+        h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1),
+        h.op(h.INVOKE, 0, "enqueue", 2), h.op(h.OK, 0, "enqueue", 2),
+        h.op(h.INVOKE, 1, "drain", None), h.op(h.OK, 1, "drain", [1, 2]),
+    ])
+    r = basic.total_queue().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2
+
+
+# -- unique-ids --------------------------------------------------------------
+
+
+def test_unique_ids():
+    hist = idx([
+        h.op(h.INVOKE, 0, "generate", None), h.op(h.OK, 0, "generate", 1),
+        h.op(h.INVOKE, 0, "generate", None), h.op(h.OK, 0, "generate", 2),
+    ])
+    r = basic.unique_ids().check({}, hist, {})
+    assert r["valid?"] is True and r["range"] == [1, 2]
+
+    dup = idx([
+        h.op(h.INVOKE, 0, "generate", None), h.op(h.OK, 0, "generate", 5),
+        h.op(h.INVOKE, 0, "generate", None), h.op(h.OK, 0, "generate", 5),
+    ])
+    r2 = basic.unique_ids().check({}, dup, {})
+    assert r2["valid?"] is False
+    assert r2["duplicated"] == {5: 2}
+
+
+# -- counter -----------------------------------------------------------------
+
+
+def test_counter_in_bounds():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 1), h.op(h.OK, 0, "add", 1),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 1),
+    ])
+    r = basic.counter().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["reads"] == [[1, 1, 1]]
+
+
+def test_counter_concurrent_window():
+    # read overlaps an in-flight add: value may be 0 (not yet applied) or 1
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 1),
+        h.op(h.INVOKE, 1, "read", None),
+        h.op(h.OK, 1, "read", 0),
+        h.op(h.OK, 0, "add", 1),
+    ])
+    r = basic.counter().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["reads"] == [[0, 0, 1]]
+
+
+def test_counter_out_of_bounds():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 1), h.op(h.OK, 0, "add", 1),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 5),
+    ])
+    r = basic.counter().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["errors"] == [[1, 5, 1]]
+
+
+def test_counter_failed_add_not_counted():
+    hist = idx([
+        h.op(h.INVOKE, 0, "add", 10), h.op(h.FAIL, 0, "add", 10),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 0),
+    ])
+    r = basic.counter().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["reads"] == [[0, 0, 0]]
+
+
+# -- log-file-pattern --------------------------------------------------------
+
+
+def test_log_file_pattern(tmp_path):
+    node_dir = tmp_path / "n1"
+    node_dir.mkdir()
+    (node_dir / "db.log").write_text("starting up\npanic: invariant violation\n")
+    chk = basic.log_file_pattern(r"panic: \w+", "db.log")
+    r = chk.check({"nodes": ["n1", "n2"], "dir": str(tmp_path)}, [], {})
+    assert r["valid?"] is False
+    assert r["count"] == 1
+    assert r["matches"][0]["node"] == "n1"
+    ok = basic.log_file_pattern(r"unfindable", "db.log").check(
+        {"nodes": ["n1"], "dir": str(tmp_path)}, [], {})
+    assert ok["valid?"] is True

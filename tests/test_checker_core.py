@@ -1,0 +1,95 @@
+"""Checker framework tests (reference pattern: checker_test.clj — literal
+histories in, result maps out)."""
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import history as h
+
+
+def test_merge_valid_priorities():
+    assert c.merge_valid([]) is True
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([True, c.UNKNOWN]) == c.UNKNOWN
+    assert c.merge_valid([c.UNKNOWN, False]) is False
+    assert c.merge_valid([False, True]) is False
+    with pytest.raises(ValueError):
+        c.merge_valid([None])
+
+
+def test_check_safe_wraps_exceptions():
+    class Boom(c.Checker):
+        def check(self, test, history, opts):
+            raise RuntimeError("kaboom")
+
+    r = c.check_safe(Boom(), {}, [])
+    assert r["valid?"] == c.UNKNOWN
+    assert "kaboom" in r["error"]
+
+
+def test_check_safe_none_result():
+    assert c.check_safe(c.noop(), {}, []) == {"valid?": True}
+
+
+def test_compose():
+    comp = c.compose(
+        {"a": c.unbridled_optimism(), "b": c.unbridled_optimism()}
+    )
+    r = comp.check({}, [], {})
+    assert r["valid?"] is True
+    assert r["a"]["valid?"] is True
+
+    class Nope(c.Checker):
+        def check(self, test, history, opts):
+            return {"valid?": False, "why": "because"}
+
+    r2 = c.compose({"good": c.unbridled_optimism(), "bad": Nope()}).check({}, [], {})
+    assert r2["valid?"] is False
+    assert r2["bad"]["why"] == "because"
+
+
+def test_compose_contains_exceptions():
+    class Boom(c.Checker):
+        def check(self, test, history, opts):
+            raise ValueError("x")
+
+    r = c.compose({"boom": Boom(), "ok": c.unbridled_optimism()}).check({}, [], {})
+    assert r["valid?"] == c.UNKNOWN
+
+
+def test_concurrency_limit_passthrough():
+    chk = c.concurrency_limit(2, c.unbridled_optimism())
+    assert chk.check({}, [], {})["valid?"] is True
+
+
+def test_stats():
+    hist = [
+        h.op(h.INVOKE, 0, "read", None),
+        h.op(h.OK, 0, "read", 1),
+        h.op(h.INVOKE, 1, "write", 2),
+        h.op(h.FAIL, 1, "write", 2),
+        h.op(h.INFO, h.NEMESIS, "start", None),
+    ]
+    r = c.stats().check({}, hist, {})
+    assert r["by-f"]["read"] == {
+        "valid?": True, "count": 1, "ok-count": 1, "fail-count": 0, "info-count": 0,
+    }
+    assert r["by-f"]["write"]["valid?"] is False
+    # write has no ok ops -> overall invalid
+    assert r["valid?"] is False
+    # nemesis ops are excluded
+    assert r["count"] == 2
+
+
+def test_unhandled_exceptions():
+    err = {"class": "TimeoutException", "message": "too slow"}
+    hist = [
+        h.op(h.INFO, 0, "read", None, exception=err),
+        h.op(h.INFO, 1, "read", None, exception=err),
+        h.op(h.OK, 2, "read", 1),
+    ]
+    r = c.unhandled_exceptions().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["exceptions"][0]["count"] == 2
+    assert r["exceptions"][0]["class"] == "TimeoutException"
+    assert c.unhandled_exceptions().check({}, [], {}) == {"valid?": True}

@@ -1,0 +1,70 @@
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+
+
+def step(model, f, value):
+    return model.step(h.op(h.INVOKE, 0, f, value))
+
+
+def test_register():
+    r = m.Register(None)
+    r = step(r, "write", 3)
+    assert r == m.Register(3)
+    assert step(r, "read", 3) == r
+    assert m.is_inconsistent(step(r, "read", 4))
+    assert step(r, "read", None) == r  # nil read always legal
+
+
+def test_cas_register():
+    r = m.CASRegister(0)
+    assert step(r, "cas", [0, 5]) == m.CASRegister(5)
+    assert m.is_inconsistent(step(r, "cas", [1, 5]))
+    assert step(r, "write", 9) == m.CASRegister(9)
+    assert m.is_inconsistent(step(r, "read", 7))
+    assert m.is_inconsistent(step(r, "cas", None))
+
+
+def test_mutex():
+    mu = m.Mutex()
+    locked = step(mu, "acquire", None)
+    assert locked == m.Mutex(True)
+    assert m.is_inconsistent(step(locked, "acquire", None))
+    assert step(locked, "release", None) == m.Mutex(False)
+    assert m.is_inconsistent(step(mu, "release", None))
+
+
+def test_unordered_queue():
+    q = m.UnorderedQueue()
+    q = step(q, "enqueue", "a")
+    q = step(q, "enqueue", "b")
+    q2 = step(q, "dequeue", "b")  # order doesn't matter
+    assert not m.is_inconsistent(q2)
+    assert m.is_inconsistent(step(q2, "dequeue", "b"))
+
+
+def test_fifo_queue():
+    q = m.FIFOQueue()
+    q = step(q, "enqueue", 1)
+    q = step(q, "enqueue", 2)
+    assert m.is_inconsistent(step(q, "dequeue", 2))  # must dequeue head
+    q = step(q, "dequeue", 1)
+    q = step(q, "dequeue", 2)
+    assert m.is_inconsistent(step(q, "dequeue", 3))
+
+
+def test_counter_model():
+    cm = m.MonotonicCounter(0)
+    cm = step(cm, "add", 3)
+    assert cm == m.MonotonicCounter(3)
+    assert m.is_inconsistent(step(cm, "read", 1))
+    assert step(cm, "read", 3) == cm
+
+
+def test_inconsistent_absorbs():
+    bad = m.inconsistent("nope")
+    assert bad.step(h.op(h.INVOKE, 0, "write", 1)) is bad
+
+
+def test_registry():
+    assert isinstance(m.model("cas-register", 0), m.CASRegister)
+    assert isinstance(m.model("fifo-queue"), m.FIFOQueue)
