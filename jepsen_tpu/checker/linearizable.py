@@ -1,0 +1,72 @@
+"""The linearizable checker front-end (reference: checker.clj:185-216).
+
+Chooses an analysis backend by ``algorithm`` the way the reference chooses
+between knossos's ``:linear``/``:wgl``/``competition`` engines:
+
+  * ``"wgl"``          — the CPU DFS oracle (jepsen_tpu.checker.wgl_cpu);
+  * ``"sweep"``        — the CPU configuration-set sweep (the TPU kernel's
+    semantics oracle);
+  * ``"tpu"``          — the jit-compiled beam kernel (jepsen_tpu.ops.wgl);
+  * ``"competition"``  — TPU first, falling back to the CPU oracle when the
+    kernel answers "unknown" (capacity overflow or unsupported model) —
+    mirroring knossos.competition's race semantics with a deterministic
+    order instead of racing threads.
+
+On failure, ``final-paths`` / ``configs`` are truncated to 10 entries, as
+the reference does because writing them out "can take *hours*"
+(checker.clj:213-216).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import Checker, UNKNOWN
+from jepsen_tpu.checker import wgl_cpu
+
+
+def _resolve_model(model) -> m.Model:
+    if isinstance(model, str):
+        return m.model(model)
+    return model
+
+
+class Linearizable(Checker):
+    def __init__(self, opts: Mapping):
+        if "model" not in opts or opts["model"] is None:
+            raise ValueError(
+                f"the linearizable checker requires a model, got {opts.get('model')!r}"
+            )
+        self.model = _resolve_model(opts["model"])
+        self.algorithm = opts.get("algorithm", "competition")
+        self.kernel_opts = dict(opts.get("kernel-opts", {}))
+
+    def _analyze(self, history):
+        if self.algorithm == "wgl":
+            return wgl_cpu.dfs_analysis(self.model, history)
+        if self.algorithm == "sweep":
+            return wgl_cpu.sweep_analysis(self.model, history)
+        from jepsen_tpu.ops import wgl as wgl_tpu
+
+        a = wgl_tpu.analysis(self.model, history, **self.kernel_opts)
+        if self.algorithm == "tpu":
+            return a
+        if self.algorithm == "competition":
+            if a["valid?"] == UNKNOWN:
+                return wgl_cpu.analysis(self.model, history)
+            return a
+        raise ValueError(f"unknown linearizability algorithm {self.algorithm!r}")
+
+    def check(self, test, history, opts):
+        a = self._analyze(history)
+        out = dict(a)
+        if "final-paths" in out:
+            out["final-paths"] = list(out["final-paths"])[:10]
+        if "configs" in out:
+            out["configs"] = list(out["configs"])[:10]
+        return out
+
+
+def linearizable(opts: Mapping) -> Checker:
+    return Linearizable(opts)

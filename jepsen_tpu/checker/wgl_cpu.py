@@ -1,0 +1,379 @@
+"""CPU-reference linearizability checkers (Wing–Gong–Lowe family).
+
+This is the rebuild's equivalent of Knossos (`knossos.wgl/analysis`,
+`knossos.linear/analysis`, called from the reference at
+jepsen/src/jepsen/checker.clj:199-203): the single-host oracle the TPU
+kernel (jepsen_tpu.ops.wgl) is differentially tested against, and the
+"Knossos-JVM-equivalent" baseline for BASELINE.md config 1.
+
+Two engines over the same prepared event stream:
+
+  * ``dfs_analysis`` — depth-first search with a visited-set cache, the
+    moral equivalent of knossos's WGL: on valid histories the greedy path
+    ("fire the returning op first") usually walks straight through in
+    O(n·branching); invalid or adversarial histories backtrack, bounded by
+    ``max_visited``.
+  * ``sweep_analysis`` — breadth-style configuration-set sweep with
+    domination pruning; this is the exact algorithm the TPU kernel
+    vectorizes, kept on CPU as its semantics oracle.
+
+Shared op semantics (knossos convention, load-bearing for correctness —
+SURVEY.md §7 "hard parts" #5):
+
+  * ``ok``   — definitely happened; must linearize between call and return;
+  * ``fail`` — definitely did not happen; removed from the search entirely;
+  * ``info`` — indeterminate; *may* linearize anywhere after its call, or
+    never: it stays open forever, multiplying the branching factor;
+  * crashed ops whose ``f`` is pure (state-preserving, e.g. reads) are
+    dropped: linearizing them never changes any state, so they cannot
+    affect the verdict.
+
+Two structural optimizations make the search tractable (both shared with
+the TPU kernel):
+
+1. **Crashed-op canonicalization.**  Open crashed ops with identical
+   ``(f, value)`` are interchangeable — both may fire at any future point —
+   so fired crashed ops are tracked as a multiset of (f, value) *groups*,
+   not identities.  A 50k-op history with 15k crashed writes over V values
+   contributes V fire-groups, not 2^15k subsets (BASELINE config 5's
+   worst case).
+2. **Barrier compression (just-in-time linearization).**  Linearization
+   points are only chosen at return barriers: once the returning op is
+   fired, the search advances instead of speculatively firing more open
+   ops — any deferred op can equally fire at the next barrier, so nothing
+   reachable is lost.
+
+Both engines answer ``"unknown"`` on resource exhaustion — never a wrong
+verdict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+
+#: fs that never change model state; crashed ops with these fs are dropped.
+PURE_FS = {
+    "register": {"read"},
+    "cas-register": {"read"},
+    "counter": {"read"},
+}
+
+CALL = 0
+RET = 1
+
+
+def _canon_value(v) -> Any:
+    return tuple(v) if isinstance(v, list) else v
+
+
+def prepare(model: m.Model, history: Sequence[dict]):
+    """Reduce a history to the event stream the searches consume.
+
+    Returns ``(events, eff_ops, crashed)``: events are ``(kind, op_index)``
+    pairs in true history order; ``eff_ops[i]`` is the *effective* op for
+    model stepping — the invoke op carrying its completion's value when the
+    completion is ok (knossos.history/complete semantics: reads invoke with
+    nil and learn their value on completion); ``crashed`` is the set of op
+    ids that never definitely completed.
+    """
+    pairs = h.pair_index(history)
+    pure = PURE_FS.get(getattr(model, "name", None), set())
+    order: list[tuple[int, int, int]] = []  # (history position, kind, op id)
+    eff_ops: dict[int, dict] = {}
+    crashed: set[int] = set()
+    for i, op in enumerate(history):
+        if not h.is_invoke(op) or not h.is_client_op(op):
+            continue
+        j = int(pairs[i])
+        completion = history[j] if j != -1 else None
+        ctype = completion["type"] if completion is not None else h.INFO
+        if ctype == h.FAIL:
+            continue  # definitely didn't happen
+        if ctype == h.INFO and op["f"] in pure:
+            continue  # crashed pure op can never matter
+        eff = op
+        if ctype == h.OK and completion.get("value") is not None and op.get("value") != completion["value"]:
+            eff = {**op, "value": completion["value"]}
+        eff_ops[i] = eff
+        order.append((i, CALL, i))
+        if ctype == h.OK:
+            order.append((j, RET, i))
+        else:
+            crashed.add(i)
+    order.sort()
+    return [(kind, i) for _, kind, i in order], eff_ops, crashed
+
+
+def _barrier_snapshots(events, eff_ops, crashed):
+    """For each return event, snapshot the open ok ops and open crashed
+    group counts at that point.  Returns (barriers, group_ops) where
+    barriers is a list of (event_pos, op_id, open_ok tuple, open_crashed
+    tuple of ((f, value), count)) and group_ops maps group -> effective op."""
+    open_ok: set[int] = set()
+    open_crashed: dict[tuple, int] = {}
+    group_ops: dict[tuple, dict] = {}
+    barriers = []
+    for pos, (kind, i) in enumerate(events):
+        if kind == CALL:
+            if i in crashed:
+                g = (eff_ops[i]["f"], _canon_value(eff_ops[i]["value"]))
+                open_crashed[g] = open_crashed.get(g, 0) + 1
+                group_ops[g] = eff_ops[i]
+            else:
+                open_ok.add(i)
+        else:
+            barriers.append(
+                (pos, i, tuple(sorted(open_ok)), tuple(sorted(open_crashed.items(), key=repr)))
+            )
+            open_ok.discard(i)
+    return barriers, group_ops
+
+
+# ---------------------------------------------------------------------------
+# DFS engine (knossos-equivalent; the CPU performance baseline)
+# ---------------------------------------------------------------------------
+
+
+def dfs_analysis(
+    model: m.Model,
+    history: Sequence[dict],
+    max_visited: int = 5_000_000,
+) -> dict:
+    """Decide linearizability by depth-first search over configurations.
+
+    A node is ``(barrier_index, state, fired_ok, fired_crashed)``.  At each
+    barrier the returning op must be fired; if it already is, we advance
+    (barrier compression); otherwise we branch over firing any available
+    open op, greedy-first.  A visited cache makes re-exploration O(1).
+
+    Returns knossos-shaped maps: ``{"valid?": True}``, or ``{"valid?":
+    False, "op": ..., "configs": [...]}`` with the furthest barrier op
+    reached, or ``{"valid?": "unknown", "cause": ...}`` past the node
+    budget.
+    """
+    events, eff_ops, crashed = prepare(model, history)
+    barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
+    n_barriers = len(barriers)
+    if n_barriers == 0:
+        return {"valid?": True, "configs": [{"model": model}]}
+
+    empty: tuple = ()
+    start = (0, model, frozenset(), empty)
+    stack = [start]
+    visited = {start}
+    deepest = 0
+    deepest_sample: list = []
+
+    while stack:
+        b, state, fok, fcr = stack.pop()
+        if b >= n_barriers:
+            return {"valid?": True, "configs": [{"model": state}]}
+        if b > deepest:
+            deepest = b
+            deepest_sample = [(state, fok, fcr)]
+        _pos, i, open_ok, open_crashed = barriers[b]
+
+        if i in fok:
+            # Barrier satisfied: strip i and advance.
+            nxt = (b + 1, state, fok - {i}, fcr)
+            if nxt not in visited:
+                visited.add(nxt)
+                stack.append(nxt)
+            continue
+
+        succs = []
+        # Fire another open ok op (enabling move).
+        for j in open_ok:
+            if j in fok or j == i:
+                continue
+            s2 = state.step(eff_ops[j])
+            if not m.is_inconsistent(s2):
+                succs.append((b, s2, fok | {j}, fcr))
+        # Fire one crashed op from an available group.
+        fcr_d = dict(fcr)
+        for g, open_count in open_crashed:
+            if fcr_d.get(g, 0) >= open_count:
+                continue
+            s2 = state.step(group_ops[g])
+            if not m.is_inconsistent(s2):
+                fcr2 = dict(fcr_d)
+                fcr2[g] = fcr2.get(g, 0) + 1
+                succs.append((b, s2, fok, tuple(sorted(fcr2.items(), key=repr))))
+        # Fire the returning op itself — pushed last so DFS tries it first.
+        s2 = state.step(eff_ops[i])
+        if not m.is_inconsistent(s2):
+            succs.append((b, s2, fok | {i}, fcr))
+
+        for nxt in succs:
+            if nxt not in visited:
+                visited.add(nxt)
+                stack.append(nxt)
+        if len(visited) > max_visited:
+            return {
+                "valid?": "unknown",
+                "cause": f"visited more than {max_visited} configurations",
+                "op": history[barriers[deepest][1]],
+            }
+
+    return {
+        "valid?": False,
+        "op": history[barriers[deepest][1]],
+        "configs": [
+            {"model": st, "pending": sorted(set(barriers[deepest][2]) - fok)}
+            for st, fok, fcr in deepest_sample[:10]
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Configuration-set sweep (the TPU kernel's semantics oracle)
+# ---------------------------------------------------------------------------
+
+
+def _dominates(a: dict, b: dict) -> bool:
+    """a ≤ b pointwise: a fired no more of any crashed group than b."""
+    return all(c <= b.get(g, 0) for g, c in a.items())
+
+
+class _Antichain:
+    """Minimal fired-crashed multisets for one (state, fired_ok) class.
+
+    A config that fired *fewer* crashed ops dominates one that fired more:
+    every continuation of the bigger set is available to the smaller one
+    (crashed ops carry no obligations), so only the minimal antichain needs
+    exploring.
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self):
+        self.items: list[dict] = []
+
+    def add(self, fcr: dict) -> bool:
+        for it in self.items:
+            if _dominates(it, fcr):
+                return False
+        self.items = [it for it in self.items if not _dominates(fcr, it)]
+        self.items.append(fcr)
+        return True
+
+
+def sweep_analysis(
+    model: m.Model,
+    history: Sequence[dict],
+    max_configs: int = 200_000,
+) -> dict:
+    """Exhaustive configuration-set sweep with domination pruning — the
+    algorithm the TPU kernel vectorizes (jepsen_tpu.ops.wgl), kept on CPU
+    as its differential-testing oracle."""
+    events, eff_ops, crashed = prepare(model, history)
+    barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
+
+    # configs: (state, fok) -> antichain of fired-crashed multisets
+    configs: dict[tuple, _Antichain] = {}
+    ac = _Antichain()
+    ac.add({})
+    configs[(model, frozenset())] = ac
+
+    for _pos, i, open_ok, open_crashed in barriers:
+        # Closure under firing, with domination pruning.
+        work = [(st, fok, dict(fcr)) for (st, fok), a in configs.items() for fcr in a.items]
+        seen: dict[tuple, _Antichain] = {}
+        for st, fok, fcr in work:
+            seen.setdefault((st, fok), _Antichain()).add(dict(fcr))
+        count = len(work)
+        while work:
+            state, fok, fcr = work.pop()
+            cands = []
+            for j in open_ok:
+                if j in fok:
+                    continue
+                s2 = state.step(eff_ops[j])
+                if not m.is_inconsistent(s2):
+                    cands.append((s2, fok | {j}, fcr))
+            for g, open_count in open_crashed:
+                if fcr.get(g, 0) >= open_count:
+                    continue
+                s2 = state.step(group_ops[g])
+                if not m.is_inconsistent(s2):
+                    fcr2 = dict(fcr)
+                    fcr2[g] = fcr2.get(g, 0) + 1
+                    cands.append((s2, fok, fcr2))
+            for s2, fok2, fcr2 in cands:
+                a = seen.setdefault((s2, fok2), _Antichain())
+                if a.add(fcr2):
+                    work.append((s2, fok2, fcr2))
+                    count += 1
+                    if count > max_configs:
+                        return {
+                            "valid?": "unknown",
+                            "cause": f"configuration set exceeded {max_configs}",
+                            "op": history[i],
+                        }
+        # Keep configs that fired i; retire i.
+        configs = {}
+        for (st, fok), a in seen.items():
+            if i in fok:
+                tgt = configs.setdefault((st, fok - {i}), _Antichain())
+                for fcr in a.items:
+                    tgt.add(fcr)
+        if not configs:
+            return {
+                "valid?": False,
+                "op": history[i],
+                "configs": [
+                    {"model": st, "pending": sorted(set(open_ok) - fok)}
+                    for (st, fok) in list(seen)[:10]
+                ],
+            }
+    return {"valid?": True, "configs": [{"model": st} for (st, _fok) in list(configs)[:10]]}
+
+
+#: Default engine, reference-equivalent ("wgl" algorithm).
+analysis = dfs_analysis
+
+
+# ---------------------------------------------------------------------------
+# Independent brute-force oracle (for validating the oracles themselves)
+# ---------------------------------------------------------------------------
+
+
+def brute_analysis(model: m.Model, history: Sequence[dict]) -> dict:
+    """Tiny-history oracle: enumerate every linearization order consistent
+    with real-time precedence and check sequential legality.  Exponential —
+    differential-test use only (≲ 12 ops)."""
+    events, eff_ops, _crashed = prepare(model, history)
+    call_pos: dict[int, int] = {}
+    ret_pos: dict[int, int] = {}
+    for pos, (kind, i) in enumerate(events):
+        if kind == CALL:
+            call_pos[i] = pos
+        else:
+            ret_pos[i] = pos
+    ids = sorted(call_pos)
+    must = [i for i in ids if i in ret_pos]  # ok ops must appear
+
+    # At each step, the next linearized op must be callable before the
+    # earliest unlinearized return: if ret(j) < call(i), j precedes i in
+    # every legal order.
+    def search(state, done: frozenset) -> bool:
+        remaining_must = [i for i in must if i not in done]
+        if not remaining_must:
+            return True
+        barrier = min(ret_pos[i] for i in remaining_must)
+        for i in ids:
+            if i in done:
+                continue
+            if call_pos[i] > barrier:
+                continue
+            s2 = state.step(eff_ops[i])
+            if m.is_inconsistent(s2):
+                continue
+            if search(s2, done | {i}):
+                return True
+        return False
+
+    return {"valid?": search(model, frozenset())}

@@ -1,0 +1,201 @@
+"""CPU-oracle linearizability tests: hand-written histories with known
+verdicts, plus randomized cross-validation against an independent
+brute-force enumerator."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.checker.linearizable import linearizable
+
+
+@pytest.fixture(params=["dfs", "sweep"])
+def engine(request):
+    return {"dfs": wgl_cpu.dfs_analysis, "sweep": wgl_cpu.sweep_analysis}[request.param]
+
+
+def an(model, hist, engine=wgl_cpu.dfs_analysis):
+    return engine(model, h.index(hist))
+
+
+def test_empty_history_valid(engine):
+    assert an(m.CASRegister(None), [], engine)["valid?"] is True
+
+
+def test_sequential_rw(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", 1),
+    ]
+    assert an(m.CASRegister(None), hist, engine)["valid?"] is True
+
+
+def test_stale_read_invalid(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "write", 2), h.op(h.OK, 0, "write", 2),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 1),
+    ]
+    a = an(m.CASRegister(None), hist, engine)
+    assert a["valid?"] is False
+    assert a["op"]["f"] == "read"
+
+
+def test_concurrent_read_either_value(engine):
+    # read overlaps write 2: may see old or new value
+    base = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "write", 2),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 1),
+        h.op(h.OK, 0, "write", 2),
+    ]
+    assert an(m.CASRegister(None), base, engine)["valid?"] is True
+    sees_new = [dict(o) for o in base]
+    sees_new[4] = h.op(h.OK, 1, "read", 2)
+    assert an(m.CASRegister(None), sees_new, engine)["valid?"] is True
+
+
+def test_failed_op_removed(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "write", 9), h.op(h.FAIL, 0, "write", 9),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 9),
+    ]
+    # the write failed, so reading 9 is impossible
+    assert an(m.CASRegister(None), hist, engine)["valid?"] is False
+
+
+def test_info_op_may_have_happened(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "write", 9), h.op(h.INFO, 0, "write", 9),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 9),
+    ]
+    # crashed write may have taken effect
+    assert an(m.CASRegister(None), hist, engine)["valid?"] is True
+    # ... or not
+    hist2 = list(hist)
+    hist2[4:] = [h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 1)]
+    assert an(m.CASRegister(None), hist2, engine)["valid?"] is True
+
+
+def test_info_op_takes_effect_late(engine):
+    # crashed write linearizes AFTER a later completed write
+    hist = [
+        h.op(h.INVOKE, 0, "write", 9), h.op(h.INFO, 0, "write", 9),
+        h.op(h.INVOKE, 1, "write", 1), h.op(h.OK, 1, "write", 1),
+        h.op(h.INVOKE, 1, "read", None), h.op(h.OK, 1, "read", 9),
+    ]
+    assert an(m.CASRegister(None), hist, engine)["valid?"] is True
+
+
+def test_cas_semantics(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "write", 0), h.op(h.OK, 0, "write", 0),
+        h.op(h.INVOKE, 1, "cas", [0, 5]), h.op(h.OK, 1, "cas", [0, 5]),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", 5),
+    ]
+    assert an(m.CASRegister(None), hist, engine)["valid?"] is True
+    bad = [
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 1, "cas", [0, 5]), h.op(h.OK, 1, "cas", [0, 5]),
+    ]
+    assert an(m.CASRegister(None), bad, engine)["valid?"] is False
+
+
+def test_mutex_double_acquire(engine):
+    hist = [
+        h.op(h.INVOKE, 0, "acquire", None), h.op(h.OK, 0, "acquire", None),
+        h.op(h.INVOKE, 1, "acquire", None), h.op(h.OK, 1, "acquire", None),
+    ]
+    assert an(m.Mutex(), hist, engine)["valid?"] is False
+
+
+def test_unknown_on_resource_exhaustion():
+    hist = []
+    for p in range(12):
+        hist.append(h.op(h.INVOKE, p, "write", p))
+        hist.append(h.op(h.INFO, p, "write", p))
+    hist += [h.op(h.INVOKE, 50, "read", None), h.op(h.OK, 50, "read", 5)]
+    hist = h.index(hist)
+    a = wgl_cpu.sweep_analysis(m.CASRegister(None), hist, max_configs=5)
+    assert a["valid?"] == "unknown"
+    b = wgl_cpu.dfs_analysis(m.CASRegister(None), hist, max_visited=3)
+    assert b["valid?"] == "unknown"
+
+
+def test_linearizable_checker_front_end():
+    chk = linearizable({"model": "cas-register", "algorithm": "wgl"})
+    hist = h.index([
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", 1),
+    ])
+    assert chk.check({}, hist, {})["valid?"] is True
+    with pytest.raises(ValueError):
+        linearizable({})
+
+
+# ---------------------------------------------------------------------------
+# Randomized differential test: sweep vs brute force
+# ---------------------------------------------------------------------------
+
+
+def random_history(rng, n_procs=3, n_ops=8, values=3):
+    """Concurrent register history: random interleaving of op lifecycles."""
+    hist = []
+    live = {}  # process -> invoke op
+    pid = 0
+    while len(hist) < n_ops * 2:
+        p = rng.randrange(n_procs)
+        if p in live:
+            inv = live.pop(p)
+            outcome = rng.choice([h.OK, h.OK, h.FAIL, h.INFO])
+            v = inv["value"]
+            if inv["f"] == "read":
+                v = rng.randrange(values) if outcome == h.OK else None
+            hist.append(h.op(outcome, p, inv["f"], v))
+        else:
+            f = rng.choice(["read", "write", "cas"])
+            v = (
+                None if f == "read"
+                else rng.randrange(values) if f == "write"
+                else [rng.randrange(values), rng.randrange(values)]
+            )
+            inv = h.op(h.INVOKE, p, f, v)
+            live[p] = inv
+            hist.append(inv)
+    return h.index(hist)
+
+
+def test_engines_match_brute_force():
+    rng = random.Random(45100)  # the reference's deterministic seed habit
+    disagreements = []
+    for trial in range(300):
+        hist = random_history(rng)
+        model = m.CASRegister(None)
+        truth = wgl_cpu.brute_analysis(model, hist)["valid?"]
+        for name, engine in [("dfs", wgl_cpu.dfs_analysis), ("sweep", wgl_cpu.sweep_analysis)]:
+            got = engine(model, hist)["valid?"]
+            if got != truth:
+                disagreements.append((trial, name, got, truth, hist))
+    assert not disagreements, disagreements[:2]
+
+
+def test_engines_match_on_larger_histories():
+    import sys, pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    from genhist import valid_register_history, corrupt
+
+    for seed in range(5):
+        hist = valid_register_history(120, 5, seed=seed, info_rate=0.1)
+        a = wgl_cpu.dfs_analysis(m.CASRegister(None), hist)
+        b = wgl_cpu.sweep_analysis(m.CASRegister(None), hist)
+        assert a["valid?"] is True, (seed, a)
+        assert b["valid?"] is True, (seed, b)
+        bad = corrupt(hist, seed=seed + 100)
+        a2 = wgl_cpu.dfs_analysis(m.CASRegister(None), bad)
+        b2 = wgl_cpu.sweep_analysis(m.CASRegister(None), bad)
+        assert a2["valid?"] == b2["valid?"], (seed, a2["valid?"], b2["valid?"])
