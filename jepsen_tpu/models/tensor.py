@@ -1,0 +1,112 @@
+"""Vectorized model step functions for the TPU checker kernels.
+
+The CPU oracle models (jepsen_tpu.models) are arbitrary Python objects; the
+TPU WGL kernel needs models expressed as pure jnp functions over packed
+int32 state (SURVEY.md §7 hard-part #2):
+
+    step(state, f, v1, v2) -> (state', legal)
+
+operating elementwise on arbitrary-shaped arrays, where ``f`` is a
+model-specific small-int code and ``v1``/``v2`` are the packed value
+columns (jepsen_tpu.history.NIL for absent).  Models whose state doesn't
+fit an int32 scalar (queues) are not tensorizable here; the linearizable
+front-end's "competition" algorithm falls back to the CPU oracle for them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.history import NIL
+
+INT_NIL = int(NIL)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorModel:
+    """A vectorizable model: f-code vocabulary + elementwise step fn."""
+
+    name: str
+    f_codes: dict  # f name -> small int code
+    step: Callable  # (state, f, v1, v2) -> (state', legal)
+    encode_state: Callable  # python model instance -> int32 initial state
+
+
+def _encode_register_state(model) -> int:
+    v = getattr(model, "value", None)
+    return INT_NIL if v is None else int(v)
+
+
+def _register_step(state, f, v1, v2):
+    """register/cas-register step. f: 0=read, 1=write, 2=cas.
+
+    A read of NIL (value unknown) is always legal and leaves state alone; a
+    read of v requires state == v.  cas [old, new] requires state == old.
+    """
+    is_read = f == 0
+    is_write = f == 1
+    is_cas = f == 2
+    read_legal = (v1 == INT_NIL) | (state == v1)
+    cas_legal = state == v1
+    legal = jnp.where(is_read, read_legal, jnp.where(is_cas, cas_legal, is_write))
+    state2 = jnp.where(is_write, v1, jnp.where(is_cas & cas_legal, v2, state))
+    return state2, legal
+
+
+def _plain_register_step(state, f, v1, v2):
+    state2, legal = _register_step(state, f, v1, v2)
+    return state2, legal & (f != 2)  # no cas on the plain register
+
+
+def _mutex_step(state, f, v1, v2):
+    """mutex step. f: 0=acquire, 1=release. state: 0 free, 1 locked."""
+    is_acq = f == 0
+    legal = jnp.where(is_acq, state == 0, state == 1)
+    state2 = jnp.where(legal, jnp.where(is_acq, 1, 0), state)
+    return state2, legal
+
+
+def _counter_step(state, f, v1, v2):
+    """counter step. f: 0=read, 1=add. NIL-state counters start at 0."""
+    is_read = f == 0
+    legal = jnp.where(is_read, (v1 == INT_NIL) | (state == v1), v1 >= 0)
+    state2 = jnp.where(is_read, state, state + jnp.where(v1 == INT_NIL, 0, v1))
+    return state2, legal
+
+
+def _encode_mutex_state(model) -> int:
+    return 1 if getattr(model, "locked", False) else 0
+
+
+def _encode_counter_state(model) -> int:
+    return int(getattr(model, "value", 0) or 0)
+
+
+REGISTRY = {
+    "cas-register": TensorModel(
+        "cas-register",
+        {"read": 0, "write": 1, "cas": 2},
+        _register_step,
+        _encode_register_state,
+    ),
+    "register": TensorModel(
+        "register",
+        {"read": 0, "write": 1},
+        _plain_register_step,
+        _encode_register_state,
+    ),
+    "mutex": TensorModel(
+        "mutex", {"acquire": 0, "release": 1}, _mutex_step, _encode_mutex_state
+    ),
+    "counter": TensorModel(
+        "counter", {"read": 0, "add": 1}, _counter_step, _encode_counter_state
+    ),
+}
+
+
+def tensor_model_for(model) -> TensorModel | None:
+    return REGISTRY.get(getattr(model, "name", None))

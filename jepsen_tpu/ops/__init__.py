@@ -1,0 +1,9 @@
+"""TPU compute kernels (jit/vmap/pjit) for the checker phase.
+
+This package is L7's device half: the host-side checker framework
+(jepsen_tpu.checker) packs histories into tensors and calls these kernels.
+
+  wgl      — frontier-parallel Wing–Gong–Lowe linearizability search
+  hashing  — row hashing + sort-based frontier dedup/compaction
+  scc      — dense reachability / SCC kernels for the Elle-style txn checker
+"""

@@ -1,0 +1,104 @@
+"""Row hashing and sort-based frontier compaction.
+
+The WGL frontier is a struct-of-arrays table of configurations.  Dedup on
+TPU is sort-based: hash each row to 96 bits (3 uint32 lanes of
+murmur3-style mixing — collision probability for ~10^6 rows is ~10^-17 per
+compaction, far below the kernel's other 'unknown' slack), sort by
+(dead, hash) lanes, and mark rows equal to their sorted predecessor as
+duplicates.  A second sort compacts survivors to the fixed capacity,
+preferring configurations that fired the fewest ops (the dominating ones —
+see jepsen_tpu.checker.wgl_cpu domination notes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0x85EBCA6B)
+_C2 = jnp.uint32(0xC2B2AE35)
+
+
+def mix32(x):
+    """murmur3 fmix32 finalizer (vectorized)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * _C1
+    x = x ^ (x >> 13)
+    x = x * _C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_rows(columns, seed: int):
+    """Hash a list of equal-length uint32/int32 column arrays to one uint32
+    lane, column-by-column (static unroll; column count is small)."""
+    h = jnp.full(columns[0].shape, jnp.uint32(seed ^ 0x9E3779B9))
+    for col in columns:
+        h = mix32(h ^ col.astype(jnp.uint32))
+    return h
+
+
+def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
+    """Kill dominated frontier rows.
+
+    Row j is dominated when some alive row i has the same (state, fok) but
+    fired strictly fewer crashed ops pointwise (fcr_i ≤ fcr_j, ≠) — the
+    smaller config's futures are a superset (see wgl_cpu domination notes),
+    so j is redundant.  Exact pruning: removing dominated rows never
+    changes the verdict.  Chunked over the dominated axis to bound the
+    [F, C, G] comparison intermediates.
+    """
+    f = state.shape[0]
+    g = fcr.shape[1]
+    if chunk_rows <= 0:
+        chunk_rows = max(64, min(f, (1 << 22) // max(1, f * g // 64)))
+    parts = []
+    for lo in range(0, f, chunk_rows):
+        hi = min(f, lo + chunk_rows)
+        eq_state = state[:, None] == state[None, lo:hi]
+        eq_fok = (fok[:, None, :] == fok[None, lo:hi, :]).all(-1)
+        le = (fcr[:, None, :] <= fcr[None, lo:hi, :]).all(-1)
+        lt = (fcr[:, None, :] < fcr[None, lo:hi, :]).any(-1)
+        dom = eq_state & eq_fok & le & lt & alive[:, None] & alive[None, lo:hi]
+        parts.append(dom.any(axis=0))
+    return alive & ~jnp.concatenate(parts)
+
+
+def compact(columns, alive, cost, capacity: int):
+    """Dedup + truncate a frontier candidate table.
+
+    ``columns``: list of [N] or [N, k] arrays describing rows; ``alive``:
+    [N] bool; ``cost``: [N] int32 priority (smaller kept first under
+    truncation).  Returns (select_idx [capacity], new_alive [capacity],
+    n_unique, overflowed) — callers gather their columns by select_idx.
+    """
+    n = alive.shape[0]
+    flat_cols = []
+    for c in columns:
+        if c.ndim == 1:
+            flat_cols.append(c)
+        else:
+            for k in range(c.shape[1]):
+                flat_cols.append(c[:, k])
+    h1 = hash_rows(flat_cols, 0x1234_5678)
+    h2 = hash_rows(flat_cols, 0x9ABC_DEF0)
+    h3 = hash_rows(flat_cols, 0x0F1E_2D3C)
+    dead = (~alive).astype(jnp.uint32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sd, s1, s2, s3, sidx = jax.lax.sort((dead, h1, h2, h3, iota), num_keys=4)
+    same_as_prev = (
+        (s1 == jnp.roll(s1, 1)) & (s2 == jnp.roll(s2, 1)) & (s3 == jnp.roll(s3, 1))
+    )
+    same_as_prev = same_as_prev.at[0].set(False)
+    uniq = (sd == 0) & ~same_as_prev
+    n_unique = uniq.sum()
+    # Compact survivors to capacity, cheapest (most-dominating) rows first.
+    cost_sorted = cost[sidx]
+    not_uniq = (~uniq).astype(jnp.uint32)
+    _k1, _k2, fidx = jax.lax.sort(
+        (not_uniq, cost_sorted.astype(jnp.uint32), sidx), num_keys=2
+    )
+    select = fidx[:capacity]
+    new_alive = jnp.arange(capacity) < jnp.minimum(n_unique, capacity)
+    return select, new_alive, n_unique, n_unique > capacity

@@ -1,0 +1,105 @@
+"""Differential tests: TPU WGL kernel vs CPU oracles (the reference's
+testing pattern for checkers — literal + randomized histories; BASELINE
+config 1 territory)."""
+
+import pathlib
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.ops import wgl
+from test_wgl_cpu import random_history
+
+
+def tpu_an(model, hist, **kw):
+    kw.setdefault("capacity", 128)
+    return wgl.analysis(model, h.index(hist), **kw)
+
+
+def test_empty_and_trivial():
+    assert tpu_an(m.CASRegister(None), [])["valid?"] is True
+    hist = [h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1)]
+    assert tpu_an(m.CASRegister(None), hist)["valid?"] is True
+
+
+def test_mutex_kernel():
+    hist = [
+        h.op(h.INVOKE, 0, "acquire", None), h.op(h.OK, 0, "acquire", None),
+        h.op(h.INVOKE, 1, "acquire", None), h.op(h.OK, 1, "acquire", None),
+    ]
+    assert tpu_an(m.Mutex(), hist)["valid?"] is False
+    hist2 = [
+        h.op(h.INVOKE, 0, "acquire", None), h.op(h.OK, 0, "acquire", None),
+        h.op(h.INVOKE, 0, "release", None), h.op(h.OK, 0, "release", None),
+        h.op(h.INVOKE, 1, "acquire", None), h.op(h.OK, 1, "acquire", None),
+    ]
+    assert tpu_an(m.Mutex(), hist2)["valid?"] is True
+
+
+def test_unsupported_model_is_unknown():
+    hist = [h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1)]
+    a = tpu_an(m.FIFOQueue(), hist)
+    assert a["valid?"] == "unknown"
+    assert "not tensorizable" in a["cause"]
+
+
+def test_capacity_overflow_is_unknown_not_wrong():
+    # Tiny capacity on a branch-heavy history: must degrade to unknown (or
+    # still answer True via a surviving witness), never a wrong False.
+    hist = []
+    for p in range(6):
+        hist.append(h.op(h.INVOKE, p, "write", p))
+        hist.append(h.op(h.INFO, p, "write", p))
+    hist += [h.op(h.INVOKE, 10, "read", None), h.op(h.OK, 10, "read", 3)]
+    a = wgl.analysis(m.CASRegister(None), h.index(hist), capacity=2, rounds=1)
+    assert a["valid?"] in (True, "unknown")
+
+
+def test_differential_random_small():
+    rng = random.Random(45100)
+    disagreements = []
+    for trial in range(150):
+        hist = random_history(rng)
+        model = m.CASRegister(None)
+        truth = wgl_cpu.brute_analysis(model, hist)["valid?"]
+        got = wgl.analysis(model, hist, capacity=256)["valid?"]
+        # unknown is permitted (capacity), wrong verdicts are not
+        if got != "unknown" and got != truth:
+            disagreements.append((trial, got, truth, hist))
+    assert not disagreements, disagreements[:2]
+
+
+def test_differential_medium_valid_histories():
+    for seed in range(3):
+        hist = valid_register_history(200, 6, seed=seed, info_rate=0.1)
+        a = wgl.analysis(m.CASRegister(None), hist, capacity=512)
+        assert a["valid?"] is True, (seed, a)
+
+
+def test_differential_medium_corrupted():
+    agree = 0
+    for seed in range(3):
+        hist = corrupt(valid_register_history(200, 6, seed=seed, info_rate=0.1), seed=seed)
+        truth = wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"]
+        got = wgl.analysis(m.CASRegister(None), hist, capacity=512)["valid?"]
+        assert got in (truth, "unknown"), (seed, got, truth)
+        if got == truth:
+            agree += 1
+    assert agree >= 2  # kernel shouldn't be degrading to unknown routinely
+
+
+def test_competition_algorithm_falls_back():
+    chk = linearizable({"model": "fifo-queue", "algorithm": "competition"})
+    hist = h.index([
+        h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1),
+        h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", 1),
+    ])
+    assert chk.check({}, hist, {})["valid?"] is True
