@@ -1,0 +1,75 @@
+"""Headline benchmark: linearizability ops verified per second per chip.
+
+Workload (BASELINE.md config 4 shape — the reference's own scaling
+strategy): a batch of independent per-key CAS-register histories, as
+produced by ``independent/concurrent-generator`` keyspace sharding
+(reference: jepsen/src/jepsen/independent.clj:103-238).  The TPU path
+packs all histories to common shapes and sweeps them in one vmapped
+kernel; the baseline is the single-host knossos-equivalent DFS
+(jepsen_tpu.checker.wgl_cpu.dfs_analysis) over the same histories.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.checker import wgl_cpu  # noqa: E402
+from jepsen_tpu.parallel import batch_analysis  # noqa: E402
+
+N_HISTORIES = 256
+OPS_PER_HISTORY = 40
+PROCS = 4
+INFO_RATE = 0.1
+
+
+def main() -> None:
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N_HISTORIES):
+        hist = valid_register_history(OPS_PER_HISTORY, PROCS, seed=i, info_rate=INFO_RATE)
+        if i % 5 == 4:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    total_ops = sum(len(hh) for hh in hists) // 2  # invoke+completion pairs
+
+    # Warm-up (compile), then measure.
+    batch_analysis(model, hists[:8], capacity=(64, 512), cpu_fallback=False)
+    t0 = time.perf_counter()
+    tpu_results = batch_analysis(model, hists, capacity=(64, 512), cpu_fallback=False)
+    tpu_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    cpu_results = [wgl_cpu.dfs_analysis(model, hh) for hh in hists]
+    cpu_s = time.perf_counter() - t0
+
+    # Verdict agreement sanity (unknowns excluded — capacity-bounded).
+    for tr, cr in zip(tpu_results, cpu_results):
+        if tr["valid?"] != "unknown" and cr["valid?"] != "unknown":
+            assert tr["valid?"] == cr["valid?"], (tr, cr)
+
+    value = total_ops / tpu_s
+    baseline = total_ops / cpu_s
+    print(
+        json.dumps(
+            {
+                "metric": "linearizability ops verified/sec/chip (256-key CAS batch)",
+                "value": round(value, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,90 @@ def hash_rows(columns, seed: int):
     return h
 
 
+def frontier_update(state, fok, fcr, alive, cost, capacity: int, window: int = 16):
+    """One-pass frontier maintenance: dedup + domination + truncation.
+
+    Sorts candidate rows by (dead, class-hash(state,fok), cost); rows of the
+    same (state, fok) class land contiguously, cheapest (fewest-fired)
+    first (stable sort by original index).  A row is killed when any of its ``window`` sorted
+    predecessors has the same exact (state, fok) and pointwise ≤ fired-
+    crashed counts — this removes exact duplicates *and* dominated configs
+    in one windowed compare (domination: the cheaper config's futures are a
+    superset, see wgl_cpu; kills through killed intermediaries are sound by
+    transitivity).  Misses beyond the window only bloat the frontier; they
+    never produce wrong kills.
+
+    Returns (state', fok', fcr', alive', overflowed, fp):
+      overflowed — undominated survivors exceeded capacity, or the exact-
+                   domination buffer spilled (loss);
+      fp         — order-insensitive content fingerprint (3 uint32 lanes)
+                   of the surviving set.  Callers detect closure fixpoints
+                   as fp == previous round's fp; being order-insensitive it
+                   is immune to 'livelock' rounds where dominated
+                   representatives are regenerated and re-killed without
+                   the set actually changing.
+    """
+    n = state.shape[0]
+    w = fok.shape[1]
+    g = fcr.shape[1]
+    class_cols = [state] + [fok[:, k] for k in range(w)]
+    ch1 = hash_rows(class_cols, 0xB00B_135)
+    ch2 = hash_rows(class_cols, 0x1CEB_00DA)
+    dead = (~alive).astype(jnp.uint32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    _sd, _s1, _s2, _sc, sidx = jax.lax.sort(
+        (dead, ch1, ch2, cost.astype(jnp.uint32), iota), num_keys=4
+    )
+    st = state[sidx]
+    fo = fok[sidx]
+    fc = fcr[sidx]
+    al = alive[sidx]
+    pos = jnp.arange(n)
+    killed = jnp.zeros(n, bool)
+    for k in range(1, window + 1):
+        pst = jnp.roll(st, k)
+        pfo = jnp.roll(fo, k, axis=0)
+        pfc = jnp.roll(fc, k, axis=0)
+        pal = jnp.roll(al, k)
+        same = (pst == st) & (pfo == fo).all(-1) & pal & (pos >= k)
+        killed = killed | (same & (pfc <= fc).all(-1))
+    aliveD = al & ~killed
+    n_w = aliveD.sum()
+    # Stage 2: exact pairwise domination on a small buffer.  The windowed
+    # pass thins the big candidate table; the buffer pass makes the
+    # retained frontier exactly domination-free so bloat can't compound
+    # across rounds.
+    # The exact pass is quadratic; cap its buffer so huge capacities don't
+    # blow memory/compute.  Frontiers past the cap stay windowed-only
+    # (conservative lossy flag below).
+    b2 = min(2 * capacity, n, 4096)
+    sc2 = cost[sidx].astype(jnp.uint32)
+    _k1, _k2, fidx = jax.lax.sort(
+        ((~aliveD).astype(jnp.uint32), sc2, jnp.arange(n, dtype=jnp.int32)), num_keys=2
+    )
+    bsel = fidx[:b2]
+    bst, bfo, bfc = st[bsel], fo[bsel], fc[bsel]
+    bcost = sc2[bsel]
+    balive = jnp.arange(b2) < jnp.minimum(n_w, b2)
+    balive = dominate(bst, bfo, bfc, balive)
+    n_x = balive.sum()
+    # Final truncation to capacity.
+    _j1, _j2, ksel = jax.lax.sort(
+        ((~balive).astype(jnp.uint32), bcost, jnp.arange(b2, dtype=jnp.int32)),
+        num_keys=2,
+    )
+    keep = ksel[:capacity]
+    kst, kfo, kfc = bst[keep], bfo[keep], bfc[keep]
+    new_alive = jnp.arange(capacity) < jnp.minimum(n_x, capacity)
+    overflowed = (n_w > b2) | (n_x > capacity)
+    row_cols = [kst] + [kfo[:, k] for k in range(w)] + [kfc[:, k] for k in range(g)]
+    r1 = hash_rows(row_cols, 0xFEED_0001)
+    r2 = hash_rows(row_cols, 0xFEED_0002)
+    am = new_alive.astype(jnp.uint32)
+    fp = jnp.stack([(r1 * am).sum(), (r2 * am).sum(), am.sum()])
+    return kst, kfo, kfc, new_alive, overflowed, fp
+
+
 def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
     """Kill dominated frontier rows.
 
@@ -52,7 +136,8 @@ def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
     f = state.shape[0]
     g = fcr.shape[1]
     if chunk_rows <= 0:
-        chunk_rows = max(64, min(f, (1 << 22) // max(1, f * g // 64)))
+        # keep [f, chunk, g] intermediates under ~16M elements
+        chunk_rows = max(16, min(f, (1 << 24) // max(1, f * g)))
     parts = []
     for lo in range(0, f, chunk_rows):
         hi = min(f, lo + chunk_rows)

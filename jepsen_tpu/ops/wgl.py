@@ -49,7 +49,7 @@ from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.models import tensor as tmodels
-from jepsen_tpu.ops.hashing import compact, dominate, hash_rows
+from jepsen_tpu.ops.hashing import frontier_update
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -151,6 +151,7 @@ def pack(model: m.Model, history: Sequence[dict]):
         "W": W,
         "init_state": np.int32(tm.encode_state(model)),
         "step": tm.step,
+        "bar_active": np.ones(B, bool),
         "bar": (bar_f, bar_v1, bar_v2, bar_slot),
         "bar_opid": bar_opid,
         "mov": (mov_f, mov_v1, mov_v2, mov_open),
@@ -161,15 +162,78 @@ def pack(model: m.Model, history: Sequence[dict]):
     }
 
 
+def _bucket(x: int, choices) -> int:
+    for c in choices:
+        if c >= x:
+            return c
+    return x
+
+
+def pad_packed(packed: dict, B: int | None = None, P: int | None = None, G: int | None = None) -> dict:
+    """Pad the packed tables to bucketed shapes so the jitted kernel is
+    reused across histories instead of recompiling per (B, P, G) triple.
+    Padding barriers are inactive (skipped); padding slots/groups are never
+    open, so the kernel's behavior is unchanged.  Explicit targets override
+    the buckets (used to align a batch of histories on common shapes)."""
+    B0, P0, G0 = packed["B"], packed["P"], packed["G"]
+    B = B if B is not None else 1 << max(6, (B0 - 1).bit_length())
+    P = P if P is not None else _bucket(P0, [8, 16, 32, 64, 128])
+    G = G if G is not None else _bucket(G0, [4, 8, 16, 32, 64])
+    assert B >= B0 and P >= P0 and G >= G0
+    if (B, P, G) == (B0, P0, G0):
+        return packed
+    W = (P + 31) // 32
+    bar_f, bar_v1, bar_v2, bar_slot = packed["bar"]
+    mov_f, mov_v1, mov_v2, mov_open = packed["mov"]
+    grp_f, grp_v1, grp_v2 = packed["grp"]
+
+    def padB(a, fill=0):
+        out = np.full((B,) + a.shape[1:], fill, a.dtype)
+        out[:B0] = a
+        return out
+
+    def padBP(a):
+        out = np.zeros((B, P), a.dtype)
+        out[:B0, :P0] = a
+        return out
+
+    def padG(a):
+        out = np.zeros(G, a.dtype)
+        out[:G0] = a
+        return out
+
+    def padBG(a):
+        out = np.zeros((B, G), a.dtype)
+        out[:B0, :G0] = a
+        return out
+
+    slot_lane = np.arange(P, dtype=np.int32) // 32
+    slot_onehot = np.zeros((P, W), np.uint32)
+    for p in range(P):
+        slot_onehot[p, p // 32] = np.uint32(1) << np.uint32(p % 32)
+    out = dict(packed)
+    out.update(
+        B=B,
+        P=P,
+        G=G,
+        W=W,
+        bar_active=padB(packed["bar_active"], False),
+        bar=(padB(bar_f), padB(bar_v1), padB(bar_v2), padB(bar_slot)),
+        mov=(padBP(mov_f), padBP(mov_v1), padBP(mov_v2), padBP(mov_open)),
+        grp=(padG(grp_f), padG(grp_v1), padG(grp_v2)),
+        grp_open=padBG(packed["grp_open"]),
+        slot_lane=slot_lane,
+        slot_onehot=slot_onehot,
+    )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Device kernel
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W")
-)
-def _run(
+def _run_core(
     step,
     F: int,
     R: int,
@@ -177,6 +241,7 @@ def _run(
     G: int,
     W: int,
     init_state,
+    bar_active,
     bar_f,
     bar_v1,
     bar_v2,
@@ -225,44 +290,11 @@ def _run(
             jax.lax.population_count(cat_fok).sum(axis=1).astype(I32)
             + cat_fcr.sum(axis=1)
         )
-        # Compact into a 4F buffer first: domination (below) can only kill
-        # rows in favour of strictly-cheaper rows, which sort first, so a
-        # buffer of a few times the capacity lets dominated overflow be
-        # discarded without counting as loss.
-        F2 = min(4 * F, F * (1 + P + G))
-        sel, buf_alive, n_uniq, _ovf = compact(
-            [cat_state, cat_fok, cat_fcr], cat_alive, cost, F2
+        state2, fok2, fcr2, alive2, ovf, fp2 = frontier_update(
+            cat_state, cat_fok, cat_fcr, cat_alive, cost, F
         )
-        bstate = cat_state[sel]
-        bfok = cat_fok[sel]
-        bfcr = cat_fcr[sel]
-        # Exact domination pruning keeps the closure finite: without it,
-        # gratuitous crashed-op fires grow the reachable set for
-        # sum(open-counts) rounds instead of the length of the longest
-        # *minimal* enabling chain.
-        balive = dominate(bstate, bfok, bfcr, buf_alive)
-        n_undom = balive.sum()
-        bcost = (
-            jax.lax.population_count(bfok).sum(axis=1).astype(I32) + bfcr.sum(axis=1)
-        )
-        _d, _c, tsel = jax.lax.sort(
-            ((~balive).astype(U32), bcost.astype(U32), jnp.arange(F2, dtype=I32)),
-            num_keys=2,
-        )
-        keep = tsel[:F]
-        state2 = bstate[keep]
-        fok2 = bfok[keep]
-        fcr2 = bfcr[keep]
-        alive2 = jnp.arange(F) < jnp.minimum(n_undom, F)
-        ovf = (n_uniq > F2) | (n_undom > F)
-        # Fixpoint detection by frontier fingerprint (hash-sum of alive
-        # rows): stable fingerprint => closure converged.
-        f1 = hash_rows([state2] + [fok2[:, k] for k in range(W)] + [fcr2[:, k] for k in range(G)], 0xA5A5_0001)
-        f2 = hash_rows([state2] + [fok2[:, k] for k in range(W)] + [fcr2[:, k] for k in range(G)], 0x5A5A_0002)
-        am = alive2.astype(U32)
-        fp2_ = jnp.stack([(f1 * am).sum(), (f2 * am).sum(), am.sum().astype(U32)])
-        changed2 = ~(fp2_ == fp).all()
-        return (state2, fok2, fcr2, alive2, r + 1, changed2, lossy | ovf, fp2_, xs)
+        changed2 = ~(fp2 == fp).all()
+        return (state2, fok2, fcr2, alive2, r + 1, changed2, lossy | ovf, fp2, xs)
 
     def round_cond(val):
         _s, _fo, _fc, _a, r, changed, _l, _fp, _xs = val
@@ -270,12 +302,12 @@ def _run(
 
     def barrier(carry, xs):
         state, fok, fcr, alive, failed_at, lossy, peak = carry
-        b_idx, xbar_f, xbar_v1, xbar_v2, xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
-        done = failed_at >= 0
+        b_idx, active, xbar_f, xbar_v1, xbar_v2, xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+        done = (failed_at >= 0) | ~active
 
         def process(_):
             xs_inner = (xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open)
-            fp0 = jnp.zeros(3, U32)
+            fp0 = jnp.full(3, jnp.uint32(0xFFFFFFFF))
             s2, fo2, fc2, a2, _r, changed, lossy2, _fp, _ = jax.lax.while_loop(
                 round_cond,
                 expand_round,
@@ -291,7 +323,7 @@ def _run(
             clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
             fo3 = fo2 & ~clear[None, :]
             dead = ~a3.any()
-            failed2 = jnp.where(dead, b_idx, jnp.int32(-1))
+            failed2 = jnp.where(dead, b_idx, failed_at)
             peak2 = jnp.maximum(peak, a3.sum())
             return (s2, fo3, fc2, a3, failed2, lossy3, peak2)
 
@@ -308,6 +340,7 @@ def _run(
     carry0 = (state0, fok0, fcr0, alive0, jnp.int32(-1), jnp.bool_(False), jnp.int32(1))
     xs = (
         jnp.arange(bar_f.shape[0], dtype=I32),
+        bar_active,
         bar_f,
         bar_v1,
         bar_v2,
@@ -322,6 +355,27 @@ def _run(
     return alive.any(), failed_at, lossy, peak
 
 
+_run = functools.partial(jax.jit, static_argnames=("step", "F", "R", "P", "G", "W"))(
+    _run_core
+)
+
+#: (step, F, R, P, G, W) -> jitted vmapped runner over a leading batch axis.
+_BATCH_RUNNERS: dict = {}
+
+
+def batched_runner(step, F: int, R: int, P: int, G: int, W: int):
+    """A jit(vmap(_run_core)) specialised to the given static shapes: checks
+    a stack of same-shape packed histories in one device program (BASELINE
+    config 4: hundreds of recorded histories vmapped across a slice).
+    slot tables are shape-derived and shared; everything else is batched."""
+    key = (step, F, R, P, G, W)
+    if key not in _BATCH_RUNNERS:
+        core = functools.partial(_run_core, step, F, R, P, G, W)
+        axes = (0,) * 14 + (None, None)
+        _BATCH_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
+    return _BATCH_RUNNERS[key]
+
+
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
@@ -330,7 +384,7 @@ def _run(
 def analysis(
     model: m.Model,
     history: Sequence[dict],
-    capacity: int = 1024,
+    capacity: int | Sequence[int] = (128, 1024, 4096),
     rounds: int = 8,
     max_groups: int = 64,
     max_procs: int = 128,
@@ -340,6 +394,12 @@ def analysis(
     Knossos-shaped result: ``{"valid?": True|False|"unknown", ...}`` plus
     kernel stats under ``"kernel"``.  True is always exact; False is exact
     unless the frontier overflowed (then "unknown").
+
+    ``capacity`` may be a sequence: iterative widening — each capacity runs
+    until an *exact* verdict; "unknown" (lossy) results escalate to the
+    next capacity.  Easy histories stay on the small, fast frontier;
+    branch-heavy ones pay for what they need (knossos-style competition,
+    but against frontier sizes instead of algorithms).
     """
     try:
         packed = pack(model, history)
@@ -351,7 +411,18 @@ def analysis(
         return {"valid?": "unknown", "cause": f"{packed['G']} crashed-op groups exceeds {max_groups}"}
     if packed["P"] > max_procs:
         return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
+    packed = pad_packed(packed)
 
+    capacities = [capacity] if isinstance(capacity, int) else list(capacity)
+    result = None
+    for cap in capacities:
+        result = _analyze_at(model, history, packed, int(cap), rounds)
+        if result["valid?"] != "unknown":
+            return result
+    return result
+
+
+def _analyze_at(model, history, packed, capacity: int, rounds: int) -> dict:
     valid, failed_at, lossy, peak = _run(
         packed["step"],
         int(capacity),
@@ -360,6 +431,7 @@ def analysis(
         packed["G"],
         packed["W"],
         packed["init_state"],
+        packed["bar_active"],
         *packed["bar"],
         *packed["mov"],
         *packed["grp"],

@@ -1,0 +1,19 @@
+"""Device-mesh parallelism for the checker phase.
+
+The reference has no NCCL/MPI analogue — its scaling axes are worker
+concurrency and keyspace sharding (SURVEY.md §2.5).  In the rebuild those
+become jax.sharding axes:
+
+  * ``histories`` (data parallel): independent per-key histories — the
+    reference's ``independent/concurrent-generator`` keyspace shards
+    (independent.clj:103-238) — are packed to common shapes, stacked, and
+    checked by one vmapped kernel sharded across the mesh (BASELINE
+    config 4: 1024 recorded histories across a v5e-8 slice).
+
+Collectives ride ICI via XLA's partitioner; there is nothing NCCL-like to
+port (SURVEY.md §5 'distributed communication backend').
+"""
+
+from jepsen_tpu.parallel.batch import batch_analysis, make_mesh
+
+__all__ = ["batch_analysis", "make_mesh"]

@@ -1,0 +1,149 @@
+"""Batched, mesh-sharded linearizability checking.
+
+The reference keeps per-key linearizability tractable by splitting the
+workload into many small independent histories
+(jepsen/src/jepsen/independent.clj:2-7, 103-238) and pmapping checkers over
+them (independent.clj:285-307, checker.clj:95-97).  Here that becomes the
+TPU's favourite shape: pack every history to common (B, P, G) buckets,
+stack, and run ONE vmapped kernel over the batch, sharded across the mesh
+on a ``histories`` axis.  Throughput scales with chips; each chip sweeps
+its shard's frontiers in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.ops import wgl
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
+    """A 1-D device mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _stack(packs: list[dict], B: int, P: int, G: int) -> dict:
+    padded = [wgl.pad_packed(p, B=B, P=P, G=G) for p in packs]
+    out = {}
+    out["init_state"] = np.stack([p["init_state"] for p in padded])
+    out["bar_active"] = np.stack([p["bar_active"] for p in padded])
+    for i, name in enumerate(["bar_f", "bar_v1", "bar_v2", "bar_slot"]):
+        out[name] = np.stack([p["bar"][i] for p in padded])
+    for i, name in enumerate(["mov_f", "mov_v1", "mov_v2", "mov_open"]):
+        out[name] = np.stack([p["mov"][i] for p in padded])
+    for i, name in enumerate(["grp_f", "grp_v1", "grp_v2"]):
+        out[name] = np.stack([p["grp"][i] for p in padded])
+    out["grp_open"] = np.stack([p["grp_open"] for p in padded])
+    out["slot_lane"] = padded[0]["slot_lane"]
+    out["slot_onehot"] = padded[0]["slot_onehot"]
+    return out
+
+
+_ARG_ORDER = [
+    "init_state", "bar_active", "bar_f", "bar_v1", "bar_v2", "bar_slot",
+    "mov_f", "mov_v1", "mov_v2", "mov_open",
+    "grp_f", "grp_v1", "grp_v2", "grp_open",
+    "slot_lane", "slot_onehot",
+]
+
+
+def batch_analysis(
+    model: m.Model,
+    histories: Sequence[Sequence[dict]],
+    capacity: int | Sequence[int] = (64, 512),
+    rounds: int = 8,
+    mesh: Mesh | None = None,
+    cpu_fallback: bool = True,
+) -> list[dict]:
+    """Check many histories against one model in batched kernel launches.
+
+    Histories that can't be tensorized (or stay "unknown" after the last
+    capacity) fall back to the CPU oracle when ``cpu_fallback``.  Returns
+    one knossos-shaped result per history, in order.
+    """
+    results: list[dict | None] = [None] * len(histories)
+    packs: list[dict] = []
+    idxs: list[int] = []
+    for i, hist in enumerate(histories):
+        try:
+            p = wgl.pack(model, hist)
+        except wgl.NotTensorizable as e:
+            results[i] = {"valid?": "unknown", "cause": f"not tensorizable: {e}"}
+            continue
+        if p["B"] == 0:
+            results[i] = {"valid?": True}
+        else:
+            packs.append(p)
+            idxs.append(i)
+
+    capacities = [capacity] if isinstance(capacity, int) else list(capacity)
+    pending = list(range(len(packs)))
+    while pending and capacities:
+        cap = int(capacities.pop(0))
+        sub = [packs[k] for k in pending]
+        B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
+        P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
+        G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
+        stacked = _stack(sub, B, P, G)
+        n = len(sub)
+        n_pad = n
+        if mesh is not None:
+            shard = mesh.devices.size
+            n_pad = ((n + shard - 1) // shard) * shard
+        if n_pad != n:
+            for k in stacked:
+                if k in ("slot_lane", "slot_onehot"):
+                    continue
+                reps = np.concatenate(
+                    [stacked[k]] + [stacked[k][-1:]] * (n_pad - n), axis=0
+                )
+                stacked[k] = reps
+        args = [stacked[k] for k in _ARG_ORDER]
+        if mesh is not None:
+            axis = mesh.axis_names[0]
+            spec = NamedSharding(mesh, PartitionSpec(axis))
+            rep = NamedSharding(mesh, PartitionSpec())
+            args = [
+                jax.device_put(a, rep if k in ("slot_lane", "slot_onehot") else spec)
+                for k, a in zip(_ARG_ORDER, args)
+            ]
+        runner = wgl.batched_runner(sub[0]["step"], cap, int(rounds), P, G, (P + 31) // 32)
+        valid, failed_at, lossy, peak = runner(*args)
+        valid = np.asarray(valid)[:n]
+        failed_at = np.asarray(failed_at)[:n]
+        lossy = np.asarray(lossy)[:n]
+        peak = np.asarray(peak)[:n]
+        still = []
+        for j, k in enumerate(pending):
+            i = idxs[k]
+            stats = {"frontier-peak": int(peak[j]), "capacity": cap, "lossy?": bool(lossy[j])}
+            if failed_at[j] < 0 and valid[j]:
+                results[i] = {"valid?": True, "kernel": stats}
+            elif failed_at[j] >= 0 and not lossy[j]:
+                op = histories[i][int(packs[k]["bar_opid"][int(failed_at[j])])]
+                results[i] = {"valid?": False, "op": op, "kernel": stats}
+            else:
+                still.append(k)
+                results[i] = {
+                    "valid?": "unknown",
+                    "cause": "frontier capacity or closure rounds exhausted",
+                    "kernel": stats,
+                }
+        pending = still
+
+    if cpu_fallback:
+        for i, r in enumerate(results):
+            if r is not None and r["valid?"] == "unknown":
+                results[i] = wgl_cpu.dfs_analysis(model, histories[i])
+    return [r if r is not None else {"valid?": "unknown"} for r in results]

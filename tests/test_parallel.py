@@ -1,0 +1,60 @@
+"""Batched + mesh-sharded checking tests, on the virtual 8-device CPU mesh
+(the way the driver's dryrun validates multi-chip compilation)."""
+
+import pathlib
+import random
+import sys
+
+import jax
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.parallel import batch_analysis, make_mesh
+
+
+def histories_mixed(n=12):
+    hists, expect = [], []
+    for i in range(n):
+        hist = valid_register_history(30, 3, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+            expect.append(wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"])
+        else:
+            expect.append(True)
+        hists.append(hist)
+    return hists, expect
+
+
+def test_batch_analysis_no_mesh():
+    hists, expect = histories_mixed(9)
+    results = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    assert [r["valid?"] for r in results] == expect
+
+
+def test_batch_analysis_sharded_mesh():
+    assert len(jax.devices()) == 8, "conftest should provide 8 virtual devices"
+    mesh = make_mesh()
+    hists, expect = histories_mixed(12)
+    results = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256), mesh=mesh)
+    assert [r["valid?"] for r in results] == expect
+
+
+def test_batch_handles_trivial_and_untensorizable():
+    from jepsen_tpu import history as h
+
+    hists = [
+        [],  # no barriers -> trivially valid
+        [h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1)],
+    ]
+    results = batch_analysis(m.CASRegister(None), hists)
+    assert results[0]["valid?"] is True
+    assert results[1]["valid?"] is True
+    fifo = batch_analysis(
+        m.FIFOQueue(),
+        [[h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1)]],
+        cpu_fallback=True,
+    )
+    assert fifo[0]["valid?"] is True  # fell back to CPU oracle
