@@ -1,0 +1,294 @@
+"""Elle-equivalent transactional consistency checkers.
+
+The reference delegates transactional anomaly detection to the external
+`elle 0.1.3` library through thin adapters (jepsen/src/jepsen/tests/cycle/
+append.clj, wr.clj).  This module is the native rebuild: dependency-graph
+inference happens host-side (jepsen_tpu.checker.txn_graph), cycle detection
+runs as batched boolean matrix powering on the TPU MXU
+(jepsen_tpu.ops.closure), and witness cycles for explanations are recovered
+by BFS over the device-computed closure.
+
+Result shape follows elle's: ``{"valid?": bool, "anomaly-types": [...],
+"anomalies": {type: [explanation, ...]}, "not": [models ruled out],
+"also-not": [stronger models implied ruled out]}``.  The anomaly vocabulary
+is the reference's documented set (tests/cycle/wr.clj:30-46): G0, G1a, G1b,
+G1c, G-single, G2, internal — plus list-append's duplicate-elements and
+incompatible-order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.checker import txn_graph as tg
+from jepsen_tpu.ops import closure as cl
+
+# ---------------------------------------------------------------------------
+# Consistency-model hierarchy (simplified from elle.consistency-model)
+# ---------------------------------------------------------------------------
+
+#: anomaly → weakest consistency models it rules out.
+ANOMALY_RULES_OUT = {
+    "G0": ["read-uncommitted"],
+    "duplicate-elements": ["read-uncommitted"],
+    "duplicate-writes": ["read-uncommitted"],
+    "incompatible-order": ["read-uncommitted"],
+    "G1a": ["read-committed"],
+    "G1b": ["read-committed"],
+    "G1c": ["read-committed"],
+    "internal": ["read-atomic"],
+    "G-single": ["snapshot-isolation"],
+    "G2": ["serializable"],
+}
+
+#: model → strictly stronger models (transitively closed) — ruling out a
+#: model also rules these out.
+STRONGER_MODELS = {
+    "read-uncommitted": [
+        "read-committed",
+        "read-atomic",
+        "snapshot-isolation",
+        "serializable",
+        "strict-serializable",
+    ],
+    "read-committed": ["snapshot-isolation", "serializable", "strict-serializable"],
+    "read-atomic": ["snapshot-isolation", "serializable", "strict-serializable"],
+    "snapshot-isolation": ["serializable", "strict-serializable"],
+    "serializable": ["strict-serializable"],
+    "strict-serializable": [],
+}
+
+#: Which anomalies each requested headline anomaly expands to
+#: (tests/cycle/wr.clj:43-46: "G2 implies G-single and G1c; G1 implies G1a,
+#: G1b, and G1c; G1c implies G0").
+ANOMALY_EXPANSION = {
+    "G2": ["G2", "G-single", "G1c", "G0"],
+    "G-single": ["G-single", "G1c", "G0"],
+    "G1": ["G1a", "G1b", "G1c", "G0"],
+    "G1c": ["G1c", "G0"],
+}
+
+
+def expand_anomalies(requested: Sequence[str]) -> set[str]:
+    out: set[str] = set()
+    for a in requested:
+        out.update(ANOMALY_EXPANSION.get(a, [a]))
+    return out
+
+
+def models_ruled_out(anomaly_types: Sequence[str]) -> tuple[list, list]:
+    """(not, also-not): weakest models ruled out, and the stronger models
+    those imply are ruled out too."""
+    out: set[str] = set()
+    for a in anomaly_types:
+        out.update(ANOMALY_RULES_OUT.get(a, []))
+    # Keep only the weakest: drop any model implied by another in the set.
+    implied: set[str] = set()
+    for m in out:
+        implied.update(STRONGER_MODELS[m])
+    weakest = sorted(out - implied)
+    also = sorted((implied | out) - set(weakest))
+    return weakest, also
+
+
+# ---------------------------------------------------------------------------
+# Witness-cycle recovery (host-side, from the device-computed closure)
+# ---------------------------------------------------------------------------
+
+
+def _shortest_path(adj: np.ndarray, src: int, dst: int) -> list[int] | None:
+    """BFS shortest path src→dst over a bool adjacency matrix."""
+    n = adj.shape[0]
+    if src == dst:
+        return [src]
+    prev = np.full(n, -1, dtype=np.int64)
+    frontier = [src]
+    seen = {src}
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in np.flatnonzero(adj[u]):
+                v = int(v)
+                if v not in seen:
+                    seen.add(v)
+                    prev[v] = u
+                    if v == dst:
+                        path = [dst]
+                        while path[-1] != src:
+                            path.append(int(prev[path[-1]]))
+                        return path[::-1]
+                    nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def _find_cycle_through_edge(
+    graph_adj: np.ndarray, a: int, b: int
+) -> list[int] | None:
+    """A cycle using edge a→b: b→a path + the edge."""
+    back = _shortest_path(graph_adj, b, a)
+    if back is None:
+        return None
+    return [a] + back
+
+
+def _edge_type(g: tg.TxnGraph, i: int, j: int) -> str:
+    if g.ww[i, j]:
+        return "ww"
+    if g.wr[i, j]:
+        return "wr"
+    if g.rw[i, j]:
+        return "rw"
+    return "rt"
+
+
+def _explain_cycle(g: tg.TxnGraph, cycle: list[int]) -> dict:
+    """Render a node cycle into an elle-style explanation."""
+    steps = []
+    for i, j in zip(cycle, cycle[1:] + [cycle[0]]):
+        et = _edge_type(g, i, j)
+        steps.append(
+            {
+                "type": et,
+                "from": g.nodes[i].op,
+                "to": g.nodes[j].op,
+                "explanation": g.explanations.get((et, i, j), et),
+            }
+        )
+    return {"cycle": [g.nodes[i].op for i in cycle], "steps": steps}
+
+
+def _first_diag_cycle(adj_parts: np.ndarray, closure: np.ndarray) -> list[int] | None:
+    """A cycle witnessing a nonzero closure diagonal."""
+    diag = np.flatnonzero(np.diag(closure))
+    if len(diag) == 0:
+        return None
+    v = int(diag[0])
+    # Find a successor u of v with a path back to v.
+    for u in np.flatnonzero(adj_parts[v]):
+        c = _find_cycle_through_edge(adj_parts, v, int(u))
+        if c is not None:
+            return c
+    return [v]
+
+
+def _witness_for_edge_type(
+    edge_adj: np.ndarray, graph_adj: np.ndarray, closure: np.ndarray
+) -> list[int] | None:
+    """A cycle through some edge (a, b) of ``edge_adj`` with a return path in
+    ``graph_adj`` (whose closure is given)."""
+    cand = np.argwhere(edge_adj & closure.T)
+    if len(cand) == 0:
+        return None
+    a, b = int(cand[0][0]), int(cand[0][1])
+    return _find_cycle_through_edge(graph_adj, a, b)
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+# ---------------------------------------------------------------------------
+
+
+def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
+    """Classify cycles + merge inference anomalies into an elle-style
+    result."""
+    wanted = expand_anomalies(requested)
+    anomalies: dict[str, list] = {k: v for k, v in g.anomalies.items() if k in wanted}
+
+    if g.n:
+        flags, closures = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
+        any_adj = g.ww | g.wr | g.extra
+        full_adj = any_adj | g.rw
+        if flags["G0"] and "G0" in wanted:
+            cyc = _first_diag_cycle(g.ww | g.extra, closures["ww"])
+            if cyc:
+                anomalies.setdefault("G0", []).append(_explain_cycle(g, cyc))
+        if flags["G1c"] and "G1c" in wanted:
+            cyc = _witness_for_edge_type(g.wr, any_adj, closures["wwr"])
+            if cyc:
+                anomalies.setdefault("G1c", []).append(_explain_cycle(g, cyc))
+        if flags["G-single"] and "G-single" in wanted:
+            cyc = _witness_for_edge_type(g.rw, any_adj, closures["wwr"])
+            if cyc:
+                anomalies.setdefault("G-single", []).append(_explain_cycle(g, cyc))
+        if flags["G2"] and not flags["G-single"] and "G2" in wanted:
+            cyc = _witness_for_edge_type(g.rw, full_adj, closures["all"])
+            if cyc:
+                anomalies.setdefault("G2", []).append(_explain_cycle(g, cyc))
+
+    types = sorted(anomalies)
+    not_, also_not = models_ruled_out(types)
+    out: dict[str, Any] = {"valid?": not anomalies}
+    if anomalies:
+        out.update(
+            {
+                "anomaly-types": types,
+                "anomalies": anomalies,
+                "not": not_,
+                "also-not": also_not,
+            }
+        )
+    return out
+
+
+DEFAULT_ANOMALIES = ["G2", "G1a", "G1b", "internal"]  # tests/cycle/wr.clj:46
+
+
+class ListAppendChecker(Checker):
+    """Native elle.list-append equivalent (tests/cycle/append.clj:11-22).
+
+    Options:
+      anomalies          headline anomalies to report (default catches all)
+      additional_graphs  iterable of "realtime" / "process"
+    """
+
+    def __init__(
+        self,
+        anomalies: Sequence[str] = DEFAULT_ANOMALIES,
+        additional_graphs: Sequence[str] = (),
+    ):
+        self.anomalies = list(anomalies) + [
+            "duplicate-elements",
+            "incompatible-order",
+        ]
+        self.additional_graphs = tuple(additional_graphs)
+
+    def check(self, test, history, opts):
+        g = tg.list_append_graph(history, self.additional_graphs)
+        return check_graph(g, self.anomalies)
+
+
+class WRRegisterChecker(Checker):
+    """Native elle.rw-register equivalent (tests/cycle/wr.clj:15-46)."""
+
+    def __init__(
+        self,
+        anomalies: Sequence[str] = DEFAULT_ANOMALIES,
+        additional_graphs: Sequence[str] = (),
+        sequential_keys: bool = False,
+        linearizable_keys: bool = False,
+    ):
+        self.anomalies = list(anomalies) + ["duplicate-writes"]
+        self.additional_graphs = tuple(additional_graphs)
+        self.sequential_keys = sequential_keys
+        self.linearizable_keys = linearizable_keys
+
+    def check(self, test, history, opts):
+        g = tg.rw_register_graph(
+            history,
+            self.additional_graphs,
+            sequential_keys=self.sequential_keys,
+            linearizable_keys=self.linearizable_keys,
+        )
+        return check_graph(g, self.anomalies)
+
+
+def list_append(**kw) -> Checker:
+    return ListAppendChecker(**kw)
+
+
+def wr_register(**kw) -> Checker:
+    return WRRegisterChecker(**kw)

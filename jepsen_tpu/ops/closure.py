@@ -1,0 +1,165 @@
+"""TPU cycle-detection kernels for transactional dependency graphs.
+
+Where Elle uses Tarjan's SCC + BFS cycle search on the JVM (elle 0.1.3, the
+reference's dep at jepsen/project.clj:13), this rebuild detects cycles with
+dense boolean matrix powering on the MXU: the transitive closure of an
+[n, n] adjacency matrix is ``ceil(log2 n)`` squarings ``R ← R ∨ R·R``, each
+a single bf16 matmul — exactly the shape the systolic array wants.  Graphs
+are padded to multiples of 128 (MXU tile) and batch via ``vmap`` so
+thousands of per-key subhistory graphs check in one launch.
+
+Anomaly classification follows Adya's vocabulary (surfaced by the reference
+at tests/cycle/wr.clj:30-46):
+
+  G0        cycle of ww edges only
+  G1c       cycle of ww+wr edges with ≥1 wr
+  G-single  cycle with exactly one rw edge (rest ww/wr)
+  G2        cycle with ≥1 rw edge (≥2 when G-single is absent)
+
+Cycle *existence* is decided on-device; witness cycles for human-readable
+explanations are recovered host-side (jepsen_tpu.checker.elle) by BFS over
+the closure, which is cheap once the flagged edge is known.
+
+``extra`` edges (realtime/process session graphs) are dependency-neutral:
+they may participate in any cycle but never count as the ww/wr/rw evidence.
+Both the realtime and process graphs are acyclic by construction, so a
+cycle in ``ww ∨ extra`` still implies a ww edge is involved.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MXU_TILE = 128
+
+
+def _pad_to(n: int, tile: int = MXU_TILE) -> int:
+    return max(tile, ((n + tile - 1) // tile) * tile)
+
+
+def _n_steps(n: int) -> int:
+    # After k squarings R covers paths of length up to 2^k; need 2^k >= n.
+    return max(1, int(np.ceil(np.log2(max(2, n)))))
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def transitive_closure(adj: jax.Array, steps: int) -> jax.Array:
+    """Closure of a 0/1 float adjacency matrix by repeated squaring.
+
+    ``adj`` is [n, n] float32 (1.0 = edge).  Matmuls run in bf16 on the MXU;
+    only sign information is needed, so bf16 accumulation inaccuracy is
+    harmless (sums of non-negative terms never round to zero).
+    """
+
+    def body(_, r):
+        sq = jnp.dot(
+            r.astype(jnp.bfloat16),
+            r.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.maximum(r, (sq > 0).astype(jnp.float32))
+
+    return lax.fori_loop(0, steps, body, adj)
+
+
+class CycleFlags(NamedTuple):
+    """Device-side anomaly verdicts + the closures needed for witnesses."""
+
+    g0: jax.Array  # bool scalar
+    g1c: jax.Array
+    g_single: jax.Array
+    g2: jax.Array  # ≥1 rw in some cycle (g_single implies a weak g2)
+    closure_ww: jax.Array  # closure(ww|extra)
+    closure_wwr: jax.Array  # closure(ww|wr|extra)
+    closure_all: jax.Array  # closure(ww|wr|rw|extra)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def classify_cycles(
+    ww: jax.Array, wr: jax.Array, rw: jax.Array, extra: jax.Array, steps: int
+) -> CycleFlags:
+    """Compute Adya cycle-anomaly flags for one dependency graph.
+
+    Inputs are [n, n] float32 0/1 matrices.  The edge-presence tests use the
+    pattern "∃ edge (a, b) of type T with a return path b→a in graph G" —
+    computed as ``(T ∧ closureᵀ(G)).any()`` without leaving the device.
+    """
+    c_ww = transitive_closure(jnp.maximum(ww, extra), steps)
+    c_wwr = transitive_closure(jnp.maximum(c_ww, wr), steps)  # warm-start
+    c_all = transitive_closure(jnp.maximum(c_wwr, rw), steps)
+
+    g0 = jnp.trace(c_ww) > 0
+    g1c = jnp.any((wr > 0) & (c_wwr.T > 0))
+    g_single = jnp.any((rw > 0) & (c_wwr.T > 0))
+    g2 = jnp.any((rw > 0) & (c_all.T > 0))
+    return CycleFlags(g0, g1c, g_single, g2, c_ww, c_wwr, c_all)
+
+
+# vmapped batch form: [b, n, n] inputs, shared step count.
+classify_cycles_batch = jax.jit(
+    jax.vmap(classify_cycles, in_axes=(0, 0, 0, 0, None)),
+    static_argnames=("steps",),
+)
+
+
+def pad_adj(m: np.ndarray, size: int) -> np.ndarray:
+    """Zero-pad a bool adjacency to [size, size] float32."""
+    out = np.zeros((size, size), dtype=np.float32)
+    n = m.shape[0]
+    out[:n, :n] = m.astype(np.float32)
+    return out
+
+
+def classify_graph(ww: np.ndarray, wr: np.ndarray, rw: np.ndarray, extra: np.ndarray):
+    """Host convenience wrapper: pad → device classify → numpy results.
+
+    Returns (flags dict, closures dict) with numpy arrays trimmed back to n.
+    """
+    n = ww.shape[0]
+    if n == 0:
+        z = np.zeros((0, 0), dtype=bool)
+        return (
+            {"G0": False, "G1c": False, "G-single": False, "G2": False},
+            {"ww": z, "wwr": z, "all": z},
+        )
+    size = _pad_to(n)
+    steps = _n_steps(n)
+    res = classify_cycles(
+        jnp.asarray(pad_adj(ww, size)),
+        jnp.asarray(pad_adj(wr, size)),
+        jnp.asarray(pad_adj(rw, size)),
+        jnp.asarray(pad_adj(extra, size)),
+        steps,
+    )
+    flags = {
+        "G0": bool(res.g0),
+        "G1c": bool(res.g1c),
+        "G-single": bool(res.g_single),
+        "G2": bool(res.g2),
+    }
+    closures = {
+        "ww": np.asarray(res.closure_ww)[:n, :n] > 0,
+        "wwr": np.asarray(res.closure_wwr)[:n, :n] > 0,
+        "all": np.asarray(res.closure_all)[:n, :n] > 0,
+    }
+    return flags, closures
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle (differential-test reference, mirrors SURVEY.md §4 pattern 1)
+# ---------------------------------------------------------------------------
+
+
+def transitive_closure_np(adj: np.ndarray) -> np.ndarray:
+    """Pure-numpy Warshall closure — the slow-but-obvious oracle."""
+    r = adj.copy().astype(bool)
+    n = r.shape[0]
+    for k in range(n):
+        r |= np.outer(r[:, k], r[k, :])
+    return r

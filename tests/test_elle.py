@@ -1,0 +1,304 @@
+"""Golden tests for the Elle-equivalent transactional checkers.
+
+Hand-written literal histories per anomaly type, following the reference's
+test strategy of feeding literal op vectors straight into check
+(SURVEY.md §4 pattern 1; anomaly vocabulary from tests/cycle/wr.clj:30-46).
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import elle
+from jepsen_tpu.checker import txn_graph as tg
+from jepsen_tpu.ops import closure as cl
+
+
+def txn_hist(*txns):
+    """Build a history of ok txns: each arg is (process, value) or
+    (process, value, type)."""
+    hist = []
+    for item in txns:
+        p, value = item[0], item[1]
+        typ = item[2] if len(item) > 2 else "ok"
+        invoke_value = [[f, k, None if f == "r" else v] for f, k, v in value]
+        hist.append({"type": "invoke", "process": p, "f": "txn", "value": invoke_value})
+        hist.append({"type": typ, "process": p, "f": "txn", "value": value})
+    for i, op in enumerate(hist):
+        op["index"] = i
+        op["time"] = i
+    return hist
+
+
+CHECK = elle.list_append()
+
+
+def check_append(*txns):
+    return CHECK.check({}, txn_hist(*txns), {})
+
+
+class TestListAppend:
+    def test_valid_empty(self):
+        assert check_append()["valid?"] is True
+
+    def test_valid_simple(self):
+        r = check_append(
+            (0, [["append", "x", 1]]),
+            (1, [["r", "x", [1]], ["append", "x", 2]]),
+            (0, [["r", "x", [1, 2]]]),
+        )
+        assert r["valid?"] is True
+
+    def test_g0_write_cycle(self):
+        r = check_append(
+            (0, [["append", "x", 1], ["append", "y", 1]]),
+            (1, [["append", "x", 2], ["append", "y", 2]]),
+            (2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G0" in r["anomaly-types"]
+        assert "read-uncommitted" in r["not"]
+
+    def test_g1a_aborted_read(self):
+        r = check_append(
+            (0, [["append", "x", 1]], "fail"),
+            (1, [["r", "x", [1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G1a" in r["anomaly-types"]
+        assert "read-committed" in r["not"]
+
+    def test_g1b_intermediate_read(self):
+        r = check_append(
+            (0, [["append", "x", 1], ["append", "x", 2]]),
+            (1, [["r", "x", [1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G1b" in r["anomaly-types"]
+
+    def test_g1c_wr_cycle(self):
+        r = check_append(
+            (0, [["append", "x", 1], ["r", "y", [2]]]),
+            (1, [["append", "y", 2], ["r", "x", [1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G1c" in r["anomaly-types"]
+
+    def test_g_single(self):
+        r = check_append(
+            (0, [["r", "x", []], ["r", "y", [2]]]),
+            (1, [["append", "x", 1], ["append", "y", 2]]),
+            (2, [["r", "x", [1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G-single" in r["anomaly-types"]
+        assert "snapshot-isolation" in r["not"]
+
+    def test_g2_write_skew(self):
+        r = check_append(
+            (0, [["r", "x", []], ["append", "y", 1]]),
+            (1, [["r", "y", []], ["append", "x", 1]]),
+            (2, [["r", "x", [1]], ["r", "y", [1]]]),
+        )
+        assert r["valid?"] is False
+        assert "G2" in r["anomaly-types"]
+        assert "G-single" not in r["anomaly-types"]
+        assert "serializable" in r["not"]
+
+    def test_internal(self):
+        r = check_append(
+            (0, [["r", "x", [1]], ["append", "x", 2], ["r", "x", [1, 3]]]),
+            (1, [["append", "x", 1]]),
+            (2, [["append", "x", 3]]),
+        )
+        assert r["valid?"] is False
+        assert "internal" in r["anomaly-types"]
+
+    def test_internal_ok(self):
+        r = check_append(
+            (0, [["r", "x", [1]], ["append", "x", 2], ["r", "x", [1, 2]]]),
+            (1, [["append", "x", 1]]),
+        )
+        assert r["valid?"] is True
+
+    def test_duplicate_elements(self):
+        r = check_append(
+            (0, [["append", "x", 1]]),
+            (1, [["append", "x", 1]]),
+        )
+        assert r["valid?"] is False
+        assert "duplicate-elements" in r["anomaly-types"]
+
+    def test_incompatible_order(self):
+        r = check_append(
+            (0, [["r", "x", [1, 2]]]),
+            (1, [["r", "x", [2, 1]]]),
+            (2, [["append", "x", 1]]),
+            (3, [["append", "x", 2]]),
+        )
+        assert r["valid?"] is False
+        assert "incompatible-order" in r["anomaly-types"]
+
+    def test_failed_txns_excluded_from_graph(self):
+        # A failed txn's appends create no edges.
+        r = check_append(
+            (0, [["append", "x", 1]], "fail"),
+            (1, [["append", "x", 2]]),
+            (2, [["r", "x", [2]]]),
+        )
+        assert r["valid?"] is True
+
+    def test_info_txn_writes_visible(self):
+        # Indeterminate appends may commit; reading one is fine.
+        r = check_append(
+            (0, [["append", "x", 1]], "info"),
+            (1, [["r", "x", [1]]]),
+        )
+        assert r["valid?"] is True
+
+
+class TestRealtime:
+    def test_stale_read_needs_realtime(self):
+        # T0 appends and completes; T1 *later* reads stale [] — fine for
+        # serializability, a violation of strict serializability.
+        txns = [
+            (0, [["append", "x", 1]]),
+            (1, [["r", "x", []]]),
+            (2, [["r", "x", [1]]]),
+        ]
+        plain = elle.list_append().check({}, txn_hist(*txns), {})
+        assert plain["valid?"] is True
+        rt = elle.list_append(additional_graphs=["realtime"]).check(
+            {}, txn_hist(*txns), {}
+        )
+        assert rt["valid?"] is False
+        assert "G-single" in rt["anomaly-types"]
+
+
+class TestWRRegister:
+    def test_valid(self):
+        h = txn_hist(
+            (0, [["w", "x", 1]]),
+            (1, [["r", "x", 1]]),
+        )
+        assert elle.wr_register().check({}, h, {})["valid?"] is True
+
+    def test_g1c_wr_cycle(self):
+        h = txn_hist(
+            (0, [["w", "x", 1], ["r", "y", 2]]),
+            (1, [["w", "y", 2], ["r", "x", 1]]),
+        )
+        r = elle.wr_register().check({}, h, {})
+        assert r["valid?"] is False
+        assert "G1c" in r["anomaly-types"]
+
+    def test_g1a(self):
+        h = txn_hist(
+            (0, [["w", "x", 1]], "fail"),
+            (1, [["r", "x", 1]]),
+        )
+        r = elle.wr_register().check({}, h, {})
+        assert "G1a" in r["anomaly-types"]
+
+    def test_g1b(self):
+        h = txn_hist(
+            (0, [["w", "x", 1], ["w", "x", 2]]),
+            (1, [["r", "x", 1]]),
+        )
+        r = elle.wr_register().check({}, h, {})
+        assert "G1b" in r["anomaly-types"]
+
+    def test_internal(self):
+        h = txn_hist(
+            (0, [["w", "x", 1], ["r", "x", 2]]),
+            (1, [["w", "x", 2]]),
+        )
+        r = elle.wr_register().check({}, h, {})
+        assert "internal" in r["anomaly-types"]
+
+    def test_linearizable_keys_g_single(self):
+        # w x=1 completes, then w x=2 completes, then a read sees stale 1.
+        h = txn_hist(
+            (0, [["w", "x", 1]]),
+            (1, [["w", "x", 2]]),
+            (2, [["r", "x", 1]]),
+        )
+        chk = elle.wr_register(
+            linearizable_keys=True, additional_graphs=["realtime"]
+        )
+        r = chk.check({}, h, {})
+        assert r["valid?"] is False
+        assert "G-single" in r["anomaly-types"]
+
+    def test_duplicate_writes(self):
+        h = txn_hist(
+            (0, [["w", "x", 1]]),
+            (1, [["w", "x", 1]]),
+        )
+        r = elle.wr_register().check({}, h, {})
+        assert "duplicate-writes" in r["anomaly-types"]
+
+
+class TestExplanations:
+    def test_cycle_witness_recovered(self):
+        r = check_append(
+            (0, [["append", "x", 1], ["r", "y", [2]]]),
+            (1, [["append", "y", 2], ["r", "x", [1]]]),
+        )
+        [anom] = r["anomalies"]["G1c"]
+        assert len(anom["cycle"]) >= 2
+        # Every step's edge must connect consecutive cycle members.
+        assert all(s["type"] in ("ww", "wr", "rw", "rt") for s in anom["steps"])
+
+
+class TestClosureKernel:
+    """Differential tests: TPU closure kernel vs numpy Warshall oracle."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_graphs(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(2, 40))
+        adj = rng.random((n, n)) < 0.15
+        np.fill_diagonal(adj, False)
+        oracle = cl.transitive_closure_np(adj)
+        size = cl._pad_to(n)
+        got = np.asarray(
+            cl.transitive_closure(
+                np.asarray(cl.pad_adj(adj, size)), cl._n_steps(n)
+            )
+        )[:n, :n]
+        np.testing.assert_array_equal(got > 0, oracle)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_flags_match_oracle(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(3, 24))
+
+        def rand_adj(p):
+            m = rng.random((n, n)) < p
+            np.fill_diagonal(m, False)
+            return m
+
+        ww, wr, rw = rand_adj(0.08), rand_adj(0.08), rand_adj(0.08)
+        extra = np.zeros((n, n), dtype=bool)
+        flags, _ = cl.classify_graph(ww, wr, rw, extra)
+
+        c_ww = cl.transitive_closure_np(ww)
+        c_wwr = cl.transitive_closure_np(ww | wr)
+        c_all = cl.transitive_closure_np(ww | wr | rw)
+        assert flags["G0"] == bool(np.diag(c_ww).any())
+        assert flags["G1c"] == bool((wr & c_wwr.T).any())
+        assert flags["G-single"] == bool((rw & c_wwr.T).any())
+        assert flags["G2"] == bool((rw & c_all.T).any())
+
+    def test_batch_classify(self):
+        rng = np.random.default_rng(0)
+        n, size = 10, 128
+        batch = []
+        for _ in range(4):
+            m = rng.random((n, n)) < 0.2
+            np.fill_diagonal(m, False)
+            batch.append(cl.pad_adj(m, size))
+        ww = np.stack(batch)
+        zero = np.zeros_like(ww)
+        res = cl.classify_cycles_batch(ww, zero, zero, zero, cl._n_steps(n))
+        assert res.g0.shape == (4,)

@@ -1,0 +1,62 @@
+"""Tests for the transaction micro-op library (mirrors txn/test in the
+reference repo's txn library)."""
+
+import random
+
+from jepsen_tpu import txn as t
+
+
+def test_ext_reads_basic():
+    assert t.ext_reads([["r", "x", 1], ["r", "y", 2]]) == {"x": 1, "y": 2}
+
+
+def test_ext_reads_ignores_after_write():
+    # A read following our own write is internal, not external.
+    assert t.ext_reads([["w", "x", 1], ["r", "x", 1], ["r", "y", 2]]) == {"y": 2}
+
+
+def test_ext_reads_first_read_wins():
+    assert t.ext_reads([["r", "x", 1], ["r", "x", 2]]) == {"x": 1}
+
+
+def test_ext_writes_last_write_wins():
+    assert t.ext_writes([["w", "x", 1], ["w", "x", 2], ["r", "y", 3]]) == {"x": 2}
+
+
+def test_ext_writes_append():
+    assert t.ext_writes([["append", "x", 1], ["w", "y", 2]]) == {"x": 1, "y": 2}
+
+
+def test_int_write_mops():
+    txn = [["w", "x", 1], ["w", "x", 2], ["w", "y", 9]]
+    assert t.int_write_mops(txn) == {"x": [["w", "x", 1]]}
+
+
+def test_reduce_mops_and_op_mops():
+    hist = [
+        {"type": "ok", "process": 0, "f": "txn", "value": [["w", "x", 1], ["r", "x", 1]]},
+        {"type": "ok", "process": 1, "f": "txn", "value": [["r", "y", None]]},
+    ]
+    mops = [mop for _, mop in t.op_mops(hist)]
+    assert len(mops) == 3
+    count = t.reduce_mops(lambda s, op, mop: s + 1, 0, hist)
+    assert count == 3
+
+
+def test_wr_txns_unique_writes():
+    rng = random.Random(7)
+    seen = {}
+    gen = t.wr_txns(rng, key_count=3, max_writes_per_key=8)
+    for _ in range(200):
+        for f, k, v in next(gen):
+            if f == "w":
+                assert (k, v) not in seen
+                seen[(k, v)] = True
+
+
+def test_append_txns_shape():
+    rng = random.Random(7)
+    gen = t.append_txns(rng)
+    for _ in range(50):
+        for f, k, v in next(gen):
+            assert f in ("r", "append")
