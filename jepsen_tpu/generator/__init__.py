@@ -1,0 +1,1172 @@
+"""Generator DSL: a pure, functional scheduling language for test workloads.
+
+Mirrors the semantics of ``jepsen.generator`` (reference:
+jepsen/src/jepsen/generator.clj, 1,581 LoC).  A generator is an immutable
+value with two operations (generator.clj:382-390):
+
+  ``op(gen, test, ctx)``     -> ``(op, gen')`` | ``(PENDING, gen)`` | ``None``
+  ``update(gen, test, ctx, event)`` -> ``gen'``
+
+``op`` asks "what would you like to do next?"; ``None`` means exhausted,
+``PENDING`` means "nothing *right now*, ask again later".  ``update`` feeds
+every history event (invocations and completions) back into the generator so
+stateful combinators (synchronize, until-ok, flip-flop) can react.
+
+The *context* tracks logical time (nanoseconds) and which worker threads are
+free (generator.clj:428-464).  Threads are ints ``0..concurrency-1`` plus the
+special ``NEMESIS`` thread; each thread is mapped to a *process* (an
+incrementing id — crashed processes are replaced, generator.clj:330-343).
+
+Everything here is pure Python over immutable dataclasses: no I/O, no wall
+clock, no threads — exactly like the reference, which is why the
+deterministic simulator (jepsen_tpu.generator.testing) can unit-test every
+combinator with exact op sequences (generator/test.clj).
+
+Python values are coerced to generators like the reference's protocol
+extensions (generator.clj:545-590):
+
+  None          -> exhausted generator
+  dict          -> emit that op once (fill in process/time/type)
+  callable      -> call it (with (test, ctx), (test,), or ()); treat the
+                   result as a generator, then repeat the function forever
+  list / tuple  -> each element in turn
+  Gen instance  -> itself
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: Sentinel: generator has nothing to do *right now* (generator.clj:382-390).
+PENDING = "pending"
+
+#: The nemesis's thread/process name (reference keyword :nemesis).
+NEMESIS = "nemesis"
+
+
+def s_to_ns(seconds: float) -> int:
+    return int(seconds * 1_000_000_000)
+
+
+def ns_to_s(ns: int) -> float:
+    return ns / 1_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# RNG — deterministic under the simulator (generator/test.clj:31-48)
+# ---------------------------------------------------------------------------
+
+#: Module RNG used for free-thread choice, mix, stagger jitter.  The
+#: scheduler (interpreter or simulator) is single-threaded, so a shared
+#: instance is safe; tests seed it via rand_seed (reference seed 45100).
+_rng = random.Random()
+
+DEFAULT_RAND_SEED = 45100
+
+
+def rand_seed(seed: int = DEFAULT_RAND_SEED) -> None:
+    """Reset the generator RNG — gives byte-identical schedules
+    (generator/test.clj:44)."""
+    _rng.seed(seed)
+
+
+# ---------------------------------------------------------------------------
+# Context (generator.clj:428-464)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Context:
+    """Scheduling context: logical time, free threads, thread->process map.
+
+    ``workers`` maps every thread (int or NEMESIS) to its current process;
+    ``free_threads`` is the subset not currently executing an op.  The
+    reference uses a Bifurcan Set for O(1) fair nth (generator.clj:440-449);
+    a Python frozenset + sorted tuple choice is equivalent here.
+    """
+
+    time: int
+    free_threads: frozenset
+    workers: Mapping[Any, Any]  # thread -> process
+
+    # -- queries ------------------------------------------------------------
+
+    def all_threads(self) -> frozenset:
+        return frozenset(self.workers)
+
+    def free_processes(self) -> list:
+        return [self.workers[t] for t in self._sorted_free()]
+
+    def all_processes(self) -> list:
+        return list(self.workers.values())
+
+    def process_of(self, thread):
+        return self.workers[thread]
+
+    def thread_of(self, process):
+        """Invert the worker map (generator.clj:506-515)."""
+        for t, p in self.workers.items():
+            if p == process:
+                return t
+        return None
+
+    def _sorted_free(self) -> list:
+        return sorted(self.free_threads, key=_thread_sort_key)
+
+    def some_free_process(self):
+        """A uniformly random free process (fair scheduling,
+        generator.clj:440-449), or None."""
+        free = self._sorted_free()
+        if not free:
+            return None
+        return self.workers[free[_rng.randrange(len(free))]]
+
+    # -- transitions --------------------------------------------------------
+
+    def with_time(self, time: int) -> "Context":
+        return dataclasses.replace(self, time=time)
+
+    def busy_thread(self, thread) -> "Context":
+        return dataclasses.replace(self, free_threads=self.free_threads - {thread})
+
+    def free_thread(self, thread) -> "Context":
+        return dataclasses.replace(self, free_threads=self.free_threads | {thread})
+
+    def with_next_process(self, thread) -> "Context":
+        """Assign a fresh process id to a crashed thread's slot
+        (generator.clj:330-343; interpreter.clj:233-236)."""
+        workers = dict(self.workers)
+        workers[thread] = next_process(self, thread)
+        return dataclasses.replace(self, workers=workers)
+
+    def restrict(self, pred: Callable[[Any], bool]) -> "Context":
+        """Restrict to threads satisfying pred — both workers and
+        free_threads, so barrier combinators see only the subset
+        (generator.clj:864-883 on-threads)."""
+        workers = {t: p for t, p in self.workers.items() if pred(t)}
+        return Context(
+            time=self.time,
+            free_threads=frozenset(t for t in self.free_threads if pred(t)),
+            workers=workers,
+        )
+
+
+def _thread_sort_key(t):
+    return (1, 0) if t == NEMESIS else (0, t)
+
+
+def context(test: Mapping) -> Context:
+    """Fresh context for a test map: threads 0..concurrency-1 + nemesis,
+    all free, process ids = thread ids (generator.clj:453-464)."""
+    n = int(test.get("concurrency", 1))
+    workers = {t: t for t in range(n)}
+    workers[NEMESIS] = NEMESIS
+    return Context(time=0, free_threads=frozenset(workers), workers=workers)
+
+
+def next_process(ctx: Context, thread):
+    """The process id that replaces a crashed one: current + number of client
+    threads, so ids never collide (generator.clj:330-343)."""
+    if thread == NEMESIS:
+        return NEMESIS
+    n_clients = sum(1 for t in ctx.workers if t != NEMESIS)
+    return ctx.workers[thread] + n_clients
+
+
+# ---------------------------------------------------------------------------
+# Op filling (generator.clj:531-543)
+# ---------------------------------------------------------------------------
+
+
+def fill_in_op(op: Mapping, ctx: Context):
+    """Fill missing :time, :process, :type on a partial op.  Returns PENDING
+    when no free thread can run it (generator.clj:531-543)."""
+    o = dict(op)
+    if "process" not in o:
+        p = ctx.some_free_process()
+        if p is None:
+            return PENDING
+        o["process"] = p
+    elif o["process"] not in ctx.free_processes():
+        # Explicit process that isn't free: can't run yet.
+        return PENDING
+    o.setdefault("time", ctx.time)
+    o.setdefault("type", "invoke")
+    o.setdefault("f", None)
+    o.setdefault("value", None)
+    return o
+
+
+# ---------------------------------------------------------------------------
+# Generator protocol & coercion (generator.clj:545-590)
+# ---------------------------------------------------------------------------
+
+
+class Gen:
+    """Base generator.  Subclasses override op/update; both must be pure
+    (return new instances, never mutate)."""
+
+    def op(self, test, ctx):
+        raise NotImplementedError
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class _Nil(Gen):
+    """The exhausted generator (None coerces here)."""
+
+    def op(self, test, ctx):
+        return None
+
+    def __repr__(self):
+        return "nil-gen"
+
+
+NIL_GEN = _Nil()
+
+
+@dataclasses.dataclass(frozen=True)
+class _OpMap(Gen):
+    """A raw op map emits itself exactly once (generator.clj:560-567 — use
+    repeat() to emit it forever)."""
+
+    m: Mapping
+
+    def op(self, test, ctx):
+        o = fill_in_op(self.m, ctx)
+        if o is PENDING:
+            return (PENDING, self)
+        return (o, NIL_GEN)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Fn(Gen):
+    """A function is called to produce an op/generator; the function itself
+    repeats forever (generator.clj:569-584).  Accepts arities (test, ctx),
+    (test,), or ()."""
+
+    f: Callable
+
+    def op(self, test, ctx):
+        x = _call_flex(self.f, test, ctx)
+        if x is None:
+            return None
+        # The result runs first, then this function again.
+        g = _Seq((to_gen(x), self))
+        return g.op(test, ctx)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def _call_flex(f, test, ctx):
+    try:
+        return f(test, ctx)
+    except TypeError as e:
+        if "positional argument" not in str(e):
+            raise
+    try:
+        return f(test)
+    except TypeError as e:
+        if "positional argument" not in str(e):
+            raise
+    return f()
+
+
+@dataclasses.dataclass(frozen=True)
+class _Seq(Gen):
+    """A sequence of generators, run one after another
+    (generator.clj:586-590)."""
+
+    gens: tuple
+
+    def op(self, test, ctx):
+        gens = self.gens
+        while gens:
+            head = to_gen(gens[0])
+            r = head.op(test, ctx)
+            if r is None:
+                gens = gens[1:]
+                continue
+            o, g2 = r
+            return (o, _Seq((g2,) + gens[1:]))
+        return None
+
+    def update(self, test, ctx, event):
+        if not self.gens:
+            return self
+        head = to_gen(self.gens[0]).update(test, ctx, event)
+        return _Seq((head,) + self.gens[1:])
+
+
+def to_gen(x) -> Gen:
+    """Coerce a Python value to a generator (see module docstring)."""
+    if x is None:
+        return NIL_GEN
+    if isinstance(x, Gen):
+        return x
+    if isinstance(x, Mapping):
+        return _OpMap(x)
+    if callable(x):
+        return _Fn(x)
+    if isinstance(x, (list, tuple)):
+        return _Seq(tuple(x))
+    raise TypeError(f"can't coerce {x!r} to a generator")
+
+
+# ---------------------------------------------------------------------------
+# soonest-op-map (generator.clj:885-927)
+# ---------------------------------------------------------------------------
+
+
+def soonest_op_map(candidates: Sequence[dict | None]):
+    """Pick the candidate map {'op','gen','weight'?} whose op occurs first.
+
+    Pending beats nothing; a real op beats pending; earlier time beats later;
+    ties break weighted-random (generator.clj:885-927).  Returns the chosen
+    map (with merged weight) or None.
+    """
+    best = None
+    for c in candidates:
+        if c is None:
+            continue
+        if best is None:
+            best = c
+            continue
+        a, b = best["op"], c["op"]
+        if a is PENDING and b is PENDING:
+            continue
+        if a is PENDING:
+            best = c
+            continue
+        if b is PENDING:
+            continue
+        ta, tb = a.get("time", 0), b.get("time", 0)
+        if tb < ta:
+            best = c
+        elif tb == ta:
+            wa = best.get("weight", 1)
+            wb = c.get("weight", 1)
+            if _rng.random() < wb / (wa + wb):
+                best = {**c, "weight": wa + wb}
+            else:
+                best = {**best, "weight": wa + wb}
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Validate(Gen):
+    """Assert emitted ops are well-formed maps with a free process and
+    non-decreasing times (generator.clj:622-671)."""
+
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is not PENDING:
+            problems = []
+            if not isinstance(o, Mapping):
+                problems.append(f"should be a map, but was {o!r}")
+            else:
+                if o.get("type") not in ("invoke", "sleep", "log", "info"):
+                    problems.append(f"bad :type {o.get('type')!r}")
+                if "time" not in o:
+                    problems.append("no :time")
+                if o.get("type") == "invoke" and o.get("process") not in ctx.free_processes():
+                    problems.append(
+                        f"process {o.get('process')!r} is not free "
+                        f"(free: {ctx.free_processes()})"
+                    )
+            if problems:
+                raise ValueError(f"invalid op {o!r} from {self.gen!r}: {problems}")
+        return (o, Validate(g2))
+
+    def update(self, test, ctx, event):
+        return Validate(to_gen(self.gen).update(test, ctx, event))
+
+
+@dataclasses.dataclass(frozen=True)
+class FriendlyExceptions(Gen):
+    """Wrap op/update so exceptions carry which generator threw
+    (generator.clj:678-718)."""
+
+    gen: Gen
+
+    def op(self, test, ctx):
+        try:
+            r = to_gen(self.gen).op(test, ctx)
+        except Exception as e:
+            raise RuntimeError(f"generator {self.gen!r} threw in op()") from e
+        if r is None:
+            return None
+        o, g2 = r
+        return (o, FriendlyExceptions(g2))
+
+    def update(self, test, ctx, event):
+        try:
+            return FriendlyExceptions(to_gen(self.gen).update(test, ctx, event))
+        except Exception as e:
+            raise RuntimeError(f"generator {self.gen!r} threw in update()") from e
+
+
+@dataclasses.dataclass(frozen=True)
+class Trace(Gen):
+    """Log every op/update passing through, tagged with k
+    (generator.clj:720-763)."""
+
+    k: Any
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        logger.info("trace %s op -> %s", self.k, None if r is None else r[0])
+        if r is None:
+            return None
+        o, g2 = r
+        return (o, Trace(self.k, g2))
+
+    def update(self, test, ctx, event):
+        logger.info("trace %s update <- %s", self.k, event)
+        return Trace(self.k, to_gen(self.gen).update(test, ctx, event))
+
+
+@dataclasses.dataclass(frozen=True)
+class Map(Gen):
+    """Apply f to every emitted op (generator.clj:782-788)."""
+
+    f: Callable
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is not PENDING:
+            o = self.f(o)
+        return (o, Map(self.f, g2))
+
+    def update(self, test, ctx, event):
+        return Map(self.f, to_gen(self.gen).update(test, ctx, event))
+
+
+def f_map(m: Mapping, gen) -> Gen:
+    """Rename op :f keys via map m — both on the way out and (inverse) on
+    update events, so composed nemeses see their own vocabulary
+    (generator.clj:790-810)."""
+    inv = {v: k for k, v in m.items()}
+    return _FMap(dict(m), inv, to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class _FMap(Gen):
+    m: Mapping
+    inv: Mapping
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is not PENDING:
+            o = {**o, "f": self.m.get(o.get("f"), o.get("f"))}
+        return (o, _FMap(self.m, self.inv, g2))
+
+    def update(self, test, ctx, event):
+        ev = {**event, "f": self.inv.get(event.get("f"), event.get("f"))}
+        return _FMap(self.m, self.inv, to_gen(self.gen).update(test, ctx, ev))
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(Gen):
+    """Only emit ops satisfying pred (generator.clj:812-862).  Skipped ops
+    advance the inner generator."""
+
+    pred: Callable
+    gen: Gen
+
+    def op(self, test, ctx):
+        gen = to_gen(self.gen)
+        while True:
+            r = gen.op(test, ctx)
+            if r is None:
+                return None
+            o, g2 = r
+            if o is PENDING or self.pred(o):
+                return (o, Filter(self.pred, g2))
+            gen = g2
+
+    def update(self, test, ctx, event):
+        return Filter(self.pred, to_gen(self.gen).update(test, ctx, event))
+
+
+@dataclasses.dataclass(frozen=True)
+class OnThreads(Gen):
+    """Restrict a generator to threads satisfying pred: it sees a filtered
+    context and only receives updates for its own threads
+    (generator.clj:864-883)."""
+
+    pred: Callable
+    gen: Gen
+
+    def op(self, test, ctx):
+        sub = ctx.restrict(self.pred)
+        if not sub.workers:
+            return None
+        r = to_gen(self.gen).op(test, sub)
+        if r is None:
+            return None
+        o, g2 = r
+        return (o, OnThreads(self.pred, g2))
+
+    def update(self, test, ctx, event):
+        thread = ctx.thread_of(event.get("process"))
+        if thread is not None and self.pred(thread):
+            sub = ctx.restrict(self.pred)
+            return OnThreads(self.pred, to_gen(self.gen).update(test, sub, event))
+        return self
+
+
+def on_threads(pred, gen) -> Gen:
+    return OnThreads(pred, to_gen(gen))
+
+
+on = on_threads
+
+
+def clients(gen, final_gen=None) -> Gen:
+    """Run gen on client threads only (generator.clj:1093-1103)."""
+    g = on_threads(lambda t: t != NEMESIS, gen)
+    if final_gen is not None:
+        return _Seq((g, on_threads(lambda t: t != NEMESIS, final_gen)))
+    return g
+
+
+def nemesis(gen, final_gen=None) -> Gen:
+    """Run gen on the nemesis thread only (generator.clj:1105-1115)."""
+    g = on_threads(lambda t: t == NEMESIS, gen)
+    if final_gen is not None:
+        return _Seq((g, on_threads(lambda t: t == NEMESIS, final_gen)))
+    return g
+
+
+@dataclasses.dataclass(frozen=True)
+class Any(Gen):
+    """Emit whichever child generator's op comes soonest; updates go to all
+    children (generator.clj:929-953)."""
+
+    gens: tuple
+
+    def op(self, test, ctx):
+        candidates = []
+        for i, g in enumerate(self.gens):
+            r = to_gen(g).op(test, ctx)
+            if r is None:
+                continue
+            o, g2 = r
+            candidates.append({"op": o, "gen": g2, "i": i})
+        best = soonest_op_map(candidates)
+        if best is None:
+            return None
+        gens = tuple(
+            best["gen"] if i == best["i"] else g for i, g in enumerate(self.gens)
+        )
+        return (best["op"], Any(gens))
+
+    def update(self, test, ctx, event):
+        return Any(tuple(to_gen(g).update(test, ctx, event) for g in self.gens))
+
+
+def any_gen(*gens) -> Gen:
+    return Any(tuple(to_gen(g) for g in gens))
+
+
+@dataclasses.dataclass(frozen=True)
+class EachThread(Gen):
+    """An independent copy of gen runs on every thread
+    (generator.clj:955-1007).  Exhausted when every thread's copy is."""
+
+    fresh: Gen
+    copies: Mapping  # thread -> Gen | None (None = exhausted)
+
+    def _copy_for(self, t):
+        if t in self.copies:
+            return self.copies[t]
+        return self.fresh
+
+    def op(self, test, ctx):
+        candidates = []
+        for t in ctx.all_threads():
+            g = self._copy_for(t)
+            if g is None:
+                continue
+            sub = ctx.restrict(lambda x, t=t: x == t)
+            r = to_gen(g).op(test, sub)
+            if r is None:
+                continue
+            o, g2 = r
+            candidates.append({"op": o, "gen": g2, "t": t})
+        if not candidates:
+            return None
+        best = soonest_op_map(candidates)
+        copies = dict(self.copies)
+        copies[best["t"]] = best["gen"]
+        return (best["op"], EachThread(self.fresh, copies))
+
+    def update(self, test, ctx, event):
+        t = ctx.thread_of(event.get("process"))
+        if t is None:
+            return self
+        g = self._copy_for(t)
+        if g is None:
+            return self
+        sub = ctx.restrict(lambda x, t=t: x == t)
+        copies = dict(self.copies)
+        copies[t] = to_gen(g).update(test, sub, event)
+        return EachThread(self.fresh, copies)
+
+
+def each_thread(gen) -> Gen:
+    return EachThread(to_gen(gen), {})
+
+
+@dataclasses.dataclass(frozen=True)
+class Reserve(Gen):
+    """Partition client threads into fixed-size groups, each running its own
+    generator; remaining threads (and the nemesis) run the default
+    (generator.clj:1009-1089)."""
+
+    ranges: tuple  # ((frozenset_of_threads, Gen), ...)
+    default: Gen
+    default_pred: Callable
+
+    def op(self, test, ctx):
+        candidates = []
+        for i, (threads, g) in enumerate(self.ranges):
+            sub = ctx.restrict(lambda t, s=threads: t in s)
+            r = to_gen(g).op(test, sub)
+            if r is None:
+                continue
+            o, g2 = r
+            candidates.append({"op": o, "gen": g2, "i": i, "weight": len(threads)})
+        sub = ctx.restrict(self.default_pred)
+        if sub.workers:
+            r = to_gen(self.default).op(test, sub)
+            if r is not None:
+                o, g2 = r
+                candidates.append(
+                    {"op": o, "gen": g2, "i": -1, "weight": max(1, len(sub.workers))}
+                )
+        best = soonest_op_map(candidates)
+        if best is None:
+            return None
+        if best["i"] == -1:
+            return (best["op"], Reserve(self.ranges, best["gen"], self.default_pred))
+        ranges = tuple(
+            (s, best["gen"] if i == best["i"] else g)
+            for i, (s, g) in enumerate(self.ranges)
+        )
+        return (best["op"], Reserve(ranges, self.default, self.default_pred))
+
+    def update(self, test, ctx, event):
+        t = ctx.thread_of(event.get("process"))
+        if t is None:
+            return self
+        for i, (threads, g) in enumerate(self.ranges):
+            if t in threads:
+                sub = ctx.restrict(lambda x, s=threads: x in s)
+                ranges = tuple(
+                    (s, to_gen(g).update(test, sub, event) if j == i else gg)
+                    for j, (s, gg) in enumerate(self.ranges)
+                )
+                return Reserve(ranges, self.default, self.default_pred)
+        if self.default_pred(t):
+            sub = ctx.restrict(self.default_pred)
+            return Reserve(
+                self.ranges, to_gen(self.default).update(test, sub, event), self.default_pred
+            )
+        return self
+
+
+def reserve(*args) -> Gen:
+    """``reserve(n1, g1, n2, g2, ..., default)`` — first n1 client threads run
+    g1, next n2 run g2, …; all other threads run default
+    (generator.clj:1009-1089)."""
+    *pairs, default = args
+    if len(pairs) % 2 != 0:
+        raise ValueError("reserve takes count/gen pairs followed by a default")
+    ranges = []
+    start = 0
+    for i in range(0, len(pairs), 2):
+        n, g = pairs[i], pairs[i + 1]
+        threads = frozenset(range(start, start + n))
+        ranges.append((threads, to_gen(g)))
+        start += n
+    reserved = frozenset().union(*[s for s, _ in ranges]) if ranges else frozenset()
+    return Reserve(tuple(ranges), to_gen(default), lambda t: t not in reserved)
+
+
+@dataclasses.dataclass(frozen=True)
+class Mix(Gen):
+    """Random choice among generators on each op; exhausted children are
+    dropped; updates are not routed (matching the reference, which keeps mix
+    stateless across updates — generator.clj:1124-1155)."""
+
+    gens: tuple
+
+    def op(self, test, ctx):
+        gens = list(self.gens)
+        order = list(range(len(gens)))
+        _rng.shuffle(order)
+        saw_pending = False
+        dropped = set()
+        for i in order:
+            r = to_gen(gens[i]).op(test, ctx)
+            if r is None:
+                dropped.add(i)
+                continue
+            o, g2 = r
+            if o is PENDING:
+                saw_pending = True
+                continue
+            remaining = tuple(
+                g2 if j == i else g for j, g in enumerate(gens) if j not in dropped
+            )
+            return (o, Mix(remaining))
+        remaining = tuple(g for j, g in enumerate(gens) if j not in dropped)
+        if saw_pending:
+            return (PENDING, Mix(remaining))
+        return None
+
+
+def mix(gens: Iterable) -> Gen:
+    return Mix(tuple(to_gen(g) for g in gens))
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(Gen):
+    """At most n ops (generator.clj:1156-1170)."""
+
+    remaining: int
+    gen: Gen
+
+    def op(self, test, ctx):
+        if self.remaining <= 0:
+            return None
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        n = self.remaining - (0 if o is PENDING else 1)
+        return (o, Limit(n, g2))
+
+    def update(self, test, ctx, event):
+        return Limit(self.remaining, to_gen(self.gen).update(test, ctx, event))
+
+
+def limit(n: int, gen) -> Gen:
+    return Limit(n, to_gen(gen))
+
+
+def once(gen) -> Gen:
+    """Exactly one op (generator.clj:1172-1175)."""
+    return Limit(1, to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class Repeat(Gen):
+    """Emit gen's next op over and over *without advancing gen* — like
+    clojure.core/repeat of a value (generator.clj:1183-1210).  With a count,
+    stops after n ops."""
+
+    remaining: int | None
+    gen: Gen
+
+    def op(self, test, ctx):
+        if self.remaining is not None and self.remaining <= 0:
+            return None
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, _g2 = r
+        if o is PENDING:
+            return (PENDING, self)
+        n = None if self.remaining is None else self.remaining - 1
+        return (o, Repeat(n, self.gen))
+
+    def update(self, test, ctx, event):
+        return Repeat(self.remaining, to_gen(self.gen).update(test, ctx, event))
+
+
+def repeat(gen, n: int | None = None) -> Gen:
+    return Repeat(n, to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class Cycle(Gen):
+    """Restart gen from pristine when exhausted, forever or n times
+    (generator.clj:1212-1238)."""
+
+    remaining: int | None
+    fresh: Gen
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is not None:
+            o, g2 = r
+            return (o, Cycle(self.remaining, self.fresh, g2))
+        if self.remaining is not None and self.remaining <= 1:
+            return None
+        n = None if self.remaining is None else self.remaining - 1
+        r = to_gen(self.fresh).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        return (o, Cycle(n, self.fresh, g2))
+
+    def update(self, test, ctx, event):
+        return Cycle(self.remaining, self.fresh, to_gen(self.gen).update(test, ctx, event))
+
+
+def cycle(gen, n: int | None = None) -> Gen:
+    g = to_gen(gen)
+    return Cycle(n, g, g)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessLimit(Gen):
+    """Allow ops from at most n distinct client processes — crashed processes
+    burn budget, bounding the search frontier for checkers
+    (generator.clj:1240-1265)."""
+
+    n: int
+    seen: frozenset
+    gen: Gen
+
+    def _eligible(self, ctx: Context):
+        budget = self.n - len(self.seen)
+
+        def ok(t):
+            if t == NEMESIS:
+                return True
+            p = ctx.workers[t]
+            return p in self.seen or budget > 0
+
+        return ok
+
+    def op(self, test, ctx):
+        sub = ctx.restrict(self._eligible(ctx))
+        free_clients = [t for t in sub.free_threads if t != NEMESIS]
+        if not free_clients and len(self.seen) >= self.n:
+            # All in-budget processes are done/crashed-over-budget.
+            live = {p for p in ctx.all_processes() if p in self.seen}
+            if not live:
+                return None
+        r = to_gen(self.gen).op(test, sub)
+        if r is None:
+            return None
+        o, g2 = r
+        seen = self.seen
+        if o is not PENDING and isinstance(o.get("process"), int):
+            seen = seen | {o["process"]}
+        return (o, ProcessLimit(self.n, seen, g2))
+
+    def update(self, test, ctx, event):
+        return ProcessLimit(self.n, self.seen, to_gen(self.gen).update(test, ctx, event))
+
+
+def process_limit(n: int, gen) -> Gen:
+    return ProcessLimit(n, frozenset(), to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeLimit(Gen):
+    """Stop emitting once logical time exceeds the deadline; the deadline is
+    fixed on first call (generator.clj:1267-1291)."""
+
+    dt: int  # ns
+    deadline: int | None
+    gen: Gen
+
+    def op(self, test, ctx):
+        deadline = self.deadline if self.deadline is not None else ctx.time + self.dt
+        if ctx.time >= deadline:
+            return None
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is not PENDING and o.get("time", ctx.time) >= deadline:
+            return None
+        return (o, TimeLimit(self.dt, deadline, g2))
+
+    def update(self, test, ctx, event):
+        return TimeLimit(self.dt, self.deadline, to_gen(self.gen).update(test, ctx, event))
+
+
+def time_limit(seconds: float, gen) -> Gen:
+    return TimeLimit(s_to_ns(seconds), None, to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class Stagger(Gen):
+    """Introduce uniform-random [0, 2dt) spacing between ops — *total* rate
+    across all threads, not per-thread (generator.clj:1293-1330)."""
+
+    dt: int  # ns (mean interval)
+    next_time: int | None
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is PENDING:
+            return (PENDING, Stagger(self.dt, self.next_time, g2))
+        nt = self.next_time if self.next_time is not None else ctx.time
+        t = max(o.get("time", ctx.time), nt)
+        o = {**o, "time": t}
+        return (o, Stagger(self.dt, t + int(_rng.random() * 2 * self.dt), g2))
+
+    def update(self, test, ctx, event):
+        return Stagger(self.dt, self.next_time, to_gen(self.gen).update(test, ctx, event))
+
+
+def stagger(seconds: float, gen) -> Gen:
+    return Stagger(s_to_ns(seconds), None, to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class Delay(Gen):
+    """Exactly dt between emitted ops — total rate 1/dt
+    (generator.clj:1369-1395)."""
+
+    dt: int
+    next_time: int | None
+    gen: Gen
+
+    def op(self, test, ctx):
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is PENDING:
+            return (PENDING, Delay(self.dt, self.next_time, g2))
+        nt = self.next_time if self.next_time is not None else ctx.time
+        t = max(o.get("time", ctx.time), nt)
+        o = {**o, "time": t}
+        return (o, Delay(self.dt, t + self.dt, g2))
+
+    def update(self, test, ctx, event):
+        return Delay(self.dt, self.next_time, to_gen(self.gen).update(test, ctx, event))
+
+
+def delay(seconds: float, gen) -> Gen:
+    return Delay(s_to_ns(seconds), None, to_gen(gen))
+
+
+def sleep(seconds: float) -> Gen:
+    """One special op telling its worker to do nothing for dt; excluded from
+    the history by the interpreter (generator.clj:1397-1401,
+    interpreter.clj:172-179)."""
+    return once({"type": "sleep", "value": seconds, "f": None})
+
+
+def log(message) -> Gen:
+    """One special op logging a message in-worker; excluded from the history
+    (generator.clj:1177-1181)."""
+    return once({"type": "log", "value": message, "f": None})
+
+
+@dataclasses.dataclass(frozen=True)
+class Synchronize(Gen):
+    """A barrier: PENDING until every thread in the context is free, then
+    becomes gen (generator.clj:1403-1423)."""
+
+    gen: Gen
+    released: bool = False
+
+    def op(self, test, ctx):
+        if self.released or ctx.free_threads == ctx.all_threads():
+            g = to_gen(self.gen)
+            r = g.op(test, ctx)
+            if r is None:
+                return None
+            o, g2 = r
+            return (o, Synchronize(g2, True))
+        return (PENDING, self)
+
+    def update(self, test, ctx, event):
+        return Synchronize(to_gen(self.gen).update(test, ctx, event), self.released)
+
+
+def synchronize(gen) -> Gen:
+    return Synchronize(to_gen(gen))
+
+
+def phases(*gens) -> Gen:
+    """Each generator runs to completion, with a full barrier before the
+    next begins (generator.clj:1425-1430)."""
+    return _Seq(tuple(synchronize(g) for g in gens))
+
+
+def then(a, b) -> Gen:
+    """b, then (after a barrier) a — argument order matches the reference's
+    threading-macro convention ``(->> a (then b))`` (generator.clj:1432-1441)."""
+    return _Seq((to_gen(b), synchronize(a)))
+
+
+@dataclasses.dataclass(frozen=True)
+class UntilOk(Gen):
+    """Pass through until one of our ops completes :ok
+    (generator.clj:1443-1473)."""
+
+    gen: Gen
+    done: bool = False
+
+    def op(self, test, ctx):
+        if self.done:
+            return None
+        r = to_gen(self.gen).op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        return (o, UntilOk(g2, False))
+
+    def update(self, test, ctx, event):
+        done = self.done or event.get("type") == "ok"
+        return UntilOk(to_gen(self.gen).update(test, ctx, event), done)
+
+
+def until_ok(gen) -> Gen:
+    return UntilOk(to_gen(gen))
+
+
+@dataclasses.dataclass(frozen=True)
+class FlipFlop(Gen):
+    """Alternate ops between generators: a, b, a, b, … Exhausted when the
+    current one is (generator.clj:1475-1489)."""
+
+    gens: tuple
+    i: int
+
+    def op(self, test, ctx):
+        g = to_gen(self.gens[self.i])
+        r = g.op(test, ctx)
+        if r is None:
+            return None
+        o, g2 = r
+        if o is PENDING:
+            gens = tuple(g2 if j == self.i else x for j, x in enumerate(self.gens))
+            return (PENDING, FlipFlop(gens, self.i))
+        gens = tuple(g2 if j == self.i else x for j, x in enumerate(self.gens))
+        return (o, FlipFlop(gens, (self.i + 1) % len(gens)))
+
+    def update(self, test, ctx, event):
+        gens = tuple(to_gen(g).update(test, ctx, event) for g in self.gens)
+        return FlipFlop(gens, self.i)
+
+
+def flip_flop(*gens) -> Gen:
+    return FlipFlop(tuple(to_gen(g) for g in gens), 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CycleTimes(Gen):
+    """Rotate between generators on a repeating schedule of durations:
+    t1 of g1, t2 of g2, …, looping (generator.clj:1491-1563)."""
+
+    periods: tuple  # (ns, ...)
+    gens: tuple
+    origin: int | None
+
+    def _window(self, time: int, origin: int):
+        """(index, abs_start, abs_end) of the window containing `time`."""
+        total = sum(self.periods)
+        phase = (time - origin) % total
+        acc = 0
+        for i, p in enumerate(self.periods):
+            if phase < acc + p:
+                start = time - phase + acc
+                return i, start, start + p
+            acc += p
+        raise AssertionError("unreachable")
+
+    def op(self, test, ctx):
+        origin = self.origin if self.origin is not None else ctx.time
+        t_ask = ctx.time
+        # Fix-point: if the asked window's op lands in a later window,
+        # re-ask the generator that owns that later window
+        # (the reference achieves this by slicing gens into time-capped
+        # pieces, generator.clj:1491-1563).
+        for _ in range(4 * len(self.gens) + 4):
+            i, start, end = self._window(max(t_ask, ctx.time), origin)
+            sub_ctx = ctx.with_time(max(ctx.time, start))
+            r = to_gen(self.gens[i]).op(test, sub_ctx)
+            if r is None:
+                return None
+            o, g2 = r
+            if o is PENDING:
+                return (PENDING, CycleTimes(self.periods, self.gens, origin))
+            t_op = o.get("time", sub_ctx.time)
+            if t_op < end:
+                gens = tuple(g2 if j == i else g for j, g in enumerate(self.gens))
+                return (o, CycleTimes(self.periods, gens, origin))
+            t_ask = t_op
+        gens = tuple(g2 if j == i else g for j, g in enumerate(self.gens))
+        return (o, CycleTimes(self.periods, gens, origin))
+
+    def update(self, test, ctx, event):
+        origin = self.origin if self.origin is not None else ctx.time
+        i, _, _ = self._window(ctx.time, origin)
+        gens = tuple(
+            to_gen(g).update(test, ctx, event) if j == i else g
+            for j, g in enumerate(self.gens)
+        )
+        return CycleTimes(self.periods, gens, origin)
+
+
+def cycle_times(*args) -> Gen:
+    """cycle_times(t1_seconds, g1, t2_seconds, g2, ...)."""
+    if len(args) % 2 != 0:
+        raise ValueError("cycle_times takes duration/gen pairs")
+    periods = tuple(s_to_ns(args[i]) for i in range(0, len(args), 2))
+    gens = tuple(to_gen(args[i]) for i in range(1, len(args), 2))
+    return CycleTimes(periods, gens, None)
+
+
+def validate(gen) -> Gen:
+    return Validate(to_gen(gen))
+
+
+def friendly_exceptions(gen) -> Gen:
+    return FriendlyExceptions(to_gen(gen))
+
+
+def trace(k, gen) -> Gen:
+    return Trace(k, to_gen(gen))
+
+
+def map_gen(f, gen) -> Gen:
+    return Map(f, to_gen(gen))
+
+
+def filter_gen(pred, gen) -> Gen:
+    return Filter(pred, to_gen(gen))

@@ -1,0 +1,182 @@
+"""Deterministic generator simulation — no threads, no wall clock.
+
+A pure re-implementation of the interpreter's scheduling loop with a
+pluggable *completion function* deciding how invocations complete, mirroring
+``jepsen.generator.test`` (reference: jepsen/src/jepsen/generator/test.clj,
+shipped in src/ precisely so downstream generator logic can be tested
+without hardware — SURVEY.md §4.2).
+
+``simulate(test, gen, completion_fn)`` returns the full simulated history.
+Completion functions map an invocation to its completion op (or None for
+invoke-only simulation):
+
+  quick         — invocations only; threads free immediately
+  perfect       — every op completes :ok exactly 10 ms later
+  perfect_info  — every op completes :info 10 ms later
+  imperfect     — rotates ok/info/fail with latencies 10/20/30 ms
+
+All randomness flows through the generator-module RNG, seeded with 45100
+(generator/test.clj:31-48) so schedules are byte-identical across runs.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Mapping
+
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu.generator import (
+    NEMESIS,
+    PENDING,
+    Context,
+    Gen,
+    context,
+    rand_seed,
+    s_to_ns,
+    to_gen,
+)
+
+LATENCY_NS = 10_000_000  # 10 ms, the reference's perfect latency
+
+
+def quick_completion(invoke_op: Mapping) -> Mapping | None:
+    """Invoke-only simulation (generator/test.clj:110-120)."""
+    return None
+
+
+def perfect_completion(invoke_op: Mapping) -> Mapping:
+    """Complete :ok after exactly 10 ms (generator/test.clj:122-138)."""
+    return {
+        **invoke_op,
+        "type": "ok",
+        "time": invoke_op["time"] + LATENCY_NS,
+    }
+
+
+def perfect_info_completion(invoke_op: Mapping) -> Mapping:
+    """Complete :info after 10 ms — worst-case for checkers
+    (generator/test.clj:140-152)."""
+    return {
+        **invoke_op,
+        "type": "info",
+        "time": invoke_op["time"] + LATENCY_NS,
+    }
+
+
+class ImperfectCompletion:
+    """Rotate ok → info → fail with latencies 10/20/30 ms
+    (generator/test.clj:154-182)."""
+
+    TYPES = ("ok", "info", "fail")
+
+    def __init__(self):
+        self.i = 0
+
+    def __call__(self, invoke_op: Mapping) -> Mapping:
+        t = self.TYPES[self.i % 3]
+        latency = LATENCY_NS * (1 + self.i % 3)
+        self.i += 1
+        return {**invoke_op, "type": t, "time": invoke_op["time"] + latency}
+
+
+def simulate(
+    test: Mapping,
+    gen,
+    completion_fn: Callable[[Mapping], Mapping | None] = perfect_completion,
+    ctx: Context | None = None,
+    max_ops: int = 100_000,
+    seed: int | None = gen_mod.DEFAULT_RAND_SEED,
+) -> list[dict]:
+    """Run the generator to exhaustion against a simulated perfect worker
+    pool; returns the history (generator/test.clj:50-108).
+
+    The loop mirrors interpreter scheduling: completions are processed
+    before any invocation scheduled at a later time; generators are pure so
+    "peeking" at an op and deciding to process a completion first simply
+    discards the speculative successor state.
+    """
+    if seed is not None:
+        rand_seed(seed)
+    g: Gen = to_gen(gen)
+    ctx = ctx if ctx is not None else context(test)
+    history: list[dict] = []
+    # Pending completions: heap of (time, tiebreak, completion_op)
+    pending: list[tuple] = []
+    tiebreak = 0
+
+    def process_completion():
+        nonlocal ctx, g
+        t, _, comp = heapq.heappop(pending)
+        ctx = ctx.with_time(max(ctx.time, t))
+        thread = ctx.thread_of(comp["process"])
+        if comp.get("type") != "sleep-wake":
+            history.append(comp)
+            g = g.update(test, ctx, comp)
+            if comp.get("type") == "info" and thread != NEMESIS:
+                # Crashed process: assign a fresh process id
+                # (interpreter.clj:233-236).
+                ctx = ctx.with_next_process(thread)
+        if thread is not None:
+            ctx = ctx.free_thread(thread)
+
+    while len(history) < max_ops:
+        r = g.op(test, ctx)
+        if r is None:
+            while pending:
+                process_completion()
+            break
+        op, g2 = r
+        if op is PENDING:
+            if not pending:
+                raise RuntimeError(
+                    f"deadlock: generator {g!r} is pending with no outstanding ops"
+                )
+            process_completion()
+            continue
+        t = op.get("time", ctx.time)
+        if pending and pending[0][0] <= t:
+            # A completion comes first; discard the speculative op.
+            process_completion()
+            continue
+        # Emit the invocation.
+        ctx = ctx.with_time(max(ctx.time, t))
+        g = g2.update(test, ctx, op)
+        thread = ctx.thread_of(op["process"])
+        ctx = ctx.busy_thread(thread)
+        history.append(op)
+        if op.get("type") == "sleep":
+            wake = {
+                "type": "sleep-wake",
+                "process": op["process"],
+                "time": t + s_to_ns(op.get("value") or 0),
+            }
+            heapq.heappush(pending, (wake["time"], tiebreak, wake))
+            tiebreak += 1
+        elif op.get("type") == "log":
+            ctx = ctx.free_thread(thread)
+        else:
+            comp = completion_fn(op)
+            if comp is None:
+                ctx = ctx.free_thread(thread)
+            else:
+                heapq.heappush(pending, (comp["time"], tiebreak, comp))
+                tiebreak += 1
+    return history
+
+
+def quick(test, gen, **kw) -> list[dict]:
+    """Invocations only (generator/test.clj:110-120)."""
+    return simulate(test, gen, quick_completion, **kw)
+
+
+def perfect(test, gen, **kw) -> list[dict]:
+    """Every op completes ok in 10 ms (generator/test.clj:122-138)."""
+    return simulate(test, gen, perfect_completion, **kw)
+
+
+def perfect_info(test, gen, **kw) -> list[dict]:
+    return simulate(test, gen, perfect_info_completion, **kw)
+
+
+def imperfect(test, gen, **kw) -> list[dict]:
+    return simulate(test, gen, ImperfectCompletion(), **kw)
