@@ -1,0 +1,113 @@
+"""Client protocol: how the harness talks to the system under test.
+
+Mirrors ``jepsen.client`` (reference: jepsen/src/jepsen/client.clj:9-27):
+a client has a five-phase lifecycle —
+
+  open(test, node)      -> a *connected* copy of this client bound to node
+  setup(test)           -> one-time data setup (schemas, tables)
+  invoke(test, op)      -> perform op, return its completion op
+  teardown(test)        -> undo setup
+  close(test)           -> release connections
+
+``invoke`` MUST return a completion of the same op: same :f, same :process,
+:type ∈ {ok, fail, info} (enforced by ValidatingClient, client.clj:64-109).
+A client marked ``reusable`` survives process crashes without being
+reopened (client.clj:29-34, interpreter.clj:33-67).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Mapping
+
+
+class Client:
+    """Base client. Subclasses override what they need; defaults are no-ops
+    that return self/op unchanged."""
+
+    #: If True, the interpreter reuses this client across process crashes
+    #: instead of close!/open! cycling it (client.clj:29-34).
+    reusable = False
+
+    def open(self, test: Mapping, node: str) -> "Client":
+        """Return a connected copy bound to node. Must not mutate self."""
+        return copy.copy(self)
+
+    def setup(self, test: Mapping) -> None:
+        pass
+
+    def invoke(self, test: Mapping, op: Mapping) -> Mapping:
+        raise NotImplementedError
+
+    def teardown(self, test: Mapping) -> None:
+        pass
+
+    def close(self, test: Mapping) -> None:
+        pass
+
+
+class NoopClient(Client):
+    """Does nothing; every op succeeds (client.clj:46-62)."""
+
+    reusable = True
+
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+
+def noop() -> Client:
+    return NoopClient()
+
+
+class ValidatingClient(Client):
+    """Wraps a client, enforcing the completion invariants
+    (client.clj:64-109): completion has the same :f and :process as the
+    invocation and a legal completion :type."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    @property
+    def reusable(self):  # type: ignore[override]
+        return self.client.reusable
+
+    def open(self, test, node):
+        return ValidatingClient(self.client.open(test, node))
+
+    def setup(self, test):
+        self.client.setup(test)
+
+    def invoke(self, test, op):
+        comp = self.client.invoke(test, op)
+        problems = []
+        if not isinstance(comp, Mapping):
+            problems.append(f"completion should be a map, was {comp!r}")
+        else:
+            if comp.get("type") not in ("ok", "fail", "info"):
+                problems.append(f"bad completion :type {comp.get('type')!r}")
+            if comp.get("f") != op.get("f"):
+                problems.append(
+                    f"completion :f {comp.get('f')!r} != invocation :f {op.get('f')!r}"
+                )
+            if comp.get("process") != op.get("process"):
+                problems.append(
+                    f"completion :process {comp.get('process')!r} != "
+                    f"invocation :process {op.get('process')!r}"
+                )
+        if problems:
+            raise ValueError(f"invalid completion {comp!r} for {op!r}: {problems}")
+        return comp
+
+    def teardown(self, test):
+        self.client.teardown(test)
+
+    def close(self, test):
+        self.client.close(test)
+
+
+def validate(client: Client) -> Client:
+    return ValidatingClient(client)
+
+
+def closable(c: Any) -> bool:
+    return isinstance(c, Client)

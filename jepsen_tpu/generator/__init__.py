@@ -36,6 +36,7 @@ extensions (generator.clj:545-590):
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import logging
 import random
 from typing import Any, Callable, Iterable, Mapping, Sequence
@@ -254,28 +255,48 @@ class _Fn(Gen):
     f: Callable
 
     def op(self, test, ctx):
-        x = _call_flex(self.f, test, ctx)
-        if x is None:
-            return None
-        # The result runs first, then this function again.
-        g = _Seq((to_gen(x), self))
-        return g.op(test, ctx)
+        # Iterative, not recursive: an fn may return an immediately-exhausted
+        # generator (e.g. []), in which case we just call it again.
+        for _ in range(100_000):
+            x = _call_flex(self.f, test, ctx)
+            if x is None:
+                return None
+            r = to_gen(x).op(test, ctx)
+            if r is None:
+                continue
+            o, g2 = r
+            # The result runs to completion first, then this fn again.
+            return (o, _Seq((g2, self)))
+        raise RuntimeError(
+            f"function generator {self.f!r} keeps returning exhausted generators"
+        )
 
     def update(self, test, ctx, event):
         return self
 
 
+def _positional_arity(f) -> int | None:
+    """Number of required positional params, or None if uninspectable /
+    varargs (meaning: pass everything)."""
+    try:
+        sig = inspect.signature(f)
+    except (TypeError, ValueError):
+        return None
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind is p.VAR_POSITIONAL:
+            return None
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and p.default is p.empty:
+            n += 1
+    return n
+
+
 def _call_flex(f, test, ctx):
-    try:
+    n = _positional_arity(f)
+    if n is None or n >= 2:
         return f(test, ctx)
-    except TypeError as e:
-        if "positional argument" not in str(e):
-            raise
-    try:
+    if n == 1:
         return f(test)
-    except TypeError as e:
-        if "positional argument" not in str(e):
-            raise
     return f()
 
 
@@ -611,6 +632,7 @@ class EachThread(Gen):
 
     def op(self, test, ctx):
         candidates = []
+        exhausted = []
         for t in ctx.all_threads():
             g = self._copy_for(t)
             if g is None:
@@ -618,6 +640,7 @@ class EachThread(Gen):
             sub = ctx.restrict(lambda x, t=t: x == t)
             r = to_gen(g).op(test, sub)
             if r is None:
+                exhausted.append(t)
                 continue
             o, g2 = r
             candidates.append({"op": o, "gen": g2, "t": t})
@@ -625,6 +648,8 @@ class EachThread(Gen):
             return None
         best = soonest_op_map(candidates)
         copies = dict(self.copies)
+        for t in exhausted:
+            copies[t] = None
         copies[best["t"]] = best["gen"]
         return (best["op"], EachThread(self.fresh, copies))
 
@@ -1134,12 +1159,11 @@ class CycleTimes(Gen):
         return (o, CycleTimes(self.periods, gens, origin))
 
     def update(self, test, ctx, event):
+        # Broadcast: a completion may arrive in a different window than its
+        # invocation, so routing by the event's window would update the
+        # wrong child (the reference slices gens per window instead).
         origin = self.origin if self.origin is not None else ctx.time
-        i, _, _ = self._window(ctx.time, origin)
-        gens = tuple(
-            to_gen(g).update(test, ctx, event) if j == i else g
-            for j, g in enumerate(self.gens)
-        )
+        gens = tuple(to_gen(g).update(test, ctx, event) for g in self.gens)
         return CycleTimes(self.periods, gens, origin)
 
 

@@ -1,0 +1,274 @@
+"""Interpreter: executes a generator against real clients and a nemesis.
+
+Mirrors ``jepsen.generator.interpreter`` (reference:
+jepsen/src/jepsen/generator/interpreter.clj): one OS thread per worker
+(concurrency client workers + the nemesis), each fed by a 1-slot input
+queue, all completing into a shared completion queue; a single-threaded
+scheduling loop asks the generator for ops, dispatches them at their
+scheduled times, and folds completions back into the generator state
+(interpreter.clj:181-310).
+
+Key semantics preserved:
+
+  * completions are polled *before* new ops — they're latency-sensitive
+    (interpreter.clj:206-241)
+  * any Throwable from a client becomes an :info completion with an
+    "indeterminate" error — the op may or may not have taken effect
+    (interpreter.clj:142-157)
+  * a client thread whose op crashed gets a fresh process id, and its
+    client is close!/open!-cycled unless reusable (interpreter.clj:33-67,
+    233-236)
+  * :sleep and :log ops are executed in-worker and excluded from the
+    history (interpreter.clj:172-179)
+  * PENDING polls at 1 ms (max-pending-interval, interpreter.clj:166-170)
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Mapping
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import NEMESIS, PENDING, Context
+from jepsen_tpu.utils import relative_time_nanos
+
+logger = logging.getLogger(__name__)
+
+#: interpreter.clj:166-170 — how long to block on the completion queue while
+#: the generator is pending.
+MAX_PENDING_INTERVAL_S = 0.001
+
+_EXIT = {"type": "exit"}
+
+#: Op types executed in-worker but excluded from history and generator
+#: updates (interpreter.clj:172-179).
+_SPECIAL_TYPES = ("sleep", "log", "sleep-done", "log-done")
+
+
+def goes_in_history(op: Mapping) -> bool:
+    return op.get("type") not in _SPECIAL_TYPES
+
+
+class Worker:
+    """Worker protocol (interpreter.clj:19-31)."""
+
+    def open(self, test, wid):
+        return self
+
+    def invoke(self, test, op) -> Mapping:
+        raise NotImplementedError
+
+    def close(self, test):
+        pass
+
+
+class ClientWorker(Worker):
+    """Wraps a Client; reopens it when its process changes, unless the
+    client is reusable (interpreter.clj:33-67)."""
+
+    def __init__(self, node: str, client: jclient.Client):
+        self.node = node
+        self.base = client
+        self.conn: jclient.Client | None = None
+        self.process: Any = None
+
+    def open(self, test, wid):
+        self.conn = self.base.open(test, self.node)
+        return self
+
+    def invoke(self, test, op):
+        if self.process != op["process"]:
+            if not self.base.reusable and self.process is not None:
+                try:
+                    if self.conn is not None:
+                        self.conn.close(test)
+                except Exception:  # noqa: BLE001
+                    logger.exception("error closing crashed client on %s", self.node)
+                self.conn = None
+            if self.conn is None:
+                self.conn = self.base.open(test, self.node)
+            self.process = op["process"]
+        return self.conn.invoke(test, op)
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close(test)
+            self.conn = None
+
+
+class NemesisWorker(Worker):
+    """The nemesis is shared state set up by the orchestrator; the worker
+    just routes ops to it (interpreter.clj:69-97)."""
+
+    def __init__(self, nemesis):
+        self.nemesis = nemesis
+
+    def invoke(self, test, op):
+        return self.nemesis.invoke(test, op)
+
+
+def client_nodes(test: Mapping) -> list:
+    nodes = list(test.get("nodes") or ["local"])
+    return nodes
+
+
+def _spawn_worker(test, wid, worker: Worker, completions: queue.Queue):
+    """Worker thread: take an op, run it, put the completion
+    (interpreter.clj:99-164).  Any Throwable becomes an :info completion
+    with an indeterminate error."""
+    inq: queue.Queue = queue.Queue(maxsize=1)
+
+    def loop():
+        try:
+            worker.open(test, wid)
+        except Exception:  # noqa: BLE001
+            logger.exception("worker %s failed to open", wid)
+        while True:
+            op = inq.get()
+            if op is _EXIT:
+                try:
+                    worker.close(test)
+                except Exception:  # noqa: BLE001
+                    logger.exception("worker %s failed to close", wid)
+                return
+            t = op.get("type")
+            if t == "sleep":
+                import time as _t
+
+                _t.sleep(op.get("value") or 0)
+                completions.put({**op, "type": "sleep-done"})
+            elif t == "log":
+                logger.info("%s", op.get("value"))
+                completions.put({**op, "type": "log-done"})
+            else:
+                try:
+                    comp = worker.invoke(test, op)
+                except Exception as e:  # noqa: BLE001 - op is indeterminate
+                    logger.debug("worker %s crashed on %s", wid, op, exc_info=True)
+                    comp = {
+                        **op,
+                        "type": "info",
+                        "error": f"indeterminate: {type(e).__name__}: {e}",
+                    }
+                completions.put(comp)
+
+    thread = threading.Thread(target=loop, name=f"jepsen-worker-{wid}", daemon=True)
+    thread.start()
+    return inq, thread
+
+
+def run(test: Mapping) -> list[dict]:
+    """Run the test's generator to completion against its client and
+    nemesis; returns the history (interpreter.clj:181-310).
+
+    Requires an active ``utils.relative_time`` scope (the orchestrator
+    establishes one; tests may use ``with relative_time():``).
+    """
+    ctx: Context = gen.context(test)
+    g = gen.validate(gen.friendly_exceptions(gen.to_gen(test.get("generator"))))
+    nodes = client_nodes(test)
+    completions: queue.Queue = queue.Queue()
+
+    workers: dict[Any, tuple[queue.Queue, threading.Thread]] = {}
+    for thread_id in sorted(ctx.all_threads(), key=gen._thread_sort_key):
+        if thread_id == NEMESIS:
+            w: Worker = NemesisWorker(test.get("nemesis") or _noop_nemesis())
+        else:
+            w = ClientWorker(
+                nodes[thread_id % len(nodes)],
+                test.get("client") or jclient.noop(),
+            )
+        workers[thread_id] = _spawn_worker(test, thread_id, w, completions)
+
+    history: list[dict] = []
+    outstanding = 0
+
+    def process_completion(comp):
+        nonlocal ctx, g, outstanding
+        comp = dict(comp)
+        comp["time"] = relative_time_nanos()
+        thread_id = ctx.thread_of(comp["process"])
+        if goes_in_history(comp):
+            history.append(comp)
+            g2 = g.update(test, ctx, comp)
+        else:
+            g2 = g
+        if (
+            comp.get("type") == "info"
+            and thread_id is not None
+            and thread_id != NEMESIS
+        ):
+            # Crashed: the thread continues under a fresh process id
+            # (interpreter.clj:233-236).
+            ctx = ctx.with_next_process(thread_id)
+        if thread_id is not None:
+            ctx = ctx.free_thread(thread_id)
+        g = g2
+        outstanding -= 1
+
+    try:
+        while True:
+            # Priority 1: completions (interpreter.clj:206-241).
+            try:
+                comp = completions.get_nowait()
+            except queue.Empty:
+                comp = None
+            if comp is not None:
+                process_completion(comp)
+                continue
+
+            ctx = ctx.with_time(relative_time_nanos())
+            r = g.op(test, ctx)
+            if r is None:
+                if outstanding == 0:
+                    break
+                process_completion(completions.get())
+                continue
+            op, g2 = r
+            if op is PENDING:
+                try:
+                    process_completion(completions.get(timeout=MAX_PENDING_INTERVAL_S))
+                except queue.Empty:
+                    pass
+                continue
+            now = relative_time_nanos()
+            due = op.get("time", now)
+            if due > now:
+                # Not yet due: wait, but service completions meanwhile
+                # (interpreter.clj:268-275).  Discard the speculative g2.
+                try:
+                    process_completion(
+                        completions.get(timeout=min((due - now) / 1e9, 0.01))
+                    )
+                except queue.Empty:
+                    pass
+                continue
+            # Dispatch.
+            op = dict(op)
+            op["time"] = now
+            thread_id = ctx.thread_of(op["process"])
+            inq, _ = workers[thread_id]
+            ctx = ctx.busy_thread(thread_id)
+            if goes_in_history(op):
+                history.append(op)
+                g = g2.update(test, ctx, op)
+            else:
+                g = g2
+            inq.put(op)
+            outstanding += 1
+    finally:
+        for inq, _ in workers.values():
+            inq.put(_EXIT)
+        for _, t in workers.values():
+            t.join(timeout=10)
+
+    return history
+
+
+def _noop_nemesis():
+    from jepsen_tpu import nemesis as nem
+
+    return nem.noop()

@@ -138,12 +138,16 @@ def simulate(
             # A completion comes first; discard the speculative op.
             process_completion()
             continue
-        # Emit the invocation.
+        # Emit the invocation.  sleep/log are excluded from history and
+        # updates, exactly like the interpreter (interpreter.clj:172-179).
         ctx = ctx.with_time(max(ctx.time, t))
-        g = g2.update(test, ctx, op)
         thread = ctx.thread_of(op["process"])
         ctx = ctx.busy_thread(thread)
-        history.append(op)
+        if op.get("type") in ("sleep", "log"):
+            g = g2
+        else:
+            g = g2.update(test, ctx, op)
+            history.append(op)
         if op.get("type") == "sleep":
             wake = {
                 "type": "sleep-wake",

@@ -1,0 +1,408 @@
+"""Nemesis: fault injection into the system under test.
+
+Mirrors ``jepsen.nemesis`` (reference: jepsen/src/jepsen/nemesis.clj).  A
+nemesis is a special client bound to the whole cluster rather than one node
+(nemesis.clj:11-16):
+
+  setup(test)       -> prepared nemesis
+  invoke(test, op)  -> perform a fault op, return its completion
+  teardown(test)
+
+``fs()`` (the Reflection protocol, nemesis.clj:18-21) reports which :f
+values this nemesis handles, enabling ``compose`` to route ops by :f
+(nemesis.clj:334-428).
+
+The partition *grudge* math (who refuses traffic from whom) is pure and
+lives here: bisect, split_one, complete_grudge, bridge, majorities_ring
+(nemesis.clj:108-281).  Network manipulation itself goes through the test's
+``net`` (jepsen_tpu.net) over the control layer.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+from jepsen_tpu.utils import majority, real_pmap
+
+
+class Nemesis:
+    """Base nemesis; the default does nothing (nemesis.clj:28-47)."""
+
+    def setup(self, test: Mapping) -> "Nemesis":
+        return self
+
+    def invoke(self, test: Mapping, op: Mapping) -> Mapping:
+        return {**op, "type": "info"}
+
+    def teardown(self, test: Mapping) -> None:
+        pass
+
+    def fs(self) -> set:
+        """Which :f values this nemesis handles (nemesis.clj:18-21)."""
+        return set()
+
+
+class NoopNemesis(Nemesis):
+    pass
+
+
+def noop() -> Nemesis:
+    return NoopNemesis()
+
+
+class ValidatingNemesis(Nemesis):
+    """Completion must match the invocation's :f and :process
+    (nemesis.clj:49-84)."""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        return ValidatingNemesis(self.nemesis.setup(test))
+
+    def invoke(self, test, op):
+        comp = self.nemesis.invoke(test, op)
+        if not isinstance(comp, Mapping) or comp.get("f") != op.get("f") or comp.get(
+            "process"
+        ) != op.get("process"):
+            raise ValueError(f"invalid nemesis completion {comp!r} for {op!r}")
+        return comp
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return self.nemesis.fs()
+
+
+def validate(nemesis: Nemesis) -> Nemesis:
+    return ValidatingNemesis(nemesis)
+
+
+class TimeoutNemesis(Nemesis):
+    """Cap invoke at dt seconds; on timeout return an :info completion noting
+    the timeout rather than blocking the nemesis thread forever
+    (nemesis.clj:92-106)."""
+
+    def __init__(self, dt: float, nemesis: Nemesis):
+        self.dt = dt
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        return TimeoutNemesis(self.dt, self.nemesis.setup(test))
+
+    def invoke(self, test, op):
+        result: list = []
+
+        def run():
+            try:
+                result.append(self.nemesis.invoke(test, op))
+            except Exception as e:  # noqa: BLE001 - reported via completion
+                result.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(self.dt)
+        if not result:
+            return {**op, "type": "info", "value": f"timed out after {self.dt} s"}
+        if isinstance(result[0], Exception):
+            raise result[0]
+        return result[0]
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return self.nemesis.fs()
+
+
+def timeout(dt: float, nemesis: Nemesis) -> Nemesis:
+    return TimeoutNemesis(dt, nemesis)
+
+
+# ---------------------------------------------------------------------------
+# Partition grudge math (pure; nemesis.clj:108-281)
+# ---------------------------------------------------------------------------
+
+
+def bisect(coll: Sequence) -> tuple[list, list]:
+    """Split a collection into two halves, first smaller on odd sizes
+    (nemesis.clj:108-113)."""
+    xs = list(coll)
+    mid = len(xs) // 2
+    return xs[:mid], xs[mid:]
+
+
+def split_one(coll: Sequence, node=None) -> tuple[list, list]:
+    """Isolate one node (random unless given) from the rest
+    (nemesis.clj:115-123)."""
+    xs = list(coll)
+    if node is None:
+        node = random.choice(xs)
+    return [node], [x for x in xs if x != node]
+
+
+def complete_grudge(components: Sequence[Sequence]) -> dict:
+    """Given components, a map node -> set of nodes it should refuse traffic
+    from: everyone outside its own component (nemesis.clj:125-135)."""
+    comps = [list(c) for c in components]
+    all_nodes = [n for c in comps for n in c]
+    grudge = {}
+    for c in comps:
+        others = {n for n in all_nodes if n not in c}
+        for n in c:
+            grudge[n] = set(others)
+    return grudge
+
+
+def invert_grudge(grudge: Mapping) -> dict:
+    """Flip a grudge: nodes cut from each other stay connected and vice
+    versa (nemesis.clj:137-144)."""
+    nodes = sorted(grudge)
+    out: dict = {n: set() for n in nodes}
+    for a in nodes:
+        for b in nodes:
+            if a != b and b not in grudge.get(a, set()):
+                out[a].add(b)
+    return out
+
+
+def bridge(nodes: Sequence) -> dict:
+    """Two components joined by a single bridge node that can see both
+    (nemesis.clj:146-155)."""
+    xs = list(nodes)
+    n = len(xs) // 2
+    bridge_node = xs[n]
+    a, b = xs[:n], xs[n + 1 :]
+    grudge = {}
+    for x in a:
+        grudge[x] = set(b)
+    for x in b:
+        grudge[x] = set(a)
+    grudge[bridge_node] = set()
+    return grudge
+
+
+def majorities_ring(nodes: Sequence) -> dict:
+    """Every node sees a majority, but no two majorities agree: each node
+    grudges the (n - majority) nodes 'opposite' it on a ring.  Exact for
+    ≤ 5 nodes, stochastic beyond (nemesis.clj:202-275)."""
+    xs = list(nodes)
+    n = len(xs)
+    if n <= 5:
+        m = majority(n)
+        shuffled = list(xs)
+        random.shuffle(shuffled)
+        grudge = {}
+        for i, node in enumerate(shuffled):
+            # Node i keeps itself + the next m-1 clockwise; grudges the rest.
+            keep = {shuffled[(i + d) % n] for d in range(m)}
+            grudge[node] = {x for x in shuffled if x not in keep}
+        return grudge
+    # Stochastic variant: random ring, each node keeps a majority window.
+    shuffled = list(xs)
+    random.shuffle(shuffled)
+    m = majority(n)
+    grudge = {}
+    for i, node in enumerate(shuffled):
+        half = (m - 1) // 2
+        keep = {shuffled[(i + d) % n] for d in range(-half, m - half)}
+        grudge[node] = {x for x in shuffled if x not in keep}
+    return grudge
+
+
+# ---------------------------------------------------------------------------
+# Partitioner nemeses (nemesis.clj:157-281)
+# ---------------------------------------------------------------------------
+
+
+class Partitioner(Nemesis):
+    """Respond to ``{:f :start}`` by partitioning the network per
+    grudge(nodes) and ``{:f :stop}`` by healing (nemesis.clj:157-183).
+
+    ``grudge_fn(nodes) -> grudge dict`` chooses the partition shape; the
+    start op may carry an explicit grudge in :value.
+    """
+
+    def __init__(self, grudge_fn: Callable | None = None, start_f="start", stop_f="stop"):
+        self.grudge_fn = grudge_fn
+        self.start_f = start_f
+        self.stop_f = stop_f
+
+    def setup(self, test):
+        test["net"].heal(test)
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == self.start_f:
+            grudge = op.get("value") or (
+                self.grudge_fn(list(test["nodes"])) if self.grudge_fn else None
+            )
+            if grudge is None:
+                raise ValueError("partition start op needs a grudge")
+            test["net"].drop_all(test, grudge)
+            desc = {n: sorted(g) for n, g in grudge.items()}
+            return {**op, "type": "info", "value": f"Cut off {desc}"}
+        if f == self.stop_f:
+            test["net"].heal(test)
+            return {**op, "type": "info", "value": "fully connected"}
+        raise ValueError(f"partitioner doesn't understand :f {f!r}")
+
+    def teardown(self, test):
+        test["net"].heal(test)
+
+    def fs(self):
+        return {self.start_f, self.stop_f}
+
+
+def partitioner(grudge_fn=None) -> Nemesis:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Nemesis:
+    """Cut the network in half (nemesis.clj:185-192)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves() -> Nemesis:
+    """Random halves each time (nemesis.clj:194-200)."""
+
+    def g(nodes):
+        xs = list(nodes)
+        random.shuffle(xs)
+        return complete_grudge(bisect(xs))
+
+    return Partitioner(g)
+
+
+def partition_random_node() -> Nemesis:
+    """Isolate a single random node (nemesis.clj:185-190)."""
+    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+
+
+def partition_majorities_ring() -> Nemesis:
+    """Intersecting-majorities ring partition (nemesis.clj:202-275)."""
+    return Partitioner(majorities_ring)
+
+
+# ---------------------------------------------------------------------------
+# Composition (nemesis.clj:285-428)
+# ---------------------------------------------------------------------------
+
+
+class FMapNemesis(Nemesis):
+    """Rename the :f vocabulary of a nemesis via bijection m
+    (nemesis.clj:285-327)."""
+
+    def __init__(self, m: Mapping, nemesis: Nemesis):
+        self.m = dict(m)
+        self.inv = {v: k for k, v in self.m.items()}
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        return FMapNemesis(self.m, self.nemesis.setup(test))
+
+    def invoke(self, test, op):
+        inner_op = {**op, "f": self.inv.get(op.get("f"), op.get("f"))}
+        comp = self.nemesis.invoke(test, inner_op)
+        return {**comp, "f": self.m.get(comp.get("f"), comp.get("f"))}
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return {self.m.get(f, f) for f in self.nemesis.fs()}
+
+
+def f_map(m: Mapping, nemesis: Nemesis) -> Nemesis:
+    return FMapNemesis(m, nemesis)
+
+
+class ComposedNemesis(Nemesis):
+    """Route each op to the nemesis whose fs() contains its :f
+    (nemesis.clj:334-428).  Also accepts explicit {fset: nemesis} maps."""
+
+    def __init__(self, nemeses: Sequence[Nemesis] | Mapping):
+        if isinstance(nemeses, Mapping):
+            self.routes = [(frozenset(fs_), n) for fs_, n in nemeses.items()]
+        else:
+            self.routes = [(frozenset(n.fs()), n) for n in nemeses]
+
+    def _route(self, f):
+        for fs_, n in self.routes:
+            if f in fs_:
+                return n
+        raise ValueError(
+            f"no nemesis handles :f {f!r} (routes: {[sorted(fs_) for fs_, _ in self.routes]})"
+        )
+
+    def setup(self, test):
+        routes = [(fs_, n.setup(test)) for fs_, n in self.routes]
+        out = ComposedNemesis([])
+        out.routes = routes
+        return out
+
+    def invoke(self, test, op):
+        return self._route(op.get("f")).invoke(test, op)
+
+    def teardown(self, test):
+        for _, n in self.routes:
+            n.teardown(test)
+
+    def fs(self):
+        out: set = set()
+        for fs_, _ in self.routes:
+            out |= fs_
+        return out
+
+
+def compose(nemeses) -> Nemesis:
+    return ComposedNemesis(nemeses)
+
+
+# ---------------------------------------------------------------------------
+# Process-wrangling nemeses (nemesis.clj:435-539) — need the control layer;
+# they accept the test map's db/control handles at invoke time.
+# ---------------------------------------------------------------------------
+
+
+class NodeStartStopper(Nemesis):
+    """:start → run start_fn on targeted nodes (degrade, e.g. kill the db);
+    :stop → run stop_fn (restore, e.g. restart it) (nemesis.clj:452-495).
+
+    ``targeter(test, nodes) -> nodes`` picks victims each :start.
+    """
+
+    def __init__(self, targeter, start_fn, stop_fn, start_f="start", stop_f="stop"):
+        self.targeter = targeter
+        self.start_fn = start_fn  # invoked on :start ops (degrade)
+        self.stop_fn = stop_fn  # invoked on :stop ops (restore)
+        self.start_f = start_f
+        self.stop_f = stop_f
+        self.affected: list = []
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == self.start_f:
+            nodes = list(self.targeter(test, list(test["nodes"])))
+            res = dict(
+                real_pmap(lambda n: (n, self.start_fn(test, n)), nodes)
+            )
+            self.affected = nodes
+            return {**op, "type": "info", "value": res}
+        if f == self.stop_f:
+            nodes = self.affected or list(test["nodes"])
+            res = dict(real_pmap(lambda n: (n, self.stop_fn(test, n)), nodes))
+            self.affected = []
+            return {**op, "type": "info", "value": res}
+        raise ValueError(f"node-start-stopper doesn't understand :f {f!r}")
+
+    def fs(self):
+        return {self.start_f, self.stop_f}
+
+
+def node_start_stopper(targeter, start_fn, stop_fn) -> Nemesis:
+    return NodeStartStopper(targeter, start_fn, stop_fn)

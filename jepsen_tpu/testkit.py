@@ -1,0 +1,103 @@
+"""Self-test scaffolding: a no-op base test map and an in-memory backend.
+
+Mirrors ``jepsen.tests`` (reference: jepsen/src/jepsen/tests.clj): the
+``noop_test`` base map (tests.clj:14-26), plus an in-memory ``AtomDB`` /
+``AtomClient`` CAS register over a lock-guarded cell (tests.clj:29-67).
+Combined with the dummy remote (control layer), the *entire* pipeline —
+interpreter, history, checker, store — runs on one machine with no cluster
+(SURVEY.md §4.3; core_test.clj:62-120 is the pattern).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping
+
+from jepsen_tpu import client as jclient
+
+
+def noop_test(**overrides) -> dict:
+    """A test map with everything stubbed (tests.clj:14-26)."""
+    base: dict[str, Any] = {
+        "name": "noop",
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "client": jclient.noop(),
+        "nemesis": None,
+        "generator": None,
+        "checker": None,
+        "os": None,
+        "db": None,
+        "ssh": {"dummy?": True},
+        "start-time": None,
+    }
+    base.update(overrides)
+    return base
+
+
+class AtomCell:
+    """The shared 'database': one lock-guarded value (tests.clj:29-34)."""
+
+    def __init__(self, value=None):
+        self.lock = threading.Lock()
+        self.value = value
+
+    def read(self):
+        with self.lock:
+            return self.value
+
+    def write(self, v):
+        with self.lock:
+            self.value = v
+            return True
+
+    def cas(self, old, new) -> bool:
+        with self.lock:
+            if self.value == old:
+                self.value = new
+                return True
+            return False
+
+
+class AtomClient(jclient.Client):
+    """CAS-register client over an AtomCell (tests.clj:36-67).
+
+    Ops: {:f :read} / {:f :write, :value v} / {:f :cas, :value [old new]}.
+    """
+
+    reusable = False
+
+    def __init__(self, cell: AtomCell):
+        self.cell = cell
+        self.opened = False
+        #: bookkeeping asserted by tests (core_test.clj:62-120)
+        self.stats = {"opens": 0, "closes": 0}
+
+    def open(self, test, node):
+        c = AtomClient(self.cell)
+        c.stats = self.stats
+        c.opened = True
+        self.stats["opens"] += 1
+        return c
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "read":
+            return {**op, "type": "ok", "value": self.cell.read()}
+        if f == "write":
+            self.cell.write(op["value"])
+            return {**op, "type": "ok"}
+        if f == "cas":
+            old, new = op["value"]
+            ok = self.cell.cas(old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        raise ValueError(f"atom client doesn't understand :f {f!r}")
+
+    def close(self, test):
+        if self.opened:
+            self.stats["closes"] += 1
+            self.opened = False
+
+
+def atom_client(initial=None) -> AtomClient:
+    return AtomClient(AtomCell(initial))

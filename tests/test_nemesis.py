@@ -1,0 +1,156 @@
+"""Nemesis partition-math and composition tests (nemesis_test.clj)."""
+
+import pytest
+
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.utils import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def test_bisect():
+    assert nem.bisect(NODES) == (["n1", "n2"], ["n3", "n4", "n5"])
+    assert nem.bisect(["a", "b"]) == (["a"], ["b"])
+
+
+def test_split_one():
+    one, rest = nem.split_one(NODES, "n3")
+    assert one == ["n3"]
+    assert sorted(rest) == ["n1", "n2", "n4", "n5"]
+
+
+def test_complete_grudge():
+    g = nem.complete_grudge(nem.bisect(["a", "b", "c", "d"]))
+    assert g["a"] == {"c", "d"}
+    assert g["c"] == {"a", "b"}
+    # Symmetric: a grudges c iff c grudges a.
+    for x in g:
+        for y in g[x]:
+            assert x in g[y]
+
+
+def test_invert_grudge():
+    g = nem.complete_grudge(nem.bisect(["a", "b", "c", "d"]))
+    inv = nem.invert_grudge(g)
+    assert inv["a"] == {"b"}
+    assert inv["c"] == {"d"}
+
+
+def test_bridge():
+    g = nem.bridge(NODES)
+    # n3 is the bridge: sees everyone.
+    assert g["n3"] == set()
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+
+
+def test_majorities_ring_properties():
+    for n_nodes in (3, 5, 7):
+        nodes = [f"n{i}" for i in range(n_nodes)]
+        g = nem.majorities_ring(nodes)
+        m = majority(n_nodes)
+        for node in nodes:
+            # Every node sees a majority (itself + unblocked peers).
+            visible = n_nodes - len(g[node])
+            assert visible >= m, f"{node} sees only {visible}/{n_nodes}"
+            assert node not in g[node]
+
+
+class FakeNet:
+    def __init__(self):
+        self.grudge = None
+        self.heals = 0
+
+    def drop_all(self, test, grudge):
+        self.grudge = grudge
+
+    def heal(self, test):
+        self.grudge = None
+        self.heals += 1
+
+
+def test_partitioner_start_stop():
+    net = FakeNet()
+    test = {"nodes": NODES, "net": net}
+    p = nem.partition_halves().setup(test)
+    comp = p.invoke(test, {"f": "start", "process": "nemesis", "type": "invoke"})
+    assert comp["type"] == "info"
+    assert net.grudge is not None
+    assert net.grudge["n1"] == {"n3", "n4", "n5"}
+    comp = p.invoke(test, {"f": "stop", "process": "nemesis", "type": "invoke"})
+    assert net.grudge is None
+
+
+def test_f_map_renames_and_routes():
+    net = FakeNet()
+    test = {"nodes": NODES, "net": net}
+    p = nem.f_map(
+        {"start": "start-partition", "stop": "stop-partition"}, nem.partition_halves()
+    )
+    assert p.fs() == {"start-partition", "stop-partition"}
+    comp = p.invoke(
+        test, {"f": "start-partition", "process": "nemesis", "type": "invoke"}
+    )
+    assert comp["f"] == "start-partition"
+    assert net.grudge is not None
+
+
+def test_compose_routes_by_f():
+    net = FakeNet()
+    test = {"nodes": NODES, "net": net}
+    calls = []
+
+    class Killer(nem.Nemesis):
+        def invoke(self, test, op):
+            calls.append(op["f"])
+            return {**op, "type": "info"}
+
+        def fs(self):
+            return {"kill", "restart"}
+
+    composed = nem.compose(
+        [
+            Killer(),
+            nem.f_map(
+                {"start": "start-partition", "stop": "stop-partition"},
+                nem.partition_halves(),
+            ),
+        ]
+    ).setup(test)
+    composed.invoke(test, {"f": "kill", "process": "nemesis", "type": "invoke"})
+    composed.invoke(
+        test, {"f": "start-partition", "process": "nemesis", "type": "invoke"}
+    )
+    assert calls == ["kill"]
+    assert net.grudge is not None
+    with pytest.raises(ValueError):
+        composed.invoke(test, {"f": "nonsense", "process": "nemesis", "type": "invoke"})
+
+
+def test_node_start_stopper():
+    events = []
+    n = nem.node_start_stopper(
+        lambda test, nodes: nodes[:1],
+        lambda test, node: events.append(("down", node)) or "killed",
+        lambda test, node: events.append(("up", node)) or "restarted",
+    )
+    test = {"nodes": NODES}
+    c1 = n.invoke(test, {"f": "start", "process": "nemesis", "type": "invoke"})
+    assert c1["value"] == {"n1": "killed"}
+    c2 = n.invoke(test, {"f": "stop", "process": "nemesis", "type": "invoke"})
+    assert c2["value"] == {"n1": "restarted"}
+    assert events == [("down", "n1"), ("up", "n1")]
+
+
+def test_timeout_nemesis():
+    import time
+
+    class Slow(nem.Nemesis):
+        def invoke(self, test, op):
+            time.sleep(5)
+            return {**op, "type": "info"}
+
+    t = nem.timeout(0.05, Slow())
+    comp = t.invoke({}, {"f": "start", "process": "nemesis", "type": "invoke"})
+    assert comp["type"] == "info"
+    assert "timed out" in comp["value"]
