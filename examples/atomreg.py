@@ -1,0 +1,68 @@
+"""Minimal example harness: an in-memory CAS register "database".
+
+The structural model is the reference's tutorial-grade zookeeper harness
+(zookeeper/src/jepsen/zookeeper.clj:106-137): build a test map from CLI
+opts + a client + generator + checker, then hand it to the CLI.  Here the
+"database" is jepsen_tpu.testkit's atom register, so the whole pipeline —
+generator, interpreter, history, linearizability checking, store, web —
+runs on one machine with the dummy remote:
+
+  python examples/atomreg.py test --no-ssh --time-limit 5
+  python examples/atomreg.py analyze --no-ssh
+  python examples/atomreg.py serve
+"""
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jepsen_tpu import cli, generator as gen, models, testkit
+from jepsen_tpu.checker import compose, stats
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.checker.timeline import timeline_checker
+
+
+def workload():
+    rng = random.Random()
+
+    def one():
+        k = rng.random()
+        if k < 0.4:
+            return {"f": "read"}
+        if k < 0.8:
+            return {"f": "write", "value": rng.randint(0, 4)}
+        return {"f": "cas", "value": [rng.randint(0, 4), rng.randint(0, 4)]}
+
+    return one
+
+
+def atomreg_test(opts):
+    return testkit.noop_test(
+        name="atomreg",
+        nodes=opts["nodes"],
+        concurrency=opts["concurrency"],
+        ssh=opts["ssh"],
+        client=testkit.atom_client(),
+        generator=gen.clients(
+            gen.time_limit(
+                min(opts.get("time-limit", 10), 10),
+                gen.stagger(0.005, gen.repeat(workload())),
+            )
+        ),
+        checker=compose(
+            {
+                "stats": stats(),
+                "linear": linearizable(
+                    {"model": models.CASRegister(None), "algorithm": "competition"}
+                ),
+                "timeline": timeline_checker(),
+            }
+        ),
+        **({"store-dir": opts["store-dir"]} if opts.get("store-dir") else {}),
+    )
+
+
+if __name__ == "__main__":
+    cli.main(atomreg_test)

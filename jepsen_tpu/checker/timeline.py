@@ -1,0 +1,108 @@
+"""Timeline checker: a static HTML gantt of operations per process.
+
+Mirrors ``jepsen.checker.timeline`` (reference:
+jepsen/src/jepsen/checker/timeline.clj): pairs invocations with their
+completions (timeline.clj:38), renders one column per process with
+color-coded op bars, and caps rendering at 10,000 ops so massive histories
+stay usable (timeline.clj:12-14).  Output goes to ``timeline.html`` in the
+checker's subdirectory; the result map is always valid.
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+from pathlib import Path
+from typing import Mapping, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu import store
+from jepsen_tpu.checker import Checker
+
+#: timeline.clj:12-14
+OP_LIMIT = 10_000
+
+TYPE_COLORS = {"ok": "#B3F3B5", "info": "#F2F3B3", "fail": "#F3B3B3"}
+
+
+def _pairs(history: Sequence[Mapping]):
+    pair = h.pair_index(history)
+    out = []
+    for i, o in enumerate(history):
+        if h.is_invoke(o):
+            j = int(pair[i])
+            out.append((o, history[j] if j != -1 else None))
+    return out
+
+
+def render_html(test: Mapping, history: Sequence[Mapping]) -> str:
+    history = list(history)[: 2 * OP_LIMIT]
+    pairs = _pairs(history)[:OP_LIMIT]
+    procs = sorted(
+        {str(o["process"]) for o, _ in pairs}, key=lambda p: (p == "nemesis", p)
+    )
+    if not pairs:
+        return "<html><body>empty history</body></html>"
+    t0 = min(o.get("time", 0) for o, _ in pairs)
+    t1 = max(
+        (c or o).get("time", 0) for o, c in pairs
+    )
+    span = max(1, t1 - t0)
+    height = 800
+    col_w = 130
+
+    def y_of(t):
+        return 40 + (t - t0) / span * (height - 60)
+
+    bars = []
+    for o, c in pairs:
+        x = 10 + procs.index(str(o["process"])) * col_w
+        y0 = y_of(o.get("time", t0))
+        y1 = y_of((c or o).get("time", t1 if c is None else 0)) if c else height - 20
+        typ = c["type"] if c else "info"
+        color = TYPE_COLORS.get(typ, "#ddd")
+        label = f"{o.get('f')} {o.get('value')!r} → {typ}" + (
+            f" {c.get('value')!r}" if c and c.get("value") is not None else ""
+        )
+        bars.append(
+            f"<div class='op' title='{html_mod.escape(label)}' "
+            f"style='left:{x}px;top:{y0:.1f}px;height:{max(3, y1 - y0):.1f}px;"
+            f"width:{col_w - 10}px;background:{color}'>"
+            f"{html_mod.escape(str(o.get('f')))}</div>"
+        )
+    heads = "".join(
+        f"<div class='head' style='left:{10 + i * col_w}px'>process {html_mod.escape(p)}</div>"
+        for i, p in enumerate(procs)
+    )
+    return (
+        "<html><head><style>"
+        "body{font-family:sans-serif;position:relative}"
+        ".head{position:absolute;top:10px;font-weight:bold}"
+        ".op{position:absolute;font-size:9px;overflow:hidden;"
+        "border:1px solid #999;border-radius:2px;padding:1px}"
+        "</style></head><body>"
+        f"{heads}{''.join(bars)}"
+        f"<div style='position:absolute;top:{height}px'>&nbsp;</div>"
+        "</body></html>"
+    )
+
+
+class Timeline(Checker):
+    def check(self, test, history, opts):
+        out = {"valid?": True}
+        doc = render_html(test, [o for o in history if o.get("process") != h.NEMESIS or True])
+        try:
+            d = store.test_dir(test)
+            sub = opts.get("subdirectory") if opts else None
+            d = d / sub if sub else d
+            d.mkdir(parents=True, exist_ok=True)
+            (Path(d) / "timeline.html").write_text(doc)
+            out["file"] = str(Path(d) / "timeline.html")
+        except (KeyError, OSError, TypeError):
+            # No store dir configured (e.g. bare checker unit tests): return
+            # the html inline instead.
+            out["html"] = doc
+        return out
+
+
+def timeline_checker() -> Checker:
+    return Timeline()

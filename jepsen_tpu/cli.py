@@ -1,0 +1,207 @@
+"""CLI: turn a test constructor into a command-line program.
+
+Mirrors ``jepsen.cli`` (reference: jepsen/src/jepsen/cli.clj): subcommand
+dispatch with the exit-code contract (cli.clj:127-139):
+
+  0    test passed (valid? true)
+  1    test failed (valid? false)
+  2    analysis inconclusive (valid? unknown)
+  254  usage error
+  255  crash
+
+Subcommands (cli.clj:355-431, 336-353, 491-519):
+
+  test      run a test_fn-constructed test `--test-count` times
+  analyze   re-run checkers on a stored history, no cluster needed
+  serve     browse the store directory over HTTP
+
+Harness authors call ``run_cli(test_fn)`` from their ``__main__``, like the
+reference's ``(cli/run! (merge (cli/single-test-cmd ...) (cli/serve-cmd)))``
+(zookeeper/src/jepsen/zookeeper.clj:131-137).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Callable, Mapping
+
+from jepsen_tpu import core, store
+
+logger = logging.getLogger(__name__)
+
+EXIT_VALID = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_USAGE = 254
+EXIT_CRASH = 255
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def add_test_opts(p: argparse.ArgumentParser):
+    """The shared option vocabulary (cli.clj:64-111)."""
+    p.add_argument("--nodes", default=",".join(DEFAULT_NODES),
+                   help="comma-separated node hostnames")
+    p.add_argument("--node", action="append", default=None,
+                   help="a node to test (repeatable; overrides --nodes)")
+    p.add_argument("--nodes-file", default=None,
+                   help="file with one node hostname per line")
+    p.add_argument("--concurrency", default="1n",
+                   help="number of workers; '3n' means 3× node count")
+    p.add_argument("--time-limit", type=float, default=60.0,
+                   help="how long to run the workload, in seconds")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="how many times to run the test")
+    p.add_argument("--username", default="root", help="ssh user")
+    p.add_argument("--password", default=None, help="ssh password (unused; use keys)")
+    p.add_argument("--private-key-path", default=None, help="ssh identity file")
+    p.add_argument("--ssh-port", type=int, default=None, help="ssh port")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="use the dummy remote: run no remote commands")
+    p.add_argument("--local", action="store_true",
+                   help="use the local-subprocess remote (single-machine tests)")
+    p.add_argument("--leave-db-running", action="store_true",
+                   help="skip DB teardown at the end")
+    p.add_argument("--store-dir", default=None, help="where test runs are stored")
+
+
+def options_to_test_opts(opts: argparse.Namespace) -> dict:
+    """argparse → the test-map option fragment (cli.clj:150-233)."""
+    if opts.node:
+        nodes = list(opts.node)
+    elif opts.nodes_file:
+        nodes = [l.strip() for l in open(opts.nodes_file) if l.strip()]
+    else:
+        nodes = [n for n in opts.nodes.split(",") if n]
+    ssh: dict = {"user": opts.username}
+    if opts.no_ssh:
+        ssh["dummy?"] = True
+    if getattr(opts, "local", False):
+        ssh["local?"] = True
+    if opts.private_key_path:
+        ssh["private-key-path"] = opts.private_key_path
+    if opts.ssh_port:
+        ssh["port"] = opts.ssh_port
+    out = {
+        "nodes": nodes,
+        "concurrency": opts.concurrency,
+        "time-limit": opts.time_limit,
+        "ssh": ssh,
+        "leave-db-running?": opts.leave_db_running,
+    }
+    if opts.store_dir:
+        out["store-dir"] = opts.store_dir
+    return out
+
+
+def _exit_code(result: Mapping) -> int:
+    v = (result or {}).get("valid?")
+    if v is True:
+        return EXIT_VALID
+    if v == "unknown":
+        return EXIT_UNKNOWN
+    return EXIT_INVALID
+
+
+def _cmd_test(test_fn: Callable, opts) -> int:
+    code = EXIT_VALID
+    for i in range(opts.test_count):
+        test = test_fn(options_to_test_opts(opts))
+        completed = core.run_test(test)
+        c = _exit_code(completed.get("results"))
+        code = max(code, c)
+        if c != EXIT_VALID and opts.test_count > 1:
+            logger.warning("run %d/%d not valid (exit %d)", i + 1, opts.test_count, c)
+    return code
+
+
+def _cmd_analyze(test_fn: Callable, opts) -> int:
+    """Re-check a stored history without touching a cluster
+    (cli.clj:402-431)."""
+    if opts.test_dir:
+        stored = store.load_dir(opts.test_dir)
+    else:
+        stored = store.latest(store_dir=opts.store_dir)
+    if stored is None:
+        print("no stored test found", file=sys.stderr)
+        return EXIT_USAGE
+    cli_test = test_fn(options_to_test_opts(opts))
+    if cli_test.get("name") and stored.get("name") and cli_test["name"] != stored["name"]:
+        print(
+            f"stored test {stored['name']!r} doesn't match this CLI's test "
+            f"{cli_test['name']!r}",
+            file=sys.stderr,
+        )
+        return EXIT_USAGE
+    merged = {**cli_test, **{k: v for k, v in stored.items() if k in
+                             ("name", "start-time-str", "history")}}
+    merged.setdefault("start-time-str", store.time_str())
+    completed = core.analyze(merged)
+    core.log_results(completed)
+    print(completed["results"].get("valid?"))
+    return _exit_code(completed.get("results"))
+
+
+def _cmd_serve(opts) -> int:
+    from jepsen_tpu import web
+
+    web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir)
+    return EXIT_VALID
+
+
+def run_cli(test_fn: Callable | None = None, argv=None, extra_opts: Callable | None = None) -> int:
+    """Dispatch subcommands; returns the exit code (call sys.exit on it).
+
+    ``test_fn(opts_dict) -> test-map`` builds the test from CLI options.
+    ``extra_opts(parser)`` may add harness-specific flags.
+    """
+    parser = argparse.ArgumentParser(prog="jepsen-tpu")
+    sub = parser.add_subparsers(dest="command")
+
+    if test_fn is not None:
+        p_test = sub.add_parser("test", help="run the test")
+        add_test_opts(p_test)
+        if extra_opts:
+            extra_opts(p_test)
+
+        p_an = sub.add_parser("analyze", help="re-check a stored history")
+        add_test_opts(p_an)
+        p_an.add_argument("--test-dir", default=None,
+                          help="stored test directory (default: latest)")
+        if extra_opts:
+            extra_opts(p_an)
+
+    p_serve = sub.add_parser("serve", help="browse results over HTTP")
+    p_serve.add_argument("--host", default="0.0.0.0")
+    p_serve.add_argument("--port", type=int, default=8080)
+    p_serve.add_argument("--store-dir", default=None)
+
+    try:
+        opts = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_USAGE if e.code not in (0, None) else 0
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)-5s %(name)s: %(message)s",
+    )
+    try:
+        if opts.command == "test":
+            return _cmd_test(test_fn, opts)
+        if opts.command == "analyze":
+            return _cmd_analyze(test_fn, opts)
+        if opts.command == "serve":
+            return _cmd_serve(opts)
+        parser.print_help()
+        return EXIT_USAGE
+    except KeyboardInterrupt:
+        return EXIT_CRASH
+    except Exception:  # noqa: BLE001
+        logger.exception("test crashed")
+        return EXIT_CRASH
+
+
+def main(test_fn=None, argv=None, **kw):
+    sys.exit(run_cli(test_fn, argv, **kw))

@@ -1,0 +1,190 @@
+"""Control facade: sessions, exec sugar, and parallel per-node fan-out.
+
+Mirrors ``jepsen.control`` (reference: jepsen/src/jepsen/control.clj).  The
+reference threads state through dynamic vars (*host*, *session*, *sudo*,
+control.clj:39-53); here a ``Session`` object carries the same state
+explicitly, which plays nicer with Python threads.
+
+  session = control.session(test, "n1")
+  session.exec("echo", "hi")            -> "hi"        (control.clj:151)
+  with session.su():  ...               sudo root      (control.clj:215)
+  with session.cd("/tmp"): ...                         (control.clj:203)
+  control.on_nodes(test, fn)            -> {node: fn(test, node, session)}
+                                        parallel, control.clj:272-311
+
+Backend selection mirrors cli.clj:233 / control.clj:35-37: the test map's
+``ssh`` opts pick the transport (``{"dummy?": True}`` → DummyRemote), or a
+``remote`` key supplies a Remote instance directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Mapping
+
+from jepsen_tpu.control.core import (
+    DockerRemote,
+    DummyRemote,
+    Lit,
+    LocalRemote,
+    Remote,
+    RemoteError,
+    RemoteExecError,
+    RetryRemote,
+    SshRemote,
+    escape,
+)
+from jepsen_tpu.utils import real_pmap
+
+__all__ = [
+    "DockerRemote", "DummyRemote", "Lit", "LocalRemote", "Remote",
+    "RemoteError", "RemoteExecError", "RetryRemote", "SshRemote",
+    "Session", "escape", "base_remote", "session", "on_nodes", "on_many",
+    "with_sessions",
+]
+
+
+def base_remote(test: Mapping) -> Remote:
+    """Choose the transport from the test map (control.clj:35-37,
+    cli.clj:233)."""
+    if test.get("remote") is not None:
+        return test["remote"]
+    ssh = test.get("ssh") or {}
+    if ssh.get("dummy?"):
+        return DummyRemote()
+    if ssh.get("local?"):
+        return LocalRemote()
+    if ssh.get("docker?"):
+        return DockerRemote()
+    return RetryRemote(SshRemote())
+
+
+class Session:
+    """A connected control channel to one node."""
+
+    def __init__(self, remote: Remote, node: str, ssh_opts: Mapping | None = None):
+        self.remote = remote
+        self.node = node
+        self.ssh_opts = dict(ssh_opts or {})
+        self._sudo: str | None = None
+        self._dir: str | None = None
+
+    # -- exec ---------------------------------------------------------------
+
+    def exec_result(self, *args, stdin=None, timeout=None, env=None) -> dict:
+        """Run a command, returning the full {out, err, exit} result."""
+        action: dict[str, Any] = {"cmd": escape(args)}
+        if stdin is not None:
+            action["in"] = stdin
+        if self._sudo:
+            action["sudo"] = self._sudo
+        if self._dir:
+            action["dir"] = self._dir
+        if timeout is not None:
+            action["timeout"] = timeout
+        if env:
+            action["env"] = env
+        return self.remote.execute(action)
+
+    def exec(self, *args, check=True, **kw) -> str:
+        """Run a command, returning trimmed stdout; raise on nonzero exit
+        (control.clj:151-157 + control/core.clj:155-171)."""
+        res = self.exec_result(*args, **kw)
+        if check and res.get("exit", 0) != 0:
+            raise RemoteExecError(self.node, {"cmd": escape(args)}, res)
+        return (res.get("out") or "").strip()
+
+    # -- file transfer ------------------------------------------------------
+
+    def upload(self, local_paths, remote_path):
+        self.remote.upload(local_paths, remote_path)
+
+    def download(self, remote_paths, local_path):
+        self.remote.download(remote_paths, local_path)
+
+    def write_file(self, content: str, remote_path: str):
+        """Write a string to a remote file via stdin (control/util.clj:88)."""
+        self.exec("tee", remote_path, stdin=content)
+
+    # -- modifiers ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def su(self, user: str = "root"):
+        """sudo block (control.clj:215-218)."""
+        prev = self._sudo
+        self._sudo = user
+        try:
+            yield self
+        finally:
+            self._sudo = prev
+
+    @contextlib.contextmanager
+    def cd(self, directory: str):
+        """working-directory block (control.clj:203-213)."""
+        prev = self._dir
+        self._dir = directory
+        try:
+            yield self
+        finally:
+            self._dir = prev
+
+    def disconnect(self):
+        self.remote.disconnect()
+
+
+def session(test: Mapping, node: str) -> Session:
+    """Connect a session to node (control.clj:226-234)."""
+    ssh = dict(test.get("ssh") or {})
+    spec = {"host": node, **{k: v for k, v in ssh.items() if k not in ("dummy?", "local?", "docker?")}}
+    remote = base_remote(test).connect(spec)
+    return Session(remote, node, ssh)
+
+
+_sessions_lock = threading.Lock()
+
+
+def sessions(test: Mapping) -> dict:
+    """The test's session cache {node: Session}; missing nodes connect in
+    parallel (core.clj:275-295 with-sessions + real-pmap)."""
+    with _sessions_lock:
+        cache = test.get("sessions")
+        if cache is None:
+            cache = {}
+            test["sessions"] = cache  # type: ignore[index]
+        missing = [n for n in (test.get("nodes") or []) if n not in cache]
+    if missing:
+        connected = real_pmap(lambda n: (n, session(test, n)), missing)
+        with _sessions_lock:
+            for n, s in connected:
+                cache.setdefault(n, s)
+    return cache
+
+
+@contextlib.contextmanager
+def with_sessions(test: Mapping):
+    """Connect sessions to every node; disconnect on exit."""
+    try:
+        yield sessions(test)
+    finally:
+        cache = test.get("sessions") or {}
+        for s in cache.values():
+            try:
+                s.disconnect()
+            except Exception:  # noqa: BLE001
+                pass
+        if "sessions" in test:
+            test["sessions"] = None  # type: ignore[index]
+
+
+def on_nodes(test: Mapping, f: Callable, nodes=None) -> dict:
+    """Run ``f(test, node, session)`` on every node in parallel; returns
+    {node: result} (control.clj:272-311 via real-pmap)."""
+    nodes = list(nodes if nodes is not None else (test.get("nodes") or []))
+    sess = sessions(test)
+    results = real_pmap(lambda n: (n, f(test, n, sess[n])), nodes)
+    return dict(results)
+
+
+def on_many(test: Mapping, nodes, f: Callable) -> dict:
+    return on_nodes(test, f, nodes)

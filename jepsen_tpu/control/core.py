@@ -1,0 +1,363 @@
+"""Remote protocol: pluggable transports for running commands on db nodes.
+
+Mirrors ``jepsen.control.core`` (reference:
+jepsen/src/jepsen/control/core.clj:7-58): a Remote can connect to a host,
+execute shell actions, and copy files both ways.  Four interchangeable
+implementations, like the reference's clj-ssh/sshj/docker/k8s set:
+
+  DummyRemote   — records actions, runs nothing (control.clj:40; wired via
+                  ``{"dummy?": True}`` ssh opts, cli.clj:233) — the backend
+                  for self-tests
+  LocalRemote   — runs actions as local subprocesses (fills the niche of
+                  the reference's docker/k8s remotes for single-machine
+                  integration tests)
+  SshRemote     — shells out to ``ssh``/``scp`` (the reference deliberately
+                  shells out for scp too: JVM SSH is orders of magnitude
+                  slower, control/scp.clj:1-9)
+  DockerRemote  — ``docker exec`` / ``docker cp`` (control/docker.clj)
+
+An *action* is a dict: ``{"cmd": str, "in": stdin-str?, "dir": cwd?,
+"sudo": user?, "env": {k: v}?}``.  Results merge in ``out``, ``err``,
+``exit``.  Nonzero exits raise ``RemoteExecError`` unless
+``check=False`` (control/core.clj:155-171 throw-on-nonzero-exit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import subprocess
+import time
+from typing import Any, Mapping, Sequence
+
+DEFAULT_TIMEOUT_S = 600.0
+
+
+class RemoteError(Exception):
+    """Connection-level failure (the reference's ::ssh-failed)."""
+
+
+class RemoteExecError(Exception):
+    """A command exited nonzero (control/core.clj:155-171 ::nonzero-exit)."""
+
+    def __init__(self, host, action, result):
+        self.host = host
+        self.action = action
+        self.result = result
+        super().__init__(
+            f"command on {host} exited {result.get('exit')}: "
+            f"{action.get('cmd')!r}\nstdout: {result.get('out', '')[:2000]}\n"
+            f"stderr: {result.get('err', '')[:2000]}"
+        )
+
+
+def escape(args: Sequence[Any]) -> str:
+    """Build a safely-quoted shell command from argument fragments
+    (control/core.clj:67-110).  ``Lit`` fragments pass through unquoted."""
+    parts = []
+    for a in args:
+        if isinstance(a, Lit):
+            parts.append(a.s)
+        else:
+            parts.append(shlex.quote(str(a)))
+    return " ".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Lit:
+    """An unescaped shell literal (e.g. ``Lit('|')``, ``Lit('2>&1')``) —
+    the reference's ``c/lit``."""
+
+    s: str
+
+
+def wrap_sudo(action: Mapping) -> Mapping:
+    """Rewrite an action to run under sudo -u <user>
+    (control/core.clj:142-153).  ``-n`` (never prompt) rather than the
+    reference's ``-S``: the action's stdin is user payload (e.g. tee'd file
+    content), not a password, and a prompting sudo must fail loudly."""
+    sudo = action.get("sudo")
+    if not sudo:
+        return action
+    cmd = f"sudo -n -u {shlex.quote(str(sudo))} bash -c {shlex.quote(action['cmd'])}"
+    return {**action, "cmd": cmd, "sudo": None}
+
+
+def wrap_cd(action: Mapping) -> Mapping:
+    d = action.get("dir")
+    if not d:
+        return action
+    return {**action, "cmd": f"cd {shlex.quote(str(d))} && {action['cmd']}", "dir": None}
+
+
+def wrap_env(action: Mapping) -> Mapping:
+    env = action.get("env")
+    if not env:
+        return action
+    prefix = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
+    return {**action, "cmd": f"env {prefix} {action['cmd']}", "env": None}
+
+
+def full_cmd(action: Mapping) -> str:
+    return wrap_sudo(wrap_cd(wrap_env(action)))["cmd"]
+
+
+class Remote:
+    """Transport protocol (control/core.clj:7-58)."""
+
+    def connect(self, conn_spec: Mapping) -> "Remote":
+        """Return a connected copy bound to conn_spec ({host, port, user,
+        password?, private-key-path?, container?})."""
+        raise NotImplementedError
+
+    def execute(self, action: Mapping) -> dict:
+        raise NotImplementedError
+
+    def upload(self, local_paths, remote_path) -> None:
+        raise NotImplementedError
+
+    def download(self, remote_paths, local_path) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+
+class DummyRemote(Remote):
+    """Does nothing, remembers everything (control.clj:40 dummy remote).
+
+    ``handler(action) -> result-dict`` lets tests script responses.
+    """
+
+    def __init__(self, handler=None):
+        self.handler = handler
+        self.host = None
+        self.history: list = []
+
+    def connect(self, conn_spec):
+        r = DummyRemote(self.handler)
+        r.host = conn_spec.get("host")
+        r.history = self.history  # shared log across nodes, like one test run
+        return r
+
+    def execute(self, action):
+        self.history.append({"host": self.host, **action})
+        if self.handler is not None:
+            res = self.handler(action) or {}
+        else:
+            res = {}
+        return {"out": "", "err": "", "exit": 0, **res}
+
+    def upload(self, local_paths, remote_path):
+        self.history.append(
+            {"host": self.host, "upload": list(map(str, _as_list(local_paths))), "to": str(remote_path)}
+        )
+
+    def download(self, remote_paths, local_path):
+        self.history.append(
+            {"host": self.host, "download": list(map(str, _as_list(remote_paths))), "to": str(local_path)}
+        )
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class LocalRemote(Remote):
+    """Run actions as local subprocesses — a real backend for
+    single-machine integration tests (the role the reference's docker
+    environment plays, docker/README.md)."""
+
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT_S):
+        self.timeout = timeout
+        self.host = None
+
+    def connect(self, conn_spec):
+        r = LocalRemote(self.timeout)
+        r.host = conn_spec.get("host", "local")
+        return r
+
+    def execute(self, action):
+        cmd = full_cmd(action)
+        try:
+            p = subprocess.run(
+                ["bash", "-c", cmd],
+                input=action.get("in"),
+                capture_output=True,
+                text=True,
+                timeout=action.get("timeout", self.timeout),
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError(f"local command timed out: {cmd!r}") from e
+        return {"out": p.stdout, "err": p.stderr, "exit": p.returncode}
+
+    def upload(self, local_paths, remote_path):
+        self.execute({"cmd": escape(["cp", "-r", *_as_list(local_paths), remote_path])})
+
+    def download(self, remote_paths, local_path):
+        self.execute({"cmd": escape(["cp", "-r", *_as_list(remote_paths), local_path])})
+
+
+SSH_BASE_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "LogLevel=ERROR",
+    "-o", "ServerAliveInterval=25",
+]
+
+
+class SshRemote(Remote):
+    """OpenSSH-subprocess remote (the role of control/clj_ssh.clj+scp.clj).
+
+    conn_spec keys: host, port (22), user ("root"), private-key-path,
+    password (unsupported — use keys or an agent, like CI does).
+    """
+
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT_S):
+        self.timeout = timeout
+        self.spec: dict = {}
+
+    def connect(self, conn_spec):
+        r = SshRemote(self.timeout)
+        r.spec = dict(conn_spec)
+        # Fail fast if unreachable, mirroring connect-time errors.
+        try:
+            res = r.execute({"cmd": "true", "timeout": conn_spec.get("connect-timeout", 30)})
+        except RemoteError:
+            raise
+        if res["exit"] != 0:
+            raise RemoteError(f"ssh to {conn_spec.get('host')} failed: {res['err']}")
+        return r
+
+    def _ssh_opts(self):
+        o = list(SSH_BASE_OPTS)
+        if self.spec.get("port"):
+            o += ["-p", str(self.spec["port"])]
+        if self.spec.get("private-key-path"):
+            o += ["-i", str(self.spec["private-key-path"])]
+        return o
+
+    def _target(self):
+        user = self.spec.get("user", "root")
+        return f"{user}@{self.spec['host']}"
+
+    def execute(self, action):
+        cmd = full_cmd(action)
+        argv = ["ssh", *self._ssh_opts(), self._target(), cmd]
+        try:
+            p = subprocess.run(
+                argv,
+                input=action.get("in"),
+                capture_output=True,
+                text=True,
+                timeout=action.get("timeout", self.timeout),
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError(f"ssh command timed out on {self.spec.get('host')}") from e
+        if p.returncode == 255:
+            # OpenSSH reserves 255 for transport errors.
+            raise RemoteError(f"ssh transport to {self.spec.get('host')} failed: {p.stderr}")
+        return {"out": p.stdout, "err": p.stderr, "exit": p.returncode}
+
+    def _scp_opts(self):
+        o = [x for x in SSH_BASE_OPTS]
+        if self.spec.get("port"):
+            o += ["-P", str(self.spec["port"])]
+        if self.spec.get("private-key-path"):
+            o += ["-i", str(self.spec["private-key-path"])]
+        return o
+
+    def upload(self, local_paths, remote_path):
+        argv = ["scp", "-r", *self._scp_opts(), *map(str, _as_list(local_paths)),
+                f"{self._target()}:{remote_path}"]
+        p = subprocess.run(argv, capture_output=True, text=True, timeout=self.timeout)
+        if p.returncode != 0:
+            raise RemoteError(f"scp upload failed: {p.stderr}")
+
+    def download(self, remote_paths, local_path):
+        argv = ["scp", "-r", *self._scp_opts(),
+                *[f"{self._target()}:{r}" for r in _as_list(remote_paths)], str(local_path)]
+        p = subprocess.run(argv, capture_output=True, text=True, timeout=self.timeout)
+        if p.returncode != 0:
+            raise RemoteError(f"scp download failed: {p.stderr}")
+
+
+class DockerRemote(Remote):
+    """``docker exec`` remote (control/docker.clj): conn_spec host is the
+    container name/id (or set ``container``)."""
+
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT_S):
+        self.timeout = timeout
+        self.container = None
+
+    def connect(self, conn_spec):
+        r = DockerRemote(self.timeout)
+        r.container = conn_spec.get("container") or conn_spec.get("host")
+        return r
+
+    def execute(self, action):
+        cmd = full_cmd(action)
+        argv = ["docker", "exec", "-i", str(self.container), "bash", "-c", cmd]
+        try:
+            p = subprocess.run(
+                argv, input=action.get("in"), capture_output=True, text=True,
+                timeout=action.get("timeout", self.timeout),
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError(f"docker exec timed out in {self.container}") from e
+        return {"out": p.stdout, "err": p.stderr, "exit": p.returncode}
+
+    def upload(self, local_paths, remote_path):
+        for lp in _as_list(local_paths):
+            p = subprocess.run(["docker", "cp", str(lp), f"{self.container}:{remote_path}"],
+                               capture_output=True, text=True, timeout=self.timeout)
+            if p.returncode != 0:
+                raise RemoteError(f"docker cp failed: {p.stderr}")
+
+    def download(self, remote_paths, local_path):
+        for rp in _as_list(remote_paths):
+            p = subprocess.run(["docker", "cp", f"{self.container}:{rp}", str(local_path)],
+                               capture_output=True, text=True, timeout=self.timeout)
+            if p.returncode != 0:
+                raise RemoteError(f"docker cp failed: {p.stderr}")
+
+
+class RetryRemote(Remote):
+    """Wrap a remote, retrying transport failures with backoff
+    (control/retry.clj:15-33; 5 tries, ~100 ms)."""
+
+    def __init__(self, remote: Remote, tries: int = 5, backoff: float = 0.1):
+        self.remote = remote
+        self.tries = tries
+        self.backoff = backoff
+        self.spec: dict = {}
+
+    def connect(self, conn_spec):
+        r = RetryRemote(self.remote, self.tries, self.backoff)
+        r.spec = dict(conn_spec)
+        r.remote = self._retry(lambda: self.remote.connect(conn_spec))
+        return r
+
+    def _retry(self, f):
+        last = None
+        for i in range(self.tries):
+            try:
+                return f()
+            except RemoteError as e:
+                last = e
+                time.sleep(self.backoff * (1 + i))
+        raise last
+
+    def execute(self, action):
+        return self._retry(lambda: self.remote.execute(action))
+
+    def upload(self, local_paths, remote_path):
+        return self._retry(lambda: self.remote.upload(local_paths, remote_path))
+
+    def download(self, remote_paths, local_path):
+        return self._retry(lambda: self.remote.download(remote_paths, local_path))
+
+    def disconnect(self):
+        self.remote.disconnect()

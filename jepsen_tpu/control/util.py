@@ -1,0 +1,178 @@
+"""Install/daemon utilities on top of the control layer.
+
+Mirrors ``jepsen.control.util`` (reference:
+jepsen/src/jepsen/control/util.clj, 403 LoC): port waiting, tmp files,
+cached downloads, archive installation, daemon supervision, grepkill.
+All functions take a connected ``Session`` as their first argument.
+"""
+
+from __future__ import annotations
+
+import base64
+import shlex
+import time
+from typing import Mapping
+
+from jepsen_tpu.control import Lit, Session
+from jepsen_tpu.control.core import RemoteExecError
+
+WGET_CACHE_DIR = "/tmp/jepsen/wget-cache"
+
+
+def exists(s: Session, path: str) -> bool:
+    """Does a file exist? (control/util.clj:38-44)."""
+    return s.exec_result("test", "-e", path).get("exit") == 0
+
+
+def file_p(s: Session, path: str) -> bool:
+    return s.exec_result("test", "-f", path).get("exit") == 0
+
+
+def await_tcp_port(s: Session, port: int, timeout: float = 60.0, interval: float = 0.5):
+    """Block until something listens on port (control/util.clj:14-30).
+
+    A hung connect attempt (packets dropped — exactly the conditions this
+    harness creates) counts as "not listening yet", not a transport error.
+    """
+    from jepsen_tpu.control.core import RemoteError
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            r = s.exec_result(
+                "bash", "-c", f"exec 3<>/dev/tcp/localhost/{int(port)}", timeout=5
+            )
+            if r.get("exit") == 0:
+                return
+        except RemoteError:
+            pass
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"nothing listening on {s.node}:{port} after {timeout}s")
+        time.sleep(interval)
+
+
+def tmp_file(s: Session, suffix: str = "") -> str:
+    """Create a remote temp file, returning its path (control/util.clj:63-76)."""
+    return s.exec("mktemp", f"--suffix={suffix}" if suffix else "--tmpdir=/tmp")
+
+
+def tmp_dir(s: Session) -> str:
+    """(control/util.clj:78-86)."""
+    return s.exec("mktemp", "-d")
+
+
+def wget(s: Session, url: str, dest: str | None = None, force: bool = False) -> str:
+    """Download url on the node, returning the local path
+    (control/util.clj:133-160)."""
+    name = url.rstrip("/").rsplit("/", 1)[-1]
+    dest = dest or name
+    if force:
+        s.exec_result("rm", "-f", dest)
+    if not exists(s, dest):
+        s.exec("wget", "-q", "-O", dest, url)
+    return dest
+
+
+def cached_wget(s: Session, url: str, force: bool = False) -> str:
+    """Download via a persistent on-node cache keyed by the (base64) url
+    (control/util.clj:162-197)."""
+    key = base64.urlsafe_b64encode(url.encode()).decode().rstrip("=")
+    path = f"{WGET_CACHE_DIR}/{key}"
+    s.exec("mkdir", "-p", WGET_CACHE_DIR)
+    if force:
+        s.exec_result("rm", "-f", path)
+    if not exists(s, path):
+        s.exec("wget", "-q", "-O", path, url)
+    return path
+
+
+def install_archive(s: Session, url: str, dest: str, force: bool = False):
+    """Download and unpack a tarball/zip into dest, stripping a single
+    top-level directory if present (control/util.clj:199-275)."""
+    if exists(s, dest) and not force:
+        return dest
+    archive = cached_wget(s, url, force=force)
+    s.exec("rm", "-rf", dest)
+    s.exec("mkdir", "-p", dest)
+    if url.endswith(".zip"):
+        tmp = tmp_dir(s)
+        s.exec("unzip", "-qq", archive, "-d", tmp)
+        _promote_single_dir(s, tmp, dest)
+    else:
+        # tar auto-detects compression with -a? use -xf which handles gz/bz2/xz
+        tmp = tmp_dir(s)
+        s.exec("tar", "-xf", archive, "-C", tmp)
+        _promote_single_dir(s, tmp, dest)
+    return dest
+
+
+def _promote_single_dir(s: Session, tmp: str, dest: str):
+    entries = [e for e in s.exec("ls", "-A", tmp).splitlines() if e]
+    if len(entries) == 1:
+        s.exec("bash", "-c", f"mv {shlex.quote(tmp)}/{shlex.quote(entries[0])}/* {shlex.quote(dest)}/ 2>/dev/null || mv {shlex.quote(tmp)}/{shlex.quote(entries[0])} {shlex.quote(dest)}")
+    else:
+        s.exec("bash", "-c", f"mv {shlex.quote(tmp)}/* {shlex.quote(dest)}/")
+    s.exec_result("rm", "-rf", tmp)
+
+
+def signal(s: Session, pattern: str, sig: str):
+    """Send a signal to matching processes (control/util.clj:399-403)."""
+    s.exec_result("pkill", f"-{sig}", "-f", pattern)
+
+
+def grepkill(s: Session, pattern: str, sig: str = "KILL"):
+    """Kill processes matching pattern (control/util.clj:286-308)."""
+    signal(s, pattern, sig)
+
+
+def start_daemon(
+    s: Session,
+    binary: str,
+    *args,
+    pidfile: str,
+    logfile: str,
+    chdir: str | None = None,
+    env: Mapping | None = None,
+    make_pidfile: bool = True,
+):
+    """Start a long-running process under a pidfile, surviving the control
+    session (control/util.clj:310-367, which uses start-stop-daemon; we use
+    setsid+nohup for portability to minimal images)."""
+    if daemon_running(s, pidfile):
+        return "already-running"
+    envs = ""
+    if env:
+        envs = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items()) + " "
+    cd = f"cd {shlex.quote(chdir)} && " if chdir else ""
+    cmd = " ".join([shlex.quote(str(binary)), *[shlex.quote(str(a)) for a in args]])
+    s.exec(
+        "bash", "-c",
+        f"{cd}{envs}setsid nohup {cmd} >> {shlex.quote(logfile)} 2>&1 < /dev/null & "
+        + (f"echo $! > {shlex.quote(pidfile)}" if make_pidfile else "true"),
+    )
+    return "started"
+
+
+def daemon_running(s: Session, pidfile: str) -> bool:
+    """Is the pidfile's process alive? (control/util.clj:369-397)."""
+    r = s.exec_result(
+        "bash", "-c", f"test -f {shlex.quote(pidfile)} && kill -0 $(cat {shlex.quote(pidfile)})"
+    )
+    return r.get("exit") == 0
+
+
+def stop_daemon(s: Session, pidfile: str, signal: str = "TERM", timeout: float = 30.0):
+    """Stop the pidfile's process, escalating to KILL
+    (control/util.clj:340-367)."""
+    if not daemon_running(s, pidfile):
+        s.exec_result("rm", "-f", pidfile)
+        return "not-running"
+    s.exec_result("bash", "-c", f"kill -{signal} $(cat {shlex.quote(pidfile)})")
+    deadline = time.monotonic() + timeout
+    while daemon_running(s, pidfile):
+        if time.monotonic() > deadline:
+            s.exec_result("bash", "-c", f"kill -KILL $(cat {shlex.quote(pidfile)})")
+            break
+        time.sleep(0.2)
+    s.exec_result("rm", "-f", pidfile)
+    return "stopped"

@@ -1,0 +1,199 @@
+"""Orchestrator: the full test lifecycle.
+
+Mirrors ``jepsen.core`` (reference: jepsen/src/jepsen/core.clj): set up the
+OS and DB on every node over the control layer, run the generator through
+the interpreter against real clients and the nemesis, record the history,
+download logs, tear everything down, then analyze — in exactly the
+reference's order (core.clj:327-406, call stack in SURVEY.md §3.1):
+
+  run_test(test)
+  ├─ prepare_test                      core.clj:311
+  ├─ store.save_0                      store.clj:375
+  ├─ sessions to all nodes             core.clj:275-295
+  ├─ os.setup on all nodes             core.clj:93-100
+  ├─ db.cycle (teardown→setup)         core.clj:172-181, db.clj:117-158
+  ├─ relative-time origin              util.clj:337
+  ├─ run_case: client/nemesis setup → interpreter.run   core.clj:190-214
+  ├─ store.save_1 (history, pre-analysis)               core.clj:401
+  ├─ snarf_logs (download db logs)     core.clj:102-136
+  ├─ teardown (reverse order)          core.clj:202-212
+  ├─ analyze                           core.clj:221-237
+  └─ results logged + saved            core.clj:239-252
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import client as jclient
+from jepsen_tpu import control, db as jdb, history as h, net as jnet, store
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.utils import real_pmap, relative_time
+
+logger = logging.getLogger(__name__)
+
+
+def prepare_test(test: Mapping) -> dict:
+    """Fill defaults: start time, concurrency (= node count), net, name
+    (core.clj:311-325)."""
+    t = dict(test)
+    t.setdefault("name", "jepsen-tpu")
+    t.setdefault("nodes", ["n1", "n2", "n3", "n4", "n5"])
+    if not t.get("start-time-str"):
+        t["start-time-str"] = store.time_str()
+    c = t.get("concurrency", "1n")
+    if isinstance(c, str):
+        # "3n" syntax: multiplier of node count (cli.clj:90-93,150-168).
+        mult = int(c[:-1] or 1) if c.endswith("n") else None
+        t["concurrency"] = (
+            mult * len(t["nodes"]) if mult is not None else int(c)
+        )
+    # Real iptables only over real remote transports: a dummy run has no
+    # network, and a --local run must NEVER touch the host's firewall.
+    ssh_opts = t.get("ssh") or {}
+    harmless = ssh_opts.get("dummy?") or ssh_opts.get("local?")
+    t.setdefault("net", jnet.noop() if harmless else jnet.iptables())
+    t.setdefault("client", jclient.noop())
+    t.setdefault("checker", None)
+    return t
+
+
+def setup_nemesis(test: Mapping):
+    nem = test.get("nemesis")
+    if nem is None:
+        return None
+    return nem.setup(test)
+
+
+def _with_clients(test: Mapping, method: str):
+    """Open a client per node and run setup/teardown on it
+    (core.clj:190-212)."""
+    client = test.get("client")
+    if client is None:
+        return
+
+    def one(node):
+        c = client.open(test, node)
+        try:
+            getattr(c, method)(test)
+        finally:
+            try:
+                c.close(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("error closing %s client on %s", method, node)
+
+    real_pmap(one, list(test["nodes"]))
+
+
+def snarf_logs(test: Mapping):
+    """Download DB log files into the store dir, one subdir per node
+    (core.clj:102-136)."""
+    database = test.get("db")
+    if database is None:
+        return
+    sess = control.sessions(test)
+    d = store.test_dir(test)
+
+    def one(node):
+        files = list(database.log_files(test, node) or [])
+        if not files:
+            return
+        dest = d / node
+        dest.mkdir(parents=True, exist_ok=True)
+        for f in files:
+            try:
+                sess[node].download(f, str(dest))
+            except Exception:  # noqa: BLE001
+                logger.warning("couldn't download %s from %s", f, node, exc_info=True)
+
+    real_pmap(one, list(test["nodes"]))
+
+
+def run_case(test: Mapping) -> list[dict]:
+    """Nemesis + client setup, then the interpreter loop
+    (core.clj:190-214)."""
+    nem = setup_nemesis(test)
+    t = {**test, "nemesis": nem}
+    try:
+        _with_clients(test, "setup")
+        return interpreter.run(t)
+    finally:
+        try:
+            _with_clients(test, "teardown")
+        except Exception:  # noqa: BLE001
+            logger.exception("client teardown failed")
+        if nem is not None:
+            try:
+                nem.teardown(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("nemesis teardown failed")
+
+
+def analyze(test: Mapping) -> dict:
+    """Index the history, run the checker, store the results — the TPU
+    insertion point (core.clj:221-237, SURVEY.md §3.3)."""
+    test = dict(test)
+    test["history"] = h.index(test.get("history") or [])
+    checker = test.get("checker")
+    if checker is not None:
+        results = chk.check_safe(checker, test, test["history"])
+    else:
+        results = {"valid?": True}
+    test["results"] = results
+    store.save_2(test)
+    return test
+
+
+def log_results(test: Mapping):
+    """(core.clj:239-252)."""
+    v = (test.get("results") or {}).get("valid?")
+    name = test.get("name")
+    if v is True:
+        logger.info("Everything looks good! ヽ(‘ー`)ノ — %s", name)
+    elif v == "unknown":
+        logger.warning("Errors occurred during analysis; validity unknown — %s", name)
+    else:
+        logger.warning("Analysis invalid! (ノಥ益ಥ）ノ ┻━┻ — %s", name)
+
+
+def run_test(test: Mapping) -> dict:
+    """The whole lifecycle; returns the completed test map with :history
+    and :results (core.clj:327-406)."""
+    test = prepare_test(test)
+    store.save_0(test)
+    logger.info("Running test %s/%s", test["name"], test["start-time-str"])
+    with control.with_sessions(test):
+        os_ = test.get("os")
+        database = test.get("db")
+        try:
+            if os_ is not None:
+                control.on_nodes(test, os_.setup)
+            if database is not None:
+                jdb.cycle_db(test)
+            with relative_time():
+                history = run_case(test)
+            test = dict(test)
+            test["history"] = history
+            store.save_1(test)
+        finally:
+            # Logs are snarfed even when the run crashed — debugging a
+            # crash needs them most (core.clj:150-166 shutdown hook).
+            try:
+                snarf_logs(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("log download failed")
+            try:
+                if database is not None and not test.get("leave-db-running?"):
+                    control.on_nodes(test, database.teardown)
+            except Exception:  # noqa: BLE001
+                logger.exception("db teardown failed")
+            try:
+                if os_ is not None:
+                    control.on_nodes(test, os_.teardown)
+            except Exception:  # noqa: BLE001
+                logger.exception("os teardown failed")
+    test = analyze(test)
+    log_results(test)
+    return test

@@ -1,0 +1,152 @@
+"""DB automation: installing, starting, and wrecking the system under test.
+
+Mirrors ``jepsen.db`` (reference: jepsen/src/jepsen/db.clj): the ``DB``
+lifecycle protocol (db.clj:11-16), optional capability mix-ins ``Process``
+(start!/kill!, db.clj:18-24), ``Pause`` (pause!/resume!, db.clj:26-29),
+``Primary`` (db.clj:31-38), ``LogFiles`` (db.clj:40-47); the
+``cycle_db`` teardown→setup sequence with setup retries (db.clj:117-158);
+and the tcpdump-capture DB (db.clj:49-115).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Mapping, Sequence
+
+from jepsen_tpu import control
+
+logger = logging.getLogger(__name__)
+
+
+class DB:
+    """Core lifecycle (db.clj:11-16).  Methods receive (test, node,
+    session)."""
+
+    def setup(self, test, node, session) -> None:
+        """Install and start the database."""
+
+    def teardown(self, test, node, session) -> None:
+        """Tear down and destroy all traces of the database."""
+
+    # -- capability probes --------------------------------------------------
+
+    def log_files(self, test, node) -> Sequence[str]:
+        """Paths of log files to download after the run (db.clj:40-47)."""
+        return []
+
+    # Process (db.clj:18-24): override both to advertise the capability.
+    def start(self, test, node, session):
+        raise NotImplementedError
+
+    def kill(self, test, node, session):
+        raise NotImplementedError
+
+    # Pause (db.clj:26-29)
+    def pause(self, test, node, session):
+        raise NotImplementedError
+
+    def resume(self, test, node, session):
+        raise NotImplementedError
+
+    # Primary (db.clj:31-38)
+    def primaries(self, test) -> Sequence[str]:
+        raise NotImplementedError
+
+    def setup_primary(self, test, node, session):
+        """One-time setup executed on the first primary only."""
+        raise NotImplementedError
+
+
+def supports(db: DB, method: str) -> bool:
+    """Did the subclass actually implement this optional capability?"""
+    return getattr(type(db), method, None) is not getattr(DB, method, None)
+
+
+class NoopDB(DB):
+    """No database at all (for stub tests)."""
+
+
+def noop() -> DB:
+    return NoopDB()
+
+
+class SetupFailed(Exception):
+    pass
+
+
+def cycle_db(test: Mapping, retries: int = 3):
+    """Tear down then set up the DB on all nodes, retrying setup failures
+    (db.clj:117-158).  Also runs setup_primary on the first primary when
+    the DB supports Primary (db.clj:141-146)."""
+    db: DB = test["db"]
+    for attempt in range(retries):
+        try:
+            control.on_nodes(test, db.teardown)
+            control.on_nodes(test, db.setup)
+            if supports(db, "setup_primary"):
+                prims = list(db.primaries(test)) if supports(db, "primaries") else []
+                primary = prims[0] if prims else (test["nodes"] or [None])[0]
+                if primary is not None:
+                    control.on_nodes(test, db.setup_primary, nodes=[primary])
+            return
+        except SetupFailed:
+            if attempt == retries - 1:
+                raise
+            logger.warning("db setup failed; retrying (%d/%d)", attempt + 1, retries)
+
+
+class TcpdumpDB(DB):
+    """Capture packets on each node for the duration of the test
+    (db.clj:49-115).  Wrap it in your test's db via ``compose``. """
+
+    def __init__(self, filter_expr: str = "", pcap_path: str = "/tmp/jepsen/trace.pcap"):
+        self.filter_expr = filter_expr
+        self.pcap_path = pcap_path
+        self.pidfile = pcap_path + ".pid"
+
+    def setup(self, test, node, session):
+        from jepsen_tpu.control import util as cu
+
+        with session.su():
+            session.exec("mkdir", "-p", "/tmp/jepsen")
+            cu.start_daemon(
+                session, "tcpdump", "-w", self.pcap_path,
+                *(self.filter_expr.split() if self.filter_expr else []),
+                pidfile=self.pidfile, logfile="/tmp/jepsen/tcpdump.log",
+            )
+
+    def teardown(self, test, node, session):
+        from jepsen_tpu.control import util as cu
+
+        with session.su():
+            cu.stop_daemon(session, self.pidfile)
+            session.exec_result("rm", "-f", self.pcap_path)
+
+    def log_files(self, test, node):
+        return [self.pcap_path]
+
+
+class ComposedDB(DB):
+    """Run several DBs' lifecycles together (setup in order, teardown in
+    reverse)."""
+
+    def __init__(self, dbs: Sequence[DB]):
+        self.dbs = list(dbs)
+
+    def setup(self, test, node, session):
+        for d in self.dbs:
+            d.setup(test, node, session)
+
+    def teardown(self, test, node, session):
+        for d in reversed(self.dbs):
+            d.teardown(test, node, session)
+
+    def log_files(self, test, node):
+        out = []
+        for d in self.dbs:
+            out.extend(d.log_files(test, node))
+        return out
+
+
+def compose(dbs: Sequence[DB]) -> DB:
+    return ComposedDB(dbs)

@@ -1,0 +1,151 @@
+"""Network manipulation: partitions, latency, loss.
+
+Mirrors ``jepsen.net`` (reference: jepsen/src/jepsen/net.clj): the ``Net``
+protocol — drop!/heal!/slow!/flaky!/fast! — plus the iptables
+implementation with the batched ``drop_all`` fast path for whole grudge
+maps (net.clj:58-111), and tc/netem for delay and loss (net.clj:71-89).
+
+All methods act over the control layer; ``NoopNet`` is the dummy used with
+the dummy remote in self-tests.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from jepsen_tpu import control
+
+
+class Net:
+    """Protocol (net.clj:15-26)."""
+
+    def drop(self, test, src, dest):
+        """Cut traffic from src to dest (one direction)."""
+        raise NotImplementedError
+
+    def drop_all(self, test, grudge: Mapping):
+        """Apply a whole grudge map {node: set-of-nodes-to-refuse} in one
+        batched pass (net.clj:88-111 PartitionAll)."""
+        raise NotImplementedError
+
+    def heal(self, test):
+        raise NotImplementedError
+
+    def slow(self, test, mean_ms: float = 50.0, variance_ms: float = 10.0):
+        raise NotImplementedError
+
+    def flaky(self, test):
+        raise NotImplementedError
+
+    def fast(self, test):
+        raise NotImplementedError
+
+
+class NoopNet(Net):
+    """Records calls; does nothing. For dummy-remote self-tests."""
+
+    def __init__(self):
+        self.log: list = []
+        self.grudge: Mapping | None = None
+
+    def drop(self, test, src, dest):
+        self.log.append(("drop", src, dest))
+
+    def drop_all(self, test, grudge):
+        self.log.append(("drop-all", grudge))
+        self.grudge = grudge
+
+    def heal(self, test):
+        self.log.append(("heal",))
+        self.grudge = None
+
+    def slow(self, test, mean_ms=50.0, variance_ms=10.0):
+        self.log.append(("slow", mean_ms))
+
+    def flaky(self, test):
+        self.log.append(("flaky",))
+
+    def fast(self, test):
+        self.log.append(("fast",))
+
+
+def _ip_of(session: control.Session, node: str, cache: dict) -> str:
+    """Resolve a node name to an IP on the node (control/net.clj:19-40,
+    memoized)."""
+    if node not in cache:
+        out = session.exec("getent", "ahosts", node).splitlines()
+        cache[node] = out[0].split()[0] if out else node
+    return cache[node]
+
+
+class IptablesNet(Net):
+    """iptables/tc implementation (net.clj:58-111)."""
+
+    def __init__(self):
+        self._ip_cache: dict = {}
+
+    def _sessions(self, test):
+        return control.sessions(test)
+
+    def drop(self, test, src, dest):
+        s = self._sessions(test)[dest]
+        with s.su():
+            ip = _ip_of(s, src, self._ip_cache)
+            s.exec("iptables", "-A", "INPUT", "-s", ip, "-j", "DROP", "-w")
+
+    def drop_all(self, test, grudge):
+        def apply_one(test_, node, s):
+            cut = grudge.get(node) or ()
+            if not cut:
+                return
+            with s.su():
+                ips = [_ip_of(s, other, self._ip_cache) for other in sorted(cut)]
+                # One batched rule per node (net.clj:88-111).
+                s.exec(
+                    "iptables", "-A", "INPUT", "-s", ",".join(ips), "-j", "DROP", "-w"
+                )
+
+        control.on_nodes(test, apply_one, nodes=list(grudge))
+
+    def heal(self, test):
+        def heal_one(test_, node, s):
+            with s.su():
+                s.exec("iptables", "-F", "-w")
+                s.exec("iptables", "-X", "-w")
+
+        control.on_nodes(test, heal_one)
+
+    def slow(self, test, mean_ms=50.0, variance_ms=10.0):
+        def slow_one(test_, node, s):
+            with s.su():
+                s.exec(
+                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "delay", f"{mean_ms}ms", f"{variance_ms}ms", "distribution", "normal",
+                )
+
+        control.on_nodes(test, slow_one)
+
+    def flaky(self, test):
+        def flaky_one(test_, node, s):
+            with s.su():
+                s.exec(
+                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "loss", "20%", "75%",
+                )
+
+        control.on_nodes(test, flaky_one)
+
+    def fast(self, test):
+        def fast_one(test_, node, s):
+            with s.su():
+                s.exec_result("tc", "qdisc", "del", "dev", "eth0", "root")
+
+        control.on_nodes(test, fast_one)
+
+
+def iptables() -> Net:
+    return IptablesNet()
+
+
+def noop() -> Net:
+    return NoopNet()

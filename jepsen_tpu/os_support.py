@@ -1,0 +1,66 @@
+"""OS automation: preparing nodes to host a database.
+
+Mirrors ``jepsen.os`` (reference: jepsen/src/jepsen/os.clj:4-8 — a
+two-method protocol) and the Debian implementation
+(jepsen/src/jepsen/os/debian.clj: package install, hostfile setup).
+Named ``os_support`` to avoid shadowing the stdlib ``os``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+
+class OS:
+    """(os.clj:4-8)."""
+
+    def setup(self, test, node, session) -> None:
+        pass
+
+    def teardown(self, test, node, session) -> None:
+        pass
+
+
+class NoopOS(OS):
+    pass
+
+
+def noop() -> OS:
+    return NoopOS()
+
+
+class DebianOS(OS):
+    """apt-based setup (os/debian.clj): install base packages, populate
+    /etc/hosts so nodes see each other by name."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.packages = ["curl", "wget", "unzip", "iptables", "psmisc", "tar",
+                        "iputils-ping", "logrotate", *extra_packages]
+
+    def setup(self, test, node, session):
+        with session.su():
+            self.setup_hostfile(test, node, session)
+            if not self._installed(session, self.packages):
+                session.exec(
+                    "env", "DEBIAN_FRONTEND=noninteractive",
+                    "apt-get", "install", "-y", "--no-install-recommends",
+                    *self.packages,
+                )
+
+    def _installed(self, session, packages) -> bool:
+        r = session.exec_result("dpkg-query", "-W", *packages)
+        return r.get("exit") == 0
+
+    def setup_hostfile(self, test, node, session):
+        """Map every node name to its IP in /etc/hosts
+        (os/debian.clj hostfile setup)."""
+        lines = ["127.0.0.1 localhost"]
+        for n in test.get("nodes") or []:
+            if n == node:
+                lines.append(f"127.0.1.1 {n}")
+            else:
+                out = session.exec_result("getent", "ahosts", n)
+                ip = (out.get("out") or "").split()
+                if ip:
+                    lines.append(f"{ip[0]} {n}")
+        session.write_file("\n".join(lines) + "\n", "/etc/hosts")

@@ -1,0 +1,218 @@
+"""Persistence: where test runs live on disk.
+
+Mirrors ``jepsen.store`` (reference: jepsen/src/jepsen/store.clj): each run
+gets ``store/<name>/<timestamp>/`` (store.clj:33-68) holding the test map,
+the history, the results, and downloaded node logs, with ``latest``
+symlinks maintained at both levels (store.clj:282-319).  Writes happen in
+three phases, exactly like the reference's crash-safety story
+(store.clj:375-420, rationale in store/format.clj:141-150):
+
+  save_0 — initial test map, before anything runs
+  save_1 — the history, as soon as the run ends (pre-analysis: a crash in
+           a checker must never lose the history)
+  save_2 — the results
+
+Formats: the test map and results are JSON (non-serializable values
+stringified, mirroring store.clj:92-104's nonserializable-key stripping);
+the history is JSON-lines (one op per line, like history.edn) plus the
+human-readable ``history.txt``.  All writes go through tmp+rename so a
+crash never leaves a torn file.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import shutil
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+BASE_DIR = Path("store")
+
+#: Test-map keys that can't/shouldn't be serialized (functions, live
+#: objects) — store.clj:92-104.
+NONSERIALIZABLE_KEYS = (
+    "db", "os", "net", "client", "nemesis", "checker", "generator", "remote",
+    "sessions", "barrier", "store",
+)
+
+
+def base_dir(test_or_opts: Mapping | None = None) -> Path:
+    if test_or_opts and test_or_opts.get("store-dir"):
+        return Path(test_or_opts["store-dir"])
+    return BASE_DIR
+
+
+def time_str(t: _dt.datetime | None = None) -> str:
+    """Directory-name timestamp (store.clj:45-50)."""
+    t = t or _dt.datetime.now()
+    return t.strftime("%Y%m%dT%H%M%S.%f")[:-3] + "Z"
+
+
+def test_dir(test: Mapping) -> Path:
+    return base_dir(test) / str(test["name"]) / str(test["start-time-str"])
+
+
+def _jsonable(x: Any):
+    if isinstance(x, Mapping):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple, set, frozenset)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    try:
+        import numpy as np
+
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    return str(x)
+
+
+def serializable_test(test: Mapping) -> dict:
+    """The test map minus live objects (store.clj:92-104)."""
+    return _jsonable({k: v for k, v in test.items() if k not in NONSERIALIZABLE_KEYS})
+
+
+def _atomic_write(path: Path, data: str):
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(data)
+    os.replace(tmp, path)
+
+
+def _write_json(path: Path, obj):
+    _atomic_write(path, json.dumps(_jsonable(obj), indent=1))
+
+
+def write_history(d: Path, history: Sequence[Mapping]):
+    """history.jsonl (machine) + history.txt (human) — store.clj:384-399
+    writes both forms in parallel futures; sequential is fine here."""
+    lines = [json.dumps(_jsonable(o), separators=(",", ":")) for o in history]
+    _atomic_write(d / "history.jsonl", "\n".join(lines) + ("\n" if lines else ""))
+    txt = []
+    for o in history:
+        txt.append(
+            f"{o.get('index', ''):>8} {str(o.get('process', '')):>8} "
+            f"{o.get('type', ''):<8} {str(o.get('f', '')):<16} {o.get('value', '')!r}"
+        )
+    _atomic_write(d / "history.txt", "\n".join(txt) + ("\n" if txt else ""))
+
+
+def save_0(test: Mapping) -> Mapping:
+    """Write the initial test map; returns test with paths filled
+    (store.clj:375-382)."""
+    d = test_dir(test)
+    d.mkdir(parents=True, exist_ok=True)
+    _write_json(d / "test.json", serializable_test(test))
+    update_symlinks(test)
+    return test
+
+def save_1(test: Mapping) -> Mapping:
+    """Write the history immediately after the run (store.clj:384-399)."""
+    d = test_dir(test)
+    d.mkdir(parents=True, exist_ok=True)
+    _write_json(d / "test.json", serializable_test(test))
+    write_history(d, test.get("history") or [])
+    return test
+
+
+def save_2(test: Mapping) -> Mapping:
+    """Write the results (store.clj:401-419)."""
+    d = test_dir(test)
+    d.mkdir(parents=True, exist_ok=True)
+    _write_json(d / "results.json", test.get("results") or {})
+    update_symlinks(test)
+    return test
+
+
+def update_symlinks(test: Mapping):
+    """Maintain <name>/latest and store/latest (store.clj:282-319)."""
+    d = test_dir(test)
+    for link in (d.parent / "latest", base_dir(test) / "latest"):
+        try:
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.parent.mkdir(parents=True, exist_ok=True)
+            link.symlink_to(os.path.relpath(d, link.parent))
+        except OSError:  # pragma: no cover - symlinks may be unsupported
+            logger.debug("couldn't update symlink %s", link, exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# Reading (store.clj:121-246)
+# ---------------------------------------------------------------------------
+
+
+def tests(name: str | None = None, store_dir=None) -> dict:
+    """{name: {timestamp: path}} of stored runs (store.clj:121-160)."""
+    base = base_dir({"store-dir": store_dir} if store_dir else None)
+    out: dict = {}
+    if not base.exists():
+        return out
+    for name_dir in sorted(base.iterdir()):
+        if not name_dir.is_dir() or name_dir.is_symlink():
+            continue
+        if name is not None and name_dir.name != name:
+            continue
+        runs = {
+            run.name: run
+            for run in sorted(name_dir.iterdir())
+            if run.is_dir() and not run.is_symlink()
+        }
+        if runs:
+            out[name_dir.name] = runs
+    return out
+
+
+def load(name: str, timestamp: str, store_dir=None) -> dict:
+    """Load a stored test (test map + history + results)
+    (store.clj:196-246)."""
+    base = base_dir({"store-dir": store_dir} if store_dir else None)
+    d = base / name / timestamp
+    return load_dir(d)
+
+
+def load_dir(d: Path) -> dict:
+    d = Path(d)
+    test = json.loads((d / "test.json").read_text()) if (d / "test.json").exists() else {}
+    hist_path = d / "history.jsonl"
+    if hist_path.exists():
+        test["history"] = [
+            json.loads(line) for line in hist_path.read_text().splitlines() if line
+        ]
+    res_path = d / "results.json"
+    if res_path.exists():
+        test["results"] = json.loads(res_path.read_text())
+    test["dir"] = str(d)
+    return test
+
+
+def latest(store_dir=None) -> dict | None:
+    """The most recent run across all tests (store.clj:282-291)."""
+    base = base_dir({"store-dir": store_dir} if store_dir else None)
+    link = base / "latest"
+    if link.exists():
+        return load_dir(link.resolve())
+    newest = None
+    for name, runs in tests(store_dir=store_dir).items():
+        for ts, path in runs.items():
+            if newest is None or ts > newest[0]:
+                newest = (ts, path)
+    return load_dir(newest[1]) if newest else None
+
+
+def delete(name: str | None = None, store_dir=None):
+    """Delete stored runs (store.clj:248-266)."""
+    base = base_dir({"store-dir": store_dir} if store_dir else None)
+    target = base / name if name else base
+    if target.exists():
+        shutil.rmtree(target)

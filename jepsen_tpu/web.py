@@ -1,0 +1,145 @@
+"""Web UI: browse stored test runs.
+
+Mirrors ``jepsen.web`` (reference: jepsen/src/jepsen/web.clj): a tiny HTTP
+app over the store directory — a home table of runs colored by validity
+(web.clj:25-41,128-158), directory listings and file serving with a
+path-traversal guard (web.clj:235-284, 328-333), and zip download of a
+whole test directory (web.clj:286-327).  stdlib http.server; no deps.
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import unquote
+
+from jepsen_tpu import store
+
+logger = logging.getLogger(__name__)
+
+VALID_COLORS = {True: "#6DB6FE", False: "#FFAA26", "unknown": "#FEB5DA"}
+
+
+def _valid_of(run_dir: Path):
+    """Cheap validity peek: read only results.json's valid? key — the role
+    of the reference's PartialMap lazy reads (web.clj:61-94,
+    store/format.clj:113-129)."""
+    p = run_dir / "results.json"
+    if not p.exists():
+        return None
+    try:
+        return json.loads(p.read_text()).get("valid?")
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def home_html(store_dir=None) -> str:
+    rows = []
+    for name, runs in sorted(store.tests(store_dir=store_dir).items()):
+        for ts, d in sorted(runs.items(), reverse=True):
+            v = _valid_of(d)
+            color = VALID_COLORS.get(v, "#eee")
+            rows.append(
+                f"<tr style='background:{color}'>"
+                f"<td>{html.escape(name)}</td>"
+                f"<td><a href='/files/{html.escape(name)}/{html.escape(ts)}/'>"
+                f"{html.escape(ts)}</a></td>"
+                f"<td>{html.escape(str(v))}</td>"
+                f"<td><a href='/zip/{html.escape(name)}/{html.escape(ts)}'>zip</a></td>"
+                f"</tr>"
+            )
+    return (
+        "<html><head><title>jepsen-tpu</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{padding:4px 12px;text-align:left}</style></head><body>"
+        "<h1>jepsen-tpu results</h1>"
+        "<table><tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+def _safe_resolve(base: Path, rel: str) -> Path | None:
+    """Path-traversal guard (web.clj:328-333)."""
+    target = (base / rel).resolve()
+    base = base.resolve()
+    if base == target or base in target.parents:
+        return target
+    return None
+
+
+class Handler(BaseHTTPRequestHandler):
+    store_dir = None
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug("web: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype="text/html; charset=utf-8"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        try:
+            path = unquote(self.path.split("?")[0])
+            base = store.base_dir({"store-dir": self.store_dir} if self.store_dir else None)
+            if path in ("/", "/index.html"):
+                self._send(200, home_html(self.store_dir).encode())
+            elif path.startswith("/files/"):
+                target = _safe_resolve(base, path[len("/files/"):])
+                if target is None or not target.exists():
+                    self._send(404, b"not found")
+                elif target.is_dir():
+                    entries = sorted(target.iterdir())
+                    items = "".join(
+                        f"<li><a href='{html.escape(e.name)}{'/' if e.is_dir() else ''}'>"
+                        f"{html.escape(e.name)}</a></li>"
+                        for e in entries
+                    )
+                    self._send(200, f"<html><body><ul>{items}</ul></body></html>".encode())
+                else:
+                    ctype = (
+                        "application/json" if target.suffix == ".json"
+                        else "text/plain; charset=utf-8"
+                    )
+                    self._send(200, target.read_bytes(), ctype)
+            elif path.startswith("/zip/"):
+                target = _safe_resolve(base, path[len("/zip/"):])
+                if target is None or not target.is_dir():
+                    self._send(404, b"not found")
+                else:
+                    buf = io.BytesIO()
+                    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                        for f in sorted(target.rglob("*")):
+                            if f.is_file():
+                                z.write(f, f.relative_to(target.parent))
+                    self._send(200, buf.getvalue(), "application/zip")
+            else:
+                self._send(404, b"not found")
+        except BrokenPipeError:  # pragma: no cover
+            pass
+        except Exception:  # noqa: BLE001 - pragma: no cover
+            logger.exception("web handler error")
+            self._send(500, b"internal error")
+
+
+def make_server(host="0.0.0.0", port=8080, store_dir=None) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (Handler,), {"store_dir": store_dir})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(host="0.0.0.0", port=8080, store_dir=None):
+    """Blocking server (web.clj:385-390)."""
+    srv = make_server(host, port, store_dir)
+    logger.info("serving store on http://%s:%d", host, port)
+    try:
+        srv.serve_forever()
+    finally:
+        srv.server_close()

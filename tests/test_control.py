@@ -1,0 +1,275 @@
+"""Control layer tests: dummy + local remotes, sessions, on_nodes fan-out,
+escaping, daemon utilities (control_test.clj patterns, minus real SSH)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control, db, net, os_support
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.control.core import (
+    DummyRemote,
+    Lit,
+    LocalRemote,
+    RemoteExecError,
+    escape,
+    full_cmd,
+)
+
+
+# ---------------------------------------------------------------------------
+# Escaping
+# ---------------------------------------------------------------------------
+
+
+def test_escape_quotes_specials():
+    assert escape(["echo", "hello world"]) == "echo 'hello world'"
+    assert escape(["echo", "a;rm -rf /"]) == "echo 'a;rm -rf /'"
+    assert escape(["echo", "plain"]) == "echo plain"
+
+
+def test_escape_literals_pass_through():
+    assert escape(["echo", "hi", Lit(">"), "/tmp/f"]) == "echo hi > /tmp/f"
+
+
+def test_full_cmd_sudo_cd_env():
+    a = {"cmd": "whoami", "sudo": "postgres", "dir": "/tmp", "env": {"A": "b c"}}
+    cmd = full_cmd(a)
+    assert "sudo -n -u postgres" in cmd
+    assert "cd /tmp &&" in cmd
+    assert "env A=" in cmd
+
+
+# ---------------------------------------------------------------------------
+# Dummy remote
+# ---------------------------------------------------------------------------
+
+
+def dummy_test(**kw):
+    return {"nodes": ["n1", "n2", "n3"], "ssh": {"dummy?": True}, **kw}
+
+
+def test_dummy_session_records():
+    t = dummy_test()
+    s = control.session(t, "n1")
+    out = s.exec("echo", "hi")
+    assert out == ""
+    assert s.remote.history[0]["cmd"] == "echo hi"
+    assert s.remote.history[0]["host"] == "n1"
+
+
+def test_on_nodes_parallel_fanout():
+    t = dummy_test()
+    res = control.on_nodes(t, lambda test, node, s: s.exec("hostname") or node)
+    assert res == {"n1": "n1", "n2": "n2", "n3": "n3"}
+
+
+def test_dummy_handler_scripts_responses():
+    t = dummy_test(remote=DummyRemote(handler=lambda a: {"out": "scripted\n"}))
+    s = control.session(t, "n1")
+    assert s.exec("anything") == "scripted"
+
+
+# ---------------------------------------------------------------------------
+# Local remote — real subprocesses
+# ---------------------------------------------------------------------------
+
+
+def local_test(**kw):
+    return {"nodes": ["local"], "ssh": {"local?": True}, **kw}
+
+
+def test_local_exec():
+    s = control.session(local_test(), "local")
+    assert s.exec("echo", "hello world") == "hello world"
+
+
+def test_local_nonzero_raises():
+    s = control.session(local_test(), "local")
+    with pytest.raises(RemoteExecError):
+        s.exec("false")
+    assert s.exec_result("false")["exit"] == 1
+
+
+def test_local_stdin_and_write_file(tmp_path):
+    s = control.session(local_test(), "local")
+    path = str(tmp_path / "f.txt")
+    s.write_file("payload\n", path)
+    assert open(path).read() == "payload\n"
+
+
+def test_local_cd(tmp_path):
+    s = control.session(local_test(), "local")
+    with s.cd(str(tmp_path)):
+        assert s.exec("pwd") == str(tmp_path)
+
+
+def test_local_injection_is_quoted(tmp_path):
+    marker = tmp_path / "pwned"
+    s = control.session(local_test(), "local")
+    s.exec("echo", f"; touch {marker}")
+    assert not marker.exists()
+
+
+# ---------------------------------------------------------------------------
+# control.util over the local remote
+# ---------------------------------------------------------------------------
+
+
+def test_exists_and_tmp(tmp_path):
+    s = control.session(local_test(), "local")
+    assert cu.exists(s, str(tmp_path))
+    assert not cu.exists(s, str(tmp_path / "nope"))
+    f = cu.tmp_file(s)
+    try:
+        assert cu.exists(s, f)
+    finally:
+        s.exec("rm", "-f", f)
+
+
+def test_daemon_lifecycle(tmp_path):
+    s = control.session(local_test(), "local")
+    pidfile = str(tmp_path / "d.pid")
+    logfile = str(tmp_path / "d.log")
+    assert not cu.daemon_running(s, pidfile)
+    cu.start_daemon(s, "sleep", "30", pidfile=pidfile, logfile=logfile)
+    assert cu.daemon_running(s, pidfile)
+    assert cu.start_daemon(s, "sleep", "30", pidfile=pidfile, logfile=logfile) == "already-running"
+    assert cu.stop_daemon(s, pidfile) == "stopped"
+    assert not cu.daemon_running(s, pidfile)
+
+
+def test_install_archive_tar(tmp_path):
+    # Build a tarball with a single top-level dir; install must strip it.
+    src = tmp_path / "pkg-1.0"
+    src.mkdir()
+    (src / "bin").mkdir()
+    (src / "bin" / "tool").write_text("#!/bin/sh\necho ok\n")
+    tarball = tmp_path / "pkg.tar.gz"
+    os.system(f"tar -czf {tarball} -C {tmp_path} pkg-1.0")
+    s = control.session(local_test(), "local")
+    dest = str(tmp_path / "installed")
+    # file:// via cached_wget needs wget; use the local path through a copy
+    import jepsen_tpu.control.util as util
+
+    orig = util.cached_wget
+    util.cached_wget = lambda s_, url, force=False: str(tarball)
+    try:
+        cu.install_archive(s, "http://example/pkg.tar.gz", dest)
+    finally:
+        util.cached_wget = orig
+    assert (tmp_path / "installed" / "bin" / "tool").exists()
+
+
+# ---------------------------------------------------------------------------
+# DB / OS protocols over dummy remote
+# ---------------------------------------------------------------------------
+
+
+class RecordingDB(db.DB):
+    def __init__(self, fail_setups: int = 0):
+        self.events = []
+        self.fail_setups = fail_setups
+
+    def setup(self, test, node, session):
+        if self.fail_setups > 0:
+            self.fail_setups -= 1
+            raise db.SetupFailed("nope")
+        self.events.append(("setup", node))
+
+    def teardown(self, test, node, session):
+        self.events.append(("teardown", node))
+
+
+def test_cycle_db_teardown_then_setup():
+    d = RecordingDB()
+    t = dummy_test(db=d)
+    db.cycle_db(t)
+    kinds = [k for k, _ in d.events]
+    assert kinds[:3] == ["teardown"] * 3
+    assert kinds[3:] == ["setup"] * 3
+
+
+def test_cycle_db_retries_setup_failures():
+    d = RecordingDB(fail_setups=1)
+    t = dummy_test(db=d)
+    db.cycle_db(t, retries=3)
+    assert ("setup", "n1") in d.events or ("setup", "n2") in d.events
+
+
+def test_db_capability_probe():
+    class WithProcess(db.DB):
+        def start(self, test, node, session):
+            pass
+
+        def kill(self, test, node, session):
+            pass
+
+    assert db.supports(WithProcess(), "start")
+    assert not db.supports(db.NoopDB(), "start")
+    assert not db.supports(db.NoopDB(), "primaries")
+
+
+def test_composed_db_order():
+    events = []
+
+    class A(db.DB):
+        def setup(self, test, node, session):
+            events.append("a-up")
+
+        def teardown(self, test, node, session):
+            events.append("a-down")
+
+    class B(db.DB):
+        def setup(self, test, node, session):
+            events.append("b-up")
+
+        def teardown(self, test, node, session):
+            events.append("b-down")
+
+    t = dummy_test(db=db.compose([A(), B()]))
+    db.cycle_db(t, retries=1)
+    per_node = events[: len(events) // 3] if events else []
+    # teardown reverse order (b,a), then setup forward (a,b) — per node.
+    assert events[0:2] == ["b-down", "a-down"]
+    assert "a-up" in events and events.index("a-up") < events.index("b-up")
+
+
+def test_noop_net_records_grudges():
+    n = net.noop()
+    t = dummy_test(net=n)
+    n.drop_all(t, {"n1": {"n2"}})
+    assert n.grudge == {"n1": {"n2"}}
+    n.heal(t)
+    assert n.grudge is None
+
+
+def test_iptables_net_issues_batched_rules():
+    t = dummy_test()
+    sess = control.sessions(t)
+    hist = sess["n1"].remote.history
+    # Pre-resolve: stub getent responses via handler-less dummy (exec returns "")
+    n = net.IptablesNet()
+    n._ip_cache.update({"n2": "10.0.0.2", "n3": "10.0.0.3"})
+    n.drop_all(t, {"n1": {"n2", "n3"}})
+    cmds = [h.get("cmd", "") for h in hist]
+    assert any("iptables -A INPUT -s 10.0.0.2,10.0.0.3 -j DROP" in c for c in cmds)
+    n.heal(t)
+    cmds = [h.get("cmd", "") for h in hist]
+    assert any("iptables -F" in c for c in cmds)
+
+
+def test_debian_os_uses_su():
+    # dpkg-query "fails" so setup proceeds to apt-get install.
+    t = dummy_test(
+        remote=DummyRemote(
+            handler=lambda a: {"exit": 1} if "dpkg-query" in a["cmd"] else {}
+        )
+    )
+    sess = control.sessions(t)
+    osd = os_support.DebianOS()
+    osd.setup(t, "n1", sess["n1"])
+    acts = sess["n1"].remote.history
+    assert any(
+        a.get("sudo") == "root" and "apt-get install" in a.get("cmd", "") for a in acts
+    )

@@ -1,0 +1,230 @@
+"""Orchestrator + store + CLI + web tests: the whole pipeline on the dummy
+remote with the in-memory backend (core_test.clj:62-120 pattern)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, core, db, generator as gen, models as m, store, testkit, web
+from jepsen_tpu.checker import compose, stats
+from jepsen_tpu.checker.linearizable import linearizable
+
+
+def r(f="read", value=None):
+    return {"f": f, "value": value}
+
+
+def cas_workload(n_ops):
+    import random
+
+    rng = random.Random(7)
+
+    def one():
+        k = rng.random()
+        if k < 0.4:
+            return {"f": "read"}
+        if k < 0.8:
+            return {"f": "write", "value": rng.randint(0, 4)}
+        return {"f": "cas", "value": [rng.randint(0, 4), rng.randint(0, 4)]}
+
+    return gen.clients(gen.limit(n_ops, gen.repeat(one)))
+
+
+def base_test(tmp_path, **kw):
+    t = testkit.noop_test(
+        name="core-test",
+        concurrency=3,
+        client=testkit.atom_client(),
+        generator=cas_workload(50),
+        checker=compose(
+            {
+                "stats": stats(),
+                "linear": linearizable({"model": m.CASRegister(None), "algorithm": "wgl"}),
+            }
+        ),
+    )
+    t["store-dir"] = str(tmp_path / "store")
+    t.update(kw)
+    return t
+
+
+def test_run_test_end_to_end(tmp_path):
+    completed = core.run_test(base_test(tmp_path))
+    assert completed["results"]["valid?"] is True
+    assert completed["results"]["linear"]["valid?"] is True
+    h = completed["history"]
+    assert len(h) == 100
+    assert [o["index"] for o in h] == list(range(100))
+    # Atom register is linearizable; stats sees ok ops.
+    assert completed["results"]["stats"]["ok-count"] > 0
+
+
+def test_run_test_writes_store_artifacts(tmp_path):
+    completed = core.run_test(base_test(tmp_path))
+    d = store.test_dir(completed)
+    assert (d / "test.json").exists()
+    assert (d / "history.jsonl").exists()
+    assert (d / "history.txt").exists()
+    assert (d / "results.json").exists()
+    res = json.loads((d / "results.json").read_text())
+    assert res["valid?"] is True
+    # latest symlinks
+    assert (d.parent / "latest").resolve() == d.resolve()
+
+
+def test_store_load_roundtrip(tmp_path):
+    completed = core.run_test(base_test(tmp_path))
+    loaded = store.latest(store_dir=completed["store-dir"])
+    assert loaded["name"] == "core-test"
+    assert len(loaded["history"]) == 100
+    assert loaded["results"]["valid?"] is True
+
+
+def test_analyze_rechecks_stored_history(tmp_path):
+    completed = core.run_test(base_test(tmp_path))
+    loaded = store.latest(store_dir=completed["store-dir"])
+    loaded["checker"] = linearizable({"model": m.CASRegister(None), "algorithm": "wgl"})
+    loaded["store-dir"] = completed["store-dir"]
+    re = core.analyze(loaded)
+    assert re["results"]["valid?"] is True
+
+
+def test_run_test_invalid_checker_result(tmp_path):
+    class AlwaysFalse:
+        def check(self, test, history, opts):
+            return {"valid?": False, "why": "because"}
+
+    t = base_test(tmp_path, checker=AlwaysFalse())
+    completed = core.run_test(t)
+    assert completed["results"]["valid?"] is False
+
+
+def test_db_lifecycle_ordering(tmp_path):
+    events = []
+
+    class TrackingDB(db.DB):
+        def setup(self, test, node, session):
+            events.append(("setup", node))
+
+        def teardown(self, test, node, session):
+            events.append(("teardown", node))
+
+    t = base_test(tmp_path, db=TrackingDB())
+    core.run_test(t)
+    # cycle: teardown all, setup all; final teardown at end.
+    n = 5
+    assert [k for k, _ in events[:n]] == ["teardown"] * n
+    assert [k for k, _ in events[n : 2 * n]] == ["setup"] * n
+    assert [k for k, _ in events[2 * n :]] == ["teardown"] * n
+
+
+def test_exception_in_db_setup_still_tears_down(tmp_path):
+    class BrokenDB(db.DB):
+        def setup(self, test, node, session):
+            raise RuntimeError("disk on fire")
+
+        def teardown(self, test, node, session):
+            pass
+
+    t = base_test(tmp_path, db=BrokenDB())
+    with pytest.raises(RuntimeError):
+        core.run_test(t)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def make_test_fn(tmp_path):
+    def test_fn(opts):
+        t = base_test(tmp_path)
+        t.update(
+            {
+                "nodes": opts["nodes"],
+                "concurrency": opts["concurrency"],
+                "ssh": {"dummy?": True},
+            }
+        )
+        return t
+
+    return test_fn
+
+
+def test_cli_test_exit_zero(tmp_path):
+    code = cli.run_cli(
+        make_test_fn(tmp_path),
+        ["test", "--no-ssh", "--nodes", "a,b,c"],
+    )
+    assert code == cli.EXIT_VALID
+
+
+def test_cli_analyze_latest(tmp_path):
+    fn = make_test_fn(tmp_path)
+    assert cli.run_cli(fn, ["test", "--no-ssh"]) == 0
+    code = cli.run_cli(
+        fn, ["analyze", "--no-ssh", "--store-dir", str(tmp_path / "store")]
+    )
+    assert code == cli.EXIT_VALID
+
+
+def test_cli_invalid_gives_exit_1(tmp_path):
+    class AlwaysFalse:
+        def check(self, test, history, opts):
+            return {"valid?": False}
+
+    def fn(opts):
+        t = base_test(tmp_path, checker=AlwaysFalse())
+        t["ssh"] = {"dummy?": True}
+        return t
+
+    assert cli.run_cli(fn, ["test", "--no-ssh"]) == cli.EXIT_INVALID
+
+
+def test_cli_concurrency_multiplier():
+    got = {}
+
+    def fn(opts):
+        got.update(opts)
+        raise KeyboardInterrupt  # stop before running
+
+    cli.run_cli(fn, ["test", "--no-ssh", "--nodes", "a,b", "--concurrency", "3n"])
+    assert got["concurrency"] == "3n"
+    t = core.prepare_test({"nodes": ["a", "b"], "concurrency": "3n"})
+    assert t["concurrency"] == 6
+
+
+# ---------------------------------------------------------------------------
+# Web
+# ---------------------------------------------------------------------------
+
+
+def test_web_home_and_files_and_zip(tmp_path):
+    completed = core.run_test(base_test(tmp_path))
+    srv = web.make_server(host="127.0.0.1", port=0, store_dir=completed["store-dir"])
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        home = urllib.request.urlopen(f"http://127.0.0.1:{port}/").read().decode()
+        assert "core-test" in home
+        ts = completed["start-time-str"]
+        res = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/core-test/{ts}/results.json"
+        ).read()
+        assert json.loads(res)["valid?"] is True
+        z = urllib.request.urlopen(f"http://127.0.0.1:{port}/zip/core-test/{ts}").read()
+        assert z[:2] == b"PK"
+        # Traversal guard
+        try:
+            bad = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/../../etc/passwd"
+            )
+            assert bad.status == 404
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
