@@ -1,0 +1,19 @@
+"""Workload bundles: reusable generator+checker packages.
+
+Mirrors the reference's ``jepsen.tests.*`` namespaces (SURVEY.md §2.1): each
+module exposes a ``workload(opts) -> dict`` with at least ``generator`` and
+``checker`` keys (plus ``final_generator`` where the workload needs a
+read-back phase), ready to merge into a test map — the same bundle shape as
+e.g. ``jepsen.tests.bank/test`` (tests/bank.clj:179-192).
+"""
+
+from jepsen_tpu.workloads import (  # noqa: F401
+    adya,
+    append,
+    bank,
+    causal,
+    linearizable_register,
+    long_fork,
+    sets,
+    wr,
+)

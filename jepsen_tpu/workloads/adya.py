@@ -1,0 +1,73 @@
+"""Adya G2: anti-dependency cycles via predicate reads (write skew).
+
+Mirrors ``jepsen.tests.adya`` (reference: jepsen/tests/adya.clj, 87 LoC):
+pairs of transactions each read a predicate over two rows ``(key, a)`` and
+``(key, b)`` and insert their own row only if the *other* row is absent.
+Serializability forbids both from committing — if both do, each read
+missed the other's write: a G2 anomaly (two rw anti-dependency edges
+forming a cycle).
+
+Ops: {"f": "txn", "value": {"key": k, "id": 1|2, "read": [row-a?, row-b?]}}
+— the client fills "read" with what the predicate observed and sets type
+ok iff its insert committed.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+
+def generator() -> gen.Gen:
+    """Two ops per key, one for each row id (adya.clj:30-60)."""
+    counter = itertools.count()
+
+    def pair():
+        k = next(counter)
+        return [
+            {"f": "txn", "value": {"key": k, "id": 1}},
+            {"f": "txn", "value": {"key": k, "id": 2}},
+        ]
+
+    return gen.repeat(pair)
+
+
+class G2Checker(Checker):
+    """Both inserts for a key committing, each having read the other row as
+    absent, is write skew (adya.clj:62-87)."""
+
+    def check(self, test, history, opts):
+        by_key: dict = {}
+        for o in history:
+            if h.is_ok(o) and o.get("f") == "txn":
+                v = o.get("value") or {}
+                by_key.setdefault(v.get("key"), []).append(o)
+        anomalies = []
+        for k, ops in by_key.items():
+            ids = {(o["value"] or {}).get("id") for o in ops}
+            if {1, 2} <= ids:
+                committed = [o for o in ops if (o["value"] or {}).get("id") in (1, 2)]
+                saw_other = [
+                    o
+                    for o in committed
+                    if not any((o["value"] or {}).get("read") or [])
+                ]
+                if len(saw_other) >= 2:
+                    anomalies.append({"key": k, "ops": committed[:2]})
+        return {
+            "valid?": not anomalies,
+            "anomaly-count": len(anomalies),
+            "anomalies": anomalies[:10],
+        }
+
+
+def checker() -> Checker:
+    return G2Checker()
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    return {"generator": generator(), "checker": checker()}

@@ -1,0 +1,47 @@
+"""Elle list-append workload: append/read transactions + cycle checking.
+
+Mirrors ``jepsen.tests.cycle.append`` (reference:
+jepsen/tests/cycle/append.clj): the generator streams transactions of
+``["append", k, unique-v]`` / ``["r", k, None]`` micro-ops
+(cycle/append.clj:24-28 re-exports elle's generator; ours is
+jepsen_tpu.txn.append_txns), and the checker is the Elle-equivalent
+list-append dependency-graph analysis (jepsen_tpu.checker.elle).
+
+Ops: {"f": "txn", "value": [[mop-f, key, value], ...]}
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import txn as jtxn
+from jepsen_tpu.checker import elle
+
+
+def generator(opts: Mapping | None = None) -> gen.Gen:
+    opts = dict(opts or {})
+    rng = random.Random(opts.get("seed"))
+    txns = jtxn.append_txns(
+        rng,
+        key_count=opts.get("key-count", 3),
+        min_txn_length=opts.get("min-txn-length", 1),
+        max_txn_length=opts.get("max-txn-length", 4),
+        max_writes_per_key=opts.get("max-writes-per-key", 32),
+    )
+    return gen.repeat(lambda: {"f": "txn", "value": next(txns)})
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    """(cycle/append.clj:30-55)."""
+    opts = dict(opts or {})
+    kw = {}
+    if "anomalies" in opts:
+        kw["anomalies"] = opts["anomalies"]
+    if "additional-graphs" in opts:
+        kw["additional_graphs"] = opts["additional-graphs"]
+    return {
+        "generator": generator(opts),
+        "checker": elle.list_append(**kw),
+    }

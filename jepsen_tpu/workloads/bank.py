@@ -1,0 +1,101 @@
+"""Bank workload: concurrent transfers must conserve total money.
+
+Mirrors ``jepsen.tests.bank`` (reference: jepsen/tests/bank.clj): a set of
+accounts with a fixed total; transfer ops move money between two accounts,
+read ops snapshot all balances (bank.clj:20-44).  Under snapshot isolation
+or weaker, write skew lets reads observe totals drifting — the checker
+asserts every ok read sums to ``total-amount`` and (optionally) that no
+balance goes negative (bank.clj:57-121).
+
+Ops:
+  {"f": "read",     "value": None -> {account: balance}}
+  {"f": "transfer", "value": {"from": a, "to": b, "amount": n}}
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+DEFAULT_ACCOUNTS = list(range(8))
+DEFAULT_TOTAL = 100
+DEFAULT_MAX_TRANSFER = 5
+
+
+def read_op(test, ctx):
+    return {"f": "read", "value": None}
+
+
+def transfer_op(accounts, max_transfer):
+    def f(test, ctx):
+        a, b = random.sample(list(accounts), 2)
+        return {
+            "f": "transfer",
+            "value": {"from": a, "to": b, "amount": random.randint(1, max_transfer)},
+        }
+
+    return f
+
+
+def generator(opts: Mapping | None = None) -> gen.Gen:
+    """Roughly even mix of reads and transfers (bank.clj:36-44)."""
+    opts = dict(opts or {})
+    accounts = opts.get("accounts", DEFAULT_ACCOUNTS)
+    max_transfer = opts.get("max-transfer", DEFAULT_MAX_TRANSFER)
+    return gen.mix([gen.repeat(read_op), gen.repeat(transfer_op(accounts, max_transfer))])
+
+
+class BankChecker(Checker):
+    """(bank.clj:57-121)."""
+
+    def __init__(self, negative_balances_ok: bool = False):
+        self.negative_balances_ok = negative_balances_ok
+
+    def check(self, test, history, opts):
+        total = test.get("total-amount", DEFAULT_TOTAL)
+        accounts = set(test.get("accounts", DEFAULT_ACCOUNTS))
+        bad_reads = []
+        read_count = 0
+        for o in history:
+            if not (h.is_ok(o) and o["f"] == "read"):
+                continue
+            read_count += 1
+            balances = o.get("value") or {}
+            errs = []
+            got_total = sum(balances.values())
+            if set(balances) != accounts:
+                errs.append(f"accounts {sorted(balances)} != expected {sorted(accounts)}")
+            if got_total != total:
+                errs.append(f"total {got_total} != expected {total}")
+            if not self.negative_balances_ok:
+                neg = {a: v for a, v in balances.items() if v < 0}
+                if neg:
+                    errs.append(f"negative balances {neg}")
+            if errs:
+                bad_reads.append({"op": o, "errors": errs})
+        return {
+            "valid?": not bad_reads,
+            "read-count": read_count,
+            "bad-reads": bad_reads[:10],
+            "bad-read-count": len(bad_reads),
+        }
+
+
+def checker(negative_balances_ok: bool = False) -> Checker:
+    return BankChecker(negative_balances_ok)
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    """Bundle (bank.clj:179-192)."""
+    opts = dict(opts or {})
+    return {
+        "accounts": opts.get("accounts", DEFAULT_ACCOUNTS),
+        "total-amount": opts.get("total-amount", DEFAULT_TOTAL),
+        "max-transfer": opts.get("max-transfer", DEFAULT_MAX_TRANSFER),
+        "generator": generator(opts),
+        "checker": checker(opts.get("negative-balances?", False)),
+    }

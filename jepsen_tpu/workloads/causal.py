@@ -1,0 +1,156 @@
+"""Causal-consistency workloads.
+
+Mirrors ``jepsen.tests.causal`` and ``jepsen.tests.causal-reverse``
+(reference: jepsen/tests/causal.clj 131 LoC, causal_reverse.clj 114 LoC):
+
+* ``causal``: a single register driven by one logical session performing
+  write 1 → read → write 2 → read; causal consistency requires
+  read-your-writes and monotonic reads within the session, so the first
+  read must see 1 and the second 2 (causal.clj's CO ops).
+* ``causal_reverse``: sequentially-ordered inserts whose order must not be
+  observed reversed — a read that sees a *later* insert but misses an
+  *earlier* one violates the prefix property (causal_reverse.clj's
+  lost-update ordering check).
+
+Ops:
+  causal:          {"f": "write"|"read", "value": int|None}
+  causal_reverse:  {"f": "insert", "value": k} and
+                   {"f": "read", "value": None -> [k...]}
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+
+def generator() -> gen.Gen:
+    """One session's CO chain (causal.clj ops)."""
+    return gen.on_threads(
+        lambda t: t == 0,
+        [
+            {"f": "write", "value": 1},
+            {"f": "read", "value": None},
+            {"f": "write", "value": 2},
+            {"f": "read", "value": None},
+        ],
+    )
+
+
+class CausalChecker(Checker):
+    """Per-process read-your-writes + monotonic reads on a register
+    (causal.clj:40-100)."""
+
+    def check(self, test, history, opts):
+        errors = []
+        last_write: dict = {}
+        last_read: dict = {}
+        pairs = h.pair_index(history)
+        for i, o in enumerate(history):
+            if not h.is_invoke(o) or not h.is_client_op(o):
+                continue
+            j = int(pairs[i])
+            comp = history[j] if j != -1 else None
+            if comp is None or comp["type"] != h.OK:
+                continue
+            p = o["process"]
+            if o["f"] == "write":
+                last_write[p] = o["value"]
+            elif o["f"] == "read":
+                v = comp.get("value")
+                if p in last_write and v != last_write[p] and (
+                    last_read.get(p) is None or v == last_read.get(p)
+                ):
+                    # Saw neither our write nor progress past it.
+                    if v is None or (
+                        isinstance(v, int)
+                        and isinstance(last_write[p], int)
+                        and v < last_write[p]
+                    ):
+                        errors.append(
+                            {
+                                "op": comp,
+                                "error": f"read {v!r} but process {p} wrote {last_write[p]!r}",
+                            }
+                        )
+                if (
+                    p in last_read
+                    and isinstance(v, int)
+                    and isinstance(last_read[p], int)
+                    and v < last_read[p]
+                ):
+                    errors.append(
+                        {"op": comp, "error": f"non-monotonic read {v!r} after {last_read[p]!r}"}
+                    )
+                last_read[p] = v
+        return {"valid?": not errors, "errors": errors[:10]}
+
+
+def checker() -> Checker:
+    return CausalChecker()
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    return {"generator": generator(), "checker": checker()}
+
+
+# ---------------------------------------------------------------------------
+# causal-reverse (causal_reverse.clj)
+# ---------------------------------------------------------------------------
+
+
+def reverse_generator() -> gen.Gen:
+    counter = itertools.count()
+    return gen.mix(
+        [
+            gen.repeat(lambda: {"f": "insert", "value": next(counter)}),
+            gen.repeat({"f": "read", "value": None}),
+        ]
+    )
+
+
+class CausalReverseChecker(Checker):
+    """Inserts are issued in increasing order; a read seeing k but missing
+    some acknowledged j<k (j inserted before k began) observed them out of
+    order (causal_reverse.clj:40-100)."""
+
+    def check(self, test, history, opts):
+        pairs = h.pair_index(history)
+        # insert value -> (invoke index, ok?)
+        acked = {}
+        for i, o in enumerate(history):
+            if h.is_invoke(o) and o["f"] == "insert":
+                j = int(pairs[i])
+                if j != -1 and history[j]["type"] == h.OK:
+                    acked[o["value"]] = (i, j)
+        errors = []
+        for i, o in enumerate(history):
+            if not (h.is_ok(o) and o["f"] == "read"):
+                continue
+            seen = set(o.get("value") or [])
+            inv_i = int(pairs[i])
+            for k in seen:
+                if k not in acked:
+                    continue
+                for jv, (ji, jj) in acked.items():
+                    # j's ok came before k's invoke → j happens-before k.
+                    if jv < k and jj < acked[k][0] and jv not in seen:
+                        errors.append(
+                            {
+                                "op": o,
+                                "error": f"read saw {k} but missed earlier acked {jv}",
+                            }
+                        )
+        return {"valid?": not errors, "errors": errors[:10]}
+
+
+def reverse_checker() -> Checker:
+    return CausalReverseChecker()
+
+
+def reverse_workload(opts: Mapping | None = None) -> dict:
+    return {"generator": reverse_generator(), "checker": reverse_checker()}

@@ -1,0 +1,65 @@
+"""Linearizable register workload over an independent keyspace.
+
+Mirrors ``jepsen.tests.linearizable-register`` (reference:
+jepsen/tests/linearizable_register.clj): a concurrent-generator of
+read/write/cas per key, each key's subhistory checked with the
+cas-register model + timeline (linearizable_register.clj:26-53).  Per-key
+op and process budgets keep the NP-hard search tractable
+(per-key-limit ~20, process-limit 20, linearizable_register.clj:30-33) —
+and give the TPU backend its vmap batch axis.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, models
+from jepsen_tpu.checker import compose
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.checker.timeline import timeline_checker
+
+
+def r(test=None, ctx=None):
+    return {"f": "read", "value": None}
+
+
+def w(test=None, ctx=None):
+    return {"f": "write", "value": random.randint(0, 4)}
+
+
+def cas(test=None, ctx=None):
+    return {"f": "cas", "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    opts = dict(opts or {})
+    n = opts.get("concurrency", 10)
+    per_key_limit = opts.get("per-key-limit", 20)
+    process_limit = opts.get("process-limit", 20)
+    algorithm = opts.get("algorithm", "competition")
+    threads_per_key = max(1, min(n, opts.get("threads-per-key", n)))
+    n_keys = opts.get("key-count", 64)
+
+    def per_key(k):
+        return gen.process_limit(
+            process_limit,
+            gen.limit(per_key_limit, gen.mix([gen.repeat(r), gen.repeat(w), gen.repeat(cas)])),
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            threads_per_key, list(range(n_keys)), per_key
+        ),
+        "checker": independent.checker(
+            compose(
+                {
+                    "linear": linearizable(
+                        {"model": models.CASRegister(None), "algorithm": algorithm}
+                    ),
+                    "timeline": timeline_checker(),
+                }
+            )
+        ),
+    }

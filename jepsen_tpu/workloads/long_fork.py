@@ -1,0 +1,115 @@
+"""Long-fork workload: detects the parallel-snapshot-isolation anomaly.
+
+Mirrors ``jepsen.tests.long-fork`` (reference: jepsen/tests/long_fork.clj,
+332 LoC).  Keys come in groups of n; each key is written *exactly once*
+(value 1), and readers snapshot a whole group in one txn
+(long_fork.clj:117+).  Under PSI, two reads may observe the writes of a
+group in contradictory orders — read A sees x but not y while read B sees
+y but not x.  Since writes are unique and monotone per group, all reads of
+a group must be totally ordered by their seen-write *sets*; any
+⊆-incomparable pair is a long fork (the linear-time verifier of
+long_fork.clj:62-88).
+
+Ops (txn micro-op form, like Elle workloads):
+  write: {"f": "txn", "value": [["w", k, 1]]}
+  read:  {"f": "txn", "value": [["r", k1, None], ..., ["r", kn, None]]}
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+DEFAULT_GROUP_SIZE = 3
+
+
+def group_of(k: int, n: int) -> int:
+    return k // n
+
+
+def group_keys(g: int, n: int) -> list[int]:
+    return list(range(g * n, (g + 1) * n))
+
+
+def generator(n: int = DEFAULT_GROUP_SIZE) -> gen.Gen:
+    """Interleave single-key writes with whole-group reads
+    (long_fork.clj:117-160)."""
+    counter = itertools.count()
+
+    def writes():
+        k = next(counter)
+        return {"f": "txn", "value": [["w", k, 1]]}
+
+    def reads(test, ctx):
+        # Read the most recently active group.
+        cur = max(0, next(counter) - 1)
+        g = group_of(cur, n)
+        return {"f": "txn", "value": [["r", k, None] for k in group_keys(g, n)]}
+
+    return gen.mix([gen.repeat(writes), gen.repeat(reads)])
+
+
+def read_sets(history: Sequence[Mapping], n: int) -> dict:
+    """{group: [set-of-keys-seen-written, ...]} from ok group reads."""
+    out: dict = {}
+    for o in history:
+        if not (h.is_ok(o) and o.get("f") == "txn"):
+            continue
+        mops = o.get("value") or []
+        rs = [(m[1], m[2]) for m in mops if m[0] == "r"]
+        if len(rs) < 2:
+            continue
+        g = group_of(rs[0][0], n)
+        if any(group_of(k, n) != g for k, _ in rs):
+            continue
+        seen = frozenset(k for k, v in rs if v is not None)
+        out.setdefault(g, []).append({"op": o, "seen": seen})
+    return out
+
+
+class LongForkChecker(Checker):
+    """All reads of a group must be ⊆-comparable (long_fork.clj:62-88)."""
+
+    def __init__(self, n: int = DEFAULT_GROUP_SIZE):
+        self.n = n
+
+    def check(self, test, history, opts):
+        groups = read_sets(history, self.n)
+        forks = []
+        for g, reads in groups.items():
+            # Sort by |seen|; incomparable pairs can only occur between
+            # reads whose set sizes are equal or where neither contains the
+            # other.
+            reads = sorted(reads, key=lambda r: len(r["seen"]))
+            for a, b in itertools.combinations(reads, 2):
+                sa, sb = a["seen"], b["seen"]
+                if not (sa <= sb or sb <= sa):
+                    forks.append(
+                        {
+                            "group": g,
+                            "read-a": a["op"],
+                            "read-b": b["op"],
+                            "only-a": sorted(sa - sb),
+                            "only-b": sorted(sb - sa),
+                        }
+                    )
+        return {
+            "valid?": not forks,
+            "group-count": len(groups),
+            "long-forks": forks[:10],
+            "fork-count": len(forks),
+        }
+
+
+def checker(n: int = DEFAULT_GROUP_SIZE) -> Checker:
+    return LongForkChecker(n)
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    opts = dict(opts or {})
+    n = opts.get("group-size", DEFAULT_GROUP_SIZE)
+    return {"generator": generator(n), "checker": checker(n)}

@@ -1,0 +1,43 @@
+"""Set workloads: unique adds followed (or interleaved) with reads.
+
+Mirrors the reference's set tests (checker.clj:240-291 for the
+final-read form; checker.clj:294-592 set-full for the read-throughout
+element-lifecycle form).
+
+Ops:
+  {"f": "add",  "value": unique int}
+  {"f": "read", "value": None -> collection of ints}
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import basic
+
+
+def add_gen():
+    counter = itertools.count()
+    return gen.repeat(lambda: {"f": "add", "value": next(counter)})
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    """Adds throughout; one final read after a barrier (the classic set
+    test)."""
+    return {
+        "generator": add_gen(),
+        "final_generator": gen.once(gen.repeat({"f": "read", "value": None})),
+        "checker": basic.set_checker(),
+    }
+
+
+def workload_full(opts: Mapping | None = None) -> dict:
+    """Adds and reads interleaved; set-full lifecycle analysis
+    (checker.clj:294-592)."""
+    opts = dict(opts or {})
+    return {
+        "generator": gen.mix([add_gen(), gen.repeat({"f": "read", "value": None})]),
+        "checker": basic.set_full(linearizable=opts.get("linearizable?", False)),
+    }

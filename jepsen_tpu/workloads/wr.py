@@ -1,0 +1,50 @@
+"""Elle rw-register workload: write/read transactions + cycle checking.
+
+Mirrors ``jepsen.tests.cycle.wr`` (reference: jepsen/tests/cycle/wr.clj):
+transactions of ``["w", k, unique-v]`` / ``["r", k, None]`` micro-ops
+(generator: jepsen_tpu.txn.wr_txns), checked by the Elle-equivalent
+rw-register analysis with the G0/G1a/G1b/G1c/G-single/G2 anomaly
+vocabulary (cycle/wr.clj:30-46).
+
+Ops: {"f": "txn", "value": [[mop-f, key, value], ...]}
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Mapping
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import txn as jtxn
+from jepsen_tpu.checker import elle
+
+
+def generator(opts: Mapping | None = None) -> gen.Gen:
+    opts = dict(opts or {})
+    rng = random.Random(opts.get("seed"))
+    txns = jtxn.wr_txns(
+        rng,
+        key_count=opts.get("key-count", 2),
+        min_txn_length=opts.get("min-txn-length", 1),
+        max_txn_length=opts.get("max-txn-length", 2),
+        max_writes_per_key=opts.get("max-writes-per-key", 32),
+    )
+    return gen.repeat(lambda: {"f": "txn", "value": next(txns)})
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    """(cycle/wr.clj:48-54)."""
+    opts = dict(opts or {})
+    kw = {}
+    if "anomalies" in opts:
+        kw["anomalies"] = opts["anomalies"]
+    if "additional-graphs" in opts:
+        kw["additional_graphs"] = opts["additional-graphs"]
+    if opts.get("sequential-keys?"):
+        kw["sequential_keys"] = True
+    if opts.get("linearizable-keys?"):
+        kw["linearizable_keys"] = True
+    return {
+        "generator": generator(opts),
+        "checker": elle.wr_register(**kw),
+    }
