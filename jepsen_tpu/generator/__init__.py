@@ -571,18 +571,23 @@ on = on_threads
 
 
 def clients(gen, final_gen=None) -> Gen:
-    """Run gen on client threads only (generator.clj:1093-1103)."""
+    """Run gen on client threads only (generator.clj:864-883 via
+    on-threads).  The optional ``final_gen`` is a convenience this rebuild
+    adds (the reference's 2-arity routes a *nemesis* gen instead and final
+    phases go through then/phases): it runs after a synchronize barrier, so
+    every outstanding op completes before the final phase begins."""
     g = on_threads(lambda t: t != NEMESIS, gen)
     if final_gen is not None:
-        return _Seq((g, on_threads(lambda t: t != NEMESIS, final_gen)))
+        return phases(g, on_threads(lambda t: t != NEMESIS, final_gen))
     return g
 
 
 def nemesis(gen, final_gen=None) -> Gen:
-    """Run gen on the nemesis thread only (generator.clj:1105-1115)."""
+    """Run gen on the nemesis thread only.  ``final_gen`` (rebuild
+    convenience, see ``clients``) runs after a synchronize barrier."""
     g = on_threads(lambda t: t == NEMESIS, gen)
     if final_gen is not None:
-        return _Seq((g, on_threads(lambda t: t == NEMESIS, final_gen)))
+        return phases(g, on_threads(lambda t: t == NEMESIS, final_gen))
     return g
 
 
@@ -1060,11 +1065,14 @@ def then(a, b) -> Gen:
 
 @dataclasses.dataclass(frozen=True)
 class UntilOk(Gen):
-    """Pass through until one of our ops completes :ok
-    (generator.clj:1443-1473)."""
+    """Pass through until one of *our* ops completes :ok.  Tracks the
+    processes of invocations this generator emitted so sibling generators'
+    :ok completions don't count (generator.clj:1443-1473 tracks
+    active-processes the same way)."""
 
     gen: Gen
     done: bool = False
+    active: frozenset = frozenset()
 
     def op(self, test, ctx):
         if self.done:
@@ -1073,11 +1081,17 @@ class UntilOk(Gen):
         if r is None:
             return None
         o, g2 = r
-        return (o, UntilOk(g2, False))
+        active = self.active
+        if o is not PENDING and isinstance(o, Mapping) and "process" in o:
+            active = active | {o["process"]}
+        return (o, UntilOk(g2, False, active))
 
     def update(self, test, ctx, event):
-        done = self.done or event.get("type") == "ok"
-        return UntilOk(to_gen(self.gen).update(test, ctx, event), done)
+        p = event.get("process")
+        ours = p in self.active
+        done = self.done or (event.get("type") == "ok" and ours)
+        active = self.active - {p} if ours and event.get("type") in ("ok", "info", "fail") else self.active
+        return UntilOk(to_gen(self.gen).update(test, ctx, event), done, active)
 
 
 def until_ok(gen) -> Gen:

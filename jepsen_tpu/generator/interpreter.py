@@ -191,11 +191,15 @@ def run(test: Mapping) -> list[dict]:
         comp = dict(comp)
         comp["time"] = relative_time_nanos()
         thread_id = ctx.thread_of(comp["process"])
+        # The generator must see the completion-time context with the
+        # completing thread already freed (but the old process mapping
+        # intact) — interpreter.clj:215-231.
+        ctx = ctx.with_time(comp["time"])
+        if thread_id is not None:
+            ctx = ctx.free_thread(thread_id)
         if goes_in_history(comp):
             history.append(comp)
-            g2 = g.update(test, ctx, comp)
-        else:
-            g2 = g
+            g = g.update(test, ctx, comp)
         if (
             comp.get("type") == "info"
             and thread_id is not None
@@ -204,9 +208,6 @@ def run(test: Mapping) -> list[dict]:
             # Crashed: the thread continues under a fresh process id
             # (interpreter.clj:233-236).
             ctx = ctx.with_next_process(thread_id)
-        if thread_id is not None:
-            ctx = ctx.free_thread(thread_id)
-        g = g2
         outstanding -= 1
 
     try:

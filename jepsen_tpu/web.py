@@ -13,6 +13,7 @@ import html
 import io
 import json
 import logging
+import mimetypes
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -105,10 +106,14 @@ class Handler(BaseHTTPRequestHandler):
                     )
                     self._send(200, f"<html><body><ul>{items}</ul></body></html>".encode())
                 else:
-                    ctype = (
-                        "application/json" if target.suffix == ".json"
-                        else "text/plain; charset=utf-8"
-                    )
+                    guessed, _ = mimetypes.guess_type(str(target))
+                    if guessed is None or guessed.startswith("text/"):
+                        # Serve unknown/plain files readably in-browser,
+                        # but html (timeline.html!) as real html.
+                        guessed = guessed or "text/plain"
+                        ctype = f"{guessed}; charset=utf-8"
+                    else:
+                        ctype = guessed
                     self._send(200, target.read_bytes(), ctype)
             elif path.startswith("/zip/"):
                 target = _safe_resolve(base, path[len("/zip/"):])

@@ -39,15 +39,18 @@ def generator(n: int = DEFAULT_GROUP_SIZE) -> gen.Gen:
     """Interleave single-key writes with whole-group reads
     (long_fork.clj:117-160)."""
     counter = itertools.count()
+    last_key = [0]  # last issued write key; reads peek, never consume
 
     def writes():
         k = next(counter)
+        last_key[0] = k
         return {"f": "txn", "value": [["w", k, 1]]}
 
     def reads(test, ctx):
-        # Read the most recently active group.
-        cur = max(0, next(counter) - 1)
-        g = group_of(cur, n)
+        # Read the most recently active group (without consuming a key —
+        # the reference picks the read group off the current write state,
+        # long_fork.clj:117-160).
+        g = group_of(last_key[0], n)
         return {"f": "txn", "value": [["r", k, None] for k in group_keys(g, n)]}
 
     return gen.mix([gen.repeat(writes), gen.repeat(reads)])

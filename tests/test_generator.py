@@ -339,3 +339,29 @@ def test_cycle_times_rotates_by_clock():
     for o in h:
         phase = (o["time"] // int(0.5e9)) % 2
         assert o["f"] == ("a" if phase == 0 else "b")
+
+
+def test_until_ok_ignores_sibling_oks():
+    # An :ok from a sibling generator (sharing threads via any_gen) must not
+    # finish until_ok; only completions of its own invocations count.
+    sib = gen.limit(1, gen.repeat(r("sib")))
+    target = gen.until_ok(gen.repeat(r("tgt")))
+    g = gen.clients(gen.any_gen(sib, target))
+    h = gt.imperfect({"concurrency": 1}, g)
+    tgt_oks = [o for o in h if o["type"] == "ok" and o["f"] == "tgt"]
+    assert len(tgt_oks) == 1
+
+
+def test_clients_final_gen_waits_for_outstanding_ops():
+    # The final generator runs behind a synchronize barrier: no final invoke
+    # may be issued before every main-phase op has completed.
+    main = gen.limit(6, gen.repeat(r("main")))
+    final = gen.limit(2, gen.repeat(r("final")))
+    h = gt.imperfect({"concurrency": 3}, gen.clients(main, final))
+    first_final = min(
+        i for i, o in enumerate(h) if o["type"] == "invoke" and o["f"] == "final"
+    )
+    main_completions = [
+        i for i, o in enumerate(h) if o["type"] != "invoke" and o["f"] == "main"
+    ]
+    assert all(i < first_final for i in main_completions)

@@ -339,3 +339,19 @@ def test_linearizable_register_workload_end_to_end():
         completed = core.run_test(t)
     assert completed["results"]["valid?"] is True
     assert len(completed["results"]["results"]) == 4  # all keys checked
+
+
+def test_long_fork_reads_do_not_consume_write_keys():
+    # Regression: reads must peek at the write-key cursor, not advance it —
+    # otherwise groups end up with never-written keys.
+    g = gen.limit(40, long_fork.generator(n=3))
+    h = gt.quick({"concurrency": 2}, gen.clients(g))
+    written = sorted(
+        m[1]
+        for o in h
+        if o["type"] == "invoke"
+        for m in (o.get("value") or [])
+        if m[0] == "w"
+    )
+    # Write keys are dense: 0..len-1, no gaps from read consumption.
+    assert written == list(range(len(written)))
