@@ -205,3 +205,7 @@ def run_cli(test_fn: Callable | None = None, argv=None, extra_opts: Callable | N
 
 def main(test_fn=None, argv=None, **kw):
     sys.exit(run_cli(test_fn, argv, **kw))
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    main()

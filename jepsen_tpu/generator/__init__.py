@@ -574,21 +574,22 @@ def clients(gen, final_gen=None) -> Gen:
     """Run gen on client threads only (generator.clj:864-883 via
     on-threads).  The optional ``final_gen`` is a convenience this rebuild
     adds (the reference's 2-arity routes a *nemesis* gen instead and final
-    phases go through then/phases): it runs after a synchronize barrier, so
-    every outstanding op completes before the final phase begins."""
-    g = on_threads(lambda t: t != NEMESIS, gen)
+    phases go through then/phases): it runs after a synchronize barrier on
+    the *client* threads, so every outstanding client op completes before
+    the final phase begins (nemesis ops may still be in flight)."""
     if final_gen is not None:
-        return phases(g, on_threads(lambda t: t != NEMESIS, final_gen))
-    return g
+        # Barrier inside the restriction: waits for the *client* threads
+        # only, not the nemesis (Context.restrict filters free_threads).
+        return on_threads(lambda t: t != NEMESIS, phases(gen, final_gen))
+    return on_threads(lambda t: t != NEMESIS, gen)
 
 
 def nemesis(gen, final_gen=None) -> Gen:
     """Run gen on the nemesis thread only.  ``final_gen`` (rebuild
     convenience, see ``clients``) runs after a synchronize barrier."""
-    g = on_threads(lambda t: t == NEMESIS, gen)
     if final_gen is not None:
-        return phases(g, on_threads(lambda t: t == NEMESIS, final_gen))
-    return g
+        return on_threads(lambda t: t == NEMESIS, phases(gen, final_gen))
+    return on_threads(lambda t: t == NEMESIS, gen)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1082,7 +1083,15 @@ class UntilOk(Gen):
             return None
         o, g2 = r
         active = self.active
-        if o is not PENDING and isinstance(o, Mapping) and "process" in o:
+        if (
+            o is not PENDING
+            and isinstance(o, Mapping)
+            and "process" in o
+            # sleep/log ops never produce update events (the interpreter
+            # keeps them out of history), so tracking them would leave a
+            # stale process entry behind.
+            and o.get("type", "invoke") == "invoke"
+        ):
             active = active | {o["process"]}
         return (o, UntilOk(g2, False, active))
 

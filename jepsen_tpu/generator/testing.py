@@ -109,6 +109,10 @@ def simulate(
         t, _, comp = heapq.heappop(pending)
         ctx = ctx.with_time(max(ctx.time, t))
         thread = ctx.thread_of(comp["process"])
+        # Mirror the interpreter: the generator sees the completion-time
+        # context with the completing thread already freed.
+        if thread is not None:
+            ctx = ctx.free_thread(thread)
         if comp.get("type") != "sleep-wake":
             history.append(comp)
             g = g.update(test, ctx, comp)
@@ -116,8 +120,6 @@ def simulate(
                 # Crashed process: assign a fresh process id
                 # (interpreter.clj:233-236).
                 ctx = ctx.with_next_process(thread)
-        if thread is not None:
-            ctx = ctx.free_thread(thread)
 
     while len(history) < max_ops:
         r = g.op(test, ctx)

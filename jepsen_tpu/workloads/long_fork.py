@@ -17,6 +17,7 @@ Ops (txn micro-op form, like Elle workloads):
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 from typing import Mapping, Sequence
 
@@ -35,25 +36,46 @@ def group_keys(g: int, n: int) -> list[int]:
     return list(range(g * n, (g + 1) * n))
 
 
+def _write_key(o) -> int | None:
+    v = o.get("value")
+    if o.get("type") == "invoke" and o.get("f") == "txn" and v and len(v) == 1 and v[0][0] == "w":
+        return v[0][1]
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class _LongForkGen(gen.Gen):
+    """Mix single-key writes with whole-group reads.  The write-key cursor
+    advances only when a write invocation is actually dispatched (seen as
+    an invoke event), never from op() side effects — the interpreter
+    speculatively calls op() and may discard the result, so impure
+    closures would burn keys (long_fork.clj:117-160 keeps the cursor in
+    generator state the same way).  Mixing is internal because ``gen.mix``
+    does not route updates to its children."""
+
+    n: int
+    next_key: int = 0
+
+    def op(self, test, ctx):
+        if gen._rng.random() < 0.5:
+            val = [["w", self.next_key, 1]]
+        else:
+            g = group_of(max(0, self.next_key - 1), self.n)
+            val = [["r", k, None] for k in group_keys(g, self.n)]
+        o = gen.fill_in_op({"f": "txn", "value": val}, ctx)
+        return (o, self)
+
+    def update(self, test, ctx, event):
+        k = _write_key(event)
+        if k is not None and k >= self.next_key:
+            return dataclasses.replace(self, next_key=k + 1)
+        return self
+
+
 def generator(n: int = DEFAULT_GROUP_SIZE) -> gen.Gen:
     """Interleave single-key writes with whole-group reads
-    (long_fork.clj:117-160)."""
-    counter = itertools.count()
-    last_key = [0]  # last issued write key; reads peek, never consume
-
-    def writes():
-        k = next(counter)
-        last_key[0] = k
-        return {"f": "txn", "value": [["w", k, 1]]}
-
-    def reads(test, ctx):
-        # Read the most recently active group (without consuming a key —
-        # the reference picks the read group off the current write state,
-        # long_fork.clj:117-160).
-        g = group_of(last_key[0], n)
-        return {"f": "txn", "value": [["r", k, None] for k in group_keys(g, n)]}
-
-    return gen.mix([gen.repeat(writes), gen.repeat(reads)])
+    (long_fork.clj:117-160), advanced by invoke events only."""
+    return _LongForkGen(n)
 
 
 def read_sets(history: Sequence[Mapping], n: int) -> dict:
