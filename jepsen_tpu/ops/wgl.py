@@ -233,6 +233,48 @@ def pad_packed(packed: dict, B: int | None = None, P: int | None = None, G: int 
 # ---------------------------------------------------------------------------
 
 
+def expand_candidates(
+    step, eye_g, slot_lane, slot_mask, slot_onehot,
+    state, fok, fcr, alive,
+    xmov_f, xmov_v1, xmov_v2, xmov_open,
+    grp_f, grp_v1, grp_v2, xgrp_open,
+):
+    """One closure round's candidate table: parents + every legal single
+    move (shared by the single-device and frontier-sharded kernels).
+
+    Process moves fire any open ok op not yet fired; group moves fire one
+    crashed op from any open group.  A crashed fire that leaves the state
+    unchanged yields a config dominated by its own parent (same state/fok,
+    one more fired) — dropped at the source.
+
+    Returns (cat_state, cat_fok, cat_fcr, cat_alive, cost) with
+    F*(1+P+G) rows."""
+    F, W = fok.shape
+    P = xmov_f.shape[0]
+    G = grp_f.shape[0]
+    pstate2, plegal = step(state[:, None], xmov_f[None, :], xmov_v1[None, :], xmov_v2[None, :])
+    already = (jnp.take(fok, slot_lane, axis=1) & slot_mask[None, :]) != 0
+    plegal = plegal & alive[:, None] & xmov_open[None, :] & ~already
+    pfok = (fok[:, None, :] | slot_onehot[None, :, :]).reshape(F * P, W)
+    pfcr = jnp.repeat(fcr, P, axis=0)
+    gstate2, glegal = step(state[:, None], grp_f[None, :], grp_v1[None, :], grp_v2[None, :])
+    glegal = (
+        glegal & alive[:, None] & (fcr < xgrp_open[None, :]) & (gstate2 != state[:, None])
+    )
+    gfok = jnp.repeat(fok, G, axis=0)
+    gfcr = (fcr[:, None, :] + eye_g[None, :, :]).reshape(F * G, G)
+
+    cat_state = jnp.concatenate([state, pstate2.reshape(-1), gstate2.reshape(-1)])
+    cat_alive = jnp.concatenate([alive, plegal.reshape(-1), glegal.reshape(-1)])
+    cat_fok = jnp.concatenate([fok, pfok, gfok], axis=0)
+    cat_fcr = jnp.concatenate([fcr, pfcr, gfcr.astype(I32)], axis=0)
+    cost = (
+        jax.lax.population_count(cat_fok).sum(axis=1).astype(I32)
+        + cat_fcr.sum(axis=1)
+    )
+    return cat_state, cat_fok, cat_fcr, cat_alive, cost
+
+
 def _run_core(
     step,
     F: int,
@@ -265,30 +307,11 @@ def _run_core(
     def expand_round(val):
         state, fok, fcr, alive, r, changed, lossy, fp, xs = val
         (xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open) = xs
-        # Process moves: fire any open ok op not yet fired.     [F, P]
-        pstate2, plegal = step(state[:, None], xmov_f[None, :], xmov_v1[None, :], xmov_v2[None, :])
-        already = (jnp.take(fok, slot_lane, axis=1) & slot_mask[None, :]) != 0
-        plegal = plegal & alive[:, None] & xmov_open[None, :] & ~already
-        pfok = (fok[:, None, :] | slot_onehot[None, :, :]).reshape(F * P, W)
-        pfcr = jnp.repeat(fcr, P, axis=0)
-        # Group moves: fire one crashed op from any open group. [F, G]
-        gstate2, glegal = step(state[:, None], grp_f[None, :], grp_v1[None, :], grp_v2[None, :])
-        # A crashed fire that leaves the state unchanged yields a config
-        # dominated by its own parent (same state/fok, one more fired) —
-        # drop it at the source.
-        glegal = (
-            glegal & alive[:, None] & (fcr < xgrp_open[None, :]) & (gstate2 != state[:, None])
-        )
-        gfok = jnp.repeat(fok, G, axis=0)
-        gfcr = (fcr[:, None, :] + eye_g[None, :, :]).reshape(F * G, G)
-
-        cat_state = jnp.concatenate([state, pstate2.reshape(-1), gstate2.reshape(-1)])
-        cat_alive = jnp.concatenate([alive, plegal.reshape(-1), glegal.reshape(-1)])
-        cat_fok = jnp.concatenate([fok, pfok, gfok], axis=0)
-        cat_fcr = jnp.concatenate([fcr, pfcr, gfcr.astype(I32)], axis=0)
-        cost = (
-            jax.lax.population_count(cat_fok).sum(axis=1).astype(I32)
-            + cat_fcr.sum(axis=1)
+        cat_state, cat_fok, cat_fcr, cat_alive, cost = expand_candidates(
+            step, eye_g, slot_lane, slot_mask, slot_onehot,
+            state, fok, fcr, alive,
+            xmov_f, xmov_v1, xmov_v2, xmov_open,
+            grp_f, grp_v1, grp_v2, xgrp_open,
         )
         state2, fok2, fcr2, alive2, ovf, fp2 = frontier_update(
             cat_state, cat_fok, cat_fcr, cat_alive, cost, F

@@ -10,10 +10,15 @@ become jax.sharding axes:
     checked by one vmapped kernel sharded across the mesh (BASELINE
     config 4: 1024 recorded histories across a v5e-8 slice).
 
+  * ``frontier`` (context parallel): ONE history's configuration frontier
+    sharded across devices with hash-routed all_to_all exchanges and psum
+    verdict merges (jepsen_tpu.parallel.sharded).
+
 Collectives ride ICI via XLA's partitioner; there is nothing NCCL-like to
 port (SURVEY.md §5 'distributed communication backend').
 """
 
 from jepsen_tpu.parallel.batch import batch_analysis, make_mesh
+from jepsen_tpu.parallel.sharded import sharded_analysis
 
-__all__ = ["batch_analysis", "make_mesh"]
+__all__ = ["batch_analysis", "make_mesh", "sharded_analysis"]

@@ -1,0 +1,292 @@
+"""Frontier-sharded linearizability search: one history across many chips.
+
+The batched path (jepsen_tpu.parallel.batch) scales across *histories*;
+this module scales across the *configuration frontier of a single
+history* — the rebuild's context-parallel axis (SURVEY.md §2.5 item 5,
+§5 'long-context': the WGL frontier is the sequence dimension).  Each
+device owns F/D frontier rows.  Per closure round:
+
+  1. local expansion (same move algebra as jepsen_tpu.ops.wgl);
+  2. hash-routed exchange: every candidate row is routed to device
+     ``hash(state, fok) % D`` via ``lax.all_to_all`` over the mesh axis,
+     so equal configurations always land on the same device;
+  3. local sort-based dedup/domination/truncation (jepsen_tpu.ops.hashing)
+     — globally exact because of the routing invariant;
+  4. ``lax.psum`` of content fingerprints/overflow for a global fixpoint
+     and loss decision (uniform across devices, so the while_loop agrees).
+
+Barrier filtering is local; survival is decided by a psum'd global alive
+count.  Soundness matches the single-device kernel: True is always a
+constructive witness; False only when no loss occurred anywhere.
+
+Reference seam: jepsen drives knossos thread-parallel inside one JVM
+(jepsen/src/jepsen/checker.clj:185-216); the rebuild's equivalent of
+"more cores" is more chips on the ICI mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops.hashing import frontier_update, hash_rows
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _route(axis: str, D: int, C: int, state, fok, fcr, alive, cost):
+    """Exchange candidate rows so each lands on device hash % D.
+
+    Builds D fixed-capacity buckets (top-C per target by cost), swaps them
+    with ``all_to_all``, and returns the received [D*C] rows plus a local
+    overflow flag (some bucket spilled)."""
+    n = state.shape[0]
+    w = fok.shape[1]
+    g = fcr.shape[1]
+    class_cols = [state] + [fok[:, k] for k in range(w)]
+    h = hash_rows(class_cols, 0x5EED_0D15)
+    target = (h % U32(D)).astype(I32)
+    dead = (~alive).astype(U32)
+    iota = jnp.arange(n, dtype=I32)
+    sd, st_t, sc, sidx = jax.lax.sort(
+        (dead, target.astype(U32), cost.astype(U32), iota), num_keys=3
+    )
+    # counts/starts per target among alive rows, in sorted coordinates
+    onehot = (st_t[:, None] == jnp.arange(D, dtype=U32)[None, :]) & (sd == 0)[:, None]
+    counts = onehot.sum(axis=0).astype(I32)
+    starts = jnp.concatenate([jnp.zeros(1, I32), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(n, dtype=I32)
+    rank = pos - starts[st_t.astype(I32) % D]
+    keep = (sd == 0) & (rank >= 0) & (rank < C)
+    spill = ((counts > C).any()) | False
+    flat = jnp.where(keep, st_t.astype(I32) * C + rank, D * C)  # D*C = drop slot
+    rows_state = state[sidx]
+    rows_fok = fok[sidx]
+    rows_fcr = fcr[sidx]
+    rows_cost = cost[sidx]
+
+    def scatter(col, fill):
+        out = jnp.full((D * C + 1,) + col.shape[1:], fill, col.dtype)
+        return out.at[flat].set(col)[: D * C]
+
+    b_state = scatter(rows_state, 0).reshape(D, C)
+    b_fok = scatter(rows_fok, U32(0)).reshape(D, C, w)
+    b_fcr = scatter(rows_fcr, 0).reshape(D, C, g)
+    b_alive = jnp.zeros(D * C + 1, bool).at[flat].set(keep)[: D * C].reshape(D, C)
+    b_cost = scatter(rows_cost, 0).reshape(D, C)
+
+    x = lambda a: jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0, tiled=True)
+    r_state = x(b_state).reshape(D * C)
+    r_fok = x(b_fok).reshape(D * C, w)
+    r_fcr = x(b_fcr).reshape(D * C, g)
+    r_alive = x(b_alive).reshape(D * C)
+    r_cost = x(b_cost).reshape(D * C)
+    return r_state, r_fok, r_fcr, r_alive, r_cost, spill
+
+
+def _run_core_sharded(
+    axis,
+    D,
+    step,
+    Fl,
+    R,
+    P_,
+    G,
+    W,
+    init_state,
+    bar_active,
+    bar_f,
+    bar_v1,
+    bar_v2,
+    bar_slot,
+    mov_f,
+    mov_v1,
+    mov_v2,
+    mov_open,
+    grp_f,
+    grp_v1,
+    grp_v2,
+    grp_open,
+    slot_lane,
+    slot_onehot,
+):
+    """Per-device body (under shard_map): scan the sharded frontier over
+    all barriers.  Fl = per-device frontier capacity; bucket capacity
+    C = 2*Fl bounds the exchange."""
+    C = 2 * Fl
+    eye_g = jnp.eye(G, dtype=I32)
+    slot_mask = slot_onehot.sum(axis=1)
+
+    def expand_round(val):
+        state, fok, fcr, alive, r, changed, lossy, fp, xs = val
+        (xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open) = xs
+        cat_state, cat_fok, cat_fcr, cat_alive, cost = wgl.expand_candidates(
+            step, eye_g, slot_lane, slot_mask, slot_onehot,
+            state, fok, fcr, alive,
+            xmov_f, xmov_v1, xmov_v2, xmov_open,
+            grp_f, grp_v1, grp_v2, xgrp_open,
+        )
+        # Route every candidate (parents included) to its hash-owner.
+        r_state, r_fok, r_fcr, r_alive, r_cost, spill = _route(
+            axis, D, C, cat_state, cat_fok, cat_fcr, cat_alive, cost
+        )
+        state2, fok2, fcr2, alive2, ovf, fp_local = frontier_update(
+            r_state, r_fok, r_fcr, r_alive, r_cost, Fl
+        )
+        fp2 = jax.lax.psum(fp_local, axis)
+        lossy2 = jax.lax.psum((ovf | spill).astype(I32), axis) > 0
+        changed2 = ~(fp2 == fp).all()
+        return (state2, fok2, fcr2, alive2, r + 1, changed2, lossy | lossy2, fp2, xs)
+
+    def round_cond(val):
+        _s, _fo, _fc, _a, r, changed, _l, _fp, _xs = val
+        return (r < R) & changed
+
+    def barrier(carry, xs):
+        state, fok, fcr, alive, failed_at, lossy, peak = carry
+        b_idx, active, xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+        done = (failed_at >= 0) | ~active
+
+        def process(_):
+            xs_inner = (xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open)
+            fp0 = jnp.full(3, jnp.uint32(0xFFFFFFFF))
+            s2, fo2, fc2, a2, _r, changed, lossy2, _fp, _ = jax.lax.while_loop(
+                round_cond,
+                expand_round,
+                (state, fok, fcr, alive, jnp.int32(0), jnp.bool_(True), lossy, fp0, xs_inner),
+            )
+            lossy3 = lossy2 | changed
+            lane = xbar_slot // 32
+            bitmask = (U32(1) << (xbar_slot % 32).astype(U32))
+            lane_vals = jnp.take(fo2, lane[None], axis=1)[:, 0]
+            a3 = a2 & ((lane_vals & bitmask) != 0)
+            clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
+            fo3 = fo2 & ~clear[None, :]
+            n_alive = jax.lax.psum(a3.sum(), axis)
+            dead = n_alive == 0
+            failed2 = jnp.where(dead, b_idx, failed_at)
+            peak2 = jnp.maximum(peak, n_alive)
+            return (s2, fo3, fc2, a3, failed2, lossy3, peak2)
+
+        def skip(_):
+            return (state, fok, fcr, alive, failed_at, lossy, peak)
+
+        return jax.lax.cond(done, skip, process, None), None
+
+    state0 = jnp.full((Fl,), init_state, I32)
+    fok0 = jnp.zeros((Fl, W), U32)
+    fcr0 = jnp.zeros((Fl, G), I32)
+    # Only one device starts with the (single) initial configuration; the
+    # first exchange hash-routes it to its owner.
+    me = jax.lax.axis_index(axis)
+    alive0 = jnp.zeros((Fl,), bool).at[0].set(me == 0)
+    carry0 = (state0, fok0, fcr0, alive0, jnp.int32(-1), jnp.bool_(False), jnp.int32(1))
+    xs = (
+        jnp.arange(bar_f.shape[0], dtype=I32),
+        bar_active,
+        bar_slot,
+        mov_f,
+        mov_v1,
+        mov_v2,
+        mov_open,
+        grp_open,
+    )
+    (state, fok, fcr, alive, failed_at, lossy, peak), _ = jax.lax.scan(barrier, carry0, xs)
+    any_alive = jax.lax.psum(alive.any().astype(I32), axis) > 0
+    return any_alive, failed_at, lossy, peak
+
+
+#: (mesh id, step, Fl, R, P, G, W) -> compiled sharded runner.
+_SHARDED_RUNNERS: dict = {}
+
+
+def _sharded_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int):
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+    key = (mesh, step, Fl, R, P_, G, W)
+    if key not in _SHARDED_RUNNERS:
+        core = functools.partial(_run_core_sharded, axis, D, step, Fl, R, P_, G, W)
+        fn = jax.shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(P(),) * 16,
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+        _SHARDED_RUNNERS[key] = jax.jit(fn)
+    return _SHARDED_RUNNERS[key]
+
+
+def sharded_analysis(
+    model: m.Model,
+    history: Sequence[dict],
+    mesh: Mesh,
+    capacity: int | Sequence[int] = (1024, 8192),
+    rounds: int = 8,
+    max_groups: int = 64,
+    max_procs: int = 128,
+) -> dict:
+    """Decide linearizability of ONE history with the frontier sharded
+    across ``mesh``.  ``capacity`` is the *total* frontier size (split
+    evenly over devices); a sequence widens iteratively like
+    jepsen_tpu.ops.wgl.analysis."""
+    D = mesh.devices.size
+    try:
+        packed = wgl.pack(model, history)
+    except wgl.NotTensorizable as e:
+        return {"valid?": "unknown", "cause": f"not tensorizable: {e}"}
+    if packed["B"] == 0:
+        return {"valid?": True}
+    if packed["G"] > max_groups:
+        return {"valid?": "unknown", "cause": f"{packed['G']} crashed-op groups exceeds {max_groups}"}
+    if packed["P"] > max_procs:
+        return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
+    packed = wgl.pad_packed(packed)
+
+    capacities = [capacity] if isinstance(capacity, int) else list(capacity)
+    result = None
+    for cap in capacities:
+        Fl = max(8, (int(cap) + D - 1) // D)
+        runner = _sharded_runner(
+            mesh, packed["step"], Fl, int(rounds), packed["P"], packed["G"], packed["W"]
+        )
+        valid, failed_at, lossy, peak = runner(
+            packed["init_state"],
+            packed["bar_active"],
+            *packed["bar"],
+            *packed["mov"],
+            *packed["grp"],
+            packed["grp_open"],
+            jnp.asarray(packed["slot_lane"]),
+            jnp.asarray(packed["slot_onehot"]),
+        )
+        valid = bool(valid)
+        failed_at = int(failed_at)
+        lossy = bool(lossy)
+        stats = {
+            "frontier-peak": int(peak),
+            "capacity": Fl * D,
+            "devices": D,
+            "lossy?": lossy,
+        }
+        if failed_at < 0 and valid:
+            return {"valid?": True, "kernel": stats}
+        op = history[int(packed["bar_opid"][failed_at])] if failed_at >= 0 else None
+        if not lossy:
+            return {"valid?": False, "op": op, "kernel": stats}
+        result = {
+            "valid?": "unknown",
+            "cause": "frontier capacity or closure rounds exhausted",
+            "op": op,
+            "kernel": stats,
+        }
+    return result
