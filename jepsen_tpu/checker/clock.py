@@ -1,0 +1,44 @@
+"""Clock-offset plot checker.
+
+Mirrors ``jepsen.checker.clock`` (reference: jepsen/src/jepsen/checker/
+clock.clj:13-75): collects the ``clock-offsets`` maps the clock nemesis
+embeds in its completions (jepsen_tpu.nemesis.time), draws one line per
+node over test time into ``clock-skew.svg``, and always reports valid —
+it's an observability aid, not a judgment.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker, checker as as_checker
+from jepsen_tpu.checker.perf import SERIES_COLORS, SvgPlot, _shade, _write
+
+
+def offset_series(history) -> dict:
+    """{node: [(time_s, offset_s)]} from nemesis completions
+    (clock.clj:13-24)."""
+    out: dict = {}
+    for o in history:
+        offsets = o.get("clock-offsets")
+        if offsets is None or o["type"] == h.INVOKE:
+            continue
+        t = o["time"] / 1e9
+        for node, off in offsets.items():
+            out.setdefault(node, []).append((t, off))
+    return out
+
+
+@as_checker
+def _clock_plot(test, history, opts):
+    plot = SvgPlot(f"{test.get('name', 'test')} clock offsets", "time (s)", "offset (s)")
+    _shade(plot, test, history)
+    for i, (node, pts) in enumerate(sorted(offset_series(history).items())):
+        plot.line(node, pts, SERIES_COLORS[i % len(SERIES_COLORS)])
+    out: dict = {"valid?": True}
+    _write(test, opts, "clock-skew.svg", plot.render(), out)
+    return out
+
+
+def clock_plot() -> Checker:
+    """The clock-offset plot checker (checker.clj:831-837)."""
+    return _clock_plot

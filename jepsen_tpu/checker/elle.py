@@ -161,13 +161,8 @@ def _explain_cycle(g: tg.TxnGraph, cycle: list[int]) -> dict:
     return {"cycle": [g.nodes[i].op for i in cycle], "steps": steps}
 
 
-def _first_diag_cycle(adj_parts: np.ndarray, closure: np.ndarray) -> list[int] | None:
-    """A cycle witnessing a nonzero closure diagonal."""
-    diag = np.flatnonzero(np.diag(closure))
-    if len(diag) == 0:
-        return None
-    v = int(diag[0])
-    # Find a successor u of v with a path back to v.
+def _diag_cycle_at(adj_parts: np.ndarray, v: int) -> list[int] | None:
+    """A cycle through node v (the device flagged closure[v, v])."""
     for u in np.flatnonzero(adj_parts[v]):
         c = _find_cycle_through_edge(adj_parts, v, int(u))
         if c is not None:
@@ -175,49 +170,35 @@ def _first_diag_cycle(adj_parts: np.ndarray, closure: np.ndarray) -> list[int] |
     return [v]
 
 
-def _witness_for_edge_type(
-    edge_adj: np.ndarray, graph_adj: np.ndarray, closure: np.ndarray
-) -> list[int] | None:
-    """A cycle through some edge (a, b) of ``edge_adj`` with a return path in
-    ``graph_adj`` (whose closure is given)."""
-    cand = np.argwhere(edge_adj & closure.T)
-    if len(cand) == 0:
-        return None
-    a, b = int(cand[0][0]), int(cand[0][1])
-    return _find_cycle_through_edge(graph_adj, a, b)
-
-
 # ---------------------------------------------------------------------------
 # Checkers
 # ---------------------------------------------------------------------------
 
 
-def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
-    """Classify cycles + merge inference anomalies into an elle-style
-    result."""
+def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
+    """Merge device cycle flags+hints with inference anomalies into an
+    elle-style result, recovering witness cycles by host BFS over the
+    (sparse, host-resident) adjacency — nothing O(n²) crosses the device
+    boundary."""
     wanted = expand_anomalies(requested)
     anomalies: dict[str, list] = {k: v for k, v in g.anomalies.items() if k in wanted}
-
     if g.n:
-        flags, closures = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
         any_adj = g.ww | g.wr | g.extra
         full_adj = any_adj | g.rw
-        if flags["G0"] and "G0" in wanted:
-            cyc = _first_diag_cycle(g.ww | g.extra, closures["ww"])
+        if flags["G0"] and "G0" in wanted and hints["G0"]:
+            cyc = _diag_cycle_at(g.ww | g.extra, hints["G0"][0])
             if cyc:
                 anomalies.setdefault("G0", []).append(_explain_cycle(g, cyc))
-        if flags["G1c"] and "G1c" in wanted:
-            cyc = _witness_for_edge_type(g.wr, any_adj, closures["wwr"])
-            if cyc:
-                anomalies.setdefault("G1c", []).append(_explain_cycle(g, cyc))
-        if flags["G-single"] and "G-single" in wanted:
-            cyc = _witness_for_edge_type(g.rw, any_adj, closures["wwr"])
-            if cyc:
-                anomalies.setdefault("G-single", []).append(_explain_cycle(g, cyc))
-        if flags["G2"] and not flags["G-single"] and "G2" in wanted:
-            cyc = _witness_for_edge_type(g.rw, full_adj, closures["all"])
-            if cyc:
-                anomalies.setdefault("G2", []).append(_explain_cycle(g, cyc))
+        for name, graph_adj, gate in (
+            ("G1c", any_adj, True),
+            ("G-single", any_adj, True),
+            ("G2", full_adj, not flags["G-single"]),
+        ):
+            if flags[name] and gate and name in wanted and hints[name]:
+                a, b = hints[name]
+                cyc = _find_cycle_through_edge(graph_adj, a, b)
+                if cyc:
+                    anomalies.setdefault(name, []).append(_explain_cycle(g, cyc))
 
     types = sorted(anomalies)
     not_, also_not = models_ruled_out(types)
@@ -232,6 +213,26 @@ def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
             }
         )
     return out
+
+
+def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
+    """Classify cycles + merge inference anomalies into an elle-style
+    result."""
+    if not g.n:
+        return _merge_flags(g, dict(cl._EMPTY_FLAGS), dict(cl._EMPTY_HINTS), requested)
+    flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
+    return _merge_flags(g, flags, hints, requested)
+
+
+def check_graphs(graphs: Sequence[tg.TxnGraph], requested: Sequence[str]) -> list[dict]:
+    """Classify MANY graphs in batched device launches (the per-key
+    scale-out path — jepsen_tpu.ops.closure.classify_graphs buckets by
+    padded size and vmaps each bucket)."""
+    results = cl.classify_graphs([(g.ww, g.wr, g.rw, g.extra) for g in graphs])
+    return [
+        _merge_flags(g, flags, hints, requested)
+        for g, (flags, hints) in zip(graphs, results)
+    ]
 
 
 DEFAULT_ANOMALIES = ["G2", "G1a", "G1b", "internal"]  # tests/cycle/wr.clj:46
@@ -260,6 +261,12 @@ class ListAppendChecker(Checker):
         g = tg.list_append_graph(history, self.additional_graphs)
         return check_graph(g, self.anomalies)
 
+    def check_batch(self, test, histories, opts):
+        """Check many subhistories in batched device launches (used by
+        independent.checker — one vmapped kernel per size bucket)."""
+        graphs = [tg.list_append_graph(hh, self.additional_graphs) for hh in histories]
+        return check_graphs(graphs, self.anomalies)
+
 
 class WRRegisterChecker(Checker):
     """Native elle.rw-register equivalent (tests/cycle/wr.clj:15-46)."""
@@ -276,14 +283,20 @@ class WRRegisterChecker(Checker):
         self.sequential_keys = sequential_keys
         self.linearizable_keys = linearizable_keys
 
-    def check(self, test, history, opts):
-        g = tg.rw_register_graph(
+    def _graph(self, history):
+        return tg.rw_register_graph(
             history,
             self.additional_graphs,
             sequential_keys=self.sequential_keys,
             linearizable_keys=self.linearizable_keys,
         )
-        return check_graph(g, self.anomalies)
+
+    def check(self, test, history, opts):
+        return check_graph(self._graph(history), self.anomalies)
+
+    def check_batch(self, test, histories, opts):
+        """Batched per-key form (see ListAppendChecker.check_batch)."""
+        return check_graphs([self._graph(hh) for hh in histories], self.anomalies)
 
 
 def list_append(**kw) -> Checker:

@@ -1,0 +1,397 @@
+"""Latency / rate observability: quantiles, graphs, nemesis shading.
+
+Mirrors ``jepsen.checker.perf`` (reference: jepsen/src/jepsen/checker/
+perf.clj): time-bucketed latency quantiles (perf.clj:21-85), per-(f, type)
+rate series (perf.clj:110-130), and nemesis-interval shading behind the
+curves.  The reference shells out to gnuplot; TPU hosts don't carry it, so
+this renders self-contained SVG directly — same artifacts (latency-raw,
+latency-quantiles, rate), zero external processes.
+
+The ``perf()`` composite checker (checker.clj:797-829) writes all three
+graphs into the test's store directory and always reports valid.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import Mapping, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu import store
+from jepsen_tpu.checker import Checker, checker as as_checker
+from jepsen_tpu.utils import nemesis_intervals
+
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99, 1.0)
+
+TYPE_COLORS = {h.OK: "#81BF67", h.INFO: "#FFA400", h.FAIL: "#FF1E90"}
+SERIES_COLORS = [
+    "#1F77B4", "#FF7F0E", "#2CA02C", "#D62728", "#9467BD",
+    "#8C564B", "#E377C2", "#7F7F7F", "#BCBD22", "#17BECF",
+]
+
+
+# ---------------------------------------------------------------------------
+# Data shaping (perf.clj:21-130)
+# ---------------------------------------------------------------------------
+
+
+def bucket_scale(dt: float, b: int) -> float:
+    """The time at the center of bucket b, seconds (perf.clj:21-33)."""
+    return (b + 0.5) * dt
+
+
+def bucket_time(dt: float, t: float) -> int:
+    return int(t // dt)
+
+
+def buckets(dt: float, points: Sequence[tuple]) -> dict:
+    """Group (time, value) points into dt-second buckets
+    (perf.clj:35-49)."""
+    out: dict = {}
+    for t, v in points:
+        out.setdefault(bucket_time(dt, t), []).append(v)
+    return out
+
+
+def quantile(sorted_xs: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of an already-sorted sequence
+    (perf.clj:51-60)."""
+    if not sorted_xs:
+        raise ValueError("quantile of empty sequence")
+    i = min(len(sorted_xs) - 1, max(0, math.ceil(q * len(sorted_xs)) - 1))
+    return sorted_xs[i]
+
+
+def latencies_to_quantiles(dt: float, qs: Sequence[float], points: Sequence[tuple]) -> dict:
+    """{q: [(bucket-center-time, latency)]} per bucket (perf.clj:62-85)."""
+    bs = {b: sorted(vs) for b, vs in buckets(dt, points).items()}
+    return {
+        q: [(bucket_scale(dt, b), quantile(vs, q)) for b, vs in sorted(bs.items())]
+        for q in qs
+    }
+
+
+def invoke_latencies(history: Sequence[dict]) -> list[dict]:
+    """Completed client ops with ``time`` (s) of invocation and ``latency``
+    (ms), tagged by f and completion type (perf.clj:87-108 invokes-by-*)."""
+    out = []
+    for o in h.history_to_latencies(history):
+        if "latency" in o and o["process"] != h.NEMESIS:
+            out.append(
+                {
+                    "time": (o["time"] - o["latency"]) / 1e9,
+                    "latency": o["latency"] / 1e6,
+                    "f": o["f"],
+                    "type": o["type"],
+                }
+            )
+    return out
+
+
+def rates(history: Sequence[dict], dt: float = 10.0) -> dict:
+    """{(f, type): [(bucket-center, ops/sec)]} for client completions
+    (perf.clj:110-130)."""
+    series: dict = {}
+    for o in history:
+        if o["process"] == h.NEMESIS or o["type"] == h.INVOKE:
+            continue
+        series.setdefault((o["f"], o["type"]), []).append((o["time"] / 1e9, 1))
+    return {
+        key: [(bucket_scale(dt, b), len(vs) / dt) for b, vs in sorted(buckets(dt, pts).items())]
+        for key, pts in series.items()
+    }
+
+
+def nemesis_regions(test: Mapping, history: Sequence[dict]) -> list[dict]:
+    """Shaded [t0, t1] regions per nemesis family, from the test's
+    ``plot.nemeses`` hints (the packages' perf maps,
+    nemesis/combined.clj:8-15) or a start/stop default
+    (perf.clj:132-175)."""
+    specs = (test.get("plot") or {}).get("nemeses")
+    if not specs:
+        specs = [{"name": "nemesis", "start": {"start"}, "stop": {"stop"}, "color": "#B3BFFF"}]
+    end = max((o["time"] for o in history), default=0) / 1e9
+    out = []
+    for spec in specs:
+        for start_op, stop_op in nemesis_intervals(
+            history, start_fs=tuple(spec.get("start", ())), stop_fs=tuple(spec.get("stop", ()))
+        ):
+            out.append(
+                {
+                    "t0": start_op["time"] / 1e9,
+                    "t1": (stop_op["time"] / 1e9) if stop_op else end,
+                    "color": spec.get("color", "#B3BFFF"),
+                    "name": spec.get("name", "nemesis"),
+                }
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SVG rendering
+# ---------------------------------------------------------------------------
+
+
+class SvgPlot:
+    """A small axes-and-series SVG canvas (the gnuplot role)."""
+
+    W, H = 900, 440
+    ML, MR, MT, MB = 70, 160, 30, 50
+
+    def __init__(self, title: str, xlabel: str, ylabel: str, log_y: bool = False):
+        self.title = title
+        self.xlabel = xlabel
+        self.ylabel = ylabel
+        self.log_y = log_y
+        self.xmin = self.xmax = self.ymin = self.ymax = None
+        self._series: list = []  # (kind, label, color, points)
+        self._regions: list = []
+
+    # -- data ---------------------------------------------------------------
+
+    def _see(self, x, y):
+        self.xmin = x if self.xmin is None else min(self.xmin, x)
+        self.xmax = x if self.xmax is None else max(self.xmax, x)
+        if not self.log_y or y > 0:
+            self.ymin = y if self.ymin is None else min(self.ymin, y)
+            self.ymax = y if self.ymax is None else max(self.ymax, y)
+
+    def line(self, label: str, points: Sequence[tuple], color: str):
+        for x, y in points:
+            self._see(x, y)
+        self._series.append(("line", label, color, list(points)))
+
+    def scatter(self, label: str, points: Sequence[tuple], color: str):
+        for x, y in points:
+            self._see(x, y)
+        self._series.append(("scatter", label, color, list(points)))
+
+    def region(self, t0: float, t1: float, color: str, name: str):
+        self._regions.append((t0, t1, color, name))
+
+    # -- projection ---------------------------------------------------------
+
+    def _px(self, x: float) -> float:
+        x0, x1 = self.xmin, self.xmax
+        if x1 == x0:
+            x1 = x0 + 1
+        return self.ML + (x - x0) / (x1 - x0) * (self.W - self.ML - self.MR)
+
+    def _py(self, y: float) -> float:
+        y0, y1 = self.ymin, self.ymax
+        if self.log_y:
+            y0 = math.log10(max(y0, 1e-6))
+            y1 = math.log10(max(y1, 1e-6))
+            y = math.log10(max(y, 1e-6))
+        if y1 == y0:
+            y1 = y0 + 1
+        return self.H - self.MB - (y - y0) / (y1 - y0) * (self.H - self.MT - self.MB)
+
+    def _ticks(self, lo: float, hi: float, n: int = 6) -> list[float]:
+        if hi <= lo:
+            return [lo]
+        step = 10 ** math.floor(math.log10((hi - lo) / n))
+        for mult in (1, 2, 5, 10):
+            if (hi - lo) / (step * mult) <= n:
+                step *= mult
+                break
+        first = math.ceil(lo / step) * step
+        out = []
+        t = first
+        while t <= hi + 1e-12:
+            out.append(round(t, 10))
+            t += step
+        return out
+
+    def _y_ticks(self) -> list[float]:
+        if not self.log_y:
+            return self._ticks(self.ymin, self.ymax)
+        lo = math.floor(math.log10(max(self.ymin, 1e-6)))
+        hi = math.ceil(math.log10(max(self.ymax, 1e-6)))
+        return [10.0**e for e in range(int(lo), int(hi) + 1)]
+
+    # -- output -------------------------------------------------------------
+
+    def render(self) -> str:
+        if self.xmin is None:
+            self.xmin, self.xmax, self.ymin, self.ymax = 0, 1, 0, 1
+        if self.ymin is None:
+            self.ymin, self.ymax = (0.1, 1) if self.log_y else (0, 1)
+        e: list[str] = []
+        e.append(
+            f'<svg xmlns="http://www.w3.org/2000/svg" width="{self.W}" height="{self.H}" '
+            f'font-family="Helvetica,Arial,sans-serif" font-size="11">'
+        )
+        e.append(f'<rect width="{self.W}" height="{self.H}" fill="white"/>')
+        plot_x0, plot_y0 = self.ML, self.MT
+        plot_w = self.W - self.ML - self.MR
+        plot_h = self.H - self.MT - self.MB
+        for t0, t1, color, _name in self._regions:
+            x0 = max(plot_x0, min(self._px(t0), plot_x0 + plot_w))
+            x1 = max(plot_x0, min(self._px(t1), plot_x0 + plot_w))
+            if x1 > x0:
+                e.append(
+                    f'<rect x="{x0:.1f}" y="{plot_y0}" width="{x1 - x0:.1f}" '
+                    f'height="{plot_h}" fill="{color}" fill-opacity="0.35"/>'
+                )
+        for tx in self._ticks(self.xmin, self.xmax):
+            px = self._px(tx)
+            e.append(
+                f'<line x1="{px:.1f}" y1="{plot_y0}" x2="{px:.1f}" y2="{plot_y0 + plot_h}" '
+                f'stroke="#DDD" stroke-width="1"/>'
+            )
+            e.append(
+                f'<text x="{px:.1f}" y="{plot_y0 + plot_h + 16}" text-anchor="middle">{tx:g}</text>'
+            )
+        for ty in self._y_ticks():
+            py = self._py(ty)
+            if py < plot_y0 - 1 or py > plot_y0 + plot_h + 1:
+                continue
+            e.append(
+                f'<line x1="{plot_x0}" y1="{py:.1f}" x2="{plot_x0 + plot_w}" y2="{py:.1f}" '
+                f'stroke="#DDD" stroke-width="1"/>'
+            )
+            e.append(
+                f'<text x="{plot_x0 - 6}" y="{py + 4:.1f}" text-anchor="end">{ty:g}</text>'
+            )
+        e.append(
+            f'<rect x="{plot_x0}" y="{plot_y0}" width="{plot_w}" height="{plot_h}" '
+            f'fill="none" stroke="#333"/>'
+        )
+        for kind, _label, color, pts in self._series:
+            if not pts:
+                continue
+            if kind == "line":
+                path = " ".join(f"{self._px(x):.1f},{self._py(y):.1f}" for x, y in pts)
+                e.append(
+                    f'<polyline points="{path}" fill="none" stroke="{color}" stroke-width="1.5"/>'
+                )
+            else:
+                for x, y in pts:
+                    e.append(
+                        f'<circle cx="{self._px(x):.1f}" cy="{self._py(y):.1f}" r="1.6" '
+                        f'fill="{color}" fill-opacity="0.6"/>'
+                    )
+        # legend
+        ly = plot_y0 + 4
+        lx = plot_x0 + plot_w + 12
+        seen = set()
+        for kind, label, color, _pts in self._series:
+            if label in seen:
+                continue
+            seen.add(label)
+            e.append(f'<rect x="{lx}" y="{ly - 8}" width="10" height="10" fill="{color}"/>')
+            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{label}</text>')
+            ly += 16
+        for _t0, _t1, color, name in {(None, None, r[2], r[3]) for r in self._regions}:
+            e.append(
+                f'<rect x="{lx}" y="{ly - 8}" width="10" height="10" fill="{color}" fill-opacity="0.35"/>'
+            )
+            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{name}</text>')
+            ly += 16
+        e.append(
+            f'<text x="{(plot_x0 + plot_w / 2):.0f}" y="16" text-anchor="middle" '
+            f'font-size="13" font-weight="bold">{self.title}</text>'
+        )
+        e.append(
+            f'<text x="{(plot_x0 + plot_w / 2):.0f}" y="{self.H - 12}" '
+            f'text-anchor="middle">{self.xlabel}</text>'
+        )
+        e.append(
+            f'<text x="16" y="{(plot_y0 + plot_h / 2):.0f}" text-anchor="middle" '
+            f'transform="rotate(-90 16 {(plot_y0 + plot_h / 2):.0f})">{self.ylabel}</text>'
+        )
+        e.append("</svg>")
+        return "\n".join(e)
+
+
+def _shade(plot: SvgPlot, test, history):
+    for r in nemesis_regions(test, history):
+        plot.region(r["t0"], r["t1"], r["color"], r["name"])
+
+
+def point_graph(test: Mapping, history: Sequence[dict], opts=None) -> str:
+    """Raw latency scatter, colored by completion type
+    (perf.clj point-graph!)."""
+    plot = SvgPlot(f"{test.get('name', 'test')} latencies", "time (s)", "latency (ms)", log_y=True)
+    _shade(plot, test, history)
+    by_type: dict = {}
+    for o in invoke_latencies(history):
+        by_type.setdefault(o["type"], []).append((o["time"], max(o["latency"], 1e-3)))
+    for typ, pts in sorted(by_type.items()):
+        plot.scatter(typ, pts, TYPE_COLORS.get(typ, "#888"))
+    return plot.render()
+
+
+def quantiles_graph(
+    test: Mapping,
+    history: Sequence[dict],
+    opts=None,
+    qs: Sequence[float] = DEFAULT_QUANTILES,
+    dt: float = 10.0,
+) -> str:
+    """Latency quantile lines per time bucket (perf.clj quantiles-graph!)."""
+    plot = SvgPlot(
+        f"{test.get('name', 'test')} latency quantiles", "time (s)", "latency (ms)", log_y=True
+    )
+    _shade(plot, test, history)
+    pts = [(o["time"], max(o["latency"], 1e-3)) for o in invoke_latencies(history)]
+    for i, (q, series) in enumerate(sorted(latencies_to_quantiles(dt, qs, pts).items())):
+        plot.line(f"p{int(q * 100)}", series, SERIES_COLORS[i % len(SERIES_COLORS)])
+    return plot.render()
+
+
+def rate_graph(test: Mapping, history: Sequence[dict], opts=None, dt: float = 10.0) -> str:
+    """Completion rate per (f, type) (perf.clj rate-graph!)."""
+    plot = SvgPlot(f"{test.get('name', 'test')} rate", "time (s)", "ops/sec")
+    _shade(plot, test, history)
+    for i, ((f, typ), series) in enumerate(sorted(rates(history, dt).items(), key=repr)):
+        plot.line(f"{f} {typ}", series, SERIES_COLORS[i % len(SERIES_COLORS)])
+    return plot.render()
+
+
+def _write(test, opts, name: str, svg: str, out: dict):
+    try:
+        d = store.test_dir(test)
+        sub = (opts or {}).get("subdirectory")
+        d = d / sub if sub else d
+        d.mkdir(parents=True, exist_ok=True)
+        path = Path(d) / name
+        path.write_text(svg)
+        out.setdefault("files", []).append(str(path))
+    except (KeyError, OSError, TypeError):
+        out.setdefault("svgs", {})[name] = svg
+
+
+@as_checker
+def _latency_graph(test, history, opts):
+    out: dict = {"valid?": True}
+    _write(test, opts, "latency-raw.svg", point_graph(test, history, opts), out)
+    _write(test, opts, "latency-quantiles.svg", quantiles_graph(test, history, opts), out)
+    return out
+
+
+@as_checker
+def _rate_graph(test, history, opts):
+    out: dict = {"valid?": True}
+    _write(test, opts, "rate.svg", rate_graph(test, history, opts), out)
+    return out
+
+
+def latency_graph() -> Checker:
+    """Latency graphs checker (checker.clj:797-808)."""
+    return _latency_graph
+
+
+def rate_graph_checker() -> Checker:
+    """Rate graph checker (checker.clj:810-819)."""
+    return _rate_graph
+
+
+def perf(opts: Mapping | None = None) -> Checker:
+    """Composite perf checker: latency + rate graphs
+    (checker.clj:821-829)."""
+    from jepsen_tpu.checker import compose
+
+    return compose({"latency-graph": latency_graph(), "rate-graph": rate_graph_checker()})

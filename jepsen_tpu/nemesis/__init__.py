@@ -406,3 +406,118 @@ class NodeStartStopper(Nemesis):
 
 def node_start_stopper(targeter, start_fn, stop_fn) -> Nemesis:
     return NodeStartStopper(targeter, start_fn, stop_fn)
+
+
+# ---------------------------------------------------------------------------
+# Clock scrambler, hammer-time, truncate-file (nemesis.clj:435-539)
+# ---------------------------------------------------------------------------
+
+
+class ClockScrambler(Nemesis):
+    """:start → jump each node's clock by a uniform random offset within
+    ±dt seconds; :stop → set clocks back to control time
+    (nemesis.clj:435-450).  Uses the on-node C tools from
+    jepsen_tpu.nemesis.time."""
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def setup(self, test):
+        from jepsen_tpu.nemesis import time as nt
+
+        real_pmap(
+            lambda n: (nt.install_tools(test["sessions"][n]), nt.stop_ntp(test["sessions"][n])),
+            list(test["nodes"]),
+        )
+        return self
+
+    def invoke(self, test, op):
+        from jepsen_tpu.nemesis import time as nt
+
+        f = op.get("f")
+        if f == "start":
+            deltas = {
+                n: int(random.uniform(-self.dt, self.dt) * 1000)
+                for n in test["nodes"]
+            }
+            real_pmap(
+                lambda kv: nt.bump_time(test["sessions"][kv[0]], kv[1]),
+                list(deltas.items()),
+            )
+            return {**op, "type": "info", "value": deltas}
+        if f == "stop":
+            real_pmap(lambda n: nt.reset_time(test["sessions"][n]), list(test["nodes"]))
+            return {**op, "type": "info", "value": "clocks reset"}
+        raise ValueError(f"clock scrambler doesn't understand :f {f!r}")
+
+    def teardown(self, test):
+        from jepsen_tpu.nemesis import time as nt
+
+        real_pmap(lambda n: nt.reset_time(test["sessions"][n]), list(test["nodes"]))
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def clock_scrambler(dt: float) -> Nemesis:
+    return ClockScrambler(dt)
+
+
+def hammer_time(process_pattern: str, targeter=None) -> Nemesis:
+    """SIGSTOP the matching processes on targeted nodes on :start, SIGCONT
+    on :stop (nemesis.clj:497-511) — the process is frozen, not killed, so
+    its sockets stay open while it stops responding."""
+    from jepsen_tpu.control import util as cu
+
+    targeter = targeter or (lambda test, nodes: [random.choice(nodes)])
+
+    def stop_procs(test, node):
+        s = test["sessions"][node]
+        with s.su():
+            cu.signal(s, process_pattern, "STOP")
+        return "paused"
+
+    def cont_procs(test, node):
+        s = test["sessions"][node]
+        with s.su():
+            cu.signal(s, process_pattern, "CONT")
+        return "resumed"
+
+    return NodeStartStopper(targeter, stop_procs, cont_procs)
+
+
+class TruncateFile(Nemesis):
+    """:truncate → chop the tail off a file on the targeted nodes, modeling
+    torn writes / lost suffixes after crashes (nemesis.clj:513-539).
+
+    The op's :value may override {node: {path, drop}} per node; otherwise
+    every node's default path loses ``drop`` bytes."""
+
+    def __init__(self, path: str, drop: int = 64):
+        self.path = path
+        self.drop = drop
+
+    def invoke(self, test, op):
+        if op.get("f") != "truncate":
+            raise ValueError(f"truncate-file doesn't understand :f {op.get('f')!r}")
+        value = op.get("value") or {n: {"path": self.path, "drop": self.drop} for n in test["nodes"]}
+
+        def go(kv):
+            node, spec = kv
+            s = test["sessions"][node]
+            path = spec.get("path", self.path)
+            drop = int(spec.get("drop", self.drop))
+            with s.su():
+                size = int(s.exec("stat", "-c", "%s", path))
+                s.exec("truncate", "-s", str(max(0, size - drop)), path)
+            return {"path": path, "from": size, "to": max(0, size - drop)}
+
+        res = dict(real_pmap(lambda kv: (kv[0], go(kv)), list(value.items())))
+        return {**op, "type": "info", "value": res}
+
+    def fs(self):
+        return {"truncate"}
+
+
+def truncate_file(path: str, drop: int = 64) -> Nemesis:
+    return TruncateFile(path, drop)

@@ -1,0 +1,307 @@
+"""Nemesis packages: composable fault + schedule bundles.
+
+Mirrors ``jepsen.nemesis.combined`` (reference: jepsen/src/jepsen/nemesis/
+combined.clj).  A *package* bundles everything one fault family needs
+(combined.clj:8-15):
+
+  nemesis          — handles the family's :f vocabulary
+  generator        — emits its fault schedule ops forever
+  final_generator  — heals/recovers at the end of the test
+  perf             — {name, start, stop, fs, color} hints for plot shading
+
+Packages compose: ``nemesis_package(faults={"partition", "kill"})`` builds
+one nemesis + generator pair that ``core.run_test`` can drop straight into
+a test map (combined.clj:328-374).
+
+Node specs (combined.clj:38-61) name *which* nodes a fault hits, resolved
+fresh on every op: "one", "minority", "majority", "minority-third",
+"primaries", "all".
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.utils import majority, real_pmap
+
+DEFAULT_INTERVAL = 10  # seconds between fault ops (combined.clj:27-29)
+
+NODE_SPECS = ("one", "minority", "majority", "minority-third", "primaries", "all")
+
+
+def db_nodes(test: Mapping, spec) -> list:
+    """Resolve a node spec to concrete nodes (combined.clj:38-61)."""
+    nodes = list(test["nodes"])
+    n = len(nodes)
+    if spec is None or spec == "all":
+        return nodes
+    if isinstance(spec, (list, tuple, set)):
+        return [x for x in nodes if x in set(spec)]
+    if spec == "one":
+        return random.sample(nodes, 1)
+    if spec == "minority":
+        return random.sample(nodes, max(1, (n - 1) // 2))
+    if spec == "majority":
+        return random.sample(nodes, majority(n))
+    if spec == "minority-third":
+        return random.sample(nodes, max(1, n // 3))
+    if spec == "primaries":
+        db = test.get("db")
+        if db is not None and jdb.supports(db, "primaries"):
+            return list(db.primaries(test))
+        return []
+    raise ValueError(f"unknown node spec {spec!r}")
+
+
+@dataclass
+class Package:
+    """One fault family's bundle (combined.clj:8-15)."""
+
+    nemesis: nem.Nemesis
+    generator: Any = None
+    final_generator: Any = None
+    perf: dict = field(default_factory=dict)
+
+
+def compose_packages(packages: Sequence[Package]) -> Package:
+    """Combine packages: one routing nemesis, schedules interleaved with
+    ``gen.any``, finals run in sequence (combined.clj:305-326)."""
+    packages = [p for p in packages if p is not None]
+    gens = [p.generator for p in packages if p.generator is not None]
+    finals = [p.final_generator for p in packages if p.final_generator is not None]
+    return Package(
+        nemesis=nem.compose([p.nemesis for p in packages]),
+        generator=gen.any_gen(*gens) if gens else None,
+        final_generator=finals if finals else None,
+        perf={"nemeses": [p.perf for p in packages if p.perf]},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Partition package (combined.clj:226-246)
+# ---------------------------------------------------------------------------
+
+PARTITION_SPECS = ("one", "majority", "majorities-ring", "minority-third")
+
+
+def _grudge_for(spec, nodes: list) -> dict:
+    """Translate a partition target spec into a grudge (combined.clj:
+    205-224 partition-specs)."""
+    xs = list(nodes)
+    if spec == "one":
+        return nem.complete_grudge(nem.split_one(xs))
+    if spec == "majority":
+        random.shuffle(xs)
+        return nem.complete_grudge(nem.bisect(xs))
+    if spec == "majorities-ring":
+        return nem.majorities_ring(xs)
+    if spec == "minority-third":
+        random.shuffle(xs)
+        k = max(1, len(xs) // 3)
+        return nem.complete_grudge([xs[:k], xs[k:]])
+    raise ValueError(f"unknown partition spec {spec!r}")
+
+
+class _PartitionNemesis(nem.Nemesis):
+    """Partitioner speaking {:f :start-partition, :value spec}
+    (combined.clj:226-236)."""
+
+    def __init__(self):
+        self.inner = nem.Partitioner(None, "start-partition", "stop-partition")
+
+    def setup(self, test):
+        self.inner.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.get("f") == "start-partition":
+            grudge = _grudge_for(op.get("value") or "majority", list(test["nodes"]))
+            return {**self.inner.invoke(test, {**op, "value": grudge}), "value": op.get("value")}
+        return self.inner.invoke(test, op)
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def fs(self):
+        return {"start-partition", "stop-partition"}
+
+
+def partition_package(opts: Mapping | None = None) -> Package:
+    """Network-partition fault package (combined.clj:226-246)."""
+    opts = dict(opts or {})
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    targets = list(opts.get("targets", PARTITION_SPECS))
+
+    def start(test, ctx):
+        return {"type": "info", "f": "start-partition", "value": random.choice(targets)}
+
+    stop = {"type": "info", "f": "stop-partition", "value": None}
+    schedule = gen.flip_flop(start, gen.repeat(stop))
+    return Package(
+        nemesis=_PartitionNemesis(),
+        generator=gen.stagger(interval, schedule),
+        final_generator=gen.once(stop),
+        perf={
+            "name": "partition",
+            "start": {"start-partition"},
+            "stop": {"stop-partition"},
+            "color": "#E9A4A0",
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# DB process package: kill / pause via db capabilities (combined.clj:70-152)
+# ---------------------------------------------------------------------------
+
+
+class DBNemesis(nem.Nemesis):
+    """Start/kill/pause/resume the DB's processes on spec'd nodes via the
+    db's Process/Pause capabilities (combined.clj:70-98)."""
+
+    def __init__(self, fset: set | None = None):
+        self._fs = set(fset) if fset else {"start", "kill", "pause", "resume"}
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f not in self._fs:
+            raise ValueError(f"db nemesis doesn't understand :f {f!r}")
+        db: jdb.DB = test["db"]
+        method = {"start": "start", "kill": "kill", "pause": "pause", "resume": "resume"}[f]
+        if not jdb.supports(db, method):
+            raise ValueError(f"db {db!r} doesn't support {method}")
+        nodes = db_nodes(test, op.get("value"))
+        sessions = test["sessions"]
+
+        def go(node):
+            return node, getattr(db, method)(test, node, sessions[node])
+
+        res = dict(real_pmap(go, nodes))
+        return {**op, "type": "info", "value": {n: (r if r is not None else f) for n, r in res.items()}}
+
+    def fs(self):
+        return set(self._fs)
+
+
+def _fault_subpackage(fset, degrade_f, restore_f, targets, interval, color) -> Package:
+    def degrade(test, ctx):
+        return {"type": "info", "f": degrade_f, "value": random.choice(list(targets))}
+
+    restore = {"type": "info", "f": restore_f, "value": "all"}
+    schedule = gen.flip_flop(degrade, gen.repeat(restore))
+    return Package(
+        nemesis=DBNemesis(fset),
+        generator=gen.stagger(interval, schedule),
+        final_generator=gen.once(restore),
+        perf={"name": degrade_f, "start": {degrade_f}, "stop": {restore_f}, "color": color},
+    )
+
+
+def db_package(opts: Mapping | None = None, db: jdb.DB | None = None) -> Package | None:
+    """Process kill/pause faults, gated on what the DB supports
+    (combined.clj:100-152).  ``faults`` in opts may narrow to {"kill"} or
+    {"pause"}."""
+    opts = dict(opts or {})
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    targets = list(opts.get("targets", ("one", "minority", "majority", "all")))
+    faults = set(opts.get("faults", {"kill", "pause"}))
+    subs = []
+    if "kill" in faults and (db is None or (jdb.supports(db, "kill") and jdb.supports(db, "start"))):
+        subs.append(
+            _fault_subpackage({"start", "kill"}, "kill", "start", targets, interval, "#E9A0E6")
+        )
+    if "pause" in faults and (db is None or (jdb.supports(db, "pause") and jdb.supports(db, "resume"))):
+        subs.append(
+            _fault_subpackage({"pause", "resume"}, "pause", "resume", targets, interval, "#A0B1E9")
+        )
+    if not subs:
+        return None
+    return compose_packages(subs)
+
+
+# ---------------------------------------------------------------------------
+# Clock package (combined.clj:248-280)
+# ---------------------------------------------------------------------------
+
+
+def clock_package(opts: Mapping | None = None) -> Package:
+    """Clock skew faults via the on-node C tools (combined.clj:248-280).
+    Op vocabulary is f-mapped to *-clock so it composes with other
+    packages."""
+    from jepsen_tpu.nemesis import time as nt
+
+    opts = dict(opts or {})
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    mapping = {
+        "reset": "reset-clock",
+        "bump": "bump-clock",
+        "strobe": "strobe-clock",
+        "check-offsets": "check-clock-offsets",
+    }
+    nemesis = nem.f_map(mapping, nt.clock_nemesis())
+
+    def rename(g):
+        return gen.f_map(mapping, g)
+
+    schedule = gen.mix(
+        [
+            rename(nt.reset_gen),
+            rename(nt.bump_gen),
+            rename(nt.strobe_gen),
+            rename(lambda t, c: {"type": "info", "f": "check-offsets"}),
+        ]
+    )
+    return Package(
+        nemesis=nemesis,
+        generator=gen.stagger(interval, schedule),
+        final_generator=gen.once({"type": "info", "f": "reset-clock", "value": None}),
+        perf={
+            "name": "clock",
+            "start": {"bump-clock", "strobe-clock"},
+            "stop": {"reset-clock"},
+            "color": "#A0E9DB",
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# Entry point (combined.clj:328-374)
+# ---------------------------------------------------------------------------
+
+FAULTS = ("partition", "kill", "pause", "clock")
+
+
+def nemesis_package(opts: Mapping | None = None) -> Package:
+    """Build the composite package for ``opts["faults"]``
+    (combined.clj:328-374).  Opts:
+
+      faults    — iterable of fault names (default: all of FAULTS)
+      db        — the test's DB (gates kill/pause on its capabilities)
+      interval  — seconds between fault ops (default 10)
+      partition/kill/pause/clock — per-family opt maps (targets, interval)
+    """
+    opts = dict(opts or {})
+    faults = set(opts.get("faults", FAULTS))
+    unknown = faults - set(FAULTS)
+    if unknown:
+        raise ValueError(f"unknown faults {sorted(unknown)}; known: {FAULTS}")
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    db = opts.get("db")
+    pkgs: list[Package | None] = []
+    if "partition" in faults:
+        pkgs.append(partition_package({"interval": interval, **opts.get("partition", {})}))
+    if faults & {"kill", "pause"}:
+        pkgs.append(
+            db_package(
+                {"interval": interval, "faults": faults & {"kill", "pause"}, **opts.get("kill", {})},
+                db=db,
+            )
+        )
+    if "clock" in faults:
+        pkgs.append(clock_package({"interval": interval, **opts.get("clock", {})}))
+    return compose_packages([p for p in pkgs if p is not None])

@@ -1,0 +1,220 @@
+"""Clock nemesis: skew, jump, and strobe node wall clocks.
+
+Mirrors ``jepsen.nemesis.time`` (reference: jepsen/src/jepsen/nemesis/
+time.clj).  The C tools are shipped in ``jepsen_tpu/resources`` and
+compiled *on the db node* with gcc at setup time, exactly as the
+reference does (time.clj:20-50); the nemesis then execs the binaries
+remotely:
+
+  bump-time DELTA_MS                      — one-shot clock jump
+  strobe-time DELTA_MS PERIOD_MS DUR_S    — oscillate for a duration
+
+Ops (time.clj:98-146):
+  {:f :reset,         :value [nodes]}         → set clocks to control time
+  {:f :bump,          :value {node: delta_ms}} → jump each node's clock
+  {:f :strobe,        :value {node: {...}}}    → strobe each node's clock
+  {:f :check-offsets}                          → measure offsets, no change
+
+Every completion carries ``:clock-offsets`` — a {node: seconds} map the
+clock checker plots (checker/clock.clj:13-34).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time as _time
+from pathlib import Path
+from typing import Mapping
+
+from jepsen_tpu import control
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.utils import real_pmap
+
+logger = logging.getLogger(__name__)
+
+RESOURCES = Path(__file__).resolve().parent.parent / "resources"
+TOOL_DIR = "/opt/jepsen"
+
+
+def install_tools(session: control.Session, tool_dir: str = TOOL_DIR):
+    """Upload the C sources and build them on the node (time.clj:20-39).
+
+    Requires gcc on the node (the reference installs build-essential via
+    the OS layer; jepsen_tpu.os_support does the same)."""
+    with session.su():
+        session.exec("mkdir", "-p", tool_dir)
+        for src, bin_name in (("bump_time.c", "bump-time"), ("strobe_time.c", "strobe-time")):
+            source = (RESOURCES / src).read_text()
+            remote_src = f"{tool_dir}/{src}"
+            session.write_file(source, remote_src)
+            session.exec("gcc", "-O2", "-o", f"{tool_dir}/{bin_name}", remote_src)
+
+
+def bump_time(session: control.Session, delta_ms: int, tool_dir: str = TOOL_DIR):
+    """Jump this node's wall clock by delta_ms (time.clj:86-90)."""
+    with session.su():
+        session.exec(f"{tool_dir}/bump-time", str(int(delta_ms)))
+
+
+def strobe_time(
+    session: control.Session,
+    delta_ms: int,
+    period_ms: int,
+    duration_s: float,
+    tool_dir: str = TOOL_DIR,
+):
+    """Oscillate this node's clock by ±delta_ms every period_ms for
+    duration_s (time.clj:92-96)."""
+    with session.su():
+        session.exec(
+            f"{tool_dir}/strobe-time", str(int(delta_ms)), str(int(period_ms)), str(int(duration_s))
+        )
+
+
+def reset_time(session: control.Session):
+    """Set the node's clock to the control node's current time
+    (time.clj:81-84)."""
+    with session.su():
+        session.exec("date", "-s", f"@{int(_time.time())}")
+
+
+def current_offset(session: control.Session) -> float:
+    """Node wall-clock minus control wall-clock, seconds (time.clj:53-60)."""
+    remote = float(session.exec("date", "+%s.%N"))
+    return remote - _time.time()
+
+
+def clock_offsets(test: Mapping, nodes=None) -> dict:
+    """Measure every node's clock offset in parallel (time.clj:62-70)."""
+    sessions = test["sessions"]
+    nodes = list(nodes if nodes is not None else test["nodes"])
+    return dict(real_pmap(lambda n: (n, current_offset(sessions[n])), nodes))
+
+
+def stop_ntp(session: control.Session):
+    """Best-effort: keep ntp daemons from snapping the clock back
+    (time.clj:72-79)."""
+    with session.su():
+        for svc in ("ntp", "ntpd", "systemd-timesyncd", "chronyd"):
+            session.exec_result("service", svc, "stop")
+        session.exec_result("timedatectl", "set-ntp", "false")
+
+
+class ClockNemesis(Nemesis):
+    """Drive the on-node clock tools (time.clj:98-146)."""
+
+    def __init__(self, tool_dir: str = TOOL_DIR):
+        self.tool_dir = tool_dir
+
+    def setup(self, test):
+        def prep(node):
+            s = test["sessions"][node]
+            install_tools(s, self.tool_dir)
+            stop_ntp(s)
+            return node
+
+        real_pmap(prep, list(test["nodes"]))
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        value = op.get("value")
+        sessions = test["sessions"]
+        if f == "reset":
+            nodes = list(value if value is not None else test["nodes"])
+            real_pmap(lambda n: reset_time(sessions[n]), nodes)
+        elif f == "bump":
+            if not isinstance(value, Mapping):
+                raise ValueError(f"bump op value must be {{node: delta_ms}}, got {value!r}")
+            real_pmap(
+                lambda kv: bump_time(sessions[kv[0]], kv[1], self.tool_dir),
+                list(value.items()),
+            )
+        elif f == "strobe":
+            if not isinstance(value, Mapping):
+                raise ValueError(
+                    f"strobe op value must be {{node: {{delta, period, duration}}}}, got {value!r}"
+                )
+
+            def go(kv):
+                node, spec = kv
+                strobe_time(
+                    sessions[node],
+                    spec["delta"],
+                    spec["period"],
+                    spec["duration"],
+                    self.tool_dir,
+                )
+
+            real_pmap(go, list(value.items()))
+        elif f == "check-offsets":
+            pass
+        else:
+            raise ValueError(f"clock nemesis doesn't understand :f {f!r}")
+        return {**op, "type": "info", "clock-offsets": clock_offsets(test)}
+
+    def teardown(self, test):
+        try:
+            real_pmap(lambda n: reset_time(test["sessions"][n]), list(test["nodes"]))
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            logger.warning("clock reset on teardown failed", exc_info=True)
+
+    def fs(self):
+        return {"reset", "bump", "strobe", "check-offsets"}
+
+
+def clock_nemesis() -> Nemesis:
+    return ClockNemesis()
+
+
+# ---------------------------------------------------------------------------
+# Generators (time.clj:148-197): exponentially distributed skews, random
+# node subsets.
+# ---------------------------------------------------------------------------
+
+
+def _random_subset(nodes):
+    nodes = list(nodes)
+    k = random.randint(1, len(nodes))
+    return random.sample(nodes, k)
+
+
+def reset_gen(test, ctx):
+    """Reset a random subset of nodes (time.clj:148-153)."""
+    return {"type": "info", "f": "reset", "value": _random_subset(test["nodes"])}
+
+
+def bump_gen(test, ctx):
+    """Bump a random subset by exponentially distributed ±2^2..2^18 ms
+    skews (time.clj:155-165)."""
+    value = {
+        n: random.choice([1, -1]) * (2 ** random.uniform(2, 18))
+        for n in _random_subset(test["nodes"])
+    }
+    return {"type": "info", "f": "bump", "value": {n: int(v) for n, v in value.items()}}
+
+
+def strobe_gen(test, ctx):
+    """Strobe a random subset: delta 2^-1..2^10 ms, period 2^0..2^10 ms,
+    duration 0-32 s (time.clj:167-178)."""
+    value = {
+        n: {
+            "delta": max(1, int(2 ** random.uniform(-1, 10))),
+            "period": max(1, int(2 ** random.uniform(0, 10))),
+            "duration": random.randint(0, 32),
+        }
+        for n in _random_subset(test["nodes"])
+    }
+    return {"type": "info", "f": "strobe", "value": value}
+
+
+def clock_gen():
+    """The full clock schedule: a reset to establish sanity, then a mix of
+    resets, bumps, strobes, and offset checks (time.clj:180-197)."""
+    from jepsen_tpu import generator as gen
+
+    return gen.phases(
+        gen.once({"type": "info", "f": "reset", "value": None}),
+        gen.mix([reset_gen, bump_gen, strobe_gen, lambda t, c: {"type": "info", "f": "check-offsets"}]),
+    )

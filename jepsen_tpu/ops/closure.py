@@ -101,9 +101,66 @@ def classify_cycles(
     return CycleFlags(g0, g1c, g_single, g2, c_ww, c_wwr, c_all)
 
 
-# vmapped batch form: [b, n, n] inputs, shared step count.
+class CycleHints(NamedTuple):
+    """Anomaly flags + one witness *hint* per anomaly: the (a, b) indices
+    of an offending edge (diag node for G0), or (-1, -1).  Hints replace
+    shipping [n, n] closures to the host — witness cycles are recovered by
+    host BFS over the (sparse, already host-resident) adjacency, so a
+    10k-node graph returns 4 flags + 8 ints instead of 3 × 400 MB."""
+
+    g0: jax.Array
+    g1c: jax.Array
+    g_single: jax.Array
+    g2: jax.Array
+    h_g0: jax.Array  # [2] int32
+    h_g1c: jax.Array
+    h_g_single: jax.Array
+    h_g2: jax.Array
+
+
+def _first_edge(mask: jax.Array) -> jax.Array:
+    """(i, j) of some true cell of a [n, n] bool mask, else (-1, -1)."""
+    n = mask.shape[1]
+    flat = mask.reshape(-1)
+    idx = jnp.argmax(flat)
+    found = flat[idx]
+    ij = jnp.stack([idx // n, idx % n]).astype(jnp.int32)
+    return jnp.where(found, ij, jnp.full(2, -1, jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def classify_cycles_hints(
+    ww: jax.Array, wr: jax.Array, rw: jax.Array, extra: jax.Array, steps: int
+) -> CycleHints:
+    """classify_cycles, but returning witness hints instead of closures —
+    the scalable form (host transfer is O(1) regardless of n)."""
+    c_ww = transitive_closure(jnp.maximum(ww, extra), steps)
+    c_wwr = transitive_closure(jnp.maximum(c_ww, wr), steps)
+    c_all = transitive_closure(jnp.maximum(c_wwr, rw), steps)
+
+    diag = jnp.diagonal(c_ww) > 0
+    v = jnp.argmax(diag).astype(jnp.int32)
+    h_g0 = jnp.where(diag[v], jnp.stack([v, v]), jnp.full(2, -1, jnp.int32))
+    m_g1c = (wr > 0) & (c_wwr.T > 0)
+    m_gs = (rw > 0) & (c_wwr.T > 0)
+    m_g2 = (rw > 0) & (c_all.T > 0)
+    return CycleHints(
+        jnp.any(diag),
+        m_g1c.any(),
+        m_gs.any(),
+        m_g2.any(),
+        h_g0,
+        _first_edge(m_g1c),
+        _first_edge(m_gs),
+        _first_edge(m_g2),
+    )
+
+
+# vmapped batch form: [b, n, n] inputs, shared step count — the per-key /
+# independent scale-out path (BASELINE config 4 for Elle: many small graphs
+# in one launch).
 classify_cycles_batch = jax.jit(
-    jax.vmap(classify_cycles, in_axes=(0, 0, 0, 0, None)),
+    jax.vmap(classify_cycles_hints, in_axes=(0, 0, 0, 0, None)),
     static_argnames=("steps",),
 )
 
@@ -116,39 +173,132 @@ def pad_adj(m: np.ndarray, size: int) -> np.ndarray:
     return out
 
 
-def classify_graph(ww: np.ndarray, wr: np.ndarray, rw: np.ndarray, extra: np.ndarray):
-    """Host convenience wrapper: pad → device classify → numpy results.
+_EMPTY_FLAGS = {"G0": False, "G1c": False, "G-single": False, "G2": False}
+_EMPTY_HINTS = {"G0": None, "G1c": None, "G-single": None, "G2": None}
 
-    Returns (flags dict, closures dict) with numpy arrays trimmed back to n.
+
+def _hints_out(res, i=None) -> tuple[dict, dict]:
+    def get(x):
+        return np.asarray(x) if i is None else np.asarray(x)[i]
+
+    flags = {
+        "G0": bool(get(res.g0)),
+        "G1c": bool(get(res.g1c)),
+        "G-single": bool(get(res.g_single)),
+        "G2": bool(get(res.g2)),
+    }
+    hints = {}
+    for name, h in (
+        ("G0", res.h_g0),
+        ("G1c", res.h_g1c),
+        ("G-single", res.h_g_single),
+        ("G2", res.h_g2),
+    ):
+        pair = get(h)
+        hints[name] = (int(pair[0]), int(pair[1])) if pair[0] >= 0 else None
+    return flags, hints
+
+
+def classify_graph(ww: np.ndarray, wr: np.ndarray, rw: np.ndarray, extra: np.ndarray):
+    """Host convenience wrapper: pad → device classify → (flags, hints).
+
+    ``hints[anomaly]`` is an (a, b) witness-edge index pair (diag node for
+    G0) or None; witness cycles are recovered host-side by BFS over the
+    adjacency (jepsen_tpu.checker.elle), so nothing O(n²) leaves the
+    device.
     """
     n = ww.shape[0]
     if n == 0:
-        z = np.zeros((0, 0), dtype=bool)
-        return (
-            {"G0": False, "G1c": False, "G-single": False, "G2": False},
-            {"ww": z, "wwr": z, "all": z},
-        )
+        return dict(_EMPTY_FLAGS), dict(_EMPTY_HINTS)
     size = _pad_to(n)
     steps = _n_steps(n)
-    res = classify_cycles(
+    res = classify_cycles_hints(
         jnp.asarray(pad_adj(ww, size)),
         jnp.asarray(pad_adj(wr, size)),
         jnp.asarray(pad_adj(rw, size)),
         jnp.asarray(pad_adj(extra, size)),
         steps,
     )
-    flags = {
-        "G0": bool(res.g0),
-        "G1c": bool(res.g1c),
-        "G-single": bool(res.g_single),
-        "G2": bool(res.g2),
-    }
-    closures = {
-        "ww": np.asarray(res.closure_ww)[:n, :n] > 0,
-        "wwr": np.asarray(res.closure_wwr)[:n, :n] > 0,
-        "all": np.asarray(res.closure_all)[:n, :n] > 0,
-    }
-    return flags, closures
+    return _hints_out(res)
+
+
+def classify_graphs(graphs) -> list[tuple[dict, dict]]:
+    """Classify MANY dependency graphs in batched device launches.
+
+    ``graphs``: sequence of (ww, wr, rw, extra) numpy bool matrix tuples
+    (ragged sizes fine).  Graphs are bucketed by padded size (MXU tiles)
+    and each bucket runs as ONE vmapped kernel — the per-key scale-out
+    shape (reference: independent.clj:285-307 bounded-pmap becomes a
+    batch axis).  Returns (flags, hints) per graph, in input order.
+    """
+    out: list = [None] * len(graphs)
+    buckets: dict[int, list[int]] = {}
+    for i, (ww, _wr, _rw, _extra) in enumerate(graphs):
+        n = ww.shape[0]
+        if n == 0:
+            out[i] = (dict(_EMPTY_FLAGS), dict(_EMPTY_HINTS))
+        else:
+            buckets.setdefault(_pad_to(n), []).append(i)
+    for size, idxs in sorted(buckets.items()):
+        steps = _n_steps(size)
+        stacks = [
+            np.stack([pad_adj(graphs[i][k], size) for i in idxs]) for k in range(4)
+        ]
+        res = classify_cycles_batch(*(jnp.asarray(s) for s in stacks), steps)
+        res = CycleHints(*(np.asarray(x) for x in res))  # one transfer per field
+        for j, i in enumerate(idxs):
+            out[i] = _hints_out(res, j)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded closure: one big graph across many chips
+# ---------------------------------------------------------------------------
+
+
+def transitive_closure_sharded(adj: np.ndarray, mesh, steps: int | None = None):
+    """Closure of one large adjacency row-block-sharded over ``mesh``.
+
+    Each device owns an [n/D, n] row block; per squaring step it
+    ``all_gather``s the full matrix over the mesh axis (ICI) and multiplies
+    its block against it on the MXU — the classic 1-D sharded matmul.  Use
+    when a single dependency graph outgrows one chip's HBM (the Elle
+    context-parallel axis; SURVEY.md §2.5 item 5).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+    n0 = adj.shape[0]
+    n = max(MXU_TILE, ((n0 + D * MXU_TILE - 1) // (D * MXU_TILE)) * D * MXU_TILE // D * D)
+    steps = steps if steps is not None else _n_steps(n0)
+    padded = pad_adj(np.asarray(adj, dtype=bool), n)
+
+    def body(r_blk):
+        # bool carry: the all_gather ships 1-byte cells over ICI, not f32.
+        def step_fn(_, r):
+            full = jax.lax.all_gather(r, axis, axis=0, tiled=True)
+            sq = jnp.dot(
+                r.astype(jnp.bfloat16),
+                full.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            return r | (sq > 0)
+
+        return lax.fori_loop(0, steps, step_fn, r_blk.astype(bool))
+
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=PartitionSpec(axis, None),
+            out_specs=PartitionSpec(axis, None),
+        )
+    )
+    arr = jax.device_put(
+        padded.astype(bool), NamedSharding(mesh, PartitionSpec(axis, None))
+    )
+    return np.asarray(fn(arr))[:n0, :n0]
 
 
 # ---------------------------------------------------------------------------

@@ -49,7 +49,7 @@ from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.models import tensor as tmodels
-from jepsen_tpu.ops.hashing import frontier_update
+from jepsen_tpu.ops.hashing import exact_prune, frontier_update, frontier_update_fast
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -282,6 +282,7 @@ def _run_core(
     P: int,
     G: int,
     W: int,
+    fast: bool,
     init_state,
     bar_active,
     bar_f,
@@ -313,7 +314,8 @@ def _run_core(
             xmov_f, xmov_v1, xmov_v2, xmov_open,
             grp_f, grp_v1, grp_v2, xgrp_open,
         )
-        state2, fok2, fcr2, alive2, ovf, fp2 = frontier_update(
+        fu = frontier_update_fast if fast else frontier_update
+        state2, fok2, fcr2, alive2, ovf, fp2 = fu(
             cat_state, cat_fok, cat_fcr, cat_alive, cost, F
         )
         changed2 = ~(fp2 == fp).all()
@@ -345,6 +347,11 @@ def _run_core(
             a3 = a2 & ((lane_vals & bitmask) != 0)
             clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
             fo3 = fo2 & ~clear[None, :]
+            if fast:
+                # The fast rounds skip domination pruning; reap once per
+                # barrier, after the return filter, so dominated rows can't
+                # breed across barriers.
+                a3 = exact_prune(s2, fo3, fc2, a3)
             dead = ~a3.any()
             failed2 = jnp.where(dead, b_idx, failed_at)
             peak2 = jnp.maximum(peak, a3.sum())
@@ -378,9 +385,9 @@ def _run_core(
     return alive.any(), failed_at, lossy, peak
 
 
-_run = functools.partial(jax.jit, static_argnames=("step", "F", "R", "P", "G", "W"))(
-    _run_core
-)
+_run = functools.partial(
+    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast")
+)(_run_core)
 
 #: (step, F, R, P, G, W) -> jitted vmapped runner over a leading batch axis.
 _BATCH_RUNNERS: dict = {}
@@ -390,10 +397,15 @@ def batched_runner(step, F: int, R: int, P: int, G: int, W: int):
     """A jit(vmap(_run_core)) specialised to the given static shapes: checks
     a stack of same-shape packed histories in one device program (BASELINE
     config 4: hundreds of recorded histories vmapped across a slice).
-    slot tables are shape-derived and shared; everything else is batched."""
+    slot tables are shape-derived and shared; everything else is batched.
+
+    Uses the fast hash-lane frontier update: under vmap, multi-key sorts
+    and full-table gathers dominate wall clock; stragglers that overflow
+    its capacity escalate to the exact path or the CPU oracle
+    (jepsen_tpu.parallel.batch)."""
     key = (step, F, R, P, G, W)
     if key not in _BATCH_RUNNERS:
-        core = functools.partial(_run_core, step, F, R, P, G, W)
+        core = functools.partial(_run_core, step, F, R, P, G, W, True)
         axes = (0,) * 14 + (None, None)
         _BATCH_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
     return _BATCH_RUNNERS[key]
@@ -453,6 +465,7 @@ def _analyze_at(model, history, packed, capacity: int, rounds: int) -> dict:
         packed["P"],
         packed["G"],
         packed["W"],
+        False,  # exact frontier update: verdict quality over batch speed
         packed["init_state"],
         packed["bar_active"],
         *packed["bar"],

@@ -88,19 +88,21 @@ def batch_analysis(
             idxs.append(i)
 
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
+    batch_cap, escalation = int(capacities[0]), [int(c) for c in capacities[1:]]
     pending = list(range(len(packs)))
-    while pending and capacities:
-        cap = int(capacities.pop(0))
+    if pending:
         sub = [packs[k] for k in pending]
         B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
         P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
         G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
         stacked = _stack(sub, B, P, G)
         n = len(sub)
-        n_pad = n
+        # Pad the batch axis to a power of two (and a mesh multiple) so the
+        # vmapped kernel compiles once per bucket, not once per batch size.
+        n_pad = 1 << max(3, (n - 1).bit_length())
         if mesh is not None:
             shard = mesh.devices.size
-            n_pad = ((n + shard - 1) // shard) * shard
+            n_pad = ((n_pad + shard - 1) // shard) * shard
         if n_pad != n:
             for k in stacked:
                 if k in ("slot_lane", "slot_onehot"):
@@ -118,7 +120,7 @@ def batch_analysis(
                 jax.device_put(a, rep if k in ("slot_lane", "slot_onehot") else spec)
                 for k, a in zip(_ARG_ORDER, args)
             ]
-        runner = wgl.batched_runner(sub[0]["step"], cap, int(rounds), P, G, (P + 31) // 32)
+        runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, (P + 31) // 32)
         valid, failed_at, lossy, peak = runner(*args)
         valid = np.asarray(valid)[:n]
         failed_at = np.asarray(failed_at)[:n]
@@ -127,7 +129,7 @@ def batch_analysis(
         still = []
         for j, k in enumerate(pending):
             i = idxs[k]
-            stats = {"frontier-peak": int(peak[j]), "capacity": cap, "lossy?": bool(lossy[j])}
+            stats = {"frontier-peak": int(peak[j]), "capacity": batch_cap, "lossy?": bool(lossy[j])}
             if failed_at[j] < 0 and valid[j]:
                 results[i] = {"valid?": True, "kernel": stats}
             elif failed_at[j] >= 0 and not lossy[j]:
@@ -140,7 +142,16 @@ def batch_analysis(
                     "cause": "frontier capacity or closure rounds exhausted",
                     "kernel": stats,
                 }
-        pending = still
+        # Stragglers escalate one-by-one through the EXACT single-history
+        # kernel at larger capacities — re-batching the whole stack at 8×
+        # capacity costs more than the handful of hard histories do
+        # (knossos-style competition, against frontier sizes).
+        if escalation:
+            for k in still:
+                i = idxs[k]
+                results[i] = wgl.analysis(
+                    model, histories[i], capacity=escalation, rounds=rounds
+                )
 
     if cpu_fallback:
         for i, r in enumerate(results):

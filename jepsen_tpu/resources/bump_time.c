@@ -1,0 +1,47 @@
+/* Jump the system wall clock by a signed number of milliseconds.
+ *
+ * Usage: bump-time DELTA_MS
+ *
+ * TPU-rebuild equivalent of the reference's on-node clock-jump tool
+ * (jepsen/resources/bump-time.c, driven by jepsen/src/jepsen/nemesis/
+ * time.clj:86-96); written fresh for this repo against clock_gettime/
+ * clock_settime.  Exit 0 on success, 1 on clock errors, 2 on usage.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#define NS_PER_S 1000000000LL
+#define NS_PER_MS 1000000LL
+
+int main(int argc, char **argv) {
+  long long delta_ms, total_ns;
+  struct timespec ts;
+  char *end;
+
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s DELTA_MS\n", argv[0]);
+    return 2;
+  }
+  delta_ms = strtoll(argv[1], &end, 10);
+  if (*end != '\0') {
+    fprintf(stderr, "%s: not an integer: %s\n", argv[0], argv[1]);
+    return 2;
+  }
+  if (clock_gettime(CLOCK_REALTIME, &ts)) {
+    perror("clock_gettime");
+    return 1;
+  }
+  total_ns = ts.tv_sec * NS_PER_S + ts.tv_nsec + delta_ms * NS_PER_MS;
+  if (total_ns < 0) {
+    fprintf(stderr, "%s: refusing to set clock before the epoch\n", argv[0]);
+    return 1;
+  }
+  ts.tv_sec = total_ns / NS_PER_S;
+  ts.tv_nsec = total_ns % NS_PER_S;
+  if (clock_settime(CLOCK_REALTIME, &ts)) {
+    perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,74 @@
+/* Oscillate the system wall clock: alternately add and subtract DELTA_MS
+ * every PERIOD_MS, for DURATION_S seconds.
+ *
+ * Usage: strobe-time DELTA_MS PERIOD_MS DURATION_S
+ *
+ * TPU-rebuild equivalent of the reference's clock-strobe tool
+ * (jepsen/resources/strobe-time.c, driven by jepsen/src/jepsen/nemesis/
+ * time.clj:92-96).  The loop is paced by CLOCK_MONOTONIC so the strobing
+ * itself cannot be derailed by the wall-clock jumps it causes.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#define NS_PER_S 1000000000LL
+#define NS_PER_MS 1000000LL
+
+static long long mono_ns(void) {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_MONOTONIC, &ts)) {
+    perror("clock_gettime(CLOCK_MONOTONIC)");
+    exit(1);
+  }
+  return ts.tv_sec * NS_PER_S + ts.tv_nsec;
+}
+
+static void bump_wall(long long delta_ms) {
+  struct timespec ts;
+  long long total_ns;
+  if (clock_gettime(CLOCK_REALTIME, &ts)) {
+    perror("clock_gettime");
+    exit(1);
+  }
+  total_ns = ts.tv_sec * NS_PER_S + ts.tv_nsec + delta_ms * NS_PER_MS;
+  if (total_ns < 0)
+    return; /* never strobe across the epoch */
+  ts.tv_sec = total_ns / NS_PER_S;
+  ts.tv_nsec = total_ns % NS_PER_S;
+  if (clock_settime(CLOCK_REALTIME, &ts)) {
+    perror("clock_settime");
+    exit(1);
+  }
+}
+
+int main(int argc, char **argv) {
+  long long delta_ms, period_ms, duration_s, deadline;
+  struct timespec nap;
+  int sign = 1;
+
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s DELTA_MS PERIOD_MS DURATION_S\n", argv[0]);
+    return 2;
+  }
+  delta_ms = atoll(argv[1]);
+  period_ms = atoll(argv[2]);
+  duration_s = atoll(argv[3]);
+  if (period_ms <= 0 || duration_s < 0) {
+    fprintf(stderr, "%s: PERIOD_MS must be > 0, DURATION_S >= 0\n", argv[0]);
+    return 2;
+  }
+  nap.tv_sec = period_ms / 1000;
+  nap.tv_nsec = (period_ms % 1000) * NS_PER_MS;
+  deadline = mono_ns() + duration_s * NS_PER_S;
+  while (mono_ns() < deadline) {
+    bump_wall(sign * delta_ms);
+    sign = -sign;
+    nanosleep(&nap, NULL);
+  }
+  /* Leave the clock where a whole number of strobe pairs would: if we
+   * ended mid-pair (last bump unbalanced), undo it. */
+  if (sign < 0)
+    bump_wall(-delta_ms);
+  return 0;
+}

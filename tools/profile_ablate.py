@@ -1,0 +1,131 @@
+"""Ablate the WGL round inside the full 64-barrier scan (reliable wall
+clock): which component costs 28 ms/round?"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from genhist import valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops.hashing import hash_rows, dominate
+from jepsen_tpu.parallel import batch as pbatch
+
+I32, U32 = jnp.int32, jnp.uint32
+
+model = m.CASRegister(None)
+packs = [wgl.pack(model, valid_register_history(40, 4, seed=i, info_rate=0.1)) for i in range(256)]
+B, P, G, W, F, L = 64, 8, 8, 1, 64, 256
+stacked = pbatch._stack(packs, B, P, G)
+args = [jnp.asarray(stacked[k]) for k in pbatch._ARG_ORDER]
+step = packs[0]["step"]
+N = F * (1 + P + G)
+
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:52s} {min(ts)*1e3:9.1f} ms   ({min(ts)*1e3/B:6.2f} ms/round)")
+
+
+def mk_kernel(mode):
+    def skeleton(init_state, bar_active, bar_f, bar_v1, bar_v2, bar_slot,
+                 mov_f, mov_v1, mov_v2, mov_open, grp_f, grp_v1, grp_v2,
+                 grp_open, slot_lane, slot_onehot):
+        eye_g = jnp.eye(G, dtype=I32)
+        slot_mask = slot_onehot.sum(axis=1)
+
+        def barrier(carry, xs):
+            state, fok, fcr, alive = carry
+            xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+            if mode == "expand-only":
+                cat = wgl.expand_candidates(
+                    step, eye_g, slot_lane, slot_mask, slot_onehot,
+                    state, fok, fcr, alive,
+                    xmov_f, xmov_v1, xmov_v2, xmov_open,
+                    grp_f, grp_v1, grp_v2, xgrp_open,
+                )
+                cs, cf, cc, ca, cost = cat
+                # cheap fold back to F rows: strided slice, no sort
+                return (cs[:F], cf[:F], cc[:F], ca[:F]), None
+            cat = wgl.expand_candidates(
+                step, eye_g, slot_lane, slot_mask, slot_onehot,
+                state, fok, fcr, alive,
+                xmov_f, xmov_v1, xmov_v2, xmov_open,
+                grp_f, grp_v1, grp_v2, xgrp_open,
+            )
+            cs, cf, cc, ca, cost = cat
+            class_cols = [cs] + [cf[:, k] for k in range(W)]
+            ch1 = hash_rows(class_cols, 0xB00B135)
+            ch2 = hash_rows(class_cols, 0x1CEB00DA)
+            dead = (~ca).astype(U32)
+            iota = jnp.arange(N, dtype=I32)
+            if mode == "hash-only":
+                sel = jnp.argsort(ch1)[:F]  # 1 sort, 1 operand
+                return (cs[sel], cf[sel], cc[sel], ca[sel]), None
+            sd, s1, s2, sc, sidx = jax.lax.sort(
+                (dead, ch1, ch2, cost.astype(U32), iota), num_keys=4
+            )
+            st = cs[sidx]
+            fo = cf[sidx]
+            fc = cc[sidx]
+            al = ca[sidx]
+            if mode == "sort1":
+                return (st[:F], fo[:F], fc[:F], al[:F]), None
+            pos = jnp.arange(N)
+            killed = jnp.zeros(N, bool)
+            window = 4 if mode == "window4" else 16
+            for k in range(1, window + 1):
+                pst = jnp.roll(st, k)
+                pfo = jnp.roll(fo, k, axis=0)
+                pfc = jnp.roll(fc, k, axis=0)
+                pal = jnp.roll(al, k)
+                same = (pst == st) & (pfo == fo).all(-1) & pal & (pos >= k)
+                killed = killed | (same & (pfc <= fc).all(-1))
+            aliveD = al & ~killed
+            if mode in ("window", "window4"):
+                return (st[:F], fo[:F], fc[:F], aliveD[:F]), None
+            sc2 = cost[sidx].astype(U32)
+            _k1, _k2, fidx = jax.lax.sort(
+                ((~aliveD).astype(U32), sc2, jnp.arange(N, dtype=I32)), num_keys=2
+            )
+            if mode == "sort2":
+                keep = fidx[:F]
+                return (st[keep], fo[keep], fc[keep], aliveD[keep]), None
+            b2 = min(2 * F, N, 4096)
+            bsel = fidx[:b2]
+            bst, bfo, bfc = st[bsel], fo[bsel], fc[bsel]
+            balive = aliveD[bsel]
+            balive = dominate(bst, bfo, bfc, balive)
+            keep = bsel[:F]
+            return (st[keep], fo[keep], fc[keep], balive[:F]), None
+
+        state0 = jnp.full((F,), init_state, I32)
+        fok0 = jnp.zeros((F, W), U32)
+        fcr0 = jnp.zeros((F, G), I32)
+        alive0 = jnp.zeros((F,), bool).at[0].set(True)
+        xs = (bar_slot, mov_f, mov_v1, mov_v2, mov_open, grp_open)
+        (state, fok, fcr, alive), _ = jax.lax.scan(
+            barrier, (state0, fok0, fcr0, alive0), xs
+        )
+        return alive.any()
+
+    return jax.jit(jax.vmap(skeleton, in_axes=(0,) * 14 + (None, None)))
+
+
+print(f"devices={jax.devices()}  L={L} N={N}")
+for mode in ("expand-only", "hash-only", "sort1", "window4", "window", "sort2", "full"):
+    timeit(f"scan64 [{mode}]", mk_kernel(mode), *args)

@@ -1,0 +1,139 @@
+"""Bisect where the batched WGL kernel's time goes."""
+
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops.hashing import frontier_update
+from jepsen_tpu.parallel import batch as pbatch
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:44s} {min(ts)*1e3:9.2f} ms")
+    return out
+
+
+model = m.CASRegister(None)
+hists = []
+for i in range(256):
+    hh = valid_register_history(40, 4, seed=i, info_rate=0.1)
+    if i % 5 == 4:
+        hh = corrupt(hh, seed=i)
+    hists.append(hh)
+packs = [wgl.pack(model, hh) for hh in hists]
+B, P, G = 64, 8, 8
+W = 1
+stacked = pbatch._stack(packs, B, P, G)
+args = [stacked[k] for k in pbatch._ARG_ORDER]
+step = packs[0]["step"]
+F = 64
+R = 8
+
+
+def variant(R_, n_sorts=None, window=None, do_dominate=None):
+    core = functools.partial(wgl._run_core, step, F, R_, P, G, W)
+    axes = (0,) * 14 + (None, None)
+    return jax.jit(jax.vmap(core, in_axes=axes))
+
+
+print(f"devices={jax.devices()}")
+for R_ in (8, 4, 2, 1):
+    timeit(f"full kernel R={R_}", variant(R_), *args)
+
+
+# Scan skeleton: barrier loop with NO while_loop — single expand+update.
+def skeleton(init_state, bar_active, bar_f, bar_v1, bar_v2, bar_slot,
+             mov_f, mov_v1, mov_v2, mov_open, grp_f, grp_v1, grp_v2,
+             grp_open, slot_lane, slot_onehot):
+    eye_g = jnp.eye(G, dtype=I32)
+    slot_mask = slot_onehot.sum(axis=1)
+
+    def barrier(carry, xs):
+        state, fok, fcr, alive = carry
+        xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+        cat = wgl.expand_candidates(
+            step, eye_g, slot_lane, slot_mask, slot_onehot,
+            state, fok, fcr, alive,
+            xmov_f, xmov_v1, xmov_v2, xmov_open,
+            grp_f, grp_v1, grp_v2, xgrp_open,
+        )
+        s2, fo2, fc2, a2, ovf, fp = frontier_update(*cat, F)
+        return (s2, fo2, fc2, a2), ovf
+
+    state0 = jnp.full((F,), init_state, I32)
+    fok0 = jnp.zeros((F, W), U32)
+    fcr0 = jnp.zeros((F, G), I32)
+    alive0 = jnp.zeros((F,), bool).at[0].set(True)
+    xs = (bar_slot, mov_f, mov_v1, mov_v2, mov_open, grp_open)
+    (state, fok, fcr, alive), ovf = jax.lax.scan(barrier, (state0, fok0, fcr0, alive0), xs)
+    return alive.any(), ovf.any()
+
+
+sk = jax.jit(jax.vmap(skeleton, in_axes=(0,) * 14 + (None, None)))
+timeit("scan skeleton: 64 barriers x 1 round", sk, *args)
+
+
+# While-loop-free kernel: fixed 2 rounds per barrier, cond replaced by mask.
+def fixed2(init_state, bar_active, bar_f, bar_v1, bar_v2, bar_slot,
+           mov_f, mov_v1, mov_v2, mov_open, grp_f, grp_v1, grp_v2,
+           grp_open, slot_lane, slot_onehot):
+    eye_g = jnp.eye(G, dtype=I32)
+    slot_mask = slot_onehot.sum(axis=1)
+
+    def barrier(carry, xs):
+        state, fok, fcr, alive, failed_at = carry
+        b_idx, active, xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+        for _ in range(2):
+            cat = wgl.expand_candidates(
+                step, eye_g, slot_lane, slot_mask, slot_onehot,
+                state, fok, fcr, alive,
+                xmov_f, xmov_v1, xmov_v2, xmov_open,
+                grp_f, grp_v1, grp_v2, xgrp_open,
+            )
+            state, fok, fcr, alive, ovf, fp = frontier_update(*cat, F)
+        lane = xbar_slot // 32
+        bitmask = (U32(1) << (xbar_slot % 32).astype(U32))
+        lane_vals = jnp.take(fok, lane[None], axis=1)[:, 0]
+        a3 = alive & ((lane_vals & bitmask) != 0)
+        clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
+        fo3 = fok & ~clear[None, :]
+        dead = ~a3.any()
+        failed2 = jnp.where(dead & (failed_at < 0) & active, b_idx, failed_at)
+        return (state, fo3, fcr, a3, failed2), None
+
+    state0 = jnp.full((F,), init_state, I32)
+    fok0 = jnp.zeros((F, W), U32)
+    fcr0 = jnp.zeros((F, G), I32)
+    alive0 = jnp.zeros((F,), bool).at[0].set(True)
+    xs = (jnp.arange(B, dtype=I32), bar_active, bar_slot, mov_f, mov_v1,
+          mov_v2, mov_open, grp_open)
+    (state, fok, fcr, alive, failed_at), _ = jax.lax.scan(
+        barrier, (state0, fok0, fcr0, alive0, jnp.int32(-1)), xs
+    )
+    return alive.any(), failed_at
+
+
+fx = jax.jit(jax.vmap(fixed2, in_axes=(0,) * 14 + (None, None)))
+timeit("no-while kernel: 64 barriers x 2 rounds", fx, *args)

@@ -1,0 +1,91 @@
+"""Honest steady-state profiling of the batched WGL kernel on the real chip.
+
+Separates compile time from run time, times each capacity stage at the
+measured batch size, and reports per-op throughput. Run on TPU (default
+backend) or CPU (JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from genhist import corrupt, valid_register_history
+
+import jax
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+
+def time_runner(runner, args, reps=3):
+    out = runner(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = runner(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def main():
+    n_hist = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    procs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(n_hist):
+        hh = valid_register_history(n_ops, procs, seed=i, info_rate=0.1)
+        if i % 5 == 4:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+    total_ops = sum(len(hh) for hh in hists) // 2
+
+    packs = [wgl.pack(model, hh) for hh in hists]
+    B = 1 << max(6, (max(p["B"] for p in packs) - 1).bit_length())
+    P = wgl._bucket(max(p["P"] for p in packs), [8, 16, 32, 64, 128])
+    G = wgl._bucket(max(p["G"] for p in packs), [4, 8, 16, 32, 64])
+    print(f"shapes: n={n_hist} B={B} P={P} G={G}", file=sys.stderr)
+    t0 = time.perf_counter()
+    stacked = pbatch._stack(packs, B, P, G)
+    print(f"pack+stack host time: {time.perf_counter()-t0:.3f}s", file=sys.stderr)
+    args = [stacked[k] for k in pbatch._ARG_ORDER]
+
+    for cap in (64, 512):
+        t0 = time.perf_counter()
+        runner = wgl.batched_runner(packs[0]["step"], cap, 8, P, G, (P + 31) // 32)
+        out = runner(*args)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
+        best, out = time_runner(runner, args)
+        valid, failed_at, lossy, peak = (np.asarray(x) for x in out)
+        print(
+            f"cap={cap}: compile+first={compile_s:.2f}s steady={best*1e3:.1f}ms"
+            f" -> {total_ops/best:,.0f} ops/s  lossy={lossy.sum()}/{n_hist}"
+            f" peak_max={peak.max()}",
+            file=sys.stderr,
+        )
+
+    t0 = time.perf_counter()
+    for hh in hists[: min(64, n_hist)]:
+        wgl_cpu.dfs_analysis(model, hh)
+    cpu_s = (time.perf_counter() - t0) * (n_hist / min(64, n_hist))
+    print(
+        f"cpu DFS est total: {cpu_s:.2f}s -> {total_ops/cpu_s:,.0f} ops/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
