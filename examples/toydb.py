@@ -1,0 +1,188 @@
+"""A real-system harness: toydb over live processes on the local remote.
+
+This is the rebuild's zookeeper.clj — the minimal real-database harness
+shape (reference: zookeeper/src/jepsen/zookeeper.clj:40-137): a DB that
+installs/starts/wrecks an actual server process per node, a client that
+speaks its wire protocol over TCP, a kill-fault nemesis package, the
+linearizable-register workload, and a CLI main.  It exercises L0-L2
+against genuinely running processes: control write_file/daemons/grepkill/
+await-port, log download, and process-kill faults with durable recovery.
+
+Run it (single machine, real processes):
+
+  python -m examples.toydb test --local --time-limit 10 --concurrency 6
+  python -m examples.toydb analyze --local
+"""
+
+from __future__ import annotations
+
+import socket
+from pathlib import Path
+
+from jepsen_tpu import checker, cli, client, core, db as jdb, generator as gen
+from jepsen_tpu import models, testkit
+from jepsen_tpu.checker import compose, stats, timeline
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.checker.perf import perf
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined as nc
+
+SERVER_SRC = Path(__file__).resolve().parent / "toydb_server.py"
+BASE = "/tmp/jepsen-toydb"
+BASE_PORT = 7701
+
+
+def node_port(test, node) -> int:
+    return BASE_PORT + list(test["nodes"]).index(node)
+
+
+class ToyDB(jdb.DB):
+    """Install + run one toydb process per node (db.clj lifecycle; all
+    nodes share the durable register file, so the service is linearizable
+    across endpoints)."""
+
+    def _paths(self, node):
+        d = f"{BASE}/{node}"
+        return {
+            "dir": d,
+            "server": f"{d}/server.py",
+            "pid": f"{d}/toydb.pid",
+            "log": f"{d}/toydb.log",
+            "data": f"{BASE}/shared-register",
+        }
+
+    def setup(self, test, node, session):
+        p = self._paths(node)
+        session.exec("mkdir", "-p", p["dir"])
+        session.write_file(SERVER_SRC.read_text(), p["server"])
+        self.start(test, node, session)
+        cu.await_tcp_port(session, node_port(test, node), timeout=30)
+
+    def teardown(self, test, node, session):
+        self.kill(test, node, session)
+        session.exec_result("rm", "-rf", self._paths(node)["dir"])
+        session.exec_result("rm", "-f", self._paths(node)["data"])
+
+    # Process capability (db.clj:18-24) — drives the kill nemesis package.
+    def start(self, test, node, session):
+        p = self._paths(node)
+        return cu.start_daemon(
+            session,
+            "python3", p["server"],
+            "--port", str(node_port(test, node)),
+            "--data", p["data"],
+            pidfile=p["pid"],
+            logfile=p["log"],
+        )
+
+    def kill(self, test, node, session):
+        p = self._paths(node)
+        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=5)
+        cu.grepkill(session, f"server.py --port {node_port(test, node)}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [self._paths(node)["log"]]
+
+
+class ToyClient(client.Client):
+    """Line-protocol TCP client (client.clj contract: raising from invoke
+    becomes :info/indeterminate via the interpreter)."""
+
+    reusable = False
+
+    def __init__(self, sock=None):
+        self.sock = sock
+        self.rfile = None
+
+    def open(self, test, node):
+        s = socket.create_connection(("127.0.0.1", node_port(test, node)), timeout=5)
+        s.settimeout(5)
+        c = ToyClient(s)
+        c.rfile = s.makefile("r")
+        return c
+
+    def _round(self, line: str) -> str:
+        self.sock.sendall((line + "\n").encode())
+        reply = self.rfile.readline().strip()
+        if not reply:
+            raise ConnectionError("server closed connection")
+        return reply
+
+    def invoke(self, test, op):
+        f, v = op["f"], op.get("value")
+        if f == "read":
+            reply = self._round("R")
+            val = None if reply == "v nil" else int(reply.split()[1])
+            return {**op, "type": "ok", "value": val}
+        if f == "write":
+            self._round(f"W {v}")
+            return {**op, "type": "ok"}
+        if f == "cas":
+            reply = self._round(f"C {v[0]} {v[1]}")
+            return {**op, "type": "ok" if reply == "ok" else "fail"}
+        raise ValueError(f"unknown op {f!r}")
+
+    def close(self, test):
+        try:
+            self.sock.close()
+        except (OSError, AttributeError):
+            pass
+
+
+def rand_op():
+    import random
+
+    k = random.random()
+    if k < 0.4:
+        return {"f": "read"}
+    if k < 0.8:
+        return {"f": "write", "value": random.randint(0, 4)}
+    return {"f": "cas", "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def toydb_test(opts) -> dict:
+    db = ToyDB()
+    pkg = nc.nemesis_package(
+        {
+            "faults": ["kill"],
+            "db": db,
+            "interval": opts.get("interval", 2),
+            # keep a majority of endpoints alive: any node serves the
+            # shared durable register, so clients on live nodes keep going
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    time_limit = opts.get("time-limit", 10)
+    t = testkit.noop_test(
+        name="toydb",
+        db=db,
+        client=ToyClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(gen.time_limit(time_limit, gen.stagger(0.02, gen.repeat(rand_op)))),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            gen.nemesis(pkg.final_generator),
+        ),
+        checker=compose(
+            {
+                "stats": stats(),
+                "linear": linearizable({"model": models.CASRegister(None)}),
+                "timeline": timeline.timeline_checker(),
+                "perf": perf(),
+            }
+        ),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
+
+
+def main(argv=None):
+    cli.main(test_fn=toydb_test, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""toydb: a real, durable, linearizable register server for harness tests.
+
+A genuinely running network service (the role etcd/ZooKeeper play for the
+reference's harnesses, at tutorial scale — zookeeper/src/jepsen/
+zookeeper.clj:40-72): every "node" runs one of these processes; all nodes
+of a cluster share one fcntl-locked, fsync'd data file, which makes the
+service linearizable across endpoints and crash-durable — `kill -9` at
+any moment must lose nothing, which is exactly what the harness's kill
+nemesis + checker verify.
+
+Protocol (one line per request):
+  R           -> "v <value>" | "v nil"
+  W <int>     -> "ok"
+  C <old> <new> -> "ok" | "fail"
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import os
+import socketserver
+import sys
+
+
+def txn(path: str, fn):
+    """Read-modify-write under an exclusive file lock, fsync'd before the
+    lock drops — the linearization point."""
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        raw = os.read(fd, 64).decode().strip()
+        value = int(raw) if raw else None
+        new, reply = fn(value)
+        if new is not ...:
+            os.lseek(fd, 0, 0)
+            os.ftruncate(fd, 0)
+            os.write(fd, str(new).encode() if new is not None else b"")
+            os.fsync(fd)
+        return reply
+    finally:
+        os.close(fd)  # releases the lock
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            parts = raw.decode().split()
+            if not parts:
+                continue
+            try:
+                reply = self.apply(parts)
+            except Exception as e:  # noqa: BLE001
+                reply = f"err {type(e).__name__}"
+            self.wfile.write((reply + "\n").encode())
+            self.wfile.flush()
+
+    def apply(self, parts):
+        path = self.server.data_path
+        if parts[0] == "R":
+            return txn(path, lambda v: (..., f"v {v if v is not None else 'nil'}"))
+        if parts[0] == "W":
+            w = int(parts[1])
+            return txn(path, lambda v: (w, "ok"))
+        if parts[0] == "C":
+            old, new = int(parts[1]), int(parts[2])
+            return txn(path, lambda v: (new, "ok") if v == old else (..., "fail"))
+        return "err bad-command"
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True)
+    args = ap.parse_args()
+    srv = Server(("127.0.0.1", args.port), Handler)
+    srv.data_path = args.data
+    print(f"toydb listening on {args.port}, data={args.data}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

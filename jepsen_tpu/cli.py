@@ -144,6 +144,29 @@ def _cmd_analyze(test_fn: Callable, opts) -> int:
     return _exit_code(completed.get("results"))
 
 
+def _cmd_test_all(suite_fn: Callable, opts) -> int:
+    """Run a whole suite of tests back to back (cli.clj:491-519): every
+    test map the suite yields runs through core.run_test; the exit code is
+    the worst individual verdict and a summary table prints at the end."""
+    rows = []
+    code = EXIT_VALID
+    for test in suite_fn(options_to_test_opts(opts)):
+        try:
+            completed = core.run_test(test)
+            c = _exit_code(completed.get("results"))
+            valid = (completed.get("results") or {}).get("valid?")
+        except Exception:  # noqa: BLE001 — one crash shouldn't end the suite
+            logger.exception("test %s crashed", test.get("name"))
+            c, valid = EXIT_UNKNOWN, "crashed"
+        code = max(code, c)
+        rows.append((test.get("name"), valid))
+    width = max((len(str(n)) for n, _ in rows), default=4)
+    print(f"\n{'test':<{width}}  valid?")
+    for name, valid in rows:
+        print(f"{str(name):<{width}}  {valid}")
+    return code
+
+
 def _cmd_serve(opts) -> int:
     from jepsen_tpu import web
 
@@ -151,11 +174,18 @@ def _cmd_serve(opts) -> int:
     return EXIT_VALID
 
 
-def run_cli(test_fn: Callable | None = None, argv=None, extra_opts: Callable | None = None) -> int:
+def run_cli(
+    test_fn: Callable | None = None,
+    argv=None,
+    extra_opts: Callable | None = None,
+    suite_fn: Callable | None = None,
+) -> int:
     """Dispatch subcommands; returns the exit code (call sys.exit on it).
 
     ``test_fn(opts_dict) -> test-map`` builds the test from CLI options.
     ``extra_opts(parser)`` may add harness-specific flags.
+    ``suite_fn(opts_dict) -> iterable[test-map]`` enables the ``test-all``
+    subcommand (cli.clj:491-519).
     """
     parser = argparse.ArgumentParser(prog="jepsen-tpu")
     sub = parser.add_subparsers(dest="command")
@@ -165,6 +195,12 @@ def run_cli(test_fn: Callable | None = None, argv=None, extra_opts: Callable | N
         add_test_opts(p_test)
         if extra_opts:
             extra_opts(p_test)
+
+        if suite_fn is not None:
+            p_all = sub.add_parser("test-all", help="run the whole test suite")
+            add_test_opts(p_all)
+            if extra_opts:
+                extra_opts(p_all)
 
         p_an = sub.add_parser("analyze", help="re-check a stored history")
         add_test_opts(p_an)
@@ -190,6 +226,8 @@ def run_cli(test_fn: Callable | None = None, argv=None, extra_opts: Callable | N
     try:
         if opts.command == "test":
             return _cmd_test(test_fn, opts)
+        if opts.command == "test-all":
+            return _cmd_test_all(suite_fn, opts)
         if opts.command == "analyze":
             return _cmd_analyze(test_fn, opts)
         if opts.command == "serve":

@@ -26,6 +26,7 @@ from typing import Any, Callable, Mapping
 from jepsen_tpu.control.core import (
     DockerRemote,
     DummyRemote,
+    K8sRemote,
     Lit,
     LocalRemote,
     Remote,
@@ -38,7 +39,7 @@ from jepsen_tpu.control.core import (
 from jepsen_tpu.utils import real_pmap
 
 __all__ = [
-    "DockerRemote", "DummyRemote", "Lit", "LocalRemote", "Remote",
+    "DockerRemote", "DummyRemote", "K8sRemote", "Lit", "LocalRemote", "Remote",
     "RemoteError", "RemoteExecError", "RetryRemote", "SshRemote",
     "Session", "escape", "base_remote", "session", "on_nodes", "on_many",
     "with_sessions",
@@ -57,6 +58,8 @@ def base_remote(test: Mapping) -> Remote:
         return LocalRemote()
     if ssh.get("docker?"):
         return DockerRemote()
+    if ssh.get("k8s?"):
+        return K8sRemote()
     return RetryRemote(SshRemote())
 
 

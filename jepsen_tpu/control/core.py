@@ -324,6 +324,61 @@ class DockerRemote(Remote):
                 raise RemoteError(f"docker cp failed: {p.stderr}")
 
 
+class K8sRemote(Remote):
+    """``kubectl exec`` remote (control/k8s.clj): conn_spec host is the
+    pod name; ``namespace`` and ``container`` narrow the target."""
+
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT_S):
+        self.timeout = timeout
+        self.pod = None
+        self.namespace = None
+        self.container = None
+
+    def _kubectl(self, *args) -> list:
+        argv = ["kubectl"]
+        if self.namespace:
+            argv += ["-n", str(self.namespace)]
+        argv += list(args)
+        return argv
+
+    def connect(self, conn_spec):
+        r = K8sRemote(self.timeout)
+        r.pod = conn_spec.get("pod") or conn_spec.get("host")
+        r.namespace = conn_spec.get("namespace")
+        r.container = conn_spec.get("container")
+        return r
+
+    def execute(self, action):
+        cmd = full_cmd(action)
+        argv = self._kubectl("exec", "-i", str(self.pod))
+        if self.container:
+            argv += ["-c", str(self.container)]
+        argv += ["--", "bash", "-c", cmd]
+        try:
+            p = subprocess.run(
+                argv, input=action.get("in"), capture_output=True, text=True,
+                timeout=action.get("timeout", self.timeout),
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError(f"kubectl exec timed out in {self.pod}") from e
+        return {"out": p.stdout, "err": p.stderr, "exit": p.returncode}
+
+    def _cp(self, src, dest):
+        extra = ["-c", str(self.container)] if self.container else []
+        p = subprocess.run(self._kubectl("cp", *extra, str(src), str(dest)),
+                           capture_output=True, text=True, timeout=self.timeout)
+        if p.returncode != 0:
+            raise RemoteError(f"kubectl cp failed: {p.stderr}")
+
+    def upload(self, local_paths, remote_path):
+        for lp in _as_list(local_paths):
+            self._cp(lp, f"{self.pod}:{remote_path}")
+
+    def download(self, remote_paths, local_path):
+        for rp in _as_list(remote_paths):
+            self._cp(f"{self.pod}:{rp}", local_path)
+
+
 class RetryRemote(Remote):
     """Wrap a remote, retrying transport failures with backoff
     (control/retry.clj:15-33; 5 tries, ~100 ms)."""

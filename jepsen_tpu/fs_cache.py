@@ -1,0 +1,115 @@
+"""Control-node filesystem cache for expensive setup artifacts.
+
+Mirrors ``jepsen.fs-cache`` (reference: jepsen/src/jepsen/fs_cache.clj
+docstring 1-44): cache strings / data / whole files on the control node,
+keyed by structured paths (e.g. ``["etcd", "3.5.0", "tarball"]``), with
+atomic writes and per-key locks — then push cached files out to db nodes
+(``deploy_remote``) so a 10-minute build happens once, not once per node
+per run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import urllib.parse
+from collections import defaultdict
+from pathlib import Path
+from typing import Any, Sequence
+
+DEFAULT_DIR = Path("/tmp/jepsen/cache")
+
+_locks: dict = defaultdict(threading.Lock)
+_locks_guard = threading.Lock()
+
+
+def _lock_for(key: tuple) -> threading.Lock:
+    with _locks_guard:
+        return _locks[key]
+
+
+def encode_path(key: Sequence) -> str:
+    """A cache key (sequence of printables) → a relative filesystem path,
+    URL-escaped so arbitrary strings are safe (fs_cache.clj's
+    path encoding)."""
+    return "/".join(urllib.parse.quote(str(part), safe="") for part in key)
+
+
+class Cache:
+    def __init__(self, root: str | Path = DEFAULT_DIR):
+        self.root = Path(root)
+
+    def path(self, key: Sequence) -> Path:
+        return self.root / encode_path(key)
+
+    def exists(self, key: Sequence) -> bool:
+        return self.path(key).exists()
+
+    # -- writes (atomic: tmp + rename) --------------------------------------
+
+    def _prepare(self, key: Sequence) -> tuple[Path, Path]:
+        p = self.path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        return p, p.with_name(p.name + ".tmp")
+
+    def save_string(self, key: Sequence, s: str) -> Path:
+        with _lock_for(tuple(key)):
+            p, tmp = self._prepare(key)
+            tmp.write_text(s)
+            os.replace(tmp, p)
+        return p
+
+    def save_data(self, key: Sequence, data: Any) -> Path:
+        return self.save_string(key, json.dumps(data))
+
+    def save_file(self, key: Sequence, local_path: str | Path) -> Path:
+        with _lock_for(tuple(key)):
+            p, tmp = self._prepare(key)
+            shutil.copyfile(local_path, tmp)
+            os.replace(tmp, p)
+        return p
+
+    # -- reads ---------------------------------------------------------------
+
+    def load_string(self, key: Sequence) -> str | None:
+        p = self.path(key)
+        return p.read_text() if p.exists() else None
+
+    def load_data(self, key: Sequence):
+        s = self.load_string(key)
+        return None if s is None else json.loads(s)
+
+    def clear(self, key: Sequence | None = None):
+        target = self.path(key) if key else self.root
+        if target.is_dir():
+            shutil.rmtree(target, ignore_errors=True)
+        elif target.exists():
+            target.unlink()
+
+    # -- node deployment (fs_cache.clj deploy-remote!) -----------------------
+
+    def deploy_remote(self, session, key: Sequence, remote_path: str):
+        """Push a cached file to a node (upload + move into place)."""
+        p = self.path(key)
+        if not p.exists():
+            raise FileNotFoundError(f"cache key {list(key)!r} not populated")
+        session.exec("mkdir", "-p", str(Path(remote_path).parent))
+        session.upload([str(p)], remote_path)
+
+
+#: module-level default cache (the reference's cache is a singleton dir)
+cache = Cache()
+
+save_string = cache.save_string
+save_data = cache.save_data
+save_file = cache.save_file
+load_string = cache.load_string
+load_data = cache.load_data
+
+
+def locking(key: Sequence):
+    """Context lock for compound check-then-populate sections
+    (fs_cache.clj's locking)."""
+    return _lock_for(tuple(key))

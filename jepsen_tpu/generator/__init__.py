@@ -110,11 +110,15 @@ class Context:
         return self.workers[thread]
 
     def thread_of(self, process):
-        """Invert the worker map (generator.clj:506-515)."""
-        for t, p in self.workers.items():
-            if p == process:
-                return t
-        return None
+        """Invert the worker map, O(1) amortized via a per-instance memo
+        (the reference keeps a Bifurcan inverse; generator.clj:506-515).
+        Contexts are immutable, so the memo can never go stale."""
+        try:
+            inv = self._thread_of_memo
+        except AttributeError:
+            inv = {p: t for t, p in self.workers.items()}
+            object.__setattr__(self, "_thread_of_memo", inv)
+        return inv.get(process)
 
     def _sorted_free(self) -> list:
         return sorted(self.free_threads, key=_thread_sort_key)
@@ -277,7 +281,10 @@ class _Fn(Gen):
 
 def _positional_arity(f) -> int | None:
     """Number of required positional params, or None if uninspectable /
-    varargs (meaning: pass everything)."""
+    varargs (meaning: pass everything).  Memoized on the function object —
+    signature introspection showed up at ~10% of interpreter time."""
+    if "__jepsen_arity__" in getattr(f, "__dict__", {}):
+        return f.__jepsen_arity__
     try:
         sig = inspect.signature(f)
     except (TypeError, ValueError):
@@ -288,6 +295,10 @@ def _positional_arity(f) -> int | None:
             return None
         if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and p.default is p.empty:
             n += 1
+    try:
+        f.__jepsen_arity__ = n
+    except (AttributeError, TypeError):
+        pass  # builtins/bound methods may refuse; fine, just uncached
     return n
 
 

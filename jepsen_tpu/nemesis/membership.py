@@ -1,0 +1,185 @@
+"""Membership nemesis: grow and shrink the cluster under test.
+
+Mirrors ``jepsen.nemesis.membership`` (reference: jepsen/src/jepsen/
+nemesis/membership.clj + membership/state.clj): a user-supplied *state
+machine* describes how to observe and change cluster membership; the
+nemesis runs it — refreshing per-node views of the cluster on an
+interval, emitting join/leave ops, applying them, and waiting for them to
+resolve before moving on.
+
+The ``MembershipState`` protocol (membership/state.clj):
+
+  setup(test)                 → initialized state
+  node_view(test, node)       → this node's view of the cluster (or None)
+  merge_views(test, views)    → canonical view from {node: view}
+  fs()                        → the :f vocabulary this machine emits
+  op(test)                    → next fault op dict, or None (nothing to do)
+  invoke(test, op)            → apply the op; returns the completion value
+  resolve_op(test, op, view)  → has the op taken effect in ``view``?
+  teardown(test)
+
+State carries ``view`` (the merged cluster view) and ``pending`` (ops
+applied but not yet resolved) — the nemesis maintains both.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Mapping
+
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.combined import DEFAULT_INTERVAL, Package
+from jepsen_tpu.utils import real_pmap
+
+logger = logging.getLogger(__name__)
+
+
+class MembershipState:
+    """Base state machine; subclass per database (membership/state.clj)."""
+
+    view: Any = None
+
+    def setup(self, test) -> "MembershipState":
+        return self
+
+    def node_view(self, test, node):
+        """One node's opinion of the membership (None = unreachable)."""
+        return None
+
+    def merge_views(self, test, views: Mapping):
+        """Collapse {node: view} into the canonical view (e.g. the most
+        common one, or the union)."""
+        for v in views.values():
+            if v is not None:
+                return v
+        return None
+
+    def fs(self) -> set:
+        return {"grow", "shrink"}
+
+    def op(self, test):
+        """The next membership fault to inject, or None."""
+        return None
+
+    def invoke(self, test, op):
+        raise NotImplementedError
+
+    def resolve_op(self, test, op, view) -> bool:
+        """Has ``op`` taken effect, judging by ``view``?  Resolved ops
+        leave the pending set."""
+        return True
+
+    def teardown(self, test):
+        pass
+
+
+class MembershipNemesis(Nemesis):
+    """Drive a MembershipState: background view refresh + op application
+    (membership.clj's nemesis wrapper)."""
+
+    def __init__(self, state: MembershipState, interval: float = 5.0):
+        self.state = state
+        self.interval = interval
+        self.pending: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- view refresh -------------------------------------------------------
+
+    def refresh_view(self, test):
+        views = dict(
+            real_pmap(
+                lambda n: (n, self._safe_view(test, n)), list(test["nodes"])
+            )
+        )
+        merged = self.state.merge_views(test, views)
+        with self._lock:
+            self.state.view = merged
+            self.pending = [
+                op for op in self.pending if not self.state.resolve_op(test, op, merged)
+            ]
+        return merged
+
+    def _safe_view(self, test, node):
+        try:
+            return self.state.node_view(test, node)
+        except Exception:  # noqa: BLE001 - unreachable nodes are normal
+            return None
+
+    def _refresher(self, test):
+        while not self._stop.wait(self.interval):
+            try:
+                self.refresh_view(test)
+            except Exception:  # noqa: BLE001
+                logger.warning("membership view refresh failed", exc_info=True)
+
+    # -- nemesis protocol ---------------------------------------------------
+
+    def setup(self, test):
+        self.state = self.state.setup(test)
+        self.refresh_view(test)
+        self._thread = threading.Thread(
+            target=self._refresher, args=(test,), daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def invoke(self, test, op):
+        value = self.state.invoke(test, op)
+        with self._lock:
+            self.pending.append(op)
+        return {**op, "type": "info", "value": value, "view": self.state.view}
+
+    def teardown(self, test):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.state.teardown(test)
+
+    def fs(self):
+        return self.state.fs()
+
+
+def membership_gen(nemesis: MembershipNemesis):
+    """Generator fn: emit the state machine's next op; None ops come back
+    as pending-style skips (the interpreter treats None as exhausted, so
+    wrap with gen.stagger + repeat upstream)."""
+
+    def gen_fn(test, ctx):
+        with nemesis._lock:
+            waiting = bool(nemesis.pending)
+        if waiting:
+            # An applied change hasn't resolved in the view yet: emit a
+            # sleep (handled in-worker, excluded from history) instead of
+            # stacking another membership change on top.
+            return {"type": "sleep", "value": 1.0}
+        op = nemesis.state.op(test)
+        return op if op is not None else {"type": "sleep", "value": 1.0}
+
+    return gen_fn
+
+
+def membership_package(
+    state: MembershipState, opts: Mapping | None = None
+) -> Package:
+    """A nemesis package wrapping a membership state machine
+    (membership.clj → combined.clj integration)."""
+    from jepsen_tpu import generator as gen
+
+    opts = dict(opts or {})
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    nemesis = MembershipNemesis(state, interval=opts.get("view-interval", 5.0))
+    return Package(
+        nemesis=nemesis,
+        generator=gen.stagger(interval, gen.repeat(membership_gen(nemesis))),
+        final_generator=None,
+        perf={
+            "name": "membership",
+            "start": set(state.fs()),
+            "stop": set(),
+            "color": "#E9DCA0",
+        },
+    )

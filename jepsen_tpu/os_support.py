@@ -64,3 +64,40 @@ class DebianOS(OS):
                 if ip:
                     lines.append(f"{ip[0]} {n}")
         session.write_file("\n".join(lines) + "\n", "/etc/hosts")
+
+
+class CentosOS(OS):
+    """yum-based setup (os/centos.clj): EPEL-capable package install,
+    hostfile, ntp stop so the clock nemesis owns the clock."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.packages = ["curl", "wget", "unzip", "iptables", "psmisc", "tar",
+                        "iputils", "logrotate", "gcc", *extra_packages]
+
+    def setup(self, test, node, session):
+        with session.su():
+            DebianOS.setup_hostfile(self, test, node, session)
+            if not self._installed(session, self.packages):
+                session.exec("yum", "install", "-y", *self.packages)
+
+    def _installed(self, session, packages) -> bool:
+        r = session.exec_result("rpm", "-q", *packages)
+        return r.get("exit") == 0
+
+
+class UbuntuOS(DebianOS):
+    """Ubuntu rides the Debian implementation (os/ubuntu.clj is a 46-line
+    specialization); the only practical difference is sudo-by-default
+    images and the universe repo already being enabled."""
+
+
+def debian() -> OS:
+    return DebianOS()
+
+
+def centos() -> OS:
+    return CentosOS()
+
+
+def ubuntu() -> OS:
+    return UbuntuOS()

@@ -65,12 +65,18 @@ def batch_analysis(
     rounds: int = 8,
     mesh: Mesh | None = None,
     cpu_fallback: bool = True,
+    exact_escalation: Sequence[int] | None = None,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
-    Histories that can't be tensorized (or stay "unknown" after the last
-    capacity) fall back to the CPU oracle when ``cpu_fallback``.  Returns
-    one knossos-shaped result per history, in order.
+    ``capacity`` lists the BATCHED (fast-kernel) capacity ladder: each
+    stage re-batches only the still-unknown histories, padded to a power
+    of two so compiles are reused.  Histories still lossy after the last
+    batched stage escalate one-by-one through the exact single-history
+    kernel (``exact_escalation`` capacities; default one stage at 4x the
+    last batch capacity; pass () to disable), then — when
+    ``cpu_fallback`` — to the CPU config-set sweep.  Returns one
+    knossos-shaped result per history, in order.
     """
     results: list[dict | None] = [None] * len(histories)
     packs: list[dict] = []
@@ -88,9 +94,15 @@ def batch_analysis(
             idxs.append(i)
 
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
-    batch_cap, escalation = int(capacities[0]), [int(c) for c in capacities[1:]]
+    batch_caps, exact_caps = [int(c) for c in capacities], []
+    if exact_escalation is None:
+        exact_caps = [4 * batch_caps[-1]] if batch_caps else []
+    elif exact_escalation:
+        exact_caps = [int(c) for c in exact_escalation]
     pending = list(range(len(packs)))
-    if pending:
+    for batch_cap in batch_caps:
+        if not pending:
+            break
         sub = [packs[k] for k in pending]
         B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
         P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
@@ -142,19 +154,23 @@ def batch_analysis(
                     "cause": "frontier capacity or closure rounds exhausted",
                     "kernel": stats,
                 }
-        # Stragglers escalate one-by-one through the EXACT single-history
-        # kernel at larger capacities — re-batching the whole stack at 8×
-        # capacity costs more than the handful of hard histories do
-        # (knossos-style competition, against frontier sizes).
-        if escalation:
-            for k in still:
-                i = idxs[k]
-                results[i] = wgl.analysis(
-                    model, histories[i], capacity=escalation, rounds=rounds
-                )
+        pending = still
+    # Whatever survives every batched stage escalates one-by-one through
+    # the EXACT single-history kernel (cost-prioritized truncation, full
+    # domination) — knossos-style competition, against frontier sizes.
+    for k in pending:
+        i = idxs[k]
+        if exact_caps:
+            results[i] = wgl.analysis(
+                model, histories[i], capacity=exact_caps, rounds=rounds
+            )
 
     if cpu_fallback:
         for i, r in enumerate(results):
             if r is not None and r["valid?"] == "unknown":
-                results[i] = wgl_cpu.dfs_analysis(model, histories[i])
+                # The config-set sweep, not the DFS: DFS backtracking goes
+                # exponential on exactly the histories that overflow the
+                # kernel (info-heavy invalid ones); the sweep is the same
+                # frontier algorithm the kernel runs and degrades linearly.
+                results[i] = wgl_cpu.sweep_analysis(model, histories[i])
     return [r if r is not None else {"valid?": "unknown"} for r in results]

@@ -1,0 +1,105 @@
+"""Auto-reconnecting connection wrapper.
+
+Mirrors ``jepsen.reconnect`` (reference: jepsen/src/jepsen/reconnect.clj):
+a wrapper owning one connection, an RW lock around its use, and
+close-then-reopen semantics when an operation throws — so flaky network
+links degrade to retried opens instead of poisoned clients.  The
+interpreter's ClientWorker covers clients; this generic wrapper serves
+everything else (db consoles, admin channels, control-plane helpers).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class Wrapper:
+    """(reconnect.clj:16-91).
+
+    ``open_fn()`` → a connection; ``close_fn(conn)`` tears one down;
+    ``log_name`` labels log lines.
+    """
+
+    def __init__(
+        self,
+        open_fn: Callable[[], Any],
+        close_fn: Callable[[Any], None] = lambda c: None,
+        log_name: str = "conn",
+    ):
+        self.open_fn = open_fn
+        self.close_fn = close_fn
+        self.log_name = log_name
+        self._conn: Any = None
+        self._open = False
+        #: bumps on every reopen so concurrent failures reopen once
+        self._generation = 0
+        self._lock = threading.RLock()
+
+    def open(self) -> "Wrapper":
+        with self._lock:
+            if not self._open:
+                self._conn = self.open_fn()
+                self._open = True
+        return self
+
+    def close(self):
+        with self._lock:
+            if self._open:
+                try:
+                    self.close_fn(self._conn)
+                finally:
+                    self._conn = None
+                    self._open = False
+
+    def reopen(self):
+        """Close (best-effort) and open a fresh connection
+        (reconnect.clj:76-91)."""
+        with self._lock:
+            try:
+                self.close()
+            except Exception:  # noqa: BLE001
+                logger.warning("[%s] close during reopen failed", self.log_name, exc_info=True)
+            self._generation += 1
+            return self.open()
+
+    def _reopen_if_current(self, generation: int):
+        """Reopen only if nobody else already did (so a burst of failures
+        across threads reopens once, not once per thread)."""
+        with self._lock:
+            if self._generation == generation:
+                self.reopen()
+
+    def with_conn(self, f: Callable[[Any], Any], retries: int = 1, backoff: float = 0.1):
+        """Run ``f(conn)``; on exception, close + reopen and (optionally)
+        retry (reconnect.clj:93-146).  The final failure propagates.
+
+        The lock guards only connection state — ``f(conn)`` and the retry
+        backoff run outside it, so a shared wrapper doesn't serialize its
+        users (the reference holds a READ lock during ops and the write
+        lock only across reopen)."""
+        attempt = 0
+        while True:
+            with self._lock:
+                self.open()
+                conn, generation = self._conn, self._generation
+            try:
+                return f(conn)
+            except Exception:
+                logger.info("[%s] op failed; reopening", self.log_name, exc_info=True)
+                try:
+                    self._reopen_if_current(generation)
+                except Exception:  # noqa: BLE001
+                    logger.warning("[%s] reopen failed", self.log_name, exc_info=True)
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                time.sleep(backoff * (2 ** (attempt - 1)))
+
+
+def wrapper(open_fn, close_fn=lambda c: None, log_name="conn") -> Wrapper:
+    return Wrapper(open_fn, close_fn, log_name)

@@ -1,0 +1,52 @@
+"""Small reporting/REPL/codec conveniences.
+
+Mirrors the reference's ``jepsen.report`` (stdout-to-file macro,
+report.clj), ``jepsen.repl`` (latest-test helper, repl.clj), and
+``jepsen.codec`` (data <-> bytes, codec.clj) — deliberately tiny, as in
+the reference (16 + 9 + 29 LoC).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import sys
+from pathlib import Path
+
+
+@contextlib.contextmanager
+def to_file(path: str | Path):
+    """Redirect stdout into a file for the duration (report.clj's
+    ``to`` macro) — e.g. rendering an analysis summary into the store."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        yield
+    finally:
+        sys.stdout = old
+        Path(path).write_text(buf.getvalue())
+
+
+def latest_test(store_dir=None) -> dict | None:
+    """The most recently run test, loaded (repl.clj:5-9)."""
+    from jepsen_tpu import store
+
+    return store.latest(store_dir=store_dir)
+
+
+def encode(obj) -> bytes:
+    """Data → bytes (codec.clj:12-20; JSON where the reference uses
+    EDN)."""
+    from jepsen_tpu.store import _jsonable
+
+    return json.dumps(_jsonable(obj), separators=(",", ":")).encode()
+
+
+def decode(data: bytes):
+    """Bytes → data (codec.clj:22-29)."""
+    if not data:
+        return None
+    return json.loads(data.decode())

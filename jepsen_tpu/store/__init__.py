@@ -107,29 +107,50 @@ def write_history(d: Path, history: Sequence[Mapping]):
     _atomic_write(d / "history.txt", "\n".join(txt) + ("\n" if txt else ""))
 
 
+def _run_file(d: Path) -> Path:
+    return d / "run.jepsen"
+
+
 def save_0(test: Mapping) -> Mapping:
     """Write the initial test map; returns test with paths filled
     (store.clj:375-382)."""
+    from jepsen_tpu.store import format as fmt
+
     d = test_dir(test)
     d.mkdir(parents=True, exist_ok=True)
     _write_json(d / "test.json", serializable_test(test))
+    w = fmt.Writer(_run_file(d))
+    w.write_test(test)
     update_symlinks(test)
     return test
 
+
 def save_1(test: Mapping) -> Mapping:
-    """Write the history immediately after the run (store.clj:384-399)."""
+    """Write the history immediately after the run — BEFORE analysis, so a
+    crash in a checker can never lose it (store.clj:384-399)."""
+    from jepsen_tpu.store import format as fmt
+
     d = test_dir(test)
     d.mkdir(parents=True, exist_ok=True)
     _write_json(d / "test.json", serializable_test(test))
     write_history(d, test.get("history") or [])
+    w = fmt.Writer(_run_file(d))
+    if not any(b["type"] == fmt.T_TEST for b in w.index["blocks"]):
+        w.write_test(test)
+    w.write_history(test.get("history") or [])
     return test
 
 
 def save_2(test: Mapping) -> Mapping:
-    """Write the results (store.clj:401-419)."""
+    """Write the results and seal the block file (store.clj:401-419)."""
+    from jepsen_tpu.store import format as fmt
+
     d = test_dir(test)
     d.mkdir(parents=True, exist_ok=True)
     _write_json(d / "results.json", test.get("results") or {})
+    w = fmt.Writer(_run_file(d))
+    w.write_results(test.get("results") or {})
+    w.close()
     update_symlinks(test)
     return test
 
@@ -183,6 +204,16 @@ def load(name: str, timestamp: str, store_dir=None) -> dict:
 
 def load_dir(d: Path) -> dict:
     d = Path(d)
+    run = d / "run.jepsen"
+    if run.exists():
+        from jepsen_tpu.store import format as fmt
+
+        try:
+            test = fmt.read(run)
+            test["dir"] = str(d)
+            return test
+        except fmt.CorruptFile:
+            logger.warning("corrupt %s; falling back to JSON artifacts", run)
     test = json.loads((d / "test.json").read_text()) if (d / "test.json").exists() else {}
     hist_path = d / "history.jsonl"
     if hist_path.exists():
@@ -194,6 +225,41 @@ def load_dir(d: Path) -> dict:
         test["results"] = json.loads(res_path.read_text())
     test["dir"] = str(d)
     return test
+
+
+def peek_dir(d: Path) -> dict:
+    """Cheap metadata read: name / start-time / valid? / op-count WITHOUT
+    loading history or results — the block file footer when present
+    (store/format.py read_index), else the small JSON artifacts.  This is
+    what the web test table and `test-all` summaries use."""
+    d = Path(d)
+    run = d / "run.jepsen"
+    if run.exists():
+        from jepsen_tpu.store import format as fmt
+
+        try:
+            idx = fmt.read_index(run)
+            return {
+                "name": idx.get("name"),
+                "start-time-str": idx.get("start-time"),
+                "valid?": idx.get("valid?"),
+                "op-count": idx.get("op-count"),
+                "dir": str(d),
+            }
+        except fmt.CorruptFile:
+            pass
+    out: dict = {"dir": str(d)}
+    try:
+        t = json.loads((d / "test.json").read_text())
+        out["name"] = t.get("name")
+        out["start-time-str"] = t.get("start-time-str")
+    except (OSError, ValueError):
+        pass
+    try:
+        out["valid?"] = json.loads((d / "results.json").read_text()).get("valid?")
+    except (OSError, ValueError):
+        out.setdefault("valid?", None)
+    return out
 
 
 def latest(store_dir=None) -> dict | None:

@@ -27,9 +27,19 @@ VALID_COLORS = {True: "#6DB6FE", False: "#FFAA26", "unknown": "#FEB5DA"}
 
 
 def _valid_of(run_dir: Path):
-    """Cheap validity peek: read only results.json's valid? key — the role
+    """Cheap validity peek: the run.jepsen footer index when present
+    (store/format.py — nothing but the footer block is read), else
+    results.json's valid? key — the role
     of the reference's PartialMap lazy reads (web.clj:61-94,
     store/format.clj:113-129)."""
+    run = run_dir / "run.jepsen"
+    if run.exists():
+        from jepsen_tpu.store import format as fmt
+
+        try:
+            return fmt.read_index(run).get("valid?")
+        except (fmt.CorruptFile, OSError):
+            pass
     p = run_dir / "results.json"
     if not p.exists():
         return None

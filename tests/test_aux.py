@@ -1,0 +1,109 @@
+"""fs_cache / reconnect / report / codec / OS variants / k8s remote
+(fs_cache_test.clj and friends)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jepsen_tpu import fs_cache, os_support, reconnect, report
+from jepsen_tpu.control.core import K8sRemote, escape
+
+
+def test_fs_cache_roundtrip(tmp_path):
+    c = fs_cache.Cache(tmp_path)
+    key = ["etcd", "v3.5 beta/2", "notes"]
+    assert not c.exists(key)
+    c.save_string(key, "hello")
+    assert c.exists(key)
+    assert c.load_string(key) == "hello"
+    c.save_data(["meta"], {"a": [1, 2]})
+    assert c.load_data(["meta"]) == {"a": [1, 2]}
+    # escaped path: no raw slash from the key component
+    assert "v3.5%20beta%2F2" in str(c.path(key))
+    c.clear(key)
+    assert not c.exists(key)
+
+
+def test_fs_cache_file_and_deploy(tmp_path):
+    c = fs_cache.Cache(tmp_path / "cache")
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"\x00\x01data")
+    c.save_file(["bin"], src)
+
+    uploads = []
+
+    class FakeSession:
+        def exec(self, *args):
+            return ""
+
+        def upload(self, paths, remote):
+            uploads.append((paths, remote))
+
+    c.deploy_remote(FakeSession(), ["bin"], "/opt/db/artifact.bin")
+    assert uploads and uploads[0][1] == "/opt/db/artifact.bin"
+    with pytest.raises(FileNotFoundError):
+        c.deploy_remote(FakeSession(), ["missing"], "/x")
+
+
+def test_reconnect_reopens_on_failure():
+    opens = []
+
+    class Conn:
+        def __init__(self, gen):
+            self.gen = gen
+            self.closed = False
+
+    def open_fn():
+        c = Conn(len(opens))
+        opens.append(c)
+        return c
+
+    w = reconnect.wrapper(open_fn, close_fn=lambda c: setattr(c, "closed", True))
+    assert w.with_conn(lambda c: c.gen) == 0
+
+    calls = {"n": 0}
+
+    def flaky(c):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("boom")
+        return c.gen
+
+    assert w.with_conn(flaky, retries=1) == 1  # reopened to conn #1
+    assert opens[0].closed
+
+    def always_fails(c):
+        raise ConnectionError("nope")
+
+    with pytest.raises(ConnectionError):
+        w.with_conn(always_fails, retries=1, backoff=0.01)
+
+
+def test_report_to_file(tmp_path):
+    p = tmp_path / "sub" / "report.txt"
+    with report.to_file(p):
+        print("analysis: ok")
+    assert p.read_text() == "analysis: ok\n"
+
+
+def test_codec_roundtrip():
+    data = {"valid?": True, "xs": [1, "two", None]}
+    assert report.decode(report.encode(data)) == data
+    assert report.decode(b"") is None
+
+
+def test_os_variants_exist():
+    for factory in (os_support.debian, os_support.centos, os_support.ubuntu, os_support.noop):
+        inst = factory()
+        assert hasattr(inst, "setup") and hasattr(inst, "teardown")
+    assert isinstance(os_support.ubuntu(), os_support.DebianOS)
+
+
+def test_k8s_remote_command_shape():
+    r = K8sRemote().connect({"host": "db-0", "namespace": "jepsen", "container": "main"})
+    argv = r._kubectl("exec", "-i", "db-0")
+    assert argv[:3] == ["kubectl", "-n", "jepsen"]
+    # escape sanity for the command path it would wrap
+    assert escape(["echo", "hi there"]) == "echo 'hi there'"

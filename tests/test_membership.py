@@ -1,0 +1,143 @@
+"""Membership nemesis state machine (nemesis/membership.clj +
+membership/state.clj equivalents) against a simulated cluster."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from jepsen_tpu import generator as gen, net, testkit
+from jepsen_tpu.control.core import DummyRemote
+from jepsen_tpu.nemesis import membership as mem
+
+
+class SimCluster:
+    """A fake 5-node cluster whose membership changes take one view
+    refresh to land."""
+
+    def __init__(self, nodes):
+        self.members = set(nodes)
+        self.applied: list = []
+        self.lag: list = []  # changes not yet visible in views
+
+    def settle(self):
+        for kind, node in self.lag:
+            if kind == "grow":
+                self.members.add(node)
+            else:
+                self.members.discard(node)
+        self.lag = []
+
+
+class SimState(mem.MembershipState):
+    def __init__(self, cluster: SimCluster, all_nodes):
+        self.cluster = cluster
+        self.all_nodes = list(all_nodes)
+
+    def node_view(self, test, node):
+        if node not in self.cluster.members:
+            return None  # removed nodes don't answer
+        return sorted(self.cluster.members)
+
+    def merge_views(self, test, views):
+        best = None
+        for v in views.values():
+            if v is not None and (best is None or len(v) > len(best)):
+                best = v
+        return best
+
+    def op(self, test):
+        gone = [n for n in self.all_nodes if n not in self.cluster.members]
+        if gone and random.random() < 0.5:
+            return {"type": "info", "f": "grow", "value": random.choice(gone)}
+        if len(self.cluster.members) > 2:
+            return {
+                "type": "info",
+                "f": "shrink",
+                "value": random.choice(sorted(self.cluster.members)),
+            }
+        return None
+
+    def invoke(self, test, op):
+        self.cluster.lag.append((op["f"], op["value"]))
+        self.cluster.applied.append((op["f"], op["value"]))
+        return op["value"]
+
+    def resolve_op(self, test, op, view) -> bool:
+        if view is None:
+            return False
+        present = op["value"] in view
+        return present if op["f"] == "grow" else not present
+
+
+def mk_test():
+    return testkit.noop_test(net=net.NoopNet(), remote=DummyRemote())
+
+
+def test_membership_lifecycle():
+    t = mk_test()
+    cluster = SimCluster(t["nodes"])
+    state = SimState(cluster, t["nodes"])
+    n = mem.MembershipNemesis(state, interval=0.05)
+    from jepsen_tpu import control
+
+    with control.with_sessions(t):
+        n.setup(t)
+        assert state.view == sorted(t["nodes"])
+        # shrink n3; not yet resolved
+        comp = n.invoke(t, {"type": "info", "f": "shrink", "value": "n3", "process": "nemesis"})
+        assert comp["type"] == "info" and comp["value"] == "n3"
+        assert n.pending
+        # generator backs off while pending
+        g = mem.membership_gen(n)
+        assert g(t, None)["type"] == "sleep"
+        # cluster settles; refresher resolves the op
+        cluster.settle()
+        n.refresh_view(t)
+        assert not n.pending
+        assert "n3" not in state.view
+        # now the generator offers a real op again
+        op = g(t, None)
+        assert op["f"] in ("grow", "shrink")
+        n.teardown(t)
+
+
+def test_membership_package_runs_inside_interpreter():
+    t = mk_test()
+    cluster = SimCluster(t["nodes"])
+    state = SimState(cluster, t["nodes"])
+    pkg = mem.membership_package(state, {"interval": 0.01, "view-interval": 0.02})
+    from jepsen_tpu import checker, core
+
+    t.update(
+        name="membership-e2e",
+        client=testkit.atom_client(),
+        nemesis=pkg.nemesis,
+        generator=gen.any_gen(
+            gen.clients(gen.limit(10, gen.repeat(lambda: {"f": "read"}))),
+            gen.nemesis(gen.time_limit(0.7, pkg.generator)),
+        ),
+        checker=checker.unbridled_optimism(),
+    )
+    # settle the cluster continuously so changes resolve
+    import threading
+
+    stop = threading.Event()
+
+    def settler():
+        while not stop.wait(0.05):
+            cluster.settle()
+
+    th = threading.Thread(target=settler, daemon=True)
+    th.start()
+    try:
+        completed = core.run_test({**t, "store-dir": "/tmp/jepsen-mem-test"})
+    finally:
+        stop.set()
+    hist = completed["history"]
+    mem_ops = [o for o in hist if o["process"] == "nemesis" and o["f"] in ("grow", "shrink")]
+    assert cluster.applied, "state machine applied changes"
+    assert mem_ops, "membership ops reached the history"
+    import shutil
+
+    shutil.rmtree("/tmp/jepsen-mem-test", ignore_errors=True)

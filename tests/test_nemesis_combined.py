@@ -241,7 +241,7 @@ def test_nemesis_package_end_to_end(tmp_path):
             gen.nemesis(pkg.final_generator),
         ),
         checker=checker.unbridled_optimism(),
-        store_root=str(tmp_path),
+        **{"store-dir": str(tmp_path)},
     )
     completed = core.run_test(t)
     hist = completed["history"]

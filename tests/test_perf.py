@@ -66,7 +66,7 @@ def test_graphs_render_svg():
 
 
 def test_perf_checker_writes_files(tmp_path):
-    t = {"name": "perf-files", "start-time-str": "t0", "store_root": str(tmp_path)}
+    t = {"name": "perf-files", "start-time-str": "t0", "store-dir": str(tmp_path)}
     res = perf.perf().check(t, mk_history(), {})
     assert res["valid?"] is True
     files = res["latency-graph"]["files"] + res["rate-graph"]["files"]
@@ -91,7 +91,7 @@ def test_clock_plot_consumes_offsets(tmp_path):
     hist = h.index(hist)
     series = cclock.offset_series(hist)
     assert series == {"n1": [(2.0, 0.5), (5.0, 1.5)], "n2": [(2.0, -2.0), (5.0, 0.0)]}
-    t = {"name": "clock-unit", "start-time-str": "t0", "store_root": str(tmp_path)}
+    t = {"name": "clock-unit", "start-time-str": "t0", "store-dir": str(tmp_path)}
     res = cclock.clock_plot().check(t, hist, {})
     assert res["valid?"] is True
     (f,) = res["files"]

@@ -1,0 +1,152 @@
+"""Block-file store format: roundtrips, cheap partial reads, crash
+recovery, CLI test-all (store/format_test.clj's role, 232 LoC in the
+reference)."""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from jepsen_tpu import cli, core, generator as gen, history as h, store, testkit
+from jepsen_tpu.checker import unbridled_optimism
+from jepsen_tpu.store import format as fmt
+
+
+def mk_history(n=20):
+    ops = []
+    for i in range(n):
+        ops.append(h.op(h.INVOKE, i % 3, "write", i, time=i * 10))
+        ops.append(h.op(h.OK, i % 3, "write", i, time=i * 10 + 5))
+    # exotic ops: nemesis, odd values, extra keys
+    ops.append(h.op(h.INFO, h.NEMESIS, "start-partition", "majority", time=999))
+    o = h.op(h.INFO, h.NEMESIS, "check-offsets", None, time=1000)
+    o["clock-offsets"] = {"n1": 0.25}
+    ops.append(o)
+    ops.append(h.op(h.OK, 1, "cas", [3, 4], time=1001))
+    ops.append(h.op(h.OK, 2, "read", None, time=1002))
+    ops.append(h.op(h.OK, 2, "txn", [["append", 1, 2]], time=1003))
+    ops.append(h.op(h.OK, 0, "write", True, time=1004))
+    ops.append(h.op(h.OK, 0, "write", [1, None], time=1005))
+    return h.index(ops)
+
+
+def test_roundtrip(tmp_path):
+    path = tmp_path / "run.jepsen"
+    hist = mk_history()
+    w = fmt.Writer(path)
+    w.write_test({"name": "rt", "start-time-str": "t0", "nodes": ["n1"]})
+    w.write_history(hist)
+    w.write_results({"valid?": False, "why": "because"})
+    w.close()
+
+    idx = fmt.read_index(path)
+    assert idx["name"] == "rt"
+    assert idx["valid?"] is False
+    assert idx["op-count"] == len(hist)
+
+    full = fmt.read(path)
+    assert full["results"] == {"valid?": False, "why": "because"}
+    assert full["history"] == hist
+
+
+def test_chunked_history(tmp_path):
+    path = tmp_path / "run.jepsen"
+    hist = h.index(
+        [h.op(h.OK, i % 5, "write", i, time=i) for i in range(fmt.CHUNK_OPS + 100)]
+    )
+    w = fmt.Writer(path)
+    w.write_test({"name": "big", "start-time-str": "t0"})
+    w.write_history(hist)
+    w.write_results({"valid?": True})
+    w.close()
+    assert sum(1 for b in fmt.read_index(path)["blocks"] if b["type"] == fmt.T_HISTORY) == 2
+    assert fmt.read(path)["history"] == hist
+
+
+def test_crash_recovery_torn_tail(tmp_path):
+    path = tmp_path / "run.jepsen"
+    hist = mk_history()
+    w = fmt.Writer(path)
+    w.write_test({"name": "crashy", "start-time-str": "t0"})
+    w.write_history(hist)
+    # Simulate a crash before save_2: no results, no footer, torn bytes.
+    with open(path, "ab") as f:
+        f.write(struct.pack("<IIB", 99999, 0, fmt.T_RESULTS))
+        f.write(b"only-part-of-a-block")
+    idx = fmt.read_index(path)  # falls back to scan
+    assert idx["name"] == "crashy"
+    assert idx.get("valid?") is None
+    full = fmt.read(path, idx)
+    assert full["history"] == hist  # everything fully written survives
+
+
+def test_reopen_appends(tmp_path):
+    # save_0 then save_1 then save_2 across separate Writer instances,
+    # mirroring the store lifecycle.
+    path = tmp_path / "run.jepsen"
+    w = fmt.Writer(path)
+    w.write_test({"name": "phases", "start-time-str": "t0"})
+    hist = mk_history(5)
+    w2 = fmt.Writer(path)
+    w2.write_test({"name": "phases", "start-time-str": "t0"})
+    w2.write_history(hist)
+    w3 = fmt.Writer(path)
+    w3.write_results({"valid?": True})
+    w3.close()
+    idx = fmt.read_index(path)
+    assert idx["valid?"] is True
+    assert fmt.read(path)["history"] == hist
+
+
+def test_store_writes_and_peeks_block_file(tmp_path):
+    t = testkit.noop_test(
+        name="fmt-e2e",
+        concurrency=2,
+        client=testkit.atom_client(),
+        generator=gen.clients(gen.limit(10, gen.repeat(lambda: {"f": "read"}))),
+        checker=unbridled_optimism(),
+    )
+    t["store-dir"] = str(tmp_path)
+    completed = core.run_test(t)
+    d = store.test_dir(completed)
+    assert (d / "run.jepsen").exists()
+    peek = store.peek_dir(d)
+    assert peek["name"] == "fmt-e2e"
+    assert peek["valid?"] is True
+    assert peek["op-count"] == len(completed["history"])
+    loaded = store.load_dir(d)
+    assert loaded["history"] == [
+        {k: v for k, v in o.items()} for o in completed["history"]
+    ]
+    assert loaded["results"]["valid?"] is True
+
+
+def test_cli_test_all(tmp_path, capsys):
+    def suite(opts):
+        for i, ok in enumerate([True, True]):
+            yield testkit.noop_test(
+                name=f"suite-{i}",
+                concurrency=2,
+                client=testkit.atom_client(),
+                generator=gen.clients(gen.limit(5, gen.repeat(lambda: {"f": "read"}))),
+                checker=unbridled_optimism(),
+                **{"store-dir": str(tmp_path)},
+            )
+
+    code = cli.run_cli(
+        test_fn=lambda o: {"name": "unused"},
+        suite_fn=suite,
+        argv=["test-all", "--no-ssh", "--store-dir", str(tmp_path)],
+    )
+    assert code == cli.EXIT_VALID
+    out = capsys.readouterr().out
+    assert "suite-0" in out and "suite-1" in out
+
+
+def test_corrupt_magic(tmp_path):
+    p = tmp_path / "bad.jepsen"
+    p.write_bytes(b"NOTJEPSEN")
+    with pytest.raises(fmt.CorruptFile):
+        fmt.read_index(p)

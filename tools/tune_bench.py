@@ -1,0 +1,91 @@
+"""Find the honest hard-regime bench shape: histories whose config
+frontiers are genuinely wide (the worst-case-branching regime BASELINE
+config 5 targets), where per-config Python cost explodes but the
+fixed-shape TPU kernel doesn't.  Reports frontier peaks, TPU batch time,
+and CPU sweep/DFS times per candidate shape."""
+
+import signal
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from genhist import corrupt, valid_register_history
+
+import jax
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.parallel import batch_analysis
+
+
+class Timeout(Exception):
+    pass
+
+
+def timed(fn, budget):
+    def bail(*a):
+        raise Timeout
+
+    signal.signal(signal.SIGALRM, bail)
+    signal.setitimer(signal.ITIMER_REAL, budget)
+    t0 = time.perf_counter()
+    try:
+        fn()
+        return time.perf_counter() - t0
+    except Timeout:
+        return None
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+
+
+SHAPES = [
+    # (ops, procs, info, n_values, label)
+    (60, 8, 0.25, 8, "A"),
+    (100, 8, 0.3, 8, "B"),
+    (100, 16, 0.3, 12, "C"),
+]
+
+model = m.CASRegister(None)
+N_H = 64
+for ops, procs, info, nv, label in SHAPES:
+    hists = []
+    for i in range(N_H):
+        hh = valid_register_history(ops, procs, seed=i, info_rate=info, n_values=nv)
+        if i % 4 == 3:
+            hh = corrupt(valid_register_history(ops, procs, seed=i, info_rate=info, n_values=nv), seed=i)
+        hists.append(hh)
+    total = sum(len(x) for x in hists) // 2
+
+    caps = (128, 512)
+    res = batch_analysis(model, hists, capacity=caps, cpu_fallback=False)
+    t0 = time.perf_counter()
+    res = batch_analysis(model, hists, capacity=caps, cpu_fallback=False)
+    tpu_s = time.perf_counter() - t0
+    peaks = [r.get("kernel", {}).get("frontier-peak", 0) for r in res]
+    unknowns = sum(1 for r in res if r["valid?"] == "unknown")
+    lossy = sum(1 for r in res if r.get("kernel", {}).get("lossy?"))
+
+    # CPU sweep on a sample, extrapolated; per-history 2s budget
+    cpu_total, cpu_n, cpu_timeouts = 0.0, 0, 0
+    for hh in hists[:16]:
+        dt = timed(lambda: wgl_cpu.sweep_analysis(model, hh), 2.0)
+        if dt is None:
+            cpu_timeouts += 1
+            cpu_total += 2.0
+        else:
+            cpu_total += dt
+        cpu_n += 1
+    cpu_est = cpu_total / cpu_n * N_H
+
+    print(
+        f"[{label}] ops={ops} procs={procs} info={info} nv={nv}: "
+        f"TPU {tpu_s:6.2f}s ({total/tpu_s:8,.0f} ops/s) "
+        f"peak med/max={sorted(peaks)[len(peaks)//2]}/{max(peaks)} "
+        f"unknown={unknowns} lossy={lossy} | "
+        f"CPU sweep est {cpu_est:7.2f}s ({cpu_timeouts}/16 hit 2s cap) "
+        f"-> vs_cpu {cpu_est/tpu_s:6.2f}x",
+        flush=True,
+    )
