@@ -36,7 +36,7 @@ from jepsen_tpu import models as m  # noqa: E402
 from jepsen_tpu.checker import wgl_cpu  # noqa: E402
 from jepsen_tpu.parallel import batch_analysis  # noqa: E402
 
-N_HISTORIES = 256
+N_HISTORIES = 128
 OPS_PER_HISTORY = 100
 PROCS = 8
 INFO_RATE = 0.3
@@ -45,7 +45,7 @@ CORRUPT_EVERY = 4
 CAPS = (128, 512)
 EXACT = (2048,)
 BUDGET_S = 3.0  # per-history CPU cap; hits understate vs_baseline
-CPU_SAMPLE = 64  # CPU baseline measured on this many histories, extrapolated
+CPU_SAMPLE = 48  # CPU baseline measured on this many histories, extrapolated
 
 
 def cpu_check(model, hist):
@@ -113,7 +113,7 @@ def main() -> None:
             {
                 "metric": (
                     "linearizability ops verified/sec/chip "
-                    f"(256x{OPS_PER_HISTORY}-op batch, {PROCS} procs, "
+                    f"({N_HISTORIES}x{OPS_PER_HISTORY}-op batch, {PROCS} procs, "
                     f"{int(INFO_RATE*100)}% info, 1/{CORRUPT_EVERY} corrupted; "
                     f"tpu unknowns {unknowns}, cpu {CPU_SAMPLE}-sample budget-capped {cap_hits})"
                 ),

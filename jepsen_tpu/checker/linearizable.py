@@ -58,14 +58,40 @@ class Linearizable(Checker):
             return a
         raise ValueError(f"unknown linearizability algorithm {self.algorithm!r}")
 
-    def check(self, test, history, opts):
-        a = self._analyze(history)
+    @staticmethod
+    def _truncate(a: Mapping) -> dict:
         out = dict(a)
         if "final-paths" in out:
             out["final-paths"] = list(out["final-paths"])[:10]
         if "configs" in out:
             out["configs"] = list(out["configs"])[:10]
         return out
+
+    def check(self, test, history, opts):
+        return self._truncate(self._analyze(history))
+
+    def check_batch(self, test, histories, opts):
+        """Check many subhistories in ONE vmapped kernel ladder (used by
+        independent.checker: per-key shards become the batch axis —
+        BASELINE config 4's shape).  CPU algorithms just loop."""
+        if self.algorithm in ("wgl", "sweep"):
+            return [self.check(test, hh, opts) for hh in histories]
+        from jepsen_tpu.parallel import batch_analysis
+
+        # kernel-opts is shaped for wgl.analysis; forward only the keys
+        # batch_analysis shares (capacity ladder, rounds, exact stage).
+        batch_kw = {
+            k: v
+            for k, v in self.kernel_opts.items()
+            if k in ("capacity", "rounds", "mesh", "exact_escalation")
+        }
+        results = batch_analysis(
+            self.model,
+            histories,
+            cpu_fallback=(self.algorithm == "competition"),
+            **batch_kw,
+        )
+        return [self._truncate(r) for r in results]
 
 
 def linearizable(opts: Mapping) -> Checker:

@@ -1,0 +1,84 @@
+"""libfaketime wrappers: run DB binaries on lying clocks.
+
+Mirrors ``jepsen.faketime`` (reference: jepsen/src/jepsen/faketime.clj:
+8-47): wrap a database binary in a shell script that LD_PRELOADs
+libfaketime with a per-process rate/offset, so different nodes' *daemons*
+experience different clock speeds — a softer, always-on cousin of the
+bump/strobe nemesis (jepsen_tpu.nemesis.time).
+
+The reference fetches its own libfaketime fork and builds it on the node;
+here the library path is configurable (distro packages ship
+``libfaketime.so.1``) and ``install`` builds from a source tree when one
+is provided via the fs cache.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Mapping
+
+from jepsen_tpu import control
+
+#: common distro install locations, probed in order
+LIB_CANDIDATES = (
+    "/usr/lib/x86_64-linux-gnu/faketime/libfaketime.so.1",
+    "/usr/lib/faketime/libfaketime.so.1",
+    "/usr/local/lib/faketime/libfaketime.so.1",
+    "/opt/jepsen/libfaketime.so.1",
+)
+
+
+def find_lib(session: control.Session) -> str | None:
+    for p in LIB_CANDIDATES:
+        if session.exec_result("test", "-e", p).get("exit") == 0:
+            return p
+    return None
+
+
+def script(binary: str, lib: str, rate: float = 1.0, offset_s: float = 0.0) -> str:
+    """The wrapper script body (faketime.clj:8-30): exec the real binary
+    under libfaketime at ``rate`` × real speed, offset by ``offset_s``."""
+    spec = f"{'+' if offset_s >= 0 else ''}{offset_s:.3f}s x{rate:.6f}"
+    return (
+        "#!/bin/bash\n"
+        f"# jepsen faketime wrapper for {binary}\n"
+        f"export LD_PRELOAD={lib}\n"
+        f'export FAKETIME="{spec}"\n'
+        "export FAKETIME_DONT_FAKE_MONOTONIC=1\n"
+        f'exec {binary}.real "$@"\n'
+    )
+
+
+def wrap_binary(
+    session: control.Session,
+    binary: str,
+    rate: float = 1.0,
+    offset_s: float = 0.0,
+    lib: str | None = None,
+):
+    """Replace ``binary`` with a faketime wrapper (the original moves to
+    ``<binary>.real``), idempotently (faketime.clj:32-47)."""
+    lib = lib or find_lib(session)
+    if lib is None:
+        raise RuntimeError("libfaketime not found on node; install it or pass lib=")
+    with session.su():
+        moved = session.exec_result("test", "-e", f"{binary}.real").get("exit") == 0
+        if not moved:
+            session.exec("mv", binary, f"{binary}.real")
+        session.write_file(script(binary, lib, rate, offset_s), binary)
+        session.exec("chmod", "+x", binary)
+
+
+def unwrap_binary(session: control.Session, binary: str):
+    """Restore the real binary."""
+    with session.su():
+        if session.exec_result("test", "-e", f"{binary}.real").get("exit") == 0:
+            session.exec("mv", f"{binary}.real", binary)
+
+
+def rand_factor(max_skew: float = 5.0) -> float:
+    """A random clock rate in [1/max_skew, max_skew], log-uniform
+    (faketime.clj:57-65)."""
+    import math
+
+    return math.exp(random.uniform(-math.log(max_skew), math.log(max_skew)))

@@ -21,7 +21,7 @@ from typing import Any, Sequence
 
 DEFAULT_DIR = Path("/tmp/jepsen/cache")
 
-_locks: dict = defaultdict(threading.Lock)
+_locks: dict = defaultdict(threading.RLock)  # reentrant: locking(key) wraps save_*
 _locks_guard = threading.Lock()
 
 

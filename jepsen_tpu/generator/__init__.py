@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import types
 import logging
 import random
 from typing import Any, Callable, Iterable, Mapping, Sequence
@@ -283,8 +284,12 @@ def _positional_arity(f) -> int | None:
     """Number of required positional params, or None if uninspectable /
     varargs (meaning: pass everything).  Memoized on the function object —
     signature introspection showed up at ~10% of interpreter time."""
-    if "__jepsen_arity__" in getattr(f, "__dict__", {}):
-        return f.__jepsen_arity__
+    # Cache on plain functions only: a bound method shares its
+    # function's __dict__, and its signature differs by self.
+    if type(f) is types.FunctionType:
+        cached = f.__dict__.get("__jepsen_arity__")
+        if cached is not None:
+            return cached
     try:
         sig = inspect.signature(f)
     except (TypeError, ValueError):
@@ -295,10 +300,8 @@ def _positional_arity(f) -> int | None:
             return None
         if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and p.default is p.empty:
             n += 1
-    try:
-        f.__jepsen_arity__ = n
-    except (AttributeError, TypeError):
-        pass  # builtins/bound methods may refuse; fine, just uncached
+    if type(f) is types.FunctionType:
+        f.__dict__["__jepsen_arity__"] = n
     return n
 
 

@@ -1,0 +1,126 @@
+"""Filesystem fault injection: unreliable disks under the database.
+
+The reference integrates CharybdeFS — an external C++/FUSE/Thrift
+filesystem built from source on each node (charybdefs/src/jepsen/
+charybdefs.clj:7-67) — to serve a ``/faulty`` directory that can return
+EIO or drop writes.  This rebuild reaches the same capability with stock
+Linux device-mapper targets instead of an external FUSE stack: the DB's
+data directory is backed by a loopback ext4 image whose dm table can be
+live-swapped between ``linear`` (healthy) and ``flakey``/``error``
+(faulty) — no daemons, no Thrift, kill-safe.
+
+  FaultyDirDB   db wrapper: create image → losetup → dm linear → mkfs →
+                mount at ``mount_point`` (setup); unmount + detach
+                (teardown)
+  FlakeyFS      nemesis: {:f :start-flakey} swaps the table to flakey
+                (drops all IO for up/down intervals), {:f :fail-fs} to
+                error (every IO fails), {:f :heal-fs} back to linear
+
+Requires root on the node (as CharybdeFS did).  Self-tests drive it
+against the dummy remote and assert the dmsetup commands.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.utils import real_pmap
+
+
+class FaultyDirDB(jdb.DB):
+    """Back ``mount_point`` with a dm device that nemeses can degrade
+    (the /faulty role, charybdefs.clj:40-67)."""
+
+    def __init__(self, mount_point: str = "/faulty", size_mb: int = 256,
+                 image: str = "/var/lib/jepsen-faulty.img", name: str = "jepsen-faulty"):
+        self.mount_point = mount_point
+        self.size_mb = size_mb
+        self.image = image
+        self.name = name
+
+    def _sectors(self) -> int:
+        return self.size_mb * 2048  # 512-byte sectors
+
+    def setup(self, test, node, session):
+        with session.su():
+            session.exec("mkdir", "-p", self.mount_point)
+            session.exec("truncate", "-s", f"{self.size_mb}M", self.image)
+            loop = session.exec("losetup", "--find", "--show", self.image).strip()
+            session.exec(
+                "dmsetup", "create", self.name, "--table",
+                f"0 {self._sectors()} linear {loop} 0",
+            )
+            dev = f"/dev/mapper/{self.name}"
+            session.exec("mkfs.ext4", "-q", dev)
+            session.exec("mount", dev, self.mount_point)
+
+    def teardown(self, test, node, session):
+        with session.su():
+            session.exec_result("umount", "-f", self.mount_point)
+            session.exec_result("dmsetup", "remove", "-f", self.name)
+            loop = session.exec_result("losetup", "-j", self.image).get("out", "")
+            if loop:
+                session.exec_result("losetup", "-d", loop.split(":")[0])
+            session.exec_result("rm", "-f", self.image)
+
+    def log_files(self, test, node):
+        return []
+
+
+class FlakeyFS(Nemesis):
+    """Swap the dm table live: flakey / error / linear
+    (CharybdeFS's set_fault / clear_faults RPCs, without the RPCs)."""
+
+    def __init__(self, db: FaultyDirDB, up_s: int = 1, down_s: int = 3):
+        self.db = db
+        self.up_s = up_s
+        self.down_s = down_s
+
+    def _loop_of(self, session) -> str:
+        out = session.exec("losetup", "-j", self.db.image)
+        return out.split(":")[0].strip()
+
+    def _swap_table(self, session, table_type: str):
+        loop = self._loop_of(session)
+        sectors = self.db._sectors()
+        if table_type == "flakey":
+            table = f"0 {sectors} flakey {loop} 0 {self.up_s} {self.down_s}"
+        elif table_type == "error":
+            table = f"0 {sectors} error"
+        else:
+            table = f"0 {sectors} linear {loop} 0"
+        with session.su():
+            session.exec("dmsetup", "suspend", self.db.name)
+            session.exec("dmsetup", "load", self.db.name, "--table", table)
+            session.exec("dmsetup", "resume", self.db.name)
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        table = {"start-flakey": "flakey", "fail-fs": "error", "heal-fs": "linear"}.get(f)
+        if table is None:
+            raise ValueError(f"filesystem nemesis doesn't understand :f {f!r}")
+        nodes = list(op.get("value") or test["nodes"])
+        real_pmap(lambda n: self._swap_table(test["sessions"][n], table), nodes)
+        return {**op, "type": "info", "value": {n: table for n in nodes}}
+
+    def teardown(self, test):
+        try:
+            real_pmap(
+                lambda n: self._swap_table(test["sessions"][n], "linear"),
+                list(test["nodes"]),
+            )
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+
+    def fs(self):
+        return {"start-flakey", "fail-fs", "heal-fs"}
+
+
+def faulty_dir(mount_point: str = "/faulty", **kw) -> FaultyDirDB:
+    return FaultyDirDB(mount_point, **kw)
+
+
+def flakey_fs(db: FaultyDirDB, **kw) -> Nemesis:
+    return FlakeyFS(db, **kw)

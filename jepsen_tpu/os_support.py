@@ -101,3 +101,20 @@ def centos() -> OS:
 
 def ubuntu() -> OS:
     return UbuntuOS()
+
+
+class SmartOS(OS):
+    """pkgin-based setup (os/smartos.clj, 132 LoC in the reference —
+    shipped for the mongodb-smartos harness): package install + hostfile."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.packages = ["curl", "wget", "gtar", *extra_packages]
+
+    def setup(self, test, node, session):
+        with session.su():
+            DebianOS.setup_hostfile(self, test, node, session)
+            session.exec_result("pkgin", "-y", "install", *self.packages)
+
+
+def smartos() -> OS:
+    return SmartOS()

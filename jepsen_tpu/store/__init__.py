@@ -149,6 +149,13 @@ def save_2(test: Mapping) -> Mapping:
     d.mkdir(parents=True, exist_ok=True)
     _write_json(d / "results.json", test.get("results") or {})
     w = fmt.Writer(_run_file(d))
+    # A dir stored before the block format (or whose file was lost) gets a
+    # complete file, not a results-only one that would shadow the JSON
+    # artifacts in load_dir.
+    if not any(b["type"] == fmt.T_TEST for b in w.index["blocks"]):
+        w.write_test(test)
+    if not any(b["type"] == fmt.T_HISTORY for b in w.index["blocks"]) and test.get("history"):
+        w.write_history(test["history"])
     w.write_results(test.get("results") or {})
     w.close()
     update_symlinks(test)

@@ -276,6 +276,8 @@ def scan(path: str | Path) -> dict:
         head = f.read(HEADER_LEN)
         if head[: len(MAGIC)] != MAGIC:
             raise CorruptFile("bad magic")
+        if len(head) < HEADER_LEN:
+            raise CorruptFile("truncated header")
         off = HEADER_LEN
         end = f.seek(0, 2)
         while off < end:
@@ -313,11 +315,16 @@ def read_index(path: str | Path) -> dict:
         head = f.read(HEADER_LEN)
         if head[: len(MAGIC)] != MAGIC:
             raise CorruptFile("bad magic")
+        if len(head) < HEADER_LEN:
+            raise CorruptFile("truncated header")
         (version, footer_off) = struct.unpack("<HQ", head[len(MAGIC) :])
         if footer_off:
-            btype, payload = _read_block(f, footer_off)
-            if btype == T_INDEX:
-                return json.loads(payload.decode())
+            try:
+                btype, payload = _read_block(f, footer_off)
+                if btype == T_INDEX:
+                    return json.loads(payload.decode())
+            except (CorruptFile, ValueError):
+                pass  # torn footer: recover by scanning below
     return scan(path)
 
 

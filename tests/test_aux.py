@@ -107,3 +107,74 @@ def test_k8s_remote_command_shape():
     assert argv[:3] == ["kubectl", "-n", "jepsen"]
     # escape sanity for the command path it would wrap
     assert escape(["echo", "hi there"]) == "echo 'hi there'"
+
+
+def test_faketime_script_and_wrap():
+    from jepsen_tpu import control, faketime, net, testkit
+    from jepsen_tpu.control.core import DummyRemote
+
+    body = faketime.script("/opt/db/bin/server", "/usr/lib/faketime/libfaketime.so.1",
+                           rate=2.0, offset_s=-1.5)
+    assert "LD_PRELOAD=/usr/lib/faketime/libfaketime.so.1" in body
+    assert 'FAKETIME="-1.500s x2.000000"' in body
+    assert "exec /opt/db/bin/server.real" in body
+    for _ in range(50):
+        f = faketime.rand_factor(5.0)
+        assert 1 / 5.0 <= f <= 5.0
+
+    def handler(action):
+        cmd = action.get("cmd", "")
+        # the first LIB_CANDIDATE exists; the binary isn't wrapped yet
+        if "test -e" in cmd and "libfaketime" in cmd:
+            return {"exit": 0}
+        if "test -e" in cmd and ".real" in cmd:
+            return {"exit": 1}
+        return {}
+
+    t = testkit.noop_test(net=net.NoopNet(), remote=DummyRemote(handler))
+    with control.with_sessions(t):
+        s = t["sessions"]["n1"]
+        faketime.wrap_binary(s, "/opt/db/bin/server", rate=0.5)
+        cmds = [a.get("cmd", "") for a in t["remote"].history]
+        assert any("mv /opt/db/bin/server /opt/db/bin/server.real" in c for c in cmds)
+        assert any("chmod +x /opt/db/bin/server" in c for c in cmds)
+        faketime.unwrap_binary(s, "/opt/db/bin/server")
+
+
+def test_filesystem_faults_dummy():
+    from jepsen_tpu import control, net, testkit
+    from jepsen_tpu.control.core import DummyRemote
+    from jepsen_tpu.nemesis import filesystem as fsn
+
+    def handler(action):
+        cmd = action.get("cmd", "")
+        if cmd.startswith("losetup --find"):
+            return {"out": "/dev/loop7\n"}
+        if cmd.startswith("losetup -j"):
+            return {"out": "/dev/loop7: 0 /var/lib/jepsen-faulty.img\n"}
+        return {}
+
+    t = testkit.noop_test(net=net.NoopNet(), remote=DummyRemote(handler))
+    db = fsn.faulty_dir("/faulty", size_mb=64)
+    nem = fsn.flakey_fs(db, up_s=2, down_s=5)
+    with control.with_sessions(t):
+        s = t["sessions"]["n1"]
+        db.setup(t, "n1", s)
+        cmds = [a.get("cmd", "") for a in t["remote"].history]
+        assert any("dmsetup create jepsen-faulty" in c and "linear /dev/loop7" in c for c in cmds)
+        assert any("mkfs.ext4" in c for c in cmds)
+        assert any("mount /dev/mapper/jepsen-faulty /faulty" in c for c in cmds)
+        comp = nem.invoke(t, {"type": "info", "f": "start-flakey", "value": ["n1"], "process": "nemesis"})
+        assert comp["value"] == {"n1": "flakey"}
+        cmds = [a.get("cmd", "") for a in t["remote"].history]
+        assert any("flakey /dev/loop7 0 2 5" in c for c in cmds)
+        comp = nem.invoke(t, {"type": "info", "f": "fail-fs", "value": ["n1"], "process": "nemesis"})
+        assert any("error" in c for c in [a.get("cmd", "") for a in t["remote"].history])
+        nem.invoke(t, {"type": "info", "f": "heal-fs", "value": ["n1"], "process": "nemesis"})
+        db.teardown(t, "n1", s)
+
+
+def test_smartos_variant():
+    from jepsen_tpu import os_support
+
+    assert hasattr(os_support.smartos(), "setup")

@@ -58,3 +58,37 @@ def test_batch_handles_trivial_and_untensorizable():
         cpu_fallback=True,
     )
     assert fifo[0]["valid?"] is True  # fell back to CPU oracle
+
+
+def test_linearizable_check_batch_via_independent():
+    """independent.checker routes per-key register subhistories through
+    the linearizable checker's batch path (one vmapped ladder)."""
+    import pathlib, sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    from genhist import corrupt, valid_register_history
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu import independent
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    hist = []
+    t = 0
+    for k in range(4):
+        sub = valid_register_history(16, 2, seed=k, info_rate=0.1)
+        if k == 2:
+            sub = corrupt(sub, seed=k)
+        for o in sub:
+            o = dict(o)
+            o["value"] = independent.tuple_(k, o["value"])
+            o["time"] = (t := t + 1)
+            hist.append(o)
+    hist = h.index(hist)
+
+    chk = independent.checker(linearizable({"model": m.CASRegister(None), "algorithm": "competition"}))
+    res = chk.check({"name": "t"}, hist, {})
+    assert res["results"][0]["valid?"] is True
+    assert res["results"][2]["valid?"] is False
+    assert res["valid?"] is False
+    assert res["failures"] == [2]
