@@ -215,12 +215,25 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
     return out
 
 
+#: Above this many nodes a single graph classifies via host SCC (O(V+E))
+#: instead of the dense MXU closure (O(n³ log n)) — batches of small
+#: per-key graphs stay on the device, one big sparse graph doesn't
+#: (measured: 10k-node dense closure ~34 s vs Tarjan ~0.5 s).
+SCC_THRESHOLD = 1024
+
+
 def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
     """Classify cycles + merge inference anomalies into an elle-style
-    result."""
+    result.  Backend picked by shape, the way the reference's competition
+    checker picks algorithms (checker.clj:199-203)."""
     if not g.n:
         return _merge_flags(g, dict(cl._EMPTY_FLAGS), dict(cl._EMPTY_HINTS), requested)
-    flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
+    if g.n > SCC_THRESHOLD:
+        from jepsen_tpu.checker.scc import classify_graph_scc
+
+        flags, hints = classify_graph_scc(g.ww, g.wr, g.rw, g.extra)
+    else:
+        flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
     return _merge_flags(g, flags, hints, requested)
 
 

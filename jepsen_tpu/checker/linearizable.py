@@ -83,7 +83,7 @@ class Linearizable(Checker):
         batch_kw = {
             k: v
             for k, v in self.kernel_opts.items()
-            if k in ("capacity", "rounds", "mesh", "exact_escalation")
+            if k in ("capacity", "rounds", "mesh", "exact_escalation", "engine")
         }
         results = batch_analysis(
             self.model,

@@ -62,6 +62,8 @@ def add_test_opts(p: argparse.ArgumentParser):
                    help="use the dummy remote: run no remote commands")
     p.add_argument("--local", action="store_true",
                    help="use the local-subprocess remote (single-machine tests)")
+    p.add_argument("--docker", action="store_true",
+                   help="use the docker-exec remote (node names = container names)")
     p.add_argument("--leave-db-running", action="store_true",
                    help="skip DB teardown at the end")
     p.add_argument("--store-dir", default=None, help="where test runs are stored")
@@ -80,6 +82,8 @@ def options_to_test_opts(opts: argparse.Namespace) -> dict:
         ssh["dummy?"] = True
     if getattr(opts, "local", False):
         ssh["local?"] = True
+    if getattr(opts, "docker", False):
+        ssh["docker?"] = True
     if opts.private_key_path:
         ssh["private-key-path"] = opts.private_key_path
     if opts.ssh_port:

@@ -1,0 +1,66 @@
+"""Native runtime components (C, built on demand).
+
+``blockio`` — CRC32-tracked positioned block appends for the run.jepsen
+store format: the role of the reference's Java FileOffsetOutputStream
+(jepsen/src/jepsen/store/FileOffsetOutputStream.java).  Built lazily with
+the system compiler into this package directory; every consumer falls
+back to the pure-Python implementation when the extension is missing, so
+nothing depends on the toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import subprocess
+import sysconfig
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_HERE = Path(__file__).resolve().parent
+
+
+def build_blockio(force: bool = False):
+    """Compile _blockio.c into this directory (gcc, one translation
+    unit).  Returns the imported module or None."""
+    so = _HERE / "_blockio.so"
+    src = _HERE / "blockio.c"
+    if force or not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+        include = sysconfig.get_paths()["include"]
+        cmd = [
+            "gcc", "-O2", "-shared", "-fPIC",
+            f"-I{include}",
+            str(src), "-o", str(so),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=120)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+            logger.debug("blockio build failed (%s); using the Python path", e)
+            return None
+    return _import_blockio()
+
+
+def _import_blockio():
+    try:
+        import sys
+
+        if str(_HERE) not in sys.path:
+            sys.path.insert(0, str(_HERE))
+        return importlib.import_module("_blockio")
+    except ImportError:
+        return None
+
+
+_blockio = None
+_tried = False
+
+
+def blockio():
+    """The extension module, building it on first use; None when no
+    toolchain is available (callers use the Python fallback)."""
+    global _blockio, _tried
+    if not _tried:
+        _tried = True
+        _blockio = build_blockio()
+    return _blockio

@@ -49,7 +49,11 @@ from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.models import tensor as tmodels
-from jepsen_tpu.ops.hashing import exact_prune, frontier_update, frontier_update_fast
+from jepsen_tpu.ops.hashing import (
+    exact_prune,
+    frontier_update,
+    frontier_update_fast,
+)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -490,3 +494,200 @@ def _analyze_at(model, history, packed, capacity: int, rounds: int) -> dict:
             "kernel": stats,
         }
     return {"valid?": False, "op": op, "kernel": stats}
+
+
+# ---------------------------------------------------------------------------
+# Async-tick kernel: configs carry their own barrier index
+# ---------------------------------------------------------------------------
+
+
+def async_ticks(B: int) -> int:
+    """Default tick budget for the lane-async kernel: enough for ~2
+    closure rounds + 1 confirm round per barrier, plus slack.  Exceeding
+    it flags lossy and escalates, so the cost of a low guess is a wasted
+    stage, never a wrong verdict."""
+    return 3 * B + 64
+
+
+def _run_core_async(
+    step,
+    F: int,
+    T: int,
+    B: int,
+    P: int,
+    G: int,
+    W: int,
+    init_state,
+    n_active,
+    bar_f,
+    bar_v1,
+    bar_v2,
+    bar_slot,
+    mov_f,
+    mov_v1,
+    mov_v2,
+    mov_open,
+    grp_f,
+    grp_v1,
+    grp_v2,
+    grp_open,
+    slot_lane,
+    slot_onehot,
+):
+    """Lane-asynchronous barrier stepping.
+
+    The barrier-scan kernel (_run_core) closes each barrier to fixpoint
+    inside a while_loop — under vmap every lane pays the MAX closure
+    depth of any lane at every barrier (Σ_b max_lanes r_b).  Here the
+    whole search is ONE scan of ``T`` uniform ticks: each tick runs one
+    closure round at the lane's own current barrier; when the round
+    reaches the closure fixpoint (content fingerprint unchanged), the
+    barrier's return filter applies and the lane's barrier pointer
+    advances.  Lanes drift apart freely, so the cost is
+    max_lanes(Σ_b r_b) — each lane's own total closure depth.
+
+    Semantics (and the soundness contract) are exactly _run_core's:
+    same move algebra, same per-barrier filter, True only via a
+    surviving frontier, False only when no loss occurred, tick-budget
+    exhaustion or overflow → lossy → "unknown".
+    """
+    eye_g = jnp.eye(G, dtype=I32)
+    slot_mask = slot_onehot.sum(axis=1)
+    FP_SENTINEL = jnp.full(3, jnp.uint32(0xFFFFFFFF))
+
+    def tick(carry):
+        t, bptr, state, fok, fcr, alive, fp_prev, failed_at, lossy, peak = carry
+        bc = jnp.clip(bptr, 0, B - 1)
+        done = (bptr >= n_active) | (failed_at >= 0)
+        # One closure round at barrier bptr.
+        cat_state, cat_fok, cat_fcr, cat_alive, cost = expand_candidates(
+            step, eye_g, slot_lane, slot_mask, slot_onehot,
+            state, fok, fcr, alive,
+            mov_f[bc], mov_v1[bc], mov_v2[bc], mov_open[bc],
+            grp_f, grp_v1, grp_v2, grp_open[bc],
+        )
+        s2, fo2, fc2, a2, ovf, fp2 = frontier_update_fast(
+            cat_state, cat_fok, cat_fcr, cat_alive, cost, F
+        )
+        stable = (fp2 == fp_prev).all()
+        # At the fixpoint: only configs that fired the returning op
+        # survive; its slot bit retires; the barrier pointer advances.
+        lane = bar_slot[bc] // 32
+        bitmask = U32(1) << (bar_slot[bc] % 32).astype(U32)
+        lane_vals = jnp.take_along_axis(fo2, jnp.full((F, 1), lane), axis=1)[:, 0]
+        a3 = a2 & ((lane_vals & bitmask) != 0)
+        clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
+        fo3 = fo2 & ~clear[None, :]
+        a3 = exact_prune(s2, fo3, fc2, a3)
+        adv = stable & ~done
+        state2 = jnp.where(done, state, s2)
+        fok2 = jnp.where(done[None], fok, jnp.where(adv, fo3, fo2))
+        fcr2 = jnp.where(done, fcr, fc2)
+        alive2 = jnp.where(done, alive, jnp.where(adv, a3, a2))
+        failed2 = jnp.where(adv & ~a3.any() & ~lossy, bc, failed_at)
+        # a lossy lane can't refute: record no failure, report unknown
+        failed2 = jnp.where(adv & ~a3.any() & lossy, jnp.int32(B + 1), failed2)
+        bptr2 = jnp.where(adv, bptr + 1, bptr)
+        fp_next = jnp.where(adv, FP_SENTINEL, fp2)
+        fp_next = jnp.where(done, fp_prev, fp_next)
+        lossy2 = lossy | (ovf & ~done)
+        peak2 = jnp.maximum(peak, alive2.sum())
+        return (t + 1, bptr2, state2, fok2, fcr2, alive2, fp_next, failed2, lossy2, peak2)
+
+    state0 = jnp.full((F,), init_state, I32)
+    fok0 = jnp.zeros((F, W), U32)
+    fcr0 = jnp.zeros((F, G), I32)
+    alive0 = jnp.zeros((F,), bool).at[0].set(True)
+    def cont(carry):
+        t, bptr, _s, _fo, _fc, _a, _fp, failed_at, _l, _p = carry
+        running = (bptr < n_active) & (failed_at < 0)
+        return (t < T) & running
+
+    carry0 = (jnp.int32(0), jnp.int32(0), state0, fok0, fcr0, alive0,
+              FP_SENTINEL, jnp.int32(-1), jnp.bool_(False), jnp.int32(1))
+    (_t, bptr, state, fok, fcr, alive, fp, failed_at, lossy, peak) = jax.lax.while_loop(
+        cont, tick, carry0
+    )
+    finished = bptr >= n_active
+    valid = finished & alive.any()
+    # Budget exhaustion (neither finished nor failed) is loss.
+    lossy_out = lossy | (~finished & (failed_at < 0)) | (failed_at > B)
+    failed_out = jnp.where(failed_at > B, jnp.int32(-1), failed_at)
+    return valid, failed_out, lossy_out, peak
+
+
+_run_async = functools.partial(
+    jax.jit, static_argnames=("step", "F", "T", "B", "P", "G", "W")
+)(_run_core_async)
+
+#: (step, F, T, B, P, G, W) -> jitted vmapped async runner.
+_ASYNC_RUNNERS: dict = {}
+
+
+def async_runner(step, F: int, T: int, B: int, P: int, G: int, W: int):
+    """jit(vmap(_run_core_async)) — the batched async-tick checker."""
+    key = (step, F, T, B, P, G, W)
+    if key not in _ASYNC_RUNNERS:
+        core = functools.partial(_run_core_async, step, F, T, B, P, G, W)
+        axes = (0,) * 14 + (None, None)
+        _ASYNC_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
+    return _ASYNC_RUNNERS[key]
+
+
+def analysis_async(
+    model: m.Model,
+    history: Sequence[dict],
+    capacity: int = 128,
+    ticks: int | None = None,
+    max_groups: int = 64,
+    max_procs: int = 128,
+) -> dict:
+    """Single-history front-end for the async-tick kernel (testing and
+    shape exploration; the batched path drives async_runner directly)."""
+    try:
+        packed = pack(model, history)
+    except NotTensorizable as e:
+        return {"valid?": "unknown", "cause": f"not tensorizable: {e}"}
+    if packed["B"] == 0:
+        return {"valid?": True}
+    if packed["G"] > max_groups:
+        return {"valid?": "unknown", "cause": f"{packed['G']} crashed-op groups exceeds {max_groups}"}
+    if packed["P"] > max_procs:
+        return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
+    n_active = int(packed["bar_active"].sum())
+    packed = pad_packed(packed)
+    B = packed["B"]
+    T = int(ticks) if ticks is not None else async_ticks(B)
+    valid, failed_at, lossy, peak = _run_async(
+        packed["step"],
+        int(capacity),
+        T,
+        B,
+        packed["P"],
+        packed["G"],
+        packed["W"],
+        packed["init_state"],
+        np.int32(n_active),
+        *packed["bar"],
+        *packed["mov"],
+        *packed["grp"],
+        packed["grp_open"],
+        jnp.asarray(packed["slot_lane"]),
+        jnp.asarray(packed["slot_onehot"]),
+    )
+    valid = bool(valid)
+    failed_at = int(failed_at)
+    lossy = bool(lossy)
+    stats = {"frontier-peak": int(peak), "capacity": int(capacity), "ticks": T, "lossy?": lossy}
+    if valid:
+        return {"valid?": True, "kernel": stats}
+    if not lossy:
+        op = None
+        if 0 <= failed_at < len(packed["bar_opid"]):
+            op = history[int(packed["bar_opid"][failed_at])]
+        return {"valid?": False, "op": op, "kernel": stats}
+    return {
+        "valid?": "unknown",
+        "cause": "frontier capacity or tick budget exhausted",
+        "kernel": stats,
+    }

@@ -197,11 +197,22 @@ class Writer:
             self.index = scan(self.path)
 
     def _append(self, btype: int, payload: bytes) -> dict:
+        try:
+            from jepsen_tpu.native import blockio
+
+            ext = blockio()
+        except ImportError:
+            ext = None
         with open(self.path, "r+b") as f:
-            f.seek(0, 2)
-            off = f.tell()
-            f.write(struct.pack("<IIB", len(payload), zlib.crc32(payload), btype))
-            f.write(payload)
+            if ext is not None:
+                # C fast path: CRC + framed append in one buffer pass
+                # (the FileOffsetOutputStream role).
+                off, _n = ext.append_block(f.fileno(), btype, payload)
+            else:
+                f.seek(0, 2)
+                off = f.tell()
+                f.write(struct.pack("<IIB", len(payload), zlib.crc32(payload), btype))
+                f.write(payload)
         entry = {"type": btype, "offset": off, "len": len(payload)}
         self.index["blocks"].append(entry)
         return entry

@@ -101,3 +101,47 @@ def test_independent_checker_uses_batch(monkeypatch):
     assert calls["batch"] == 1
     assert res["valid?"] is True
     assert set(res["results"]) == {1, 2}
+
+
+def test_scc_classifier_matches_closure():
+    """Differential: host SCC classification vs the device closure on
+    random mixed graphs."""
+    from jepsen_tpu.checker.scc import classify_graph_scc
+
+    rng = np.random.default_rng(11)
+    for trial in range(25):
+        n = int(rng.integers(2, 40))
+        def sprinkle(p):
+            mat = rng.random((n, n)) < p
+            np.fill_diagonal(mat, False)
+            return mat
+        ww, wr, rw, extra = sprinkle(0.05), sprinkle(0.04), sprinkle(0.04), sprinkle(0.02)
+        sf, sh = classify_graph_scc(ww, wr, rw, extra)
+        cf, ch = cl.classify_graph(ww, wr, rw, extra)
+        assert sf == cf, (trial, sf, cf)
+        for k in sf:
+            assert (sh[k] is None) == (ch[k] is None), (trial, k)
+
+
+def test_scc_threshold_routing():
+    import jepsen_tpu.checker.elle as elle_mod
+
+    n = elle_mod.SCC_THRESHOLD + 10
+    ww = np.zeros((n, n), bool)
+    for i in range(n - 1):
+        ww[i, i + 1] = True
+    ww[n - 1, 0] = True  # big ring: G0
+    import jepsen_tpu.checker.txn_graph as tgm
+
+    g = tgm.TxnGraph(
+        nodes=[tgm.TxnNode(id=i, op={"index": i}, invoke_index=i, complete_index=i, ok=True) for i in range(n)],
+        ww=ww,
+        wr=np.zeros((n, n), bool),
+        rw=np.zeros((n, n), bool),
+        extra=np.zeros((n, n), bool),
+        explanations={},
+        anomalies={},
+    )
+    res = elle_mod.check_graph(g, ["G2", "G1c"])
+    assert res["valid?"] is False
+    assert "G0" in res["anomaly-types"]

@@ -150,3 +150,34 @@ def test_corrupt_magic(tmp_path):
     p.write_bytes(b"NOTJEPSEN")
     with pytest.raises(fmt.CorruptFile):
         fmt.read_index(p)
+
+
+def test_native_blockio_matches_python(tmp_path):
+    """The C block writer produces byte-identical files to the Python
+    path (CRC and framing interchangeable)."""
+    from jepsen_tpu import native
+
+    ext = native.blockio()
+    if ext is None:
+        pytest.skip("no C toolchain")
+    payload = b"\x00\x01jepsen-block-payload" * 65
+    assert ext.crc32(payload) == __import__("zlib").crc32(payload)
+
+    p1 = tmp_path / "c.bin"
+    with open(p1, "wb") as f:
+        f.write(b"")
+    with open(p1, "r+b") as f:
+        off, n = ext.append_block(f.fileno(), fmt.T_HISTORY, payload)
+    assert (off, n) == (0, len(payload))
+    with open(p1, "rb") as f:
+        btype, got = fmt._read_block(f, 0)
+    assert btype == fmt.T_HISTORY and got == payload
+
+    # whole-file equivalence through the Writer
+    hist = mk_history(10)
+    w = fmt.Writer(tmp_path / "native.jepsen")
+    w.write_test({"name": "n", "start-time-str": "t"})
+    w.write_history(hist)
+    w.write_results({"valid?": True})
+    w.close()
+    assert fmt.read(tmp_path / "native.jepsen")["history"] == hist

@@ -103,3 +103,29 @@ def test_competition_algorithm_falls_back():
         h.op(h.INVOKE, 1, "dequeue", None), h.op(h.OK, 1, "dequeue", 1),
     ])
     assert chk.check({}, hist, {})["valid?"] is True
+
+
+def test_async_kernel_differential_small():
+    """Lane-async kernel vs the brute oracle on random small histories."""
+    rng = random.Random(777)
+    for trial in range(60):
+        hist = random_history(rng)
+        truth = wgl_cpu.brute_analysis(m.CASRegister(None), hist)["valid?"]
+        got = wgl.analysis_async(m.CASRegister(None), hist, capacity=256)["valid?"]
+        assert got in (truth, "unknown"), (trial, got, truth)
+
+
+def test_async_kernel_medium():
+    agree = 0
+    for seed in range(3):
+        hist = valid_register_history(150, 6, seed=seed, info_rate=0.1)
+        truth = wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"]
+        got = wgl.analysis_async(m.CASRegister(None), hist, capacity=512)["valid?"]
+        assert got in (truth, "unknown"), (seed, got, truth)
+        agree += got == truth
+        bad = corrupt(hist, seed=seed)
+        truth = wgl_cpu.sweep_analysis(m.CASRegister(None), bad)["valid?"]
+        got = wgl.analysis_async(m.CASRegister(None), bad, capacity=512)["valid?"]
+        assert got in (truth, "unknown"), (seed, got, truth)
+        agree += got == truth
+    assert agree >= 2, f"async kernel resolved only {agree}/6"

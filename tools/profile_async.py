@@ -1,0 +1,83 @@
+"""Head-to-head: barrier-scan batched kernel vs lane-async batched kernel
+at the headline bench shape."""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+L = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+OPS = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+PROCS = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+INFO = 0.3
+CAP = 128
+
+model = m.CASRegister(None)
+hists = []
+for i in range(L):
+    hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=8)
+    if i % 4 == 3:
+        hh = corrupt(valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=8), seed=i)
+    hists.append(hh)
+total_ops = sum(len(x) for x in hists) // 2
+
+packs = [wgl.pack(model, hh) for hh in hists]
+n_actives = np.array([p["bar_active"].sum() for p in packs], np.int32)
+B = 1 << max(6, (max(p["B"] for p in packs) - 1).bit_length())
+P = wgl._bucket(max(p["P"] for p in packs), [8, 16, 32, 64, 128])
+G = wgl._bucket(max(p["G"] for p in packs), [4, 8, 16, 32, 64])
+stacked = pbatch._stack(packs, B, P, G)
+W = (P + 31) // 32
+print(f"devices={jax.devices()} L={L} B={B} P={P} G={G}", file=sys.stderr)
+
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+# sync barrier-scan
+sync_args = [jnp.asarray(stacked[k]) for k in pbatch._ARG_ORDER]
+runner = wgl.batched_runner(packs[0]["step"], CAP, 8, P, G, W)
+dt, out = timeit("sync", runner, *sync_args)
+lossy = np.asarray(out[2])
+print(f"sync  cap={CAP} R=8:   {dt*1e3:8.1f} ms  ({total_ops/dt:10,.0f} ops/s) lossy={lossy.sum()}/{L}")
+
+# async
+T = wgl.async_ticks(B)
+async_args = [
+    jnp.asarray(stacked["init_state"]),
+    jnp.asarray(n_actives),
+    *(jnp.asarray(stacked[k]) for k in pbatch.ASYNC_ARG_ORDER[1:]),
+]
+arunner = wgl.async_runner(packs[0]["step"], CAP, T, B, P, G, W)
+dt2, out2 = timeit("async", arunner, *async_args)
+lossy2 = np.asarray(out2[2])
+print(f"async cap={CAP} T={T}: {dt2*1e3:8.1f} ms  ({total_ops/dt2:10,.0f} ops/s) lossy={lossy2.sum()}/{L}")
+
+# verdict agreement between the engines (non-lossy lanes)
+v1, f1 = np.asarray(out[0]), np.asarray(out[1])
+v2, f2 = np.asarray(out2[0]), np.asarray(out2[1])
+both = ~lossy & ~lossy2
+ver1 = np.where(f1 >= 0, False, v1)
+ver2 = np.where(f2 >= 0, False, v2)
+agree = (ver1 == ver2)[both].all()
+print(f"verdict agreement on {both.sum()} mutually-exact lanes: {agree}")
