@@ -68,14 +68,41 @@ class Linearizable(Checker):
         return out
 
     def check(self, test, history, opts):
-        return self._truncate(self._analyze(history))
+        out = self._truncate(self._analyze(history))
+        if out.get("valid?") is False:
+            self._render_failure(test, history, out, opts)
+        return out
+
+    @staticmethod
+    def _render_failure(test, history, result, opts):
+        """Write linear.svg next to the results — the reference renders
+        the failed linearization via knossos.linear.report
+        (checker.clj:207-210)."""
+        from jepsen_tpu import store
+        from jepsen_tpu.checker.linear_svg import render_failure
+
+        if not (test.get("name") and test.get("start-time-str")):
+            return  # no store configured (bare checker unit tests)
+        svg = render_failure(history, result.get("op"), result.get("cause", ""))
+        try:
+            d = store.test_dir(test)
+            sub = (opts or {}).get("subdirectory")
+            d = d / sub if sub else d
+            d.mkdir(parents=True, exist_ok=True)
+            (d / "linear.svg").write_text(svg)
+            result["svg"] = str(d / "linear.svg")
+        except OSError:
+            pass  # store dir not writable
 
     def check_batch(self, test, histories, opts):
         """Check many subhistories in ONE vmapped kernel ladder (used by
         independent.checker: per-key shards become the batch axis —
         BASELINE config 4's shape).  CPU algorithms just loop."""
         if self.algorithm in ("wgl", "sweep"):
-            return [self.check(test, hh, opts) for hh in histories]
+            # headless: no per-key linear.svg (they would all land on the
+            # same path and overwrite each other; independent.checker
+            # writes per-key artifacts itself)
+            return [self._truncate(self._analyze(hh)) for hh in histories]
         from jepsen_tpu.parallel import batch_analysis
 
         # kernel-opts is shaped for wgl.analysis; forward only the keys

@@ -74,7 +74,7 @@ class AtomClient(jclient.Client):
         self.stats = {"opens": 0, "closes": 0}
 
     def open(self, test, node):
-        c = AtomClient(self.cell)
+        c = type(self)(self.cell)  # subclass-friendly: wrappers survive open
         c.stats = self.stats
         c.opened = True
         self.stats["opens"] += 1

@@ -14,6 +14,7 @@ from jepsen_tpu.workloads import (  # noqa: F401
     causal,
     linearizable_register,
     long_fork,
+    monotonic,
     sets,
     wr,
 )

@@ -1,0 +1,102 @@
+"""Monotonic workload: an increment-only counter whose observed values
+must never run backwards.
+
+The pattern three of the reference's biggest harnesses carry
+(cockroachdb/src/jepsen/cockroach/monotonic.clj, tidb, faunadb): clients
+increment a counter and read it; a database that reorders or loses
+increments shows a read going backwards in real time or a value the
+increments can't explain.
+
+Ops:
+  {"f": "inc",  "value": None -> the post-increment count}
+  {"f": "read", "value": None -> the current count}
+
+Checker verdict:
+  nonmonotonic — a read completed before another read began, yet the
+                 later read observed a SMALLER value (real-time
+                 regression)
+  impossible   — a read observed more than the number of increments
+                 INVOKED by its completion (an invoked op may take
+                 effect before its ack arrives, so invocations — not
+                 completions — bound what a read may see)
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+
+class MonotonicChecker(Checker):
+    def check(self, test, history: Sequence[Mapping], opts) -> dict:
+        reads = []  # (invoke_time, complete_time, value, op)
+        pair = h.pair_index(history)
+        attempted_incs = 0  # incs INVOKED so far: the committable bound
+        errors: list = []
+        for i, o in enumerate(history):
+            if o.get("process") == h.NEMESIS:
+                continue
+            if o["type"] == h.INVOKE:
+                if o["f"] == "inc":
+                    attempted_incs += 1
+                continue
+            j = int(pair[i])
+            inv = history[j] if j >= 0 else None
+            if o["f"] == "read" and o["type"] == h.OK and inv is not None:
+                v = o.get("value")
+                if not isinstance(v, int):
+                    errors.append({"type": "non-integer-read", "op": o})
+                    continue
+                if v > attempted_incs:
+                    errors.append(
+                        {
+                            "type": "impossible",
+                            "op": o,
+                            "observed": v,
+                            "max-possible": attempted_incs,
+                        }
+                    )
+                reads.append((inv["time"], o["time"], v, o))
+        # Real-time monotonicity: if read A completed before read B began,
+        # B must not observe LESS than A.  Sweep in invocation order,
+        # carrying the max value among reads already completed (O(n log n)).
+        by_completion = sorted(reads, key=lambda r: r[1])
+        by_invocation = sorted(reads, key=lambda r: r[0])
+        ci = 0
+        hi = None  # (value, op) with max value among completed reads
+        for inv_b, _comp_b, vb, ob in by_invocation:
+            while ci < len(by_completion) and by_completion[ci][1] < inv_b:
+                _ia, _ca, va, oa = by_completion[ci]
+                if hi is None or va > hi[0]:
+                    hi = (va, oa)
+                ci += 1
+            if hi is not None and vb < hi[0]:
+                errors.append(
+                    {
+                        "type": "nonmonotonic",
+                        "earlier": hi[1],
+                        "later": ob,
+                        "went": [hi[0], vb],
+                    }
+                )
+        out: dict = {"valid?": not errors, "reads": len(reads), "incs": attempted_incs}
+        if errors:
+            out["errors"] = errors[:8]
+            out["error-count"] = len(errors)
+        return out
+
+
+def checker() -> Checker:
+    return MonotonicChecker()
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    return {
+        "generator": gen.mix(
+            [gen.repeat({"f": "inc", "value": None}), gen.repeat({"f": "read", "value": None})]
+        ),
+        "checker": checker(),
+    }

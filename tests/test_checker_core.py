@@ -93,3 +93,26 @@ def test_unhandled_exceptions():
     assert r["exceptions"][0]["count"] == 2
     assert r["exceptions"][0]["class"] == "TimeoutException"
     assert c.unhandled_exceptions().check({}, [], {}) == {"valid?": True}
+
+
+def test_linear_svg_on_failure(tmp_path):
+    """A failed linearizable analysis renders linear.svg into the store
+    (the knossos.linear.report role, checker.clj:207-210)."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "write", 1, time=10),
+        h.op(h.OK, 0, "write", 1, time=20),
+        h.op(h.INVOKE, 1, "read", None, time=30),
+        h.op(h.OK, 1, "read", 99, time=40),  # never written: invalid
+    ])
+    t = {"name": "linsvg", "start-time-str": "t0", "store-dir": str(tmp_path)}
+    chk = linearizable({"model": m.CASRegister(None)})
+    res = chk.check(t, hist, {})
+    assert res["valid?"] is False
+    svg_path = tmp_path / "linsvg" / "t0" / "linear.svg"
+    assert svg_path.exists()
+    svg = svg_path.read_text()
+    assert svg.startswith("<svg") and "linearizability failure" in svg
+    assert "#D0021B" in svg  # the failing op is highlighted

@@ -6,6 +6,7 @@ import random
 import pytest
 
 from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
 from jepsen_tpu import independent, testkit
 from jepsen_tpu.generator import testing as gt
 from jepsen_tpu.workloads import adya, append, bank, causal, linearizable_register, long_fork, sets, wr
@@ -355,3 +356,80 @@ def test_long_fork_reads_do_not_consume_write_keys():
     )
     # Write keys are dense: 0..len-1, no gaps from read consumption.
     assert written == list(range(len(written)))
+
+
+# ---------------------------------------------------------------------------
+# Monotonic (cockroach/tidb/faunadb harness pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_monotonic_valid():
+    from jepsen_tpu.workloads import monotonic
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "inc", None, time=10), h.op(h.OK, 0, "inc", 1, time=20),
+        h.op(h.INVOKE, 1, "read", None, time=30), h.op(h.OK, 1, "read", 1, time=40),
+        h.op(h.INVOKE, 0, "inc", None, time=50), h.op(h.INFO, 0, "inc", None, time=60),
+        h.op(h.INVOKE, 1, "read", None, time=70), h.op(h.OK, 1, "read", 2, time=80),
+    ])
+    res = monotonic.checker().check({}, hist, {})
+    assert res["valid?"] is True
+    assert res["reads"] == 2 and res["incs"] == 2
+
+
+def test_monotonic_regression():
+    from jepsen_tpu.workloads import monotonic
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "inc", None, time=10), h.op(h.OK, 0, "inc", 1, time=20),
+        h.op(h.INVOKE, 0, "inc", None, time=25), h.op(h.OK, 0, "inc", 2, time=28),
+        h.op(h.INVOKE, 1, "read", None, time=30), h.op(h.OK, 1, "read", 2, time=40),
+        # completes after the read above BEGAN? no: begins at 50 > 40, sees 1: regression
+        h.op(h.INVOKE, 2, "read", None, time=50), h.op(h.OK, 2, "read", 1, time=60),
+    ])
+    res = monotonic.checker().check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["type"] == "nonmonotonic"
+    assert res["errors"][0]["went"] == [2, 1]
+
+
+def test_monotonic_impossible():
+    from jepsen_tpu.workloads import monotonic
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "inc", None, time=10), h.op(h.OK, 0, "inc", 1, time=20),
+        h.op(h.INVOKE, 1, "read", None, time=30), h.op(h.OK, 1, "read", 7, time=40),
+    ])
+    res = monotonic.checker().check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["type"] == "impossible"
+
+
+def test_monotonic_concurrent_reads_ok():
+    from jepsen_tpu.workloads import monotonic
+
+    # Overlapping reads may disagree in either direction.
+    hist = h.index([
+        h.op(h.INVOKE, 0, "inc", None, time=5), h.op(h.OK, 0, "inc", 1, time=6),
+        h.op(h.INVOKE, 1, "read", None, time=10), 
+        h.op(h.INVOKE, 2, "read", None, time=12),
+        h.op(h.OK, 1, "read", 1, time=30),
+        h.op(h.OK, 2, "read", 0, time=32),
+    ])
+    res = monotonic.checker().check({}, hist, {})
+    assert res["valid?"] is True
+
+
+def test_monotonic_concurrent_inc_read_valid():
+    """An inc still in flight may already have taken effect: a read
+    observing it is legal (regression for the invocation-bound rule)."""
+    from jepsen_tpu.workloads import monotonic
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "inc", None, time=10),
+        h.op(h.INVOKE, 1, "read", None, time=15),
+        h.op(h.OK, 1, "read", 1, time=20),
+        h.op(h.OK, 0, "inc", 1, time=30),
+    ])
+    res = monotonic.checker().check({}, hist, {})
+    assert res["valid?"] is True
