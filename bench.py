@@ -43,7 +43,7 @@ INFO_RATE = 0.3
 N_VALUES = 8
 CORRUPT_EVERY = 4
 CAPS = (128, 512)
-EXACT = (2048,)
+EXACT = (1024,)
 BUDGET_S = 3.0  # per-history CPU cap; hits understate vs_baseline
 CPU_SAMPLE = 48  # CPU baseline measured on this many histories, extrapolated
 
