@@ -1,0 +1,244 @@
+"""An etcd harness: linearizable register over the v3 JSON gateway.
+
+The reference's canonical demo (and its tutorial arc) tests etcd with a
+CAS register; this is that harness for a real etcd cluster reachable
+over ssh/docker/k8s remotes.  The cluster-touching paths follow the
+zookeeper.clj shape (reference: zookeeper/src/jepsen/zookeeper.clj:
+40-137): install from a release tarball (fs-cacheable), run under a
+pidfile daemon, kill/restart for the fault packages, download logs.
+
+Self-tests cover the pure parts — request building, response decoding,
+the command vocabulary against a scripted dummy remote — so the harness
+logic is exercised without a cluster (SURVEY.md §4.3's pattern); run it
+for real with e.g.:
+
+  docker compose -f docker/docker-compose.yml up -d
+  python -m examples.etcd test --docker --node n1 --node n2 --node n3 \\
+      --time-limit 30 --concurrency 3n
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+
+from jepsen_tpu import cli, client, db as jdb, generator as gen, models, testkit
+from jepsen_tpu.checker import compose, stats, timeline
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.checker.perf import perf
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined as nc
+
+VERSION = "3.5.12"
+URL = (
+    "https://github.com/etcd-io/etcd/releases/download/"
+    f"v{VERSION}/etcd-v{VERSION}-linux-amd64.tar.gz"
+)
+DIR = "/opt/etcd"
+DATA = "/var/lib/etcd-jepsen"
+CLIENT_PORT = 2379
+PEER_PORT = 2380
+REGISTER_KEY = "jepsen-register"
+
+
+# ---------------------------------------------------------------------------
+# Pure request/response helpers (unit-testable without a cluster)
+# ---------------------------------------------------------------------------
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def initial_cluster(nodes) -> str:
+    """The --initial-cluster flag value (name=peer-url pairs)."""
+    return ",".join(f"{n}=http://{n}:{PEER_PORT}" for n in nodes)
+
+
+def range_request(key: str) -> tuple[str, dict]:
+    return "/v3/kv/range", {"key": _b64(key)}
+
+
+def put_request(key: str, value: int) -> tuple[str, dict]:
+    return "/v3/kv/put", {"key": _b64(key), "value": _b64(str(value))}
+
+
+def cas_request(key: str, old: int, new: int) -> tuple[str, dict]:
+    """A txn: put(new) iff VALUE == old (etcd's compare-and-swap form)."""
+    return "/v3/kv/txn", {
+        "compare": [
+            {"key": _b64(key), "target": "VALUE", "result": "EQUAL", "value": _b64(str(old))}
+        ],
+        "success": [{"requestPut": {"key": _b64(key), "value": _b64(str(new))}}],
+    }
+
+
+def decode_range(resp: dict):
+    """The register's value from a range response (None when unset)."""
+    kvs = resp.get("kvs") or []
+    return int(_unb64(kvs[0]["value"])) if kvs else None
+
+
+def decode_txn(resp: dict) -> bool:
+    """Did the CAS txn's compare succeed?"""
+    return bool(resp.get("succeeded"))
+
+
+# ---------------------------------------------------------------------------
+# DB + client
+# ---------------------------------------------------------------------------
+
+
+class EtcdDB(jdb.DB):
+    """Install + run etcd (db.clj lifecycle), fault-package capable."""
+
+    pidfile = f"{DATA}/etcd.pid"
+    logfile = f"{DATA}/etcd.log"
+
+    def setup(self, test, node, session):
+        with session.su():
+            session.exec("mkdir", "-p", DATA)
+            if not cu.exists(session, f"{DIR}/etcd"):
+                cu.install_archive(session, test.get("etcd-url", URL), DIR)
+            self.start(test, node, session)
+        cu.await_tcp_port(session, CLIENT_PORT, timeout=60)
+
+    def teardown(self, test, node, session):
+        with session.su():
+            self.kill(test, node, session)
+            session.exec_result("rm", "-rf", DATA)
+
+    # start/kill run under su() themselves: the fault packages invoke
+    # them with plain sessions, and the daemon/dirs are root-owned.
+    def start(self, test, node, session):
+        nodes = list(test["nodes"])
+        with session.su():
+            return cu.start_daemon(
+                session,
+                f"{DIR}/etcd",
+                "--name", node,
+                "--data-dir", DATA,
+                "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+                "--advertise-client-urls", f"http://{node}:{CLIENT_PORT}",
+                "--listen-peer-urls", f"http://0.0.0.0:{PEER_PORT}",
+                "--initial-advertise-peer-urls", f"http://{node}:{PEER_PORT}",
+                "--initial-cluster", initial_cluster(nodes),
+                "--initial-cluster-state", "new",
+                pidfile=self.pidfile,
+                logfile=self.logfile,
+            )
+
+    def kill(self, test, node, session):
+        with session.su():
+            cu.stop_daemon(session, self.pidfile, signal="KILL", timeout=10)
+            cu.grepkill(session, f"{DIR}/etcd --name {node}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [self.logfile]
+
+
+class EtcdClient(client.Client):
+    """read / write / cas over the node's v3 JSON gateway."""
+
+    reusable = False
+
+    def __init__(self, base_url: str | None = None, timeout: float = 5.0):
+        self.base_url = base_url
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return EtcdClient(f"http://{node}:{CLIENT_PORT}", self.timeout)
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def invoke(self, test, op):
+        f, v = op["f"], op.get("value")
+        if f == "read":
+            resp = self._post(*range_request(REGISTER_KEY))
+            return {**op, "type": "ok", "value": decode_range(resp)}
+        if f == "write":
+            self._post(*put_request(REGISTER_KEY, v))
+            return {**op, "type": "ok"}
+        if f == "cas":
+            resp = self._post(*cas_request(REGISTER_KEY, v[0], v[1]))
+            return {**op, "type": "ok" if decode_txn(resp) else "fail"}
+        raise ValueError(f"unknown op {f!r}")
+
+    def close(self, test):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Test map + CLI
+# ---------------------------------------------------------------------------
+
+
+def rand_op():
+    import random
+
+    k = random.random()
+    if k < 0.4:
+        return {"f": "read"}
+    if k < 0.8:
+        return {"f": "write", "value": random.randint(0, 4)}
+    return {"f": "cas", "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def etcd_test(opts) -> dict:
+    db = EtcdDB()
+    pkg = nc.nemesis_package(
+        {
+            "faults": opts.get("faults", ["kill", "partition"]),
+            "db": db,
+            "interval": opts.get("interval", 10),
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    time_limit = opts.get("time-limit", 60)
+    t = testkit.noop_test(
+        name="etcd",
+        db=db,
+        client=EtcdClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(
+                    gen.time_limit(time_limit, gen.stagger(0.05, gen.repeat(rand_op)))
+                ),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            gen.nemesis(pkg.final_generator),
+        ),
+        checker=compose(
+            {
+                "stats": stats(),
+                "linear": linearizable({"model": models.CASRegister(None)}),
+                "timeline": timeline.timeline_checker(),
+                "perf": perf(),
+            }
+        ),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
+
+
+def main(argv=None):
+    cli.main(test_fn=etcd_test, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

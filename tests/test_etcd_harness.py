@@ -1,0 +1,97 @@
+"""etcd harness logic without a cluster: request/response codecs and the
+DB's command vocabulary against a scripted dummy remote (SURVEY.md §4.3:
+the pieces that can be tested cluster-free, are)."""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from examples import etcd
+from jepsen_tpu import control, net, testkit
+from jepsen_tpu.control.core import DummyRemote
+
+
+def test_request_builders():
+    path, body = etcd.range_request("k")
+    assert path == "/v3/kv/range"
+    assert base64.b64decode(body["key"]).decode() == "k"
+
+    path, body = etcd.put_request("k", 7)
+    assert path == "/v3/kv/put"
+    assert base64.b64decode(body["value"]).decode() == "7"
+
+    path, body = etcd.cas_request("k", 1, 2)
+    assert path == "/v3/kv/txn"
+    cmp = body["compare"][0]
+    assert cmp["target"] == "VALUE" and base64.b64decode(cmp["value"]).decode() == "1"
+    put = body["success"][0]["requestPut"]
+    assert base64.b64decode(put["value"]).decode() == "2"
+
+
+def test_response_decoders():
+    assert etcd.decode_range({}) is None
+    assert etcd.decode_range({"kvs": []}) is None
+    resp = {"kvs": [{"value": base64.b64encode(b"42").decode()}]}
+    assert etcd.decode_range(resp) == 42
+    assert etcd.decode_txn({"succeeded": True}) is True
+    assert etcd.decode_txn({}) is False
+
+
+def test_initial_cluster():
+    assert (
+        etcd.initial_cluster(["n1", "n2"])
+        == "n1=http://n1:2380,n2=http://n2:2380"
+    )
+
+
+def test_db_command_vocabulary():
+    def handler(action):
+        cmd = action.get("cmd", "")
+        if cmd.startswith("test -e") or "test -f" in cmd:
+            return {"exit": 1}  # nothing installed/cached, no daemon yet
+        return {}
+
+    t = testkit.noop_test(
+        nodes=["n1", "n2", "n3"],
+        net=net.NoopNet(),
+        remote=DummyRemote(handler),
+    )
+    db = etcd.EtcdDB()
+    with control.with_sessions(t):
+        s = t["sessions"]["n1"]
+        db.setup(t, "n1", s)
+        cmds = [a.get("cmd", "") for a in t["remote"].history]
+        assert any("mkdir -p /var/lib/etcd-jepsen" in c for c in cmds)
+        assert any("wget" in c and "etcd-v3.5.12-linux-amd64.tar.gz" in c for c in cmds)
+        start = next(c for c in cmds if "--initial-cluster " in c)
+        assert "--name n1" in start
+        assert "n1=http://n1:2380,n2=http://n2:2380,n3=http://n3:2380" in start
+        assert "--initial-cluster-state new" in start
+        db.kill(t, "n1", s)
+        cmds = [a.get("cmd", "") for a in t["remote"].history]
+        assert any("pkill" in c and "etcd --name n1" in c for c in cmds)
+        db.teardown(t, "n1", s)
+        assert any(
+            "rm -rf /var/lib/etcd-jepsen" in a.get("cmd", "")
+            for a in t["remote"].history
+        )
+
+
+def test_client_invoke_against_fake_gateway(monkeypatch):
+    calls = []
+
+    def fake_post(self, path, body):
+        calls.append((path, body))
+        if path == "/v3/kv/range":
+            return {"kvs": [{"value": base64.b64encode(b"3").decode()}]}
+        if path == "/v3/kv/txn":
+            return {"succeeded": False}
+        return {}
+
+    monkeypatch.setattr(etcd.EtcdClient, "_post", fake_post)
+    c = etcd.EtcdClient("http://n1:2379")
+    assert c.invoke({}, {"f": "read"})["value"] == 3
+    assert c.invoke({}, {"f": "write", "value": 5})["type"] == "ok"
+    assert c.invoke({}, {"f": "cas", "value": [1, 2]})["type"] == "fail"
+    assert [p for p, _ in calls] == ["/v3/kv/range", "/v3/kv/put", "/v3/kv/txn"]
