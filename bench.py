@@ -14,8 +14,9 @@ a wider batch stage then the exact single-history kernel
 config-set sweep (jepsen_tpu.checker.wgl_cpu.sweep_analysis — the same
 frontier algorithm, i.e. the knossos-linear-equivalent and the strongest
 CPU oracle here; the DFS oracle goes exponential and never finishes this
-workload), capped at BUDGET_S per history.  Cap hits make the reported
-vs_baseline an UNDERestimate.
+workload), capped at CPU_MAX_CONFIGS explored configurations per history
+(a deterministic work budget; BUDGET_S is only a wall-clock backstop).
+Cap hits make the reported vs_baseline an UNDERestimate.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -44,7 +45,8 @@ N_VALUES = 8
 CORRUPT_EVERY = 4
 CAPS = (128, 512)
 EXACT = (1024,)
-BUDGET_S = 3.0  # per-history CPU cap; hits understate vs_baseline
+BUDGET_S = 10.0  # wall-clock backstop only; the real cap is work-based
+CPU_MAX_CONFIGS = 100_000  # deterministic sweep budget (low run variance)
 CPU_SAMPLE = 48  # CPU baseline measured on this many histories, extrapolated
 
 
@@ -57,7 +59,8 @@ def cpu_check(model, hist):
     old = signal.signal(signal.SIGALRM, bail)
     signal.setitimer(signal.ITIMER_REAL, BUDGET_S)
     try:
-        return wgl_cpu.sweep_analysis(model, hist), False
+        r = wgl_cpu.sweep_analysis(model, hist, max_configs=CPU_MAX_CONFIGS)
+        return r, r.get("cause") is not None
     except TimeoutError:
         return {"valid?": "unknown", "cause": "budget"}, True
     finally:

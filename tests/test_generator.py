@@ -365,3 +365,108 @@ def test_clients_final_gen_waits_for_outstanding_ops():
         i for i, o in enumerate(h) if o["type"] != "invoke" and o["f"] == "main"
     ]
     assert all(i < first_final for i in main_completions)
+
+
+# ---------------------------------------------------------------------------
+# Parity-tightening golden tests (generator_test.clj corpus style)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_passthrough(caplog):
+    """trace logs but never perturbs the op stream (generator.clj:720)."""
+    import logging
+
+    plain = gt.perfect(TEST, gen.limit(4, gen.repeat(r("write", 1))))
+    with caplog.at_level(logging.DEBUG):
+        traced = gt.perfect(TEST, gen.trace("t", gen.limit(4, gen.repeat(r("write", 1)))))
+    strip = lambda h: [{k: o[k] for k in ("type", "f", "value", "process", "time")} for o in h]
+    assert strip(traced) == strip(plain)
+    assert caplog.records, "trace emitted no log records"
+
+
+def test_friendly_exceptions_annotates():
+    """friendly-exceptions wraps generator errors with context
+    (generator.clj:678)."""
+
+    class Bomb(gen.Gen):
+        def op(self, test, ctx):
+            raise RuntimeError("kaput")
+
+        def update(self, test, ctx, event):
+            return self
+
+    with pytest.raises(RuntimeError) as ei:
+        gt.perfect(TEST, gen.friendly_exceptions(Bomb()))
+    assert "kaput" in str(ei.value) or "generator" in str(ei.value).lower()
+
+
+def test_stagger_total_rate_independent_of_concurrency():
+    """stagger's interval is a TOTAL rate across all threads, not
+    per-thread (generator.clj:1293-1330): doubling concurrency must not
+    double throughput."""
+    dt = 0.1
+
+    def span(conc):
+        h = gt.perfect({"concurrency": conc}, gen.limit(40, gen.stagger(dt, gen.repeat(r()))))
+        inv = invokes(h)
+        return (inv[-1]["time"] - inv[0]["time"]) / (len(inv) - 1)
+
+    mean2 = span(2)
+    mean8 = span(8)
+    # both should hover near dt (in ns), within generous tolerance
+    assert 0.3 * dt * 1e9 < mean2 < 3 * dt * 1e9
+    assert 0.3 * dt * 1e9 < mean8 < 3 * dt * 1e9
+
+
+def test_phases_three_stage_exact_order():
+    """phases inserts barriers between stages (generator.clj:1425)."""
+    h = gt.perfect(
+        {"concurrency": 3},
+        gen.phases(
+            gen.limit(3, gen.repeat(r("a"))),
+            gen.limit(2, gen.repeat(r("b"))),
+            gen.limit(1, gen.repeat(r("c"))),
+        ),
+    )
+    fs = [o["f"] for o in invokes(h)]
+    assert fs == ["a", "a", "a", "b", "b", "c"]
+    # no b invoke may precede the completion of the last a
+    last_a_done = max(o["time"] for o in h if o["f"] == "a" and o["type"] != "invoke")
+    first_b = min(o["time"] for o in h if o["f"] == "b" and o["type"] == "invoke")
+    assert first_b >= last_a_done
+
+
+def test_soonest_op_map_prefers_earlier():
+    """soonest-op-map picks the op with the earliest time
+    (generator.clj:885-927)."""
+    a = {"op": {"f": "a", "time": 100}, "gen": "ga", "weight": 1}
+    b = {"op": {"f": "b", "time": 50}, "gen": "gb", "weight": 1}
+    chosen = gen.soonest_op_map([a, b])
+    assert chosen["op"]["f"] == "b"
+    assert gen.soonest_op_map([None, a])["op"]["f"] == "a"
+    assert gen.soonest_op_map([None, None]) is None
+    pend = {"op": PENDING, "gen": "gp"}
+    assert gen.soonest_op_map([pend, a])["op"]["f"] == "a"
+
+
+def test_reserve_remainder_goes_to_default():
+    """reserve's trailing generator owns the remaining threads
+    (generator.clj:1009-1089)."""
+    h = gt.perfect(
+        {"concurrency": 5},
+        gen.clients(
+            gen.reserve(2, gen.limit(4, gen.repeat(r("fast"))),
+                        gen.limit(4, gen.repeat(r("slow"))))
+        ),
+    )
+    by_f = {}
+    for o in invokes(h):
+        by_f.setdefault(o["f"], set()).add(o["process"])
+    assert by_f["fast"] <= {0, 1}
+    assert by_f["slow"] <= {2, 3, 4}
+
+
+def test_limit_zero_and_nested_limits():
+    assert gt.perfect(TEST, gen.limit(0, gen.repeat(r()))) == []
+    h = gt.perfect(TEST, gen.limit(5, gen.limit(3, gen.repeat(r()))))
+    assert len(invokes(h)) == 3
