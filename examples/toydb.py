@@ -61,7 +61,7 @@ class ToyDB(jdb.DB):
     def teardown(self, test, node, session):
         self.kill(test, node, session)
         session.exec_result("rm", "-rf", self._paths(node)["dir"])
-        session.exec_result("rm", "-f", self._paths(node)["data"])
+        session.exec_result("bash", "-c", f"rm -f {self._paths(node)['data']}*")
 
     # Process capability (db.clj:18-24) — drives the kill nemesis package.
     def start(self, test, node, session):
@@ -98,7 +98,7 @@ class ToyClient(client.Client):
     def open(self, test, node):
         s = socket.create_connection(("127.0.0.1", node_port(test, node)), timeout=5)
         s.settimeout(5)
-        c = ToyClient(s)
+        c = type(self)(s)  # subclass-friendly: keyed clients survive open
         c.rfile = s.makefile("r")
         return c
 
@@ -107,19 +107,30 @@ class ToyClient(client.Client):
         reply = self.rfile.readline().strip()
         if not reply:
             raise ConnectionError("server closed connection")
+        if reply.startswith("err"):
+            # raising → the interpreter records an indeterminate :info,
+            # never a false definite ok
+            raise RuntimeError(f"toydb error reply: {reply!r}")
         return reply
+
+    @staticmethod
+    def _read_value(reply: str):
+        if not reply.startswith("v "):
+            raise RuntimeError(f"unexpected read reply {reply!r}")
+        return None if reply == "v nil" else int(reply.split()[1])
 
     def invoke(self, test, op):
         f, v = op["f"], op.get("value")
         if f == "read":
-            reply = self._round("R")
-            val = None if reply == "v nil" else int(reply.split()[1])
-            return {**op, "type": "ok", "value": val}
+            return {**op, "type": "ok", "value": self._read_value(self._round("R"))}
         if f == "write":
-            self._round(f"W {v}")
+            if self._round(f"W {v}") != "ok":
+                raise RuntimeError("unexpected write reply")
             return {**op, "type": "ok"}
         if f == "cas":
             reply = self._round(f"C {v[0]} {v[1]}")
+            if reply not in ("ok", "fail"):
+                raise RuntimeError(f"unexpected cas reply {reply!r}")
             return {**op, "type": "ok" if reply == "ok" else "fail"}
         raise ValueError(f"unknown op {f!r}")
 
@@ -128,6 +139,57 @@ class ToyClient(client.Client):
             self.sock.close()
         except (OSError, AttributeError):
             pass
+
+
+class ToyKVClient(ToyClient):
+    """Keyed variant for independent per-key workloads: op values are
+    independent tuples ``[key, value]`` and completions re-wrap them."""
+
+    def invoke(self, test, op):
+        from jepsen_tpu import independent
+
+        k = independent.tuple_key(op["value"])
+        v = independent.tuple_value(op["value"])
+        f = op["f"]
+        if f == "read":
+            val = self._read_value(self._round(f"R {k}"))
+            return {**op, "type": "ok", "value": independent.tuple_(k, val)}
+        if f == "write":
+            if self._round(f"W {k} {v}") != "ok":
+                raise RuntimeError("unexpected write reply")
+            return {**op, "type": "ok"}
+        if f == "cas":
+            reply = self._round(f"C {k} {v[0]} {v[1]}")
+            if reply not in ("ok", "fail"):
+                raise RuntimeError(f"unexpected cas reply {reply!r}")
+            return {**op, "type": "ok" if reply == "ok" else "fail"}
+        raise ValueError(f"unknown op {f!r}")
+
+
+def toydb_kv_test(opts) -> dict:
+    """Per-key linearizable-register workload against live toydb
+    processes: the independent keyspace becomes the TPU batch axis."""
+    from jepsen_tpu.workloads import linearizable_register
+
+    db = ToyDB()
+    wl = linearizable_register.workload(
+        {
+            "concurrency": opts.get("concurrency", 6),
+            "key-count": opts.get("key-count", 8),
+            "per-key-limit": opts.get("per-key-limit", 12),
+            **opts,  # callers may tune threads-per-key / algorithm / etc.
+        }
+    )
+    time_limit = opts.get("time-limit", 10)
+    t = testkit.noop_test(
+        name="toydb-kv",
+        db=db,
+        client=ToyKVClient(),
+        generator=gen.clients(gen.time_limit(time_limit, wl["generator"])),
+        checker=wl["checker"],
+    )
+    t.update(opts)
+    return t
 
 
 def rand_op():

@@ -8,10 +8,12 @@ service linearizable across endpoints and crash-durable — `kill -9` at
 any moment must lose nothing, which is exactly what the harness's kill
 nemesis + checker verify.
 
-Protocol (one line per request):
-  R           -> "v <value>" | "v nil"
-  W <int>     -> "ok"
-  C <old> <new> -> "ok" | "fail"
+Protocol (one line per request; [k] is an optional key, default "r" —
+each key gets its own locked, fsync'd file, so every key is an
+independent linearizable register):
+  R [k]             -> "v <value>" | "v nil"
+  W [k] <int>       -> "ok"
+  C [k] <old> <new> -> "ok" | "fail"
 """
 
 from __future__ import annotations
@@ -55,17 +57,25 @@ class Handler(socketserver.StreamRequestHandler):
             self.wfile.write((reply + "\n").encode())
             self.wfile.flush()
 
+    N_ARGS = {"R": 0, "W": 1, "C": 2}
+
     def apply(self, parts):
-        path = self.server.data_path
-        if parts[0] == "R":
+        cmd, rest = parts[0], parts[1:]
+        want = self.N_ARGS.get(cmd)
+        if want is None:
+            return "err bad-command"
+        if len(rest) not in (want, want + 1):
+            return "err bad-arity"
+        key = rest[0] if len(rest) == want + 1 else "r"
+        args = rest[len(rest) - want:] if want else []
+        path = f"{self.server.data_path}-{key}"
+        if cmd == "R":
             return txn(path, lambda v: (..., f"v {v if v is not None else 'nil'}"))
-        if parts[0] == "W":
-            w = int(parts[1])
+        if cmd == "W":
+            w = int(args[0])
             return txn(path, lambda v: (w, "ok"))
-        if parts[0] == "C":
-            old, new = int(parts[1]), int(parts[2])
-            return txn(path, lambda v: (new, "ok") if v == old else (..., "fail"))
-        return "err bad-command"
+        old, new = int(args[0]), int(args[1])
+        return txn(path, lambda v: (new, "ok") if v == old else (..., "fail"))
 
 
 class Server(socketserver.ThreadingTCPServer):
