@@ -153,7 +153,8 @@ class EtcdClient(client.Client):
         self.timeout = timeout
 
     def open(self, test, node):
-        return EtcdClient(f"http://{node}:{CLIENT_PORT}", self.timeout)
+        # type(self): subclasses (e.g. keyed variants) must survive reopen
+        return type(self)(f"http://{node}:{CLIENT_PORT}", self.timeout)
 
     def _post(self, path: str, body: dict) -> dict:
         req = urllib.request.Request(

@@ -30,7 +30,13 @@ class Client:
     reusable = False
 
     def open(self, test: Mapping, node: str) -> "Client":
-        """Return a connected copy bound to node. Must not mutate self."""
+        """Return a connected copy bound to node. Must not mutate self.
+
+        Overrides should construct the copy via ``type(self)(...)``, never
+        a hard-coded class: the interpreter reopens clients on process
+        crashes, and a hard-coded class silently discards subclass
+        behavior (wrappers, keyed variants) at every reopen.
+        """
         return copy.copy(self)
 
     def setup(self, test: Mapping) -> None:
