@@ -8,9 +8,11 @@ int32 state (SURVEY.md §7 hard-part #2):
 
 operating elementwise on arbitrary-shaped arrays, where ``f`` is a
 model-specific small-int code and ``v1``/``v2`` are the packed value
-columns (jepsen_tpu.history.NIL for absent).  Models whose state doesn't
-fit an int32 scalar (queues) are not tensorizable here; the linearizable
-front-end's "competition" algorithm falls back to the CPU oracle for them.
+columns (jepsen_tpu.history.NIL for absent).  State must fit an int32
+scalar: registers/mutex/counter trivially, the fifo queue via a bounded
+packed encoding gated by a precheck (histories outside its envelope —
+and models with genuinely unbounded state like the unordered queue —
+fall back to the CPU oracle through the "competition" front-end).
 """
 
 from __future__ import annotations
@@ -34,6 +36,10 @@ class TensorModel:
     f_codes: dict  # f name -> small int code
     step: Callable  # (state, f, v1, v2) -> (state', legal)
     encode_state: Callable  # python model instance -> int32 initial state
+    #: optional: raise ValueError when a history's ops don't fit this
+    #: model's packed-state representation (callers translate to
+    #: NotTensorizable and fall back to the CPU oracle)
+    precheck: Callable | None = None
 
 
 def _encode_register_state(model) -> int:
@@ -86,6 +92,69 @@ def _encode_counter_state(model) -> int:
     return int(getattr(model, "value", 0) or 0)
 
 
+# ---------------------------------------------------------------------------
+# FIFO queue: the whole queue packed into one int32.
+#
+# Layout: bits [0..2] = length (0..7 — the field is 3 bits, which is
+# exactly why the capacity is 7); slot i (head = slot 0) at bits
+# [3 + 3i .. 5 + 3i], storing value+1 (so 0 = empty).  Capacity 7 slots,
+# values 0..6 — histories that can't fit (checked by _fifo_precheck)
+# refuse to tensorize and fall back to the CPU oracle, so a packed-state
+# overflow can never refute a valid history.
+# ---------------------------------------------------------------------------
+
+FIFO_CAP = 7
+FIFO_MAX_VALUE = 6
+
+
+def _fifo_step(state, f, v1, v2):
+    """fifo-queue step. f: 0=enqueue, 1=dequeue (of the observed head)."""
+    length = state & 7
+    vals = state >> 3  # stored v+1, head in the low 3 bits
+    head = vals & 7
+    is_enq = f == 0
+    enq_legal = (length < FIFO_CAP) & (v1 >= 0) & (v1 <= FIFO_MAX_VALUE)
+    enq_vals = vals | ((v1 + 1) << (3 * length))
+    enq_state = (enq_vals << 3) | (length + 1)
+    deq_legal = (length > 0) & (head == v1 + 1)
+    deq_state = ((vals >> 3) << 3) | jnp.maximum(length - 1, 0)
+    legal = jnp.where(is_enq, enq_legal, deq_legal)
+    state2 = jnp.where(is_enq & enq_legal, enq_state,
+                       jnp.where(~is_enq & deq_legal, deq_state, state))
+    return state2, legal
+
+
+def _encode_fifo_state(model) -> int:
+    items = tuple(getattr(model, "items", ()) or ())
+    if len(items) > FIFO_CAP:
+        raise ValueError(f"initial queue longer than {FIFO_CAP}")
+    state = len(items)
+    for i, v in enumerate(items):
+        if not isinstance(v, int) or not 0 <= v <= FIFO_MAX_VALUE:
+            raise ValueError(f"queue value {v!r} outside 0..{FIFO_MAX_VALUE}")
+        state |= (v + 1) << (3 + 3 * i)
+    return state
+
+
+def _fifo_precheck(model, ops):
+    """Sound tensorization gate: every value must fit 0..6, and the queue
+    can never need more than FIFO_CAP slots in ANY linearization — bounded
+    by initial length + total enqueues (dequeues only shrink it)."""
+    items = tuple(getattr(model, "items", ()) or ())
+    enqueues = 0
+    for op in ops:
+        v = op.get("value")
+        if not isinstance(v, int) or isinstance(v, bool) or not 0 <= v <= FIFO_MAX_VALUE:
+            raise ValueError(f"queue value {v!r} outside 0..{FIFO_MAX_VALUE}")
+        if op["f"] == "enqueue":
+            enqueues += 1
+    if len(items) + enqueues > FIFO_CAP:
+        raise ValueError(
+            f"{len(items)} initial + {enqueues} enqueued items exceed the "
+            f"packed capacity {FIFO_CAP}"
+        )
+
+
 REGISTRY = {
     "cas-register": TensorModel(
         "cas-register",
@@ -104,6 +173,13 @@ REGISTRY = {
     ),
     "counter": TensorModel(
         "counter", {"read": 0, "add": 1}, _counter_step, _encode_counter_state
+    ),
+    "fifo-queue": TensorModel(
+        "fifo-queue",
+        {"enqueue": 0, "dequeue": 1},
+        _fifo_step,
+        _encode_fifo_state,
+        precheck=_fifo_precheck,
     ),
 }
 

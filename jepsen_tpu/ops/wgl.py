@@ -87,6 +87,11 @@ def pack(model: m.Model, history: Sequence[dict]):
     if tm is None:
         raise NotTensorizable(f"no tensor model for {getattr(model, 'name', model)!r}")
     events, eff_ops, crashed = wgl_cpu.prepare(model, history)
+    if tm.precheck is not None:
+        try:
+            tm.precheck(model, eff_ops.values())
+        except ValueError as e:
+            raise NotTensorizable(str(e)) from None
     barriers, group_ops = wgl_cpu._barrier_snapshots(events, eff_ops, crashed)
     B = len(barriers)
 
@@ -153,7 +158,7 @@ def pack(model: m.Model, history: Sequence[dict]):
         "P": P,
         "G": G,
         "W": W,
-        "init_state": np.int32(tm.encode_state(model)),
+        "init_state": np.int32(_encode_state(tm, model)),
         "step": tm.step,
         "bar_active": np.ones(B, bool),
         "bar": (bar_f, bar_v1, bar_v2, bar_slot),
@@ -164,6 +169,13 @@ def pack(model: m.Model, history: Sequence[dict]):
         "slot_lane": slot_lane,
         "slot_onehot": slot_onehot,
     }
+
+
+def _encode_state(tm, model) -> int:
+    try:
+        return tm.encode_state(model)
+    except ValueError as e:
+        raise NotTensorizable(str(e)) from None
 
 
 def _bucket(x: int, choices) -> int:

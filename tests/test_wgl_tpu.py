@@ -45,8 +45,8 @@ def test_mutex_kernel():
 
 
 def test_unsupported_model_is_unknown():
-    hist = [h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1)]
-    a = tpu_an(m.FIFOQueue(), hist)
+    hist = [h.op(h.INVOKE, 0, "add", 1), h.op(h.OK, 0, "add", 1)]
+    a = tpu_an(m.UnorderedQueue(), hist)
     assert a["valid?"] == "unknown"
     assert "not tensorizable" in a["cause"]
 
@@ -207,3 +207,108 @@ def test_differential_other_models():
         # the kernels must actually RESOLVE these small histories, not
         # hide behind blanket "unknown"s
         assert agree >= 30, (type(model).__name__, agree)
+
+
+def _random_queue_history(rng, n_procs=3, n_ops=12):
+    """Enqueue/dequeue interleavings; values 0..3, enqueues capped at the
+    packed-state envelope so capacity-boundary lengths get exercised.
+    Dequeues complete with a plausibly-dequeued value so valid histories
+    are common."""
+    hist = []
+    live = {}
+    fifo = []
+    enq_budget = 7
+    while len(hist) < n_ops * 2:
+        p = rng.randrange(n_procs)
+        if p in live:
+            inv = live.pop(p)
+            outcome = rng.choice([h.OK, h.OK, h.OK, h.FAIL])
+            v = inv["value"]
+            if inv["f"] == "enqueue" and outcome == h.OK:
+                fifo.append(v)
+            if inv["f"] == "dequeue":
+                if outcome == h.OK:
+                    v = fifo.pop(0) if (fifo and rng.random() < 0.85) else rng.randrange(4)
+                else:
+                    v = rng.randrange(4)
+            hist.append(h.op(outcome, p, inv["f"], v))
+        else:
+            if enq_budget > 0 and rng.random() < 0.5:
+                f, v = "enqueue", rng.randrange(4)
+                enq_budget -= 1
+            else:
+                f, v = "dequeue", rng.randrange(4)
+            o = h.op(h.INVOKE, p, f, v)
+            live[p] = o
+            hist.append(o)
+    return h.index(hist)
+
+
+def test_fifo_queue_tensor_model_differential():
+    rng = random.Random(1357)
+    agree = 0
+    for trial in range(50):
+        hist = _random_queue_history(rng)
+        model = m.FIFOQueue()
+        truth = wgl_cpu.brute_analysis(model, hist)["valid?"]
+        got = wgl.analysis(model, hist, capacity=256)["valid?"]
+        assert got in (truth, "unknown"), (trial, got, truth)
+        agree += got == truth
+    assert agree >= 40, agree
+
+
+def test_fifo_queue_capacity_boundary_exact():
+    """Directed boundary case: fill the packed queue to exactly FIFO_CAP
+    then drain it — the length field must survive its maximum value
+    (regression: a 3-bit length field with a capacity of 9 corrupted the
+    encoding at lengths 8-9 and wrongly refuted valid histories)."""
+    from jepsen_tpu.models import tensor as tmodels
+
+    cap = tmodels.FIFO_CAP
+    model = m.FIFOQueue()
+    hist = []
+    t_ = 0
+    for i in range(cap):
+        hist.append(h.op(h.INVOKE, 0, "enqueue", i % 7, time=(t_ := t_ + 1)))
+        hist.append(h.op(h.OK, 0, "enqueue", i % 7, time=(t_ := t_ + 1)))
+    for i in range(cap):
+        hist.append(h.op(h.INVOKE, 0, "dequeue", i % 7, time=(t_ := t_ + 1)))
+        hist.append(h.op(h.OK, 0, "dequeue", i % 7, time=(t_ := t_ + 1)))
+    hist = h.index(hist)
+    assert wgl_cpu.brute_analysis(model, hist)["valid?"] is True
+    assert wgl.analysis(model, hist, capacity=256)["valid?"] is True
+    # one past capacity refuses to tensorize (never a wrong refutation)
+    extra = list(hist) + [
+        h.op(h.INVOKE, 0, "enqueue", 1, time=t_ + 1),
+        h.op(h.OK, 0, "enqueue", 1, time=t_ + 2),
+    ]
+    a = wgl.analysis(model, h.index(extra), capacity=256)
+    assert a["valid?"] == "unknown" and "capacity" in a["cause"]
+
+
+def test_fifo_queue_tensorization_gates():
+    """Histories outside the packed envelope refuse to tensorize (CPU
+    fallback) rather than risking a wrong refutation."""
+    model = m.FIFOQueue()
+    # too many enqueues for the packed capacity
+    big = []
+    for i in range(10):
+        big.append(h.op(h.INVOKE, 0, "enqueue", i % 4, time=2 * i))
+        big.append(h.op(h.OK, 0, "enqueue", i % 4, time=2 * i + 1))
+    a = wgl.analysis(model, h.index(big), capacity=64)
+    assert a["valid?"] == "unknown" and "capacity" in a["cause"]
+    # value out of range
+    bad = h.index([h.op(h.INVOKE, 0, "enqueue", 99), h.op(h.OK, 0, "enqueue", 99)])
+    a = wgl.analysis(model, bad, capacity=64)
+    assert a["valid?"] == "unknown" and "outside" in a["cause"]
+    # simple exact cases
+    ok_hist = h.index([
+        h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1),
+        h.op(h.INVOKE, 1, "dequeue", 1), h.op(h.OK, 1, "dequeue", 1),
+    ])
+    assert wgl.analysis(model, ok_hist, capacity=64)["valid?"] is True
+    bad_hist = h.index([
+        h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1),
+        h.op(h.INVOKE, 1, "dequeue", 2), h.op(h.OK, 1, "dequeue", 2),
+    ])
+    assert wgl.analysis(model, bad_hist, capacity=64)["valid?"] is False
