@@ -56,9 +56,11 @@ def frontier_update_fast(
          predecessors has both hash lanes equal — collision probability
          ~1e-13 per compaction, far below the kernel's other "unknown"
          slack.  Dup runs longer than the window survive as bloat;
-      4. survivors compact to ``capacity`` by cumsum-rank scatter of their
-         ORIGINAL indices — only the ``capacity`` retained rows are ever
-         gathered;
+      4. survivors compact to ``capacity`` by cumsum-rank scatter in
+         CANDIDATE order (parents precede children, i.e. fewest-fired
+         first, so truncation drops the most-speculative rows and
+         witnesses survive longest) — only the ``capacity`` retained
+         rows are ever gathered;
       5. optionally (``prune``) an exact O(capacity² · G) domination prune
          on the retained rows.  The batch kernel runs steps 1-4 every
          closure round and the prune once per barrier, after the return
@@ -66,10 +68,10 @@ def frontier_update_fast(
          before they breed across barriers.
 
     ``cost`` is accepted for signature parity with frontier_update but
-    unused: over-capacity truncation keeps a hash-ordered subset, not the
-    cheapest-first subset — sound either way (overflow flags lossy and the
-    caller escalates to the exact path), and skipping the cost sort is
-    part of what makes this path fast.
+    unused: candidate order already approximates cheapest-first (children
+    always carry one more fired op than their parent), so no cost sort is
+    needed — and truncation order only affects verdict quality, never
+    soundness (overflow flags lossy and the caller escalates).
 
     Returns (state', fok', fcr', alive', overflowed, fp) — see
     frontier_update for the contract.
@@ -99,16 +101,18 @@ def frontier_update_fast(
         )
         dup = dup | same
     keep = al & ~dup
-    # Compact survivors to capacity by cumsum rank (ranks unique; dropped
-    # rows get distinct out-of-bounds positions so the unique-indices
-    # promise holds).  Only the retained rows are gathered.
-    rank = jnp.cumsum(keep) - 1
+    # Map the keep mask back to CANDIDATE order before compacting: the
+    # candidate table lists parents before children, i.e. fewest-fired
+    # first, so truncation under overflow drops the most-speculative rows
+    # — witnesses survive longer than under hash-order truncation.
+    keep_orig = jnp.zeros(n, bool).at[sidx].set(keep, unique_indices=True)
+    rank = jnp.cumsum(keep_orig) - 1
     n_keep = jnp.maximum(rank[-1] + 1, 0)
-    pos2 = jnp.where(keep, rank, capacity + pos)
+    pos2 = jnp.where(keep_orig, rank, capacity + pos)
     src = (
         jnp.zeros(capacity, jnp.int32)
         .at[pos2]
-        .set(sidx, mode="drop", unique_indices=True)
+        .set(iota, mode="drop", unique_indices=True)
     )
     kst = state[src]
     kfo = fok[src]

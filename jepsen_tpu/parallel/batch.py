@@ -70,17 +70,17 @@ def batch_analysis(
     mesh: Mesh | None = None,
     cpu_fallback: bool = True,
     exact_escalation: Sequence[int] | None = None,
-    engine: str = "sync",
+    engine: str = "async",
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
     ``capacity`` lists the BATCHED (fast-kernel) capacity ladder: each
     stage re-batches only the still-unknown histories, padded to a power
     of two so compiles are reused.  ``engine`` picks the batched kernel:
-    "sync" (the barrier-scan kernel; the default — measured faster
-    end-to-end through the full ladder) or "async" (lane-asynchronous
-    barrier stepping — lanes pay their own closure depth; ~1.4x faster
-    at the first-stage shape but slower at later ladder stages).  ``rounds`` bounds per-barrier
+    "async" (lane-asynchronous barrier stepping — lanes pay their own
+    closure depth; the default: with candidate-order truncation it
+    matches the sync engine's verdict quality and runs the full ladder
+    ~15% faster) or "sync" (the barrier-scan kernel).  ``rounds`` bounds per-barrier
     closure depth on the "sync" engine and the exact escalation stage;
     the async engine's closure budget is its tick budget
     (wgl.async_ticks).  Histories still lossy after the last
