@@ -15,6 +15,7 @@ from jepsen_tpu.workloads import (  # noqa: F401
     linearizable_register,
     long_fork,
     monotonic,
+    sequential,
     sets,
     wr,
 )

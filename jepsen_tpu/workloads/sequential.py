@@ -1,0 +1,105 @@
+"""Sequential-consistency workload: ordered key chains with prefix reads.
+
+The pattern the cockroach/tidb/dgraph harnesses share (reference:
+cockroachdb/src/jepsen/cockroach/sequential.clj and kin): each writer
+owns a chain of keys it writes strictly in order (key 0, then key 1, …);
+a reader scanning a chain in REVERSE key order must observe a suffix
+whose presence implies every earlier key — seeing key i written but key
+i-1 missing means the later write became visible before the earlier one,
+a sequential-consistency (per-session order) violation.
+
+Ops:
+  {"f": "write", "value": [chain, i]}        write key i of a chain
+  {"f": "read",  "value": [chain, observed]} observed = sorted key
+                                             indices seen (completion)
+
+Checker verdict per chain: the observed set of every read must be a
+PREFIX of 0..n (no holes below the maximum seen).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import Checker
+
+
+class SequentialChecker(Checker):
+    def check(self, test, history: Sequence[Mapping], opts) -> dict:
+        errors: list = []
+        reads = 0
+        for o in history:
+            if o.get("process") == h.NEMESIS or o["type"] != h.OK or o["f"] != "read":
+                continue
+            chain, observed = o["value"]
+            observed = sorted(observed or [])
+            reads += 1
+            if observed and observed != list(range(observed[-1] + 1)):
+                missing = sorted(set(range(observed[-1] + 1)) - set(observed))
+                errors.append(
+                    {
+                        "type": "hole",
+                        "chain": chain,
+                        "observed": observed,
+                        "missing": missing,
+                        "op": o,
+                    }
+                )
+        out: dict = {"valid?": not errors, "reads": reads}
+        if errors:
+            out["errors"] = errors[:8]
+            out["error-count"] = len(errors)
+        return out
+
+
+def checker() -> Checker:
+    return SequentialChecker()
+
+
+def writes(chain: int, n_keys: int):
+    """The chain's ordered writes, one op per key."""
+    return [{"f": "write", "value": [chain, i]} for i in range(n_keys)]
+
+
+def workload(opts: Mapping | None = None) -> dict:
+    """Writers walk their chains in order while readers scan chains; the
+    client contract: a read returns [chain, observed-key-indices] with
+    the scan performed in reverse key order.
+
+    Each chain is OWNED by one writer thread (``on_threads`` binding): a
+    thread never has two ops in flight, so a chain's writes are serialized
+    by construction — without that, consecutive writes of one chain could
+    race and a correct system would show spurious holes.
+    """
+    import random as _random
+
+    opts = dict(opts or {})
+    n_chains = opts.get("chain-count", 8)
+    n_keys = opts.get("keys-per-chain", 5)
+    conc = opts.get("concurrency", 4)
+    rng = _random.Random(opts.get("seed"))
+
+    n_writers = max(1, min(n_chains, conc - 1 if conc > 1 else 1))
+    chains = list(range(n_chains))
+    rng.shuffle(chains)
+    by_writer: list[list] = [[] for _ in range(n_writers)]
+    for k, c in enumerate(chains):
+        by_writer[k % n_writers].extend(writes(c, n_keys))
+    writer_gens = [
+        gen.on_threads(lambda t, w=w: t == w, gen.stagger(0.01, gen.to_gen(ops)))
+        for w, ops in enumerate(by_writer)
+    ]
+
+    def read_gen(test=None, ctx=None):
+        return {"f": "read", "value": [rng.randrange(n_chains), None]}
+
+    readers = gen.on_threads(
+        lambda t: isinstance(t, int) and t >= n_writers,
+        gen.stagger(0.02, gen.repeat(read_gen)),
+    )
+    return {
+        "generator": gen.any_gen(*writer_gens, readers),
+        "checker": checker(),
+    }

@@ -433,3 +433,73 @@ def test_monotonic_concurrent_inc_read_valid():
     ])
     res = monotonic.checker().check({}, hist, {})
     assert res["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# Sequential (cockroach/tidb/dgraph harness pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_sequential_valid_prefixes():
+    from jepsen_tpu.workloads import sequential
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "read", [1, None], time=10),
+        h.op(h.OK, 0, "read", [1, []], time=20),
+        h.op(h.INVOKE, 0, "read", [1, None], time=30),
+        h.op(h.OK, 0, "read", [1, [0, 1, 2]], time=40),
+    ])
+    res = sequential.checker().check({}, hist, {})
+    assert res["valid?"] is True and res["reads"] == 2
+
+
+def test_sequential_hole_detected():
+    from jepsen_tpu.workloads import sequential
+
+    hist = h.index([
+        h.op(h.INVOKE, 0, "read", [3, None], time=10),
+        h.op(h.OK, 0, "read", [3, [0, 2]], time=20),  # key 1 missing below max 2
+    ])
+    res = sequential.checker().check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["missing"] == [1]
+    assert res["errors"][0]["chain"] == 3
+
+
+def test_sequential_workload_runs_in_interpreter():
+    from jepsen_tpu.workloads import sequential
+
+    wl = sequential.workload({"chain-count": 3, "keys-per-chain": 3, "concurrency": 4, "seed": 9})
+    chains: dict = {}
+
+    class ChainClient(testkit.AtomClient):
+        def invoke(self, test, op):
+            f = op["f"]
+            if f == "write":
+                c, i = op["value"]
+                with self.cell.lock:
+                    chains.setdefault(c, []).append(i)
+                return {**op, "type": "ok"}
+            c, _ = op["value"]
+            with self.cell.lock:
+                seen = sorted(chains.get(c, []))
+            return {**op, "type": "ok", "value": [c, seen]}
+
+    t = testkit.noop_test(
+        name="seq",
+        concurrency=4,
+        client=ChainClient(testkit.AtomCell()),
+        generator=gen.clients(gen.time_limit(3, wl["generator"])),
+        checker=wl["checker"],
+    )
+    import tempfile
+
+    from jepsen_tpu import core
+
+    with tempfile.TemporaryDirectory() as d:
+        completed = core.run_test({**t, "store-dir": d})
+    assert completed["results"]["valid?"] is True
+    assert completed["results"]["reads"] > 0
+    # every chain was written in order (thread-ownership serializes them)
+    for c, seq in chains.items():
+        assert seq == sorted(seq), (c, seq)
