@@ -1,19 +1,22 @@
 """Headline benchmark: linearizability ops verified per second per chip.
 
-Workload: a batch of 256 independent register histories in the
+Workload (shape constants below; the metric string is derived from them):
+a batch of N_HISTORIES independent register histories in the
 worst-case-branching regime the north star targets (BASELINE config 4's
-batch shape at config 5's difficulty): 100 ops x 8 processes per history,
-30% indeterminate (:info) completions — crashed ops stay concurrent
-forever, multiplying the configuration frontier — and a quarter of the
-histories corrupted, because refuting an invalid history is the expensive
-case that matters (jepsen runs checkers to FIND violations).
+batch shape at config 5's difficulty): OPS_PER_HISTORY ops x PROCS
+processes per history, INFO_RATE indeterminate (:info) completions —
+crashed ops stay concurrent forever, multiplying the configuration
+frontier — and 1/CORRUPT_EVERY of the histories corrupted, because
+refuting an invalid history is the expensive case that matters (jepsen
+runs checkers to FIND violations).
 
-TPU path: the batched fast-frontier kernel, escalating stragglers through
-a wider batch stage then the exact single-history kernel
-(jepsen_tpu.parallel.batch_analysis).  Baseline: the single-host
-config-set sweep (jepsen_tpu.checker.wgl_cpu.sweep_analysis — the same
-frontier algorithm, i.e. the knossos-linear-equivalent and the strongest
-CPU oracle here; the DFS oracle goes exponential and never finishes this
+TPU path: the batched fast-frontier kernel with a per-stage capacity
+ladder; every stage's verdicts are exact (content-confirmed kills), so
+escalation is purely capacity (jepsen_tpu.parallel.batch_analysis).
+Baseline: the single-host config-set sweep
+(jepsen_tpu.checker.wgl_cpu.sweep_analysis — the same frontier
+algorithm, i.e. the knossos-linear-equivalent and the strongest CPU
+oracle here; the DFS oracle goes exponential and never finishes this
 workload), capped at CPU_MAX_CONFIGS explored configurations per history
 (a deterministic work budget; BUDGET_S is only a wall-clock backstop).
 Cap hits make the reported vs_baseline an UNDERestimate.
@@ -43,8 +46,8 @@ PROCS = 8
 INFO_RATE = 0.3
 N_VALUES = 8
 CORRUPT_EVERY = 4
-CAPS = (128, 512)
-EXACT = (1024,)
+CAPS = (128, 512, 2048)
+EXACT = ()
 BUDGET_S = 10.0  # wall-clock backstop only; the real cap is work-based
 CPU_MAX_CONFIGS = 100_000  # deterministic sweep budget (low run variance)
 CPU_SAMPLE = 48  # CPU baseline measured on this many histories, extrapolated

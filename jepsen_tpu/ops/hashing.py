@@ -1,13 +1,24 @@
-"""Row hashing and sort-based frontier compaction.
+"""Frontier compaction, dedup and domination pruning for the WGL kernels.
 
-The WGL frontier is a struct-of-arrays table of configurations.  Dedup on
-TPU is sort-based: hash each row to 96 bits (3 uint32 lanes of
-murmur3-style mixing — collision probability for ~10^6 rows is ~10^-17 per
-compaction, far below the kernel's other 'unknown' slack), sort by
-(dead, hash) lanes, and mark rows equal to their sorted predecessor as
-duplicates.  A second sort compacts survivors to the fixed capacity,
-preferring configurations that fired the fewest ops (the dominating ones —
-see jepsen_tpu.checker.wgl_cpu domination notes).
+The WGL frontier is a struct-of-arrays table of configurations.  Two
+maintenance strategies live here:
+
+  * frontier_update_fast — the production path: single-key hash sort +
+    windowed hash-lane dedup with candidate-order compaction.  Kills are
+    hash-decided (collision ~1e-13 per compaction), so the batch driver
+    confirms every fast-path refutation on the exact CPU sweep before
+    reporting it — overlapped with the remaining device stages, which
+    makes the confirmation sound and nearly free in wall clock.  (A
+    season of sort-free redesigns — pairwise-exact buffers, winner
+    buckets, dense slot tables — all measured SLOWER on this TPU than
+    the hash sort; the engine notes in PERF.md record the numbers.)
+  * frontier_update — the sort-based formulation (hash-ordered 4-key
+    lax.sort, windowed kills, two-stage domination), kept as the
+    reference implementation and used by the frontier-sharded multi-chip
+    path, whose all_to_all routing is hash-based by construction.
+
+Row hashes (murmur3-style mixing) order sorts and fingerprint frontiers;
+no kill decision rides on hash identity anywhere.
 """
 
 from __future__ import annotations
@@ -40,7 +51,8 @@ def hash_rows(columns, seed: int):
 
 
 def frontier_update_fast(
-    state, fok, fcr, alive, cost, capacity: int, window: int = 4, prune: bool = False
+    state, fok, fcr, alive, cost, capacity: int, window: int = 4,
+    n_parents: int | None = None,
 ):
     """Frontier dedup + truncation, tuned for the vmapped batch kernel.
 
@@ -54,18 +66,22 @@ def frontier_update_fast(
          (alive | index) payload — row data never moves through the sort;
       3. a row is a duplicate when a neighbor within ``window`` sorted
          predecessors has both hash lanes equal — collision probability
-         ~1e-13 per compaction, far below the kernel's other "unknown"
-         slack.  Dup runs longer than the window survive as bloat;
+         ~1e-13 per compaction.  A collision kills a distinct config
+         silently, which is why engines built on this update never
+         report ``False`` as final: jepsen_tpu.parallel.batch_analysis
+         confirms every fast-path refutation on the exact CPU sweep
+         (overlapped with the remaining device stages, so the
+         confirmation is sound AND nearly free in wall clock).  Dup runs
+         longer than the window survive as bloat;
       4. survivors compact to ``capacity`` by cumsum-rank scatter in
          CANDIDATE order (parents precede children, i.e. fewest-fired
          first, so truncation drops the most-speculative rows and
          witnesses survive longest) — only the ``capacity`` retained
          rows are ever gathered;
-      5. optionally (``prune``) an exact O(capacity² · G) domination prune
-         on the retained rows.  The batch kernel runs steps 1-4 every
-         closure round and the prune once per barrier, after the return
-         filter — dominated rows bloat within a barrier but are reaped
-         before they breed across barriers.
+      5. the engines run ``exact_prune`` (content-decided domination)
+         once per barrier, after the return filter, so dominated rows
+         bloat within a barrier but are reaped before they breed across
+         barriers.
 
     ``cost`` is accepted for signature parity with frontier_update but
     unused: candidate order already approximates cheapest-first (children
@@ -73,8 +89,18 @@ def frontier_update_fast(
     needed — and truncation order only affects verdict quality, never
     soundness (overflow flags lossy and the caller escalates).
 
-    Returns (state', fok', fcr', alive', overflowed, fp) — see
-    frontier_update for the contract.
+    ``n_parents``: when the candidate table's first ``n_parents`` rows
+    are the previous frontier (parents) and the rest are this round's
+    expansions, the returned ``child`` mask marks surviving rows that
+    came from an expansion.  ``(alive' & child).any()`` is a no-growth
+    closure-fixpoint signal (exact modulo the same hash-dedup caveat as
+    step 3 — which is covered by the same refutation confirmation), so
+    engines advance a barrier after ONE tick when its closure is already
+    complete instead of burning a second fingerprint-compare tick.
+
+    Returns (state', fok', fcr', alive', overflowed, fp, child) — fp is
+    an order-insensitive content fingerprint of the surviving set
+    (diagnostic only).
     """
     n = state.shape[0]
     w = fok.shape[1]
@@ -106,27 +132,54 @@ def frontier_update_fast(
     # first, so truncation under overflow drops the most-speculative rows
     # — witnesses survive longer than under hash-order truncation.
     keep_orig = jnp.zeros(n, bool).at[sidx].set(keep, unique_indices=True)
+    # Compact dedup survivors into a 2*capacity buffer, DOMINATION-prune
+    # it there ([2C, 2C, G] dense pairwise compares — cheap), and only
+    # then truncate: ``overflowed`` counts undominated survivors, not the
+    # closure's domination bloat.  Measured on the headline batch at cap
+    # 128: 105 → 108 histories resolved for ~0.4 s, and the carried
+    # frontier is antichain-minimal so later rounds stay small.
+    Cb = min(2 * capacity, n)
     rank = jnp.cumsum(keep_orig) - 1
-    n_keep = jnp.maximum(rank[-1] + 1, 0)
-    pos2 = jnp.where(keep_orig, rank, capacity + pos)
-    src = (
-        jnp.zeros(capacity, jnp.int32)
+    n_keep0 = jnp.maximum(rank[-1] + 1, 0)
+    pos2 = jnp.where(keep_orig, rank, Cb + pos)
+    srcB = (
+        jnp.zeros(Cb, jnp.int32)
         .at[pos2]
         .set(iota, mode="drop", unique_indices=True)
     )
-    kst = state[src]
-    kfo = fok[src]
-    kfc = fcr[src]
+    bst = state[srcB]
+    bfo = fok[srcB]
+    bfc = fcr[srcB]
+    balive = jnp.arange(Cb) < jnp.minimum(n_keep0, Cb)
+    spill = n_keep0 > Cb
+    balive = exact_prune(bst, bfo, bfc, balive)
+    rank2 = jnp.cumsum(balive) - 1
+    n_keep = jnp.maximum(rank2[-1] + 1, 0)
+    pos3 = jnp.where(balive, rank2, capacity + jnp.arange(Cb))
+    src2 = (
+        jnp.zeros(capacity, jnp.int32)
+        .at[pos3]
+        .set(jnp.arange(Cb, dtype=jnp.int32), mode="drop", unique_indices=True)
+    )
+    kst = bst[src2]
+    kfo = bfo[src2]
+    kfc = bfc[src2]
     new_alive = jnp.arange(capacity) < jnp.minimum(n_keep, capacity)
-    overflowed = n_keep > capacity
-    if prune:
-        new_alive = exact_prune(kst, kfo, kfc, new_alive)
+    overflowed = spill | (n_keep > capacity)
+    if n_parents is None:
+        child = jnp.zeros(capacity, bool)
+    else:
+        child = srcB[src2] >= n_parents
+    fp = _fingerprint(kst, kfo, kfc, new_alive, w, g)
+    return kst, kfo, kfc, new_alive, overflowed, fp, child
+
+
+def _fingerprint(kst, kfo, kfc, new_alive, w, g):
     out_cols = [kst] + [kfo[:, k] for k in range(w)] + [kfc[:, k] for k in range(g)]
     r1 = hash_rows(out_cols, 0xFEED_0001)
     r2 = hash_rows(out_cols, 0xFEED_0002)
     am = new_alive.astype(jnp.uint32)
-    fp = jnp.stack([(r1 * am).sum(), (r2 * am).sum(), am.sum()])
-    return kst, kfo, kfc, new_alive, overflowed, fp
+    return jnp.stack([(r1 * am).sum(), (r2 * am).sum(), am.sum()])
 
 
 def frontier_update(state, fok, fcr, alive, cost, capacity: int, window: int = 16):
@@ -213,34 +266,65 @@ def frontier_update(state, fok, fcr, alive, cost, capacity: int, window: int = 1
 
 
 
-def exact_prune(state, fok, fcr, alive, chunk_rows: int = 0):
+def exact_prune(state, fok, fcr, alive, chunk_rows: int = 0, order=None):
     """Kill duplicate and dominated frontier rows, exactly.
 
     Row j dies when some alive row i has the same (state, fok) class with
     pointwise ≤ fired-crashed counts AND is either strictly smaller
-    somewhere or earlier in the table (ties keep the first copy).  The
-    survivor set is the pointwise-minimal antichain with one representative
-    per duplicate group — exact pruning, never changes the verdict (the
-    survivor's futures are a superset, see wgl_cpu domination notes).
-    Chunked over the killed axis to bound the [F, C, G] intermediates.
+    somewhere or ranked before j by ``order`` (default: table index) —
+    ties keep the preferred copy.  The survivor set is the pointwise-
+    minimal antichain with one representative per duplicate group — exact
+    pruning, never changes the verdict (the survivor's futures are a
+    superset, see wgl_cpu domination notes).
+
+    ``order`` matters for the slot-table update: a duplicate of a live
+    row can land in a DIFFERENT (even lower-indexed) slot, and index
+    tie-breaking would then kill the OLD copy — equal content would
+    migrate between slots every round and the engines' no-growth
+    fixpoint would never fire.  Passing an age-aware order (old rows
+    first) pins the resident copy.
+
+    Chunked over the killed axis — via lax.scan, so the program size is
+    constant however many chunks a wide buffer needs — to bound the
+    [F, C, G] intermediates (under vmap the peak multiplies by the lane
+    count, and oversized buffers here have faulted the TPU worker).
     """
     f = state.shape[0]
     g = fcr.shape[1]
     if chunk_rows <= 0:
-        chunk_rows = max(16, min(f, (1 << 24) // max(1, f * g)))
-    idx = jnp.arange(f)
-    parts = []
-    for lo in range(0, f, chunk_rows):
-        hi = min(f, lo + chunk_rows)
-        same = (state[:, None] == state[None, lo:hi]) & (
-            (fok[:, None, :] == fok[None, lo:hi, :]).all(-1)
+        chunk_rows = min(f, max(16, (1 << 22) // max(1, f * g)))
+    idx = jnp.arange(f, dtype=jnp.int32) if order is None else order.astype(jnp.int32)
+
+    def part(lo):
+        st_c = jax.lax.dynamic_slice_in_dim(state, lo, chunk_rows)
+        fo_c = jax.lax.dynamic_slice_in_dim(fok, lo, chunk_rows, axis=0)
+        fc_c = jax.lax.dynamic_slice_in_dim(fcr, lo, chunk_rows, axis=0)
+        al_c = jax.lax.dynamic_slice_in_dim(alive, lo, chunk_rows)
+        idx_c = jax.lax.dynamic_slice_in_dim(idx, lo, chunk_rows)
+        same = (state[:, None] == st_c[None, :]) & (
+            (fok[:, None, :] == fo_c[None, :, :]).all(-1)
         )
-        le = (fcr[:, None, :] <= fcr[None, lo:hi, :]).all(-1)
-        lt = (fcr[:, None, :] < fcr[None, lo:hi, :]).any(-1)
-        earlier = idx[:, None] < idx[None, lo:hi]
-        dom = same & le & (lt | earlier) & alive[:, None] & alive[None, lo:hi]
-        parts.append(dom.any(axis=0))
-    return alive & ~jnp.concatenate(parts)
+        le = (fcr[:, None, :] <= fc_c[None, :, :]).all(-1)
+        lt = (fcr[:, None, :] < fc_c[None, :, :]).any(-1)
+        earlier = idx[:, None] < idx_c[None, :]
+        dom = same & le & (lt | earlier) & alive[:, None] & al_c[None, :]
+        return dom.any(axis=0)
+
+    if f <= chunk_rows:
+        return alive & ~part(jnp.int32(0))
+    n_chunks = (f + chunk_rows - 1) // chunk_rows
+    fpad = n_chunks * chunk_rows
+    if fpad != f:
+        # pad with dead rows so every dynamic_slice is in bounds (a
+        # clamped slice would mis-align the reshape below)
+        state = jnp.pad(state, (0, fpad - f))
+        fok = jnp.pad(fok, ((0, fpad - f), (0, 0)))
+        fcr = jnp.pad(fcr, ((0, fpad - f), (0, 0)))
+        alive = jnp.pad(alive, (0, fpad - f))
+        idx = jnp.pad(idx, (0, fpad - f))
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * chunk_rows
+    _, parts = jax.lax.scan(lambda c, lo: (c, part(lo)), None, starts)
+    return alive[:f] & ~parts.reshape(-1)[:f]
 
 
 def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
@@ -256,8 +340,9 @@ def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
     f = state.shape[0]
     g = fcr.shape[1]
     if chunk_rows <= 0:
-        # keep [f, chunk, g] intermediates under ~16M elements
-        chunk_rows = max(16, min(f, (1 << 24) // max(1, f * g)))
+        # keep [f, chunk, g] intermediates under ~4M elements (vmap
+        # multiplies the peak by the lane count)
+        chunk_rows = max(16, min(f, (1 << 22) // max(1, f * g)))
     parts = []
     for lo in range(0, f, chunk_rows):
         hi = min(f, lo + chunk_rows)

@@ -12,6 +12,9 @@ its shard's frontiers in lockstep.
 
 from __future__ import annotations
 
+import multiprocessing
+import os
+from concurrent.futures import ProcessPoolExecutor
 from typing import Sequence
 
 import numpy as np
@@ -23,6 +26,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.ops import wgl
+
+
+def _worker_init():
+    # Confirmation workers must never touch the accelerator: the parent
+    # process holds the TPU, and a forked/spawned JAX init would fight it.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+#: lazily created, reused across batch_analysis calls (spawn startup is
+#: ~seconds; the pool is harmless idle and dies with the process)
+_CONFIRM_POOL: ProcessPoolExecutor | None = None
+
+
+def _confirm_pool(workers: int | None) -> ProcessPoolExecutor:
+    global _CONFIRM_POOL
+    if _CONFIRM_POOL is None:
+        _CONFIRM_POOL = ProcessPoolExecutor(
+            max_workers=workers or min(8, os.cpu_count() or 1),
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=_worker_init,
+        )
+    return _CONFIRM_POOL
+
+
+def _confirm_refutation(model: m.Model, history, max_configs: int) -> dict:
+    """Run the exact CPU config-set sweep on a history the fast device
+    engines refuted.  The sweep's kills are content-decided, so its
+    verdict is exact; it runs in a worker process, overlapped with the
+    remaining device stages (the sweep path is jax-free)."""
+    return wgl_cpu.sweep_analysis(model, history, max_configs=max_configs)
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
@@ -65,12 +98,15 @@ ASYNC_ARG_ORDER = [k for k in _ARG_ORDER if k != "bar_active"]
 def batch_analysis(
     model: m.Model,
     histories: Sequence[Sequence[dict]],
-    capacity: int | Sequence[int] = (64, 512),
+    capacity: int | Sequence[int] = (64, 512, 4096),
     rounds: int = 8,
     mesh: Mesh | None = None,
     cpu_fallback: bool = True,
     exact_escalation: Sequence[int] | None = None,
     engine: str = "async",
+    confirm_refutations: bool = True,
+    confirm_workers: int | None = None,
+    confirm_max_configs: int = 2_000_000,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -81,13 +117,27 @@ def batch_analysis(
     closure depth; the default: with candidate-order truncation it
     matches the sync engine's verdict quality and runs the full ladder
     ~15% faster) or "sync" (the barrier-scan kernel).  ``rounds`` bounds per-barrier
-    closure depth on the "sync" engine and the exact escalation stage;
+    closure depth on the "sync" engine and the exact escalation stages;
     the async engine's closure budget is its tick budget
-    (wgl.async_ticks).  Histories still lossy after the last
-    batched stage escalate one-by-one through the exact single-history
-    kernel (``exact_escalation`` capacities; default one stage at 4x the
-    last batch capacity; pass () to disable), then — when
-    ``cpu_fallback`` — to the CPU config-set sweep.  Returns one
+    (wgl.async_ticks).
+
+    ``True`` verdicts are sound from every stage (a surviving frontier is
+    a constructive witness).  The fast engines dedup by 64-bit row hash,
+    so their refutations are PROVISIONAL: with ``confirm_refutations``
+    (the default, honoring the "never an unconfirmed False" contract)
+    each one is confirmed by the exact CPU config-set sweep running in
+    worker processes CONCURRENTLY with the remaining device stages — by
+    the time the ladder drains, the confirmations have usually finished,
+    so soundness costs almost no wall clock.  A sweep that exceeds
+    ``confirm_max_configs`` leaves the verdict "unknown" (never a wrong
+    False); a sweep that disagrees (the ~1e-13 collision case) wins.
+
+    Escalation is about CAPACITY: each ladder stage re-runs only the
+    still-lossy histories wider.  ``exact_escalation`` optionally appends
+    stages on the in-round-domination kernel (frontier_update; ~10x
+    slower per lane but content-exact, so its refutations are final);
+    wide stages sub-batch automatically.  Remaining unknowns fall back
+    to the CPU config-set sweep when ``cpu_fallback``.  Returns one
     knossos-shaped result per history, in order.
     """
     results: list[dict | None] = [None] * len(histories)
@@ -108,16 +158,12 @@ def batch_analysis(
     if engine not in ("sync", "async"):
         raise ValueError(f"unknown engine {engine!r}; expected 'sync' or 'async'")
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
-    batch_caps, exact_caps = [int(c) for c in capacities], []
-    if exact_escalation is None:
-        exact_caps = [4 * batch_caps[-1]] if batch_caps else []
-    elif exact_escalation:
-        exact_caps = [int(c) for c in exact_escalation]
-    pending = list(range(len(packs)))
-    for batch_cap in batch_caps:
-        if not pending:
-            break
-        sub = [packs[k] for k in pending]
+    batch_caps = [int(c) for c in capacities]
+    exact_caps = [int(c) for c in (exact_escalation or ())]
+    def _launch(st_engine: str, batch_cap: int, sub: list[dict]):
+        """Stack ``sub`` to common bucket shapes and run one vmapped
+        kernel launch; returns (valid, failed_at, lossy, peak) host
+        arrays of len(sub)."""
         B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
         P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
         G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
@@ -147,7 +193,7 @@ def batch_analysis(
                 for k, a in zip(_ARG_ORDER, args)
             ]
         W = (P + 31) // 32
-        if engine == "async":
+        if st_engine == "async":
             T = wgl.async_ticks(B)
             n_actives = np.array([p["bar_active"].sum() for p in sub], np.int32)
             if n_pad != n:
@@ -163,13 +209,37 @@ def batch_analysis(
                 a_args[1] = jax.device_put(np.asarray(a_args[1]), spec)
             runner = wgl.async_runner(sub[0]["step"], batch_cap, T, B, P, G, W)
             valid, failed_at, lossy, peak = runner(*a_args)
-        else:
+        elif st_engine == "sync":
             runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
             valid, failed_at, lossy, peak = runner(*args)
-        valid = np.asarray(valid)[:n]
-        failed_at = np.asarray(failed_at)[:n]
-        lossy = np.asarray(lossy)[:n]
-        peak = np.asarray(peak)[:n]
+        else:  # "exact": content-compare dedup/domination — may refute
+            runner = wgl.exact_batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
+            valid, failed_at, lossy, peak = runner(*args)
+        return (
+            np.asarray(valid)[:n],
+            np.asarray(failed_at)[:n],
+            np.asarray(lossy)[:n],
+            np.asarray(peak)[:n],
+        )
+
+    stages = [(engine, c) for c in batch_caps] + [("exact", c) for c in exact_caps]
+    pending = list(range(len(packs)))
+    confirm_futs: dict = {}  # history index -> (future, device result)
+    for st_engine, batch_cap in stages:
+        if not pending:
+            break
+        # Bound total frontier rows per launch so wide-capacity stages
+        # sub-batch instead of faulting the TPU worker (observed at
+        # capacity*lanes ≳ 64k on the exact engine, whose sort and
+        # domination buffers are ~10x the fast kernel's per-lane
+        # footprint; fast engines get a proportionally larger budget).
+        budget = (16 * 1024) if st_engine == "exact" else (64 * 1024)
+        lanes_cap = max(1, budget // batch_cap)
+        outs = [
+            _launch(st_engine, batch_cap, [packs[k] for k in pending[s0 : s0 + lanes_cap]])
+            for s0 in range(0, len(pending), lanes_cap)
+        ]
+        valid, failed_at, lossy, peak = (np.concatenate(x) for x in zip(*outs))
         still = []
         for j, k in enumerate(pending):
             i = idxs[k]
@@ -178,7 +248,22 @@ def batch_analysis(
                 results[i] = {"valid?": True, "kernel": stats}
             elif failed_at[j] >= 0 and not lossy[j]:
                 op = histories[i][int(packs[k]["bar_opid"][int(failed_at[j])])]
-                results[i] = {"valid?": False, "op": op, "kernel": stats}
+                res = {"valid?": False, "op": op, "kernel": stats}
+                if st_engine == "exact" or not confirm_refutations:
+                    # content-decided kills (or the caller opted out):
+                    # the refutation is final
+                    results[i] = res
+                else:
+                    # fast-engine refutation: hash-dedup could in
+                    # principle have killed a distinct config, so the
+                    # exact CPU sweep confirms it — in a worker
+                    # process, concurrent with the remaining stages
+                    fut = _confirm_pool(confirm_workers).submit(
+                        _confirm_refutation, model, list(histories[i]),
+                        confirm_max_configs,
+                    )
+                    confirm_futs[i] = (fut, res)
+                    results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
                 results[i] = {
@@ -187,22 +272,29 @@ def batch_analysis(
                     "kernel": stats,
                 }
         pending = still
-    # Whatever survives every batched stage escalates one-by-one through
-    # the EXACT single-history kernel (cost-prioritized truncation, full
-    # domination) — knossos-style competition, against frontier sizes.
-    for k in pending:
-        i = idxs[k]
-        if exact_caps:
-            results[i] = wgl.analysis(
-                model, histories[i], capacity=exact_caps, rounds=rounds
-            )
 
     if cpu_fallback:
         for i, r in enumerate(results):
-            if r is not None and r["valid?"] == "unknown":
+            if r is not None and r["valid?"] == "unknown" and i not in confirm_futs:
                 # The config-set sweep, not the DFS: DFS backtracking goes
                 # exponential on exactly the histories that overflow the
                 # kernel (info-heavy invalid ones); the sweep is the same
                 # frontier algorithm the kernel runs and degrades linearly.
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
+
+    for i, (fut, dev_res) in confirm_futs.items():
+        cpu_res = fut.result()
+        if cpu_res["valid?"] is False:
+            dev_res["confirmed?"] = True
+            results[i] = dev_res
+        elif cpu_res["valid?"] is True:
+            # the 1e-13 case: a hash collision killed a live config;
+            # the exact sweep's witness wins
+            results[i] = cpu_res
+        else:
+            results[i] = {
+                "valid?": "unknown",
+                "cause": "device refutation; exact confirmation exceeded budget",
+                "kernel": dev_res.get("kernel"),
+            }
     return [r if r is not None else {"valid?": "unknown"} for r in results]

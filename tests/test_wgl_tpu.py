@@ -85,11 +85,14 @@ def test_differential_medium_valid_histories():
 
 
 def test_differential_medium_corrupted():
+    # The ladder, not a single capacity: the slot-table frontier trades
+    # ~2x capacity headroom (hash-table load) for its per-round speed, so
+    # a borderline history legitimately escalates one stage.
     agree = 0
     for seed in range(3):
         hist = corrupt(valid_register_history(200, 6, seed=seed, info_rate=0.1), seed=seed)
         truth = wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"]
-        got = wgl.analysis(m.CASRegister(None), hist, capacity=512)["valid?"]
+        got = wgl.analysis(m.CASRegister(None), hist, capacity=(512, 2048))["valid?"]
         assert got in (truth, "unknown"), (seed, got, truth)
         if got == truth:
             agree += 1
@@ -312,3 +315,4 @@ def test_fifo_queue_tensorization_gates():
         h.op(h.INVOKE, 1, "dequeue", 2), h.op(h.OK, 1, "dequeue", 2),
     ])
     assert wgl.analysis(model, bad_hist, capacity=64)["valid?"] is False
+
