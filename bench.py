@@ -39,6 +39,7 @@ from genhist import corrupt, valid_register_history  # noqa: E402
 from jepsen_tpu import models as m  # noqa: E402
 from jepsen_tpu.checker import wgl_cpu  # noqa: E402
 from jepsen_tpu.parallel import batch_analysis  # noqa: E402
+from jepsen_tpu.parallel.batch import warm_confirm_pool  # noqa: E402
 
 N_HISTORIES = 128
 OPS_PER_HISTORY = 100
@@ -85,7 +86,9 @@ def main() -> None:
 
     kw = dict(capacity=CAPS, exact_escalation=EXACT, cpu_fallback=False)
     # Warm-up at the MEASURED shapes (full batch, every ladder stage) so
-    # the measurement excludes compilation, then measure steady state.
+    # the measurement excludes compilation, and spawn the confirmation
+    # workers so pool startup stays outside the timed window.
+    warm_confirm_pool()
     batch_analysis(model, hists, **kw)
     t0 = time.perf_counter()
     tpu_results = batch_analysis(model, hists, **kw)

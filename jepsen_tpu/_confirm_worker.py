@@ -1,0 +1,76 @@
+"""Entry points for confirmation-sweep worker processes.
+
+Refutations from the fast device engines are hash-deduped, so
+``parallel.batch_analysis`` confirms each one with the exact CPU
+config-set sweep in a worker process, concurrent with the remaining
+device stages (the reference seam: checkers must run anywhere,
+jepsen/src/jepsen/independent.clj:285-307).
+
+This module is deliberately import-light.  A spawned worker unpickles
+its initializer and task functions by importing the module that defines
+them — if that pulls in jax-heavy modules (``ops.hashing`` builds
+``jnp`` constants at import time), the worker initializes an accelerator
+backend and, under the axon TPU plugin, dies fighting the parent for the
+chip (the round-3 BrokenProcessPool regression).  So:
+
+  * the import chain here is jax-free: ``checker.wgl_cpu`` ->
+    ``history`` + ``models`` are numpy/stdlib only, and the sweep itself
+    never touches jax;
+  * ``init`` pins any *later* jax import to CPU via the config flag —
+    the axon plugin overrides the JAX_PLATFORMS env var, so the env var
+    alone is not enough (same dance as tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def init() -> None:
+    """Pool initializer: force any jax backend in this process to CPU.
+
+    Runs before any task, i.e. before any task's import chain could
+    initialize a backend.  Importing jax here does NOT initialize a
+    backend (that happens on first device use); it just lets us set the
+    config flag the axon plugin respects.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def confirm_refutation(model, history, max_configs: int) -> dict:
+    """Exact CPU config-set sweep over one refuted history.
+
+    The sweep's kills are content-decided, so its verdict is exact; it
+    confirms (or, in the ~1e-13 hash-collision case, overturns) a fast
+    device engine's provisional refutation.
+    """
+    from jepsen_tpu.checker import wgl_cpu
+
+    return wgl_cpu.sweep_analysis(model, history, max_configs=max_configs)
+
+
+def probe_backend() -> dict:
+    """Diagnostic task for tests/warm-up: report this worker's jax
+    platform and which jepsen_tpu modules its tasks so far dragged in.
+
+    Initializes the backend (first device use), so the platform must
+    come back "cpu" even when the parent's environment was pointed at a
+    TPU.  The module list (snapshotted before this probe imports jax)
+    guards the import-light invariant: a confirmation must never have
+    imported the kernel modules.
+    """
+    import sys
+
+    modules = sorted(k for k in sys.modules if k.startswith("jepsen_tpu"))
+    jax_loaded = "jax" in sys.modules
+    import jax
+
+    return {
+        "platform": jax.default_backend(),
+        "pid": os.getpid(),
+        "jepsen_tpu_modules": modules,
+        "jax_loaded_before_probe": jax_loaded,
+    }

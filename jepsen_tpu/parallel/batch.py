@@ -15,6 +15,7 @@ from __future__ import annotations
 import multiprocessing
 import os
 from concurrent.futures import ProcessPoolExecutor
+from concurrent.futures.process import BrokenProcessPool
 from typing import Sequence
 
 import numpy as np
@@ -23,19 +24,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from jepsen_tpu import _confirm_worker
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.ops import wgl
 
-
-def _worker_init():
-    # Confirmation workers must never touch the accelerator: the parent
-    # process holds the TPU, and a forked/spawned JAX init would fight it.
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
-
 #: lazily created, reused across batch_analysis calls (spawn startup is
-#: ~seconds; the pool is harmless idle and dies with the process)
+#: ~seconds; the pool is harmless idle and dies with the process).
+#: Workers must never touch the accelerator — the parent owns the TPU —
+#: so both the initializer and the task live in the import-light,
+#: jax-free module jepsen_tpu._confirm_worker (unpickling a function
+#: imports its defining module; this one would drag in the kernels).
 _CONFIRM_POOL: ProcessPoolExecutor | None = None
 
 
@@ -45,17 +44,42 @@ def _confirm_pool(workers: int | None) -> ProcessPoolExecutor:
         _CONFIRM_POOL = ProcessPoolExecutor(
             max_workers=workers or min(8, os.cpu_count() or 1),
             mp_context=multiprocessing.get_context("spawn"),
-            initializer=_worker_init,
+            initializer=_confirm_worker.init,
         )
     return _CONFIRM_POOL
 
 
-def _confirm_refutation(model: m.Model, history, max_configs: int) -> dict:
-    """Run the exact CPU config-set sweep on a history the fast device
-    engines refuted.  The sweep's kills are content-decided, so its
-    verdict is exact; it runs in a worker process, overlapped with the
-    remaining device stages (the sweep path is jax-free)."""
-    return wgl_cpu.sweep_analysis(model, history, max_configs=max_configs)
+def _reset_confirm_pool() -> None:
+    """Drop a broken pool so later calls rebuild it instead of failing."""
+    global _CONFIRM_POOL
+    if _CONFIRM_POOL is not None:
+        _CONFIRM_POOL.shutdown(wait=False, cancel_futures=True)
+        _CONFIRM_POOL = None
+
+
+def warm_confirm_pool(workers: int | None = None) -> None:
+    """Spawn the confirmation workers ahead of time (outside any timed
+    window): pool startup + worker init cost ~seconds once per process."""
+    pool = _confirm_pool(workers)
+    futs = [
+        pool.submit(_confirm_worker.probe_backend) for _ in range(pool._max_workers)
+    ]
+    for f in futs:
+        f.result()
+
+
+def _submit_confirmation(workers: int | None, *args):
+    """Submit a confirmation, rebuilding the pool once if it is broken.
+    Returns None when no worker could take the job (the caller degrades
+    that one history, not the batch)."""
+    for _ in range(2):
+        try:
+            return _confirm_pool(workers).submit(
+                _confirm_worker.confirm_refutation, *args
+            )
+        except BrokenProcessPool:
+            _reset_confirm_pool()
+    return None
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
@@ -136,9 +160,16 @@ def batch_analysis(
     still-lossy histories wider.  ``exact_escalation`` optionally appends
     stages on the in-round-domination kernel (frontier_update; ~10x
     slower per lane but content-exact, so its refutations are final);
-    wide stages sub-batch automatically.  Remaining unknowns fall back
-    to the CPU config-set sweep when ``cpu_fallback``.  Returns one
-    knossos-shaped result per history, in order.
+    wide stages sub-batch automatically.  Behavior change (round 3):
+    ``exact_escalation=None`` now means NO exact stages — it used to mean
+    one stage at 4x the last batch capacity.  Refutation soundness moved
+    to the confirmation sweep, and the wider default batch ladder covers
+    the capacity range; but callers with ``cpu_fallback=False`` that
+    relied on the implicit exact stage to resolve capacity-bound lanes
+    may see more "unknown"s and should pass ``exact_escalation``
+    explicitly.  Remaining unknowns fall back to the CPU config-set
+    sweep when ``cpu_fallback``.  Returns one knossos-shaped result per
+    history, in order.
     """
     results: list[dict | None] = [None] * len(histories)
     packs: list[dict] = []
@@ -258,8 +289,8 @@ def batch_analysis(
                     # principle have killed a distinct config, so the
                     # exact CPU sweep confirms it — in a worker
                     # process, concurrent with the remaining stages
-                    fut = _confirm_pool(confirm_workers).submit(
-                        _confirm_refutation, model, list(histories[i]),
+                    fut = _submit_confirmation(
+                        confirm_workers, model, list(histories[i]),
                         confirm_max_configs,
                     )
                     confirm_futs[i] = (fut, res)
@@ -283,7 +314,27 @@ def batch_analysis(
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
 
     for i, (fut, dev_res) in confirm_futs.items():
-        cpu_res = fut.result()
+        try:
+            if fut is None:
+                raise BrokenProcessPool("no confirmation worker available")
+            cpu_res = fut.result()
+        except Exception as e:  # noqa: BLE001 — a dead worker must not
+            # lose the other histories' verdicts; degrade this one only
+            if isinstance(e, BrokenProcessPool):
+                _reset_confirm_pool()
+            if cpu_fallback:
+                # the caller asked for CPU fallback on unknowns: confirm
+                # in-process instead (same sweep the worker would run)
+                results[i] = wgl_cpu.sweep_analysis(
+                    model, histories[i], max_configs=confirm_max_configs
+                )
+            else:
+                results[i] = {
+                    "valid?": "unknown",
+                    "cause": f"device refutation; confirmation worker failed: {e!r}",
+                    "kernel": dev_res.get("kernel"),
+                }
+            continue
         if cpu_res["valid?"] is False:
             dev_res["confirmed?"] = True
             results[i] = dev_res

@@ -92,3 +92,74 @@ def test_linearizable_check_batch_via_independent():
     assert res["results"][2]["valid?"] is False
     assert res["valid?"] is False
     assert res["failures"] == [2]
+
+
+def test_confirm_worker_isolated_from_accelerator(monkeypatch):
+    """Round-3 regression: spawned confirmation workers initialized the
+    accelerator backend and died (BrokenProcessPool, libtpu mismatch).
+    The worker entry points live in the import-light jepsen_tpu._confirm_worker
+    module, and its initializer pins jax to CPU via the config flag — the
+    axon plugin overrides the env var, so env alone is not enough."""
+    from jepsen_tpu import _confirm_worker as cw
+    from jepsen_tpu.parallel import batch as pb
+
+    # Poison the inherited environment: point any env-var-honoring backend
+    # selection at a TPU that does not exist here.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    pb._reset_confirm_pool()
+    try:
+        pool = pb._confirm_pool(2)
+        hist = corrupt(valid_register_history(20, 2, seed=3, info_rate=0.2), seed=3)
+        r = pool.submit(
+            cw.confirm_refutation, m.CASRegister(None), hist, 100_000
+        ).result(timeout=180)
+        assert r["valid?"] in (True, False)
+        info = pool.submit(cw.probe_backend).result(timeout=180)
+        # The config flag won: the worker's backend is CPU despite the env.
+        assert info["platform"] == "cpu"
+        # The confirmation path stayed import-light: no kernel modules, no
+        # parallel.batch (whose import would drag in both jax and the kernels).
+        heavy = {"jepsen_tpu.ops.wgl", "jepsen_tpu.ops.hashing",
+                 "jepsen_tpu.parallel.batch", "jepsen_tpu.models.tensor"}
+        assert not heavy & set(info["jepsen_tpu_modules"]), info
+    finally:
+        pb._reset_confirm_pool()
+
+
+def test_confirm_future_failure_degrades_to_unknown(monkeypatch):
+    """A dead confirmation worker must cost one history's verdict, not the
+    whole batch (advisor r3: unguarded fut.result() lost everything and
+    left a broken module-global pool behind)."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    from jepsen_tpu.parallel import batch as pb
+
+    class ExplodingFuture:
+        def result(self, timeout=None):
+            raise BrokenProcessPool("worker died")
+
+    class ExplodingPool:
+        def submit(self, fn, *a, **kw):
+            return ExplodingFuture()
+
+    reset_calls = []
+    monkeypatch.setattr(pb, "_confirm_pool", lambda workers: ExplodingPool())
+    monkeypatch.setattr(pb, "_reset_confirm_pool", lambda: reset_calls.append(1))
+    hists, expect = histories_mixed(6)
+    results = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=False
+    )
+    for r, want in zip(results, expect):
+        if want is True:
+            assert r["valid?"] is True  # valid verdicts survive
+        else:
+            assert r["valid?"] == "unknown"
+            assert "confirmation worker failed" in r["cause"]
+    assert reset_calls  # the broken pool was dropped for rebuild
+
+    # With cpu_fallback=True the same failure confirms in-process instead
+    # of degrading: the caller asked for definite verdicts where possible.
+    results = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=True
+    )
+    assert [r["valid?"] for r in results] == expect
