@@ -22,6 +22,7 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
+from jepsen_tpu import store
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.checker import txn_graph as tg
 from jepsen_tpu.ops import closure as cl
@@ -147,6 +148,10 @@ def _edge_type(g: tg.TxnGraph, i: int, j: int) -> str:
 
 def _explain_cycle(g: tg.TxnGraph, cycle: list[int]) -> dict:
     """Render a node cycle into an elle-style explanation."""
+    if len(cycle) > 1 and cycle[0] == cycle[-1]:
+        # recovery paths come back closed ([a, …, a]); the step zip
+        # re-closes the cycle itself, so drop the duplicate endpoint
+        cycle = cycle[:-1]
     steps = []
     for i, j in zip(cycle, cycle[1:] + [cycle[0]]):
         et = _edge_type(g, i, j)
@@ -162,12 +167,16 @@ def _explain_cycle(g: tg.TxnGraph, cycle: list[int]) -> dict:
 
 
 def _diag_cycle_at(adj_parts: np.ndarray, v: int) -> list[int] | None:
-    """A cycle through node v (the device flagged closure[v, v])."""
+    """A cycle through node v (the device flagged closure[v, v]), or None
+    when the host adjacency has no such cycle — a stale/mismatched hint
+    must surface as unwitnessed, not as a fabricated witness."""
+    if adj_parts[v, v]:
+        return [v]
     for u in np.flatnonzero(adj_parts[v]):
         c = _find_cycle_through_edge(adj_parts, v, int(u))
         if c is not None:
             return c
-    return [v]
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -182,23 +191,34 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
     boundary."""
     wanted = expand_anomalies(requested)
     anomalies: dict[str, list] = {k: v for k, v in g.anomalies.items() if k in wanted}
+    # A device flag asserts a cycle exists; host BFS recovers the witness.
+    # If recovery fails (stale/empty hint, adjacency mismatch), the flag
+    # must still surface — never a clean True over a flagged graph.
+    unwitnessed: list[str] = []
     if g.n:
         any_adj = g.ww | g.wr | g.extra
         full_adj = any_adj | g.rw
-        if flags["G0"] and "G0" in wanted and hints["G0"]:
-            cyc = _diag_cycle_at(g.ww | g.extra, hints["G0"][0])
+        if flags["G0"] and "G0" in wanted:
+            cyc = _diag_cycle_at(g.ww | g.extra, hints["G0"][0]) if hints["G0"] else None
             if cyc:
                 anomalies.setdefault("G0", []).append(_explain_cycle(g, cyc))
+            else:
+                unwitnessed.append("G0")
         for name, graph_adj, gate in (
             ("G1c", any_adj, True),
             ("G-single", any_adj, True),
             ("G2", full_adj, not flags["G-single"]),
         ):
-            if flags[name] and gate and name in wanted and hints[name]:
-                a, b = hints[name]
-                cyc = _find_cycle_through_edge(graph_adj, a, b)
+            if flags[name] and gate and name in wanted:
+                cyc = (
+                    _find_cycle_through_edge(graph_adj, *hints[name])
+                    if hints[name]
+                    else None
+                )
                 if cyc:
                     anomalies.setdefault(name, []).append(_explain_cycle(g, cyc))
+                else:
+                    unwitnessed.append(name)
 
     types = sorted(anomalies)
     not_, also_not = models_ruled_out(types)
@@ -212,6 +232,15 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
                 "also-not": also_not,
             }
         )
+    if unwitnessed:
+        out["unwitnessed-flags"] = sorted(set(unwitnessed))
+        if not anomalies:
+            out["valid?"] = "unknown"
+            out["cause"] = (
+                "device flagged cycle(s) "
+                f"({', '.join(out['unwitnessed-flags'])}) but witness "
+                "recovery found no cycle — flag and host graph disagree"
+            )
     return out
 
 
@@ -248,6 +277,78 @@ def check_graphs(graphs: Sequence[tg.TxnGraph], requested: Sequence[str]) -> lis
     ]
 
 
+# ---------------------------------------------------------------------------
+# elle/ output directory (anomaly explanation files)
+# ---------------------------------------------------------------------------
+
+
+def _render_op(op: Mapping) -> str:
+    return (
+        f"{{:index {op.get('index')}, :process {op.get('process')}, "
+        f":type :{op.get('type')}, :f :{op.get('f')}, :value {op.get('value')!r}}}"
+    )
+
+
+def render_anomaly(name: str, item) -> str:
+    """One anomaly instance as elle-style prose (elle writes files like
+    elle/G1c.txt with 'Let's consider the following transaction cycle'
+    sections; SURVEY.md §2.3)."""
+    if isinstance(item, Mapping) and "cycle" in item:
+        lines = ["Let's consider the following transaction cycle:", ""]
+        for op in item["cycle"]:
+            lines.append("  " + _render_op(op))
+        lines.append("  (and back to the start)")
+        lines.append("")
+        lines.append("Each step in the cycle:")
+        for s in item.get("steps", ()):
+            lines.append(f"  - [{s['type']}] {s['explanation']}")
+        return "\n".join(lines)
+    if isinstance(item, Mapping):
+        lines = []
+        for k, v in item.items():
+            if isinstance(v, Mapping) and "type" in v and "f" in v:
+                lines.append(f"  :{k} {_render_op(v)}")
+            elif (
+                isinstance(v, Sequence)
+                and not isinstance(v, (str, bytes))
+                and v
+                and all(isinstance(x, Mapping) and "type" in x for x in v)
+            ):
+                lines.append(f"  :{k}")
+                lines.extend(f"    {_render_op(x)}" for x in v)
+            else:
+                lines.append(f"  :{k} {v!r}")
+        return "\n".join(lines)
+    return f"  {item!r}"
+
+
+def write_anomaly_dir(test, result: Mapping, opts=None, dirname: str = "elle"):
+    """Write one explanation file per anomaly type under the test's store
+    directory (the reference's elle output dir: elle emits anomaly
+    explanations into ``elle/``, served alongside the other artifacts by
+    jepsen.web).  Returns the directory, or None when no store is
+    configured or the result is clean."""
+    anomalies = result.get("anomalies")
+    if not anomalies:
+        return None
+    try:
+        d = store.test_dir(test)
+    except (KeyError, TypeError):
+        return None  # bare unit-test maps have no store coordinates
+    sub = (opts or {}).get("subdirectory")
+    if sub:
+        d = d / sub
+    d = d / dirname
+    d.mkdir(parents=True, exist_ok=True)
+    for name, items in anomalies.items():
+        n = len(items)
+        chunks = [f"{n} {name} anomal{'y' if n == 1 else 'ies'}"]
+        for i, item in enumerate(items, 1):
+            chunks.append(f"--- {name} #{i} ---\n{render_anomaly(name, item)}")
+        (d / f"{name}.txt").write_text("\n\n".join(chunks) + "\n", encoding="utf-8")
+    return d
+
+
 DEFAULT_ANOMALIES = ["G2", "G1a", "G1b", "internal"]  # tests/cycle/wr.clj:46
 
 
@@ -272,7 +373,17 @@ class ListAppendChecker(Checker):
 
     def check(self, test, history, opts):
         g = tg.list_append_graph(history, self.additional_graphs)
-        return check_graph(g, self.anomalies)
+        res = check_graph(g, self.anomalies)
+        self.write_artifacts(test, res, opts)
+        return res
+
+    def write_artifacts(self, test, result, opts=None):
+        """Render the elle/ anomaly-explanation directory for a stored
+        run (called per key by independent.checker on the batch path)."""
+        try:
+            write_anomaly_dir(test, result, opts)
+        except OSError:
+            pass
 
     def check_batch(self, test, histories, opts):
         """Check many subhistories in batched device launches (used by
@@ -305,7 +416,16 @@ class WRRegisterChecker(Checker):
         )
 
     def check(self, test, history, opts):
-        return check_graph(self._graph(history), self.anomalies)
+        res = check_graph(self._graph(history), self.anomalies)
+        self.write_artifacts(test, res, opts)
+        return res
+
+    def write_artifacts(self, test, result, opts=None):
+        """See ListAppendChecker.write_artifacts."""
+        try:
+            write_anomaly_dir(test, result, opts)
+        except OSError:
+            pass
 
     def check_batch(self, test, histories, opts):
         """Batched per-key form (see ListAppendChecker.check_batch)."""

@@ -20,6 +20,7 @@ from typing import Mapping, Sequence
 from jepsen_tpu import history as h
 from jepsen_tpu import store
 from jepsen_tpu.checker import Checker, checker as as_checker
+from jepsen_tpu.checker.linear_svg import _esc
 from jepsen_tpu.utils import nemesis_intervals
 
 DEFAULT_QUANTILES = (0.5, 0.95, 0.99, 1.0)
@@ -282,25 +283,25 @@ class SvgPlot:
                 continue
             seen.add(label)
             e.append(f'<rect x="{lx}" y="{ly - 8}" width="10" height="10" fill="{color}"/>')
-            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{label}</text>')
+            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{_esc(label)}</text>')
             ly += 16
         for _t0, _t1, color, name in {(None, None, r[2], r[3]) for r in self._regions}:
             e.append(
                 f'<rect x="{lx}" y="{ly - 8}" width="10" height="10" fill="{color}" fill-opacity="0.35"/>'
             )
-            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{name}</text>')
+            e.append(f'<text x="{lx + 14}" y="{ly + 1}">{_esc(name)}</text>')
             ly += 16
         e.append(
             f'<text x="{(plot_x0 + plot_w / 2):.0f}" y="16" text-anchor="middle" '
-            f'font-size="13" font-weight="bold">{self.title}</text>'
+            f'font-size="13" font-weight="bold">{_esc(self.title)}</text>'
         )
         e.append(
             f'<text x="{(plot_x0 + plot_w / 2):.0f}" y="{self.H - 12}" '
-            f'text-anchor="middle">{self.xlabel}</text>'
+            f'text-anchor="middle">{_esc(self.xlabel)}</text>'
         )
         e.append(
             f'<text x="16" y="{(plot_y0 + plot_h / 2):.0f}" text-anchor="middle" '
-            f'transform="rotate(-90 16 {(plot_y0 + plot_h / 2):.0f})">{self.ylabel}</text>'
+            f'transform="rotate(-90 16 {(plot_y0 + plot_h / 2):.0f})">{_esc(self.ylabel)}</text>'
         )
         e.append("</svg>")
         return "\n".join(e)

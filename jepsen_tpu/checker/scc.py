@@ -99,8 +99,14 @@ def _first_edge_in_cycle(edges: np.ndarray, comp: np.ndarray):
 
 
 def _dag_reach_pairs(n: int, comp: np.ndarray, edges: np.ndarray, queries: np.ndarray):
-    """For each query edge (a, b): is there a path b→a in the graph?
-    Bitset closure over the SCC condensation (O(C·E/64))."""
+    """For each query edge (a, b): is there a NONEMPTY path b→a in the
+    graph?  Bitset closure over the SCC condensation (O(C·E/64)).
+
+    Nonempty matters for self-loop queries (a == b): the dense backend's
+    ``closure(wwr)[a, a]`` is true only for a real cycle through a, so a
+    bare rw self-loop on an otherwise-acyclic node must NOT read as a
+    return path here either (it is G2 territory, not G-single — both
+    backends must agree regardless of graph size)."""
     if len(queries) == 0:
         return np.zeros(0, dtype=bool)
     C = int(comp.max()) + 1 if n else 0
@@ -109,6 +115,12 @@ def _dag_reach_pairs(n: int, comp: np.ndarray, edges: np.ndarray, queries: np.nd
     reach[np.arange(C), np.arange(C) // 64] |= np.uint64(1) << (
         np.arange(C) % 64
     ).astype(np.uint64)
+    # A component contains a nonempty internal path between any two of its
+    # nodes iff it is cyclic: size > 1, or a singleton with a self-loop.
+    cyclic = np.bincount(comp, minlength=C) > 1
+    if len(edges):
+        self_loops = edges[edges[:, 0] == edges[:, 1], 0]
+        cyclic[comp[self_loops]] = True
     cedges = np.unique(comp[edges], axis=0) if len(edges) else np.zeros((0, 2), np.int64)
     cedges = cedges[cedges[:, 0] != cedges[:, 1]]
     # Tarjan completes an SCC only after all its successors, so an SCC's
@@ -122,7 +134,10 @@ def _dag_reach_pairs(n: int, comp: np.ndarray, edges: np.ndarray, queries: np.nd
             reach[c] |= reach[d]
     qa, qb = comp[queries[:, 0]], comp[queries[:, 1]]
     word, bit = qa // 64, (qa % 64).astype(np.uint64)
-    return (reach[qb, word] >> bit) & np.uint64(1) > 0
+    reach_refl = (reach[qb, word] >> bit) & np.uint64(1) > 0
+    # Same component: reflexive reach is trivially true; the real question
+    # is whether the component supports a nonempty return path.
+    return np.where(qa == qb, cyclic[qa], reach_refl)
 
 
 def classify_graph_scc(ww, wr, rw, extra):

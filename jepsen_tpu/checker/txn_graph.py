@@ -77,6 +77,13 @@ class TxnGraph:
         return len(self.nodes)
 
 
+def _t(nd: TxnNode) -> str:
+    """Name a transaction in explanation prose by its history index
+    (elle names them T1, T2, … — the completion op's :index is our
+    stable equivalent)."""
+    return f"T{nd.op.get('index', nd.id)}"
+
+
 def _empty(n: int) -> np.ndarray:
     return np.zeros((n, n), dtype=bool)
 
@@ -319,7 +326,9 @@ def list_append_graph(
             if na is not None and nb is not None and na.id != nb.id:
                 ww[na.id, nb.id] = True
                 expl[("ww", na.id, nb.id)] = (
-                    f"appended {a!r} before {b!r} to {k!r}"
+                    f"{_t(na)} appended {a!r} to {k!r} ([:append {k!r} {a!r}]) "
+                    f"and {_t(nb)} appended {b!r} immediately after it in "
+                    f"{k!r}'s version order {order!r}"
                 )
         # wr / rw per read
         for nd, lst in pairs:
@@ -328,7 +337,9 @@ def list_append_graph(
                 if wn is not None and wn.id != nd.id:
                     wr[wn.id, nd.id] = True
                     expl[("wr", wn.id, nd.id)] = (
-                        f"read {k!r} ending in {lst[-1]!r} appended by writer"
+                        f"{_t(nd)}'s read of {k!r} ([:r {k!r} {lst!r}]) observed "
+                        f"{lst[-1]!r} as its final element, which {_t(wn)} "
+                        f"appended ([:append {k!r} {lst[-1]!r}])"
                     )
             pos = len(lst)
             if pos < len(order):
@@ -336,7 +347,9 @@ def list_append_graph(
                 if nxt is not None and nxt.id != nd.id:
                     rw[nd.id, nxt.id] = True
                     expl[("rw", nd.id, nxt.id)] = (
-                        f"read {k!r} without {order[pos]!r}, which writer appended next"
+                        f"{_t(nd)}'s read of {k!r} ([:r {k!r} {lst!r}]) did not "
+                        f"observe {order[pos]!r}, which {_t(nxt)} appended next "
+                        f"in the version order ([:append {k!r} {order[pos]!r}])"
                     )
 
     return TxnGraph(
@@ -417,7 +430,10 @@ def rw_register_graph(
         wn = writer.get((k, v))
         if wn is not None and wn.id != nd.id:
             wr[wn.id, nd.id] = True
-            expl[("wr", wn.id, nd.id)] = f"read {k!r} = {v!r} written by writer"
+            expl[("wr", wn.id, nd.id)] = (
+                f"{_t(nd)}'s read of {k!r} ([:r {k!r} {v!r}]) observed the "
+                f"value {_t(wn)} wrote ([:w {k!r} {v!r}])"
+            )
 
     # -- Version orders under per-key ordering assumptions
     if sequential_keys or linearizable_keys:
@@ -436,7 +452,11 @@ def rw_register_graph(
                 na, nb = wnodes.get(a), wnodes.get(b)
                 if na is not None and nb is not None and na.id != nb.id:
                     ww[na.id, nb.id] = True
-                    expl[("ww", na.id, nb.id)] = f"wrote {k!r} = {a!r} before {b!r}"
+                    expl[("ww", na.id, nb.id)] = (
+                        f"{_t(na)} wrote {k!r} = {a!r} ([:w {k!r} {a!r}]) and "
+                        f"{_t(nb)} overwrote it with {b!r} ([:w {k!r} {b!r}]) "
+                        f"in {k!r}'s version order"
+                    )
             pos_of = {v: i for i, v in enumerate(order)}
             for nd, v in readers.get(k, ()):
                 if v not in pos_of:
@@ -447,7 +467,10 @@ def rw_register_graph(
                     if nxt is not None and nxt.id != nd.id:
                         rw[nd.id, nxt.id] = True
                         expl[("rw", nd.id, nxt.id)] = (
-                            f"read {k!r} = {v!r}, overwritten by {order[pos + 1]!r}"
+                            f"{_t(nd)}'s read of {k!r} ([:r {k!r} {v!r}]) did "
+                            f"not observe {order[pos + 1]!r}, which {_t(nxt)} "
+                            f"wrote next in the version order "
+                            f"([:w {k!r} {order[pos + 1]!r}])"
                         )
 
     return TxnGraph(

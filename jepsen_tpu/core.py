@@ -23,6 +23,7 @@ reference's order (core.clj:327-406, call stack in SURVEY.md §3.1):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import Any, Mapping
 
@@ -131,18 +132,26 @@ def run_case(test: Mapping) -> list[dict]:
                 logger.exception("nemesis teardown failed")
 
 
-def analyze(test: Mapping) -> dict:
+def analyze(test: Mapping, *, capture: bool = True) -> dict:
     """Index the history, run the checker, store the results — the TPU
-    insertion point (core.clj:221-237, SURVEY.md §3.3)."""
+    insertion point (core.clj:221-237, SURVEY.md §3.3).
+
+    ``capture`` tees the harness log to the run's jepsen.log
+    (store.clj:436-464); run_test passes False because its own capture
+    already spans the analysis."""
     test = dict(test)
-    test["history"] = h.index(test.get("history") or [])
-    checker = test.get("checker")
-    if checker is not None:
-        results = chk.check_safe(checker, test, test["history"])
-    else:
-        results = {"valid?": True}
-    test["results"] = results
-    store.save_2(test)
+    cm = (
+        store.capture_logging(test) if capture else contextlib.nullcontext()
+    )
+    with cm:
+        test["history"] = h.index(test.get("history") or [])
+        checker = test.get("checker")
+        if checker is not None:
+            results = chk.check_safe(checker, test, test["history"])
+        else:
+            results = {"valid?": True}
+        test["results"] = results
+        store.save_2(test)
     return test
 
 
@@ -162,6 +171,14 @@ def run_test(test: Mapping) -> dict:
     """The whole lifecycle; returns the completed test map with :history
     and :results (core.clj:327-406)."""
     test = prepare_test(test)
+    with contextlib.ExitStack() as stack:
+        # Tee the whole run's log — setup through analysis — into the
+        # store dir (store.clj:436-464).
+        stack.enter_context(store.capture_logging(test))
+        return _run_test_captured(test)
+
+
+def _run_test_captured(test: dict) -> dict:
     store.save_0(test)
     logger.info("Running test %s/%s", test["name"], test["start-time-str"])
     with control.with_sessions(test):
@@ -194,6 +211,6 @@ def run_test(test: Mapping) -> dict:
                     control.on_nodes(test, os_.teardown)
             except Exception:  # noqa: BLE001
                 logger.exception("os teardown failed")
-    test = analyze(test)
+    test = analyze(test, capture=False)
     log_results(test)
     return test

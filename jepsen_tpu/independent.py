@@ -197,14 +197,22 @@ class IndependentChecker(Checker):
         keys = history_keys(history)
         opts = dict(opts or {})
 
-        def save_key(k, sub, res):
+        def save_key(k, sub, res, from_batch=False):
             try:
                 d = store.test_dir(test) / "independent" / str(k)
                 d.mkdir(parents=True, exist_ok=True)
                 store._write_json(d / "results.json", res)
                 store.write_history(d, sub)
             except (KeyError, OSError, TypeError):
-                pass  # no store configured (bare unit tests)
+                return  # no store configured (bare unit tests)
+            # Checkers with extra artifact output (e.g. elle's anomaly
+            # explanation dir) render per key too.  Only the batch path
+            # needs the hook: it skips the per-key check(), which writes
+            # its own artifacts on the fallback path.
+            if from_batch:
+                write = getattr(self.checker, "write_artifacts", None)
+                if write is not None:
+                    write(test, res, {**opts, "subdirectory": f"independent/{k}"})
 
         batch = None
         if hasattr(self.checker, "check_batch"):
@@ -223,7 +231,7 @@ class IndependentChecker(Checker):
             results = {}
             for k, sub, res in zip(keys, subs, batch):
                 results[k] = res
-                save_key(k, sub, res)
+                save_key(k, sub, res, from_batch=True)
         else:
 
             def check_key(k):

@@ -78,10 +78,12 @@ def frontier_update_fast(
          first, so truncation drops the most-speculative rows and
          witnesses survive longest) — only the ``capacity`` retained
          rows are ever gathered;
-      5. the engines run ``exact_prune`` (content-decided domination)
-         once per barrier, after the return filter, so dominated rows
-         bloat within a barrier but are reaped before they breed across
-         barriers.
+      5. dedup survivors compact into a 2*capacity buffer which is
+         ``exact_prune``d (content-decided domination) HERE, before
+         truncation — the single prune site: the returned frontier is a
+         duplicate-free antichain, and every subset the engines take of
+         it (truncation, the per-barrier return filter, the uniform
+         slot-bit clear) stays one, so no outer prune is needed.
 
     ``cost`` is accepted for signature parity with frontier_update but
     unused: candidate order already approximates cheapest-first (children
@@ -266,23 +268,16 @@ def frontier_update(state, fok, fcr, alive, cost, capacity: int, window: int = 1
 
 
 
-def exact_prune(state, fok, fcr, alive, chunk_rows: int = 0, order=None):
+def exact_prune(state, fok, fcr, alive, chunk_rows: int = 0):
     """Kill duplicate and dominated frontier rows, exactly.
 
     Row j dies when some alive row i has the same (state, fok) class with
     pointwise ≤ fired-crashed counts AND is either strictly smaller
-    somewhere or ranked before j by ``order`` (default: table index) —
-    ties keep the preferred copy.  The survivor set is the pointwise-
-    minimal antichain with one representative per duplicate group — exact
-    pruning, never changes the verdict (the survivor's futures are a
-    superset, see wgl_cpu domination notes).
-
-    ``order`` matters for the slot-table update: a duplicate of a live
-    row can land in a DIFFERENT (even lower-indexed) slot, and index
-    tie-breaking would then kill the OLD copy — equal content would
-    migrate between slots every round and the engines' no-growth
-    fixpoint would never fire.  Passing an age-aware order (old rows
-    first) pins the resident copy.
+    somewhere or earlier in the table — ties keep the first copy.  The
+    survivor set is the pointwise-minimal antichain with one
+    representative per duplicate group — exact pruning, never changes the
+    verdict (the survivor's futures are a superset, see wgl_cpu
+    domination notes).
 
     Chunked over the killed axis — via lax.scan, so the program size is
     constant however many chunks a wide buffer needs — to bound the
@@ -293,7 +288,7 @@ def exact_prune(state, fok, fcr, alive, chunk_rows: int = 0, order=None):
     g = fcr.shape[1]
     if chunk_rows <= 0:
         chunk_rows = min(f, max(16, (1 << 22) // max(1, f * g)))
-    idx = jnp.arange(f, dtype=jnp.int32) if order is None else order.astype(jnp.int32)
+    idx = jnp.arange(f, dtype=jnp.int32)
 
     def part(lo):
         st_c = jax.lax.dynamic_slice_in_dim(state, lo, chunk_rows)

@@ -56,7 +56,6 @@ from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.models import tensor as tmodels
 from jepsen_tpu.ops.hashing import (
-    exact_prune,
     frontier_update,
     frontier_update_fast,
 )
@@ -364,13 +363,13 @@ def _scan_chunk_core(
         if fast:
             # Closure terminates on the no-growth signal: no expansion
             # survived dedup ⟹ fixpoint (modulo the hash-dedup caveat
-            # covered by refutation confirmation).  The per-round dense
-            # domination prune keeps capacity holding the antichain
-            # instead of the closure's bloat.
+            # covered by refutation confirmation).  frontier_update_fast
+            # domination-prunes its own buffer, so its output is already
+            # an antichain — no outer prune (advisor r3: the double prune
+            # doubled the hot loop's prune cost for zero alive change).
             state2, fok2, fcr2, alive2, ovf, fp2, child = frontier_update_fast(
                 cat_state, cat_fok, cat_fcr, cat_alive, cost, F, n_parents=F
             )
-            alive2 = exact_prune(state2, fok2, fcr2, alive2)
             changed2 = (alive2 & child).any()
         else:
             state2, fok2, fcr2, alive2, ovf, fp2 = frontier_update(
@@ -405,11 +404,11 @@ def _scan_chunk_core(
             a3 = a2 & ((lane_vals & bitmask) != 0)
             clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
             fo3 = fo2 & ~clear[None, :]
-            if fast:
-                # The fast rounds skip domination pruning; reap once per
-                # barrier, after the return filter, so dominated rows can't
-                # breed across barriers.
-                a3 = exact_prune(s2, fo3, fc2, a3)
+            # fast path: the frontier is already an antichain (pruned
+            # inside frontier_update_fast), and the return filter keeps
+            # only rows holding the retiring bit, so the uniform clear
+            # preserves both distinctness and domination order — no
+            # per-barrier reap needed.
             dead = ~a3.any()
             failed2 = jnp.where(dead, b_idx, failed_at)
             peak2 = jnp.maximum(peak, a3.sum())
@@ -625,6 +624,12 @@ def chunked_analysis(
         while True:
             F = caps[idx]
             k = min(n_in, F)
+            # k < n_in: the carried frontier overflows this capacity
+            # (possible with a non-monotone ladder) and live configs are
+            # dropped — loss, IF this attempt's result is the one kept
+            # (retries re-slice the untruncated f_state, so a discarded
+            # lossy attempt loses nothing; latched after the loop).
+            trunc = k < n_in
             st0 = np.zeros(F, np.int32)
             fo0 = np.zeros((F, W), np.uint32)
             fc0 = np.zeros((F, G), np.int16)
@@ -646,6 +651,7 @@ def chunked_analysis(
                 idx += 1  # re-run THIS chunk wider, from the same frontier
                 continue
             break
+        lossy_any |= trunc  # input truncation of the ACCEPTED attempt
         stats = {
             "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": lossy or lossy_any,
             "chunks": len(bounds), "launches": launches,
@@ -794,12 +800,10 @@ def _run_core_async(
         s2, fo2, fc2, a2, ovf, _fp, child = frontier_update_fast(
             cat_state, cat_fok, cat_fcr, cat_alive, cost, F, n_parents=F
         )
-        # Reap dominated rows from the carried frontier every tick: the
-        # [F, F, G] dense pairwise prune costs ~0.6 ms/tick at bench
-        # shapes and keeps capacity holding the ANTICHAIN instead of the
-        # closure's domination bloat — measured +5 resolved histories at
-        # cap 128 on the headline batch for zero wall-clock change.
-        a2 = exact_prune(s2, fo2, fc2, a2)
+        # frontier_update_fast domination-prunes its own 2C buffer, so a2
+        # already marks a duplicate-free antichain (the "+5 resolved
+        # histories at cap 128" benefit lives there) — no outer prune
+        # (advisor r3: the doubled prune bought zero alive change).
         stable = ~(a2 & child).any()
         # At the fixpoint: only configs that fired the returning op
         # survive; its slot bit retires; the barrier pointer advances.
@@ -809,9 +813,9 @@ def _run_core_async(
         a3 = a2 & ((lane_vals & bitmask) != 0)
         clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
         fo3 = fo2 & ~clear[None, :]
-        # Domination reaping at the barrier boundary (the fast rounds
-        # only dedup); a3/fo3 are used only on the ticks that advance.
-        a3 = exact_prune(s2, fo3, fc2, a3)
+        # The return filter subsets an antichain and the uniform bit clear
+        # preserves it (all survivors held the bit), so a3/fo3 need no
+        # reaping; they are used only on the ticks that advance.
         adv = stable & ~done
         state2 = jnp.where(done, state, s2)
         fok2 = jnp.where(done[None], fok, jnp.where(adv, fo3, fo2))

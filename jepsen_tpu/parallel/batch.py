@@ -38,11 +38,15 @@ from jepsen_tpu.ops import wgl
 _CONFIRM_POOL: ProcessPoolExecutor | None = None
 
 
+def _default_workers(workers: int | None) -> int:
+    return workers or min(8, os.cpu_count() or 1)
+
+
 def _confirm_pool(workers: int | None) -> ProcessPoolExecutor:
     global _CONFIRM_POOL
     if _CONFIRM_POOL is None:
         _CONFIRM_POOL = ProcessPoolExecutor(
-            max_workers=workers or min(8, os.cpu_count() or 1),
+            max_workers=_default_workers(workers),
             mp_context=multiprocessing.get_context("spawn"),
             initializer=_confirm_worker.init,
         )
@@ -59,27 +63,34 @@ def _reset_confirm_pool() -> None:
 
 def warm_confirm_pool(workers: int | None = None) -> None:
     """Spawn the confirmation workers ahead of time (outside any timed
-    window): pool startup + worker init cost ~seconds once per process."""
-    pool = _confirm_pool(workers)
-    futs = [
-        pool.submit(_confirm_worker.probe_backend) for _ in range(pool._max_workers)
-    ]
-    for f in futs:
-        f.result()
+    window): pool startup + worker init cost ~seconds once per process.
+    Warm-up failure is non-fatal — batch_analysis degrades per history —
+    so a broken pool is dropped, never propagated."""
+    try:
+        pool = _confirm_pool(workers)
+        futs = [
+            pool.submit(_confirm_worker.probe_backend)
+            for _ in range(_default_workers(workers))
+        ]
+        for f in futs:
+            f.result()
+    except Exception:  # noqa: BLE001 — warm-up is best-effort by contract
+        _reset_confirm_pool()
 
 
 def _submit_confirmation(workers: int | None, *args):
     """Submit a confirmation, rebuilding the pool once if it is broken.
-    Returns None when no worker could take the job (the caller degrades
-    that one history, not the batch)."""
+    Returns (pool, future) — the pool handle lets the resolution loop
+    reset only the pool the failure actually came from — or (None, None)
+    when no worker could take the job (the caller degrades that one
+    history, not the batch)."""
     for _ in range(2):
         try:
-            return _confirm_pool(workers).submit(
-                _confirm_worker.confirm_refutation, *args
-            )
+            pool = _confirm_pool(workers)
+            return pool, pool.submit(_confirm_worker.confirm_refutation, *args)
         except BrokenProcessPool:
             _reset_confirm_pool()
-    return None
+    return None, None
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
@@ -289,11 +300,11 @@ def batch_analysis(
                     # principle have killed a distinct config, so the
                     # exact CPU sweep confirms it — in a worker
                     # process, concurrent with the remaining stages
-                    fut = _submit_confirmation(
+                    pool, fut = _submit_confirmation(
                         confirm_workers, model, list(histories[i]),
                         confirm_max_configs,
                     )
-                    confirm_futs[i] = (fut, res)
+                    confirm_futs[i] = (pool, fut, res)
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
@@ -313,14 +324,18 @@ def batch_analysis(
                 # frontier algorithm the kernel runs and degrades linearly.
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
 
-    for i, (fut, dev_res) in confirm_futs.items():
+    for i, (pool, fut, dev_res) in confirm_futs.items():
         try:
             if fut is None:
                 raise BrokenProcessPool("no confirmation worker available")
             cpu_res = fut.result()
         except Exception as e:  # noqa: BLE001 — a dead worker must not
-            # lose the other histories' verdicts; degrade this one only
-            if isinstance(e, BrokenProcessPool):
+            # lose the other histories' verdicts; degrade this one only.
+            # Reset only the pool the failure came from, and only while
+            # it is still installed: a stale future's error must not
+            # shut down a healthy rebuilt pool that other histories'
+            # confirmations are running on.
+            if isinstance(e, BrokenProcessPool) and pool is not None and pool is _CONFIRM_POOL:
                 _reset_confirm_pool()
             if cpu_fallback:
                 # the caller asked for CPU fallback on unknowns: confirm

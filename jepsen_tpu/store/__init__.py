@@ -21,6 +21,7 @@ crash never leaves a torn file.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import datetime as _dt
 import json
 import logging
@@ -289,3 +290,43 @@ def delete(name: str | None = None, store_dir=None):
     target = base / name if name else base
     if target.exists():
         shutil.rmtree(target)
+
+
+@_contextlib.contextmanager
+def capture_logging(test: Mapping, filename: str = "jepsen.log"):
+    """Tee the harness log to ``store/<name>/<time>/<filename>`` for the
+    duration (reference: jepsen/src/jepsen/store.clj:436-464 — unilog
+    writes the run's console log to jepsen.log so a stored run carries
+    its own post-mortem record; jepsen.web serves it).
+
+    The file captures INFO+ regardless of the console level; existing
+    handlers keep their previous effective threshold so console output
+    is unchanged.
+    """
+    d = test_dir(test)
+    d.mkdir(parents=True, exist_ok=True)
+    handler = logging.FileHandler(d / filename, encoding="utf-8")
+    handler.setLevel(logging.INFO)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)-7s [%(name)s] %(message)s")
+    )
+    root = logging.getLogger()
+    old_level = root.level
+    bumped: list[tuple[logging.Handler, int]] = []
+    if root.getEffectiveLevel() > logging.INFO:
+        # Lower the root gate so INFO records reach our handler, but pin
+        # the previous threshold onto the other handlers.
+        for hh in root.handlers:
+            if hh.level < root.getEffectiveLevel():
+                bumped.append((hh, hh.level))
+                hh.setLevel(root.getEffectiveLevel())
+        root.setLevel(logging.INFO)
+    root.addHandler(handler)
+    try:
+        yield d / filename
+    finally:
+        root.removeHandler(handler)
+        handler.close()
+        root.setLevel(old_level)
+        for hh, lvl in bumped:
+            hh.setLevel(lvl)

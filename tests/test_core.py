@@ -229,3 +229,23 @@ def test_web_home_and_files_and_zip(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_run_test_captures_jepsen_log(tmp_path):
+    """Every stored run carries its own harness log
+    (reference store.clj:436-464: unilog tees the console log to
+    store/<test>/jepsen.log; jepsen.web serves it)."""
+    completed = core.run_test(base_test(tmp_path))
+    d = store.test_dir(completed)
+    log = d / "jepsen.log"
+    assert log.exists()
+    text = log.read_text()
+    assert "Running test" in text  # setup-phase line
+    assert "Everything looks good" in text  # analysis-phase line
+
+    # Standalone analyze captures too (CLI analyze path).
+    loaded = store.latest(store_dir=completed["store-dir"])
+    loaded["store-dir"] = completed["store-dir"]
+    loaded["checker"] = None
+    core.analyze(loaded)
+    assert (store.test_dir(loaded) / "jepsen.log").exists()

@@ -143,7 +143,9 @@ def test_confirm_future_failure_degrades_to_unknown(monkeypatch):
             return ExplodingFuture()
 
     reset_calls = []
-    monkeypatch.setattr(pb, "_confirm_pool", lambda workers: ExplodingPool())
+    pool = ExplodingPool()
+    monkeypatch.setattr(pb, "_CONFIRM_POOL", pool)
+    monkeypatch.setattr(pb, "_confirm_pool", lambda workers: pool)
     monkeypatch.setattr(pb, "_reset_confirm_pool", lambda: reset_calls.append(1))
     hists, expect = histories_mixed(6)
     results = pb.batch_analysis(

@@ -98,3 +98,21 @@ def test_clock_plot_consumes_offsets(tmp_path):
     assert f.endswith("clock-skew.svg")
     svg = open(f).read()
     assert "n1" in svg and "n2" in svg
+
+
+def test_svg_escapes_titles_and_labels():
+    """Advisor r2 regression: test names / op :f keywords containing XML
+    metacharacters must not produce malformed SVG."""
+    import xml.etree.ElementTree as ET
+
+    from jepsen_tpu.checker.perf import SvgPlot
+
+    plot = SvgPlot('nasty <name> & "co"', "x <axis>", "y & axis")
+    plot.line("series <a> & b", [(0, 1), (1, 2)], "#123456")
+    plot.region(0.2, 0.5, "#B3BFFF", "kill <proc> & restart")
+    svg = plot.render()
+    root = ET.fromstring(svg)  # raises on malformed XML
+    texts = [t.text for t in root.iter("{http://www.w3.org/2000/svg}text")]
+    assert 'nasty <name> & "co"' in texts
+    assert "series <a> & b" in texts
+    assert "kill <proc> & restart" in texts

@@ -316,3 +316,31 @@ def test_fifo_queue_tensorization_gates():
     ])
     assert wgl.analysis(model, bad_hist, capacity=64)["valid?"] is False
 
+
+
+def test_chunked_carried_frontier_truncation_is_lossy():
+    """Advisor r3 regression (ops/wgl.py chunked_analysis): when the
+    carried frontier overflows the current chunk capacity (reachable with
+    a non-monotone ladder), dropping configs must count as loss — a later
+    dead frontier answers "unknown", never a sound-looking False.  Also a
+    general soundness sweep: chunked False verdicts must agree with the
+    exact CPU sweep."""
+    from genhist import corrupt, valid_register_history
+
+    from jepsen_tpu.checker import wgl_cpu
+
+    model = m.CASRegister(None)
+    for seed in range(6):
+        hist = valid_register_history(120, 6, seed=seed, info_rate=0.3)
+        if seed % 2:
+            hist = corrupt(hist, seed=seed)
+        # Adversarial decreasing ladder + tiny chunks: a chunk that
+        # escalates to 64 can hand >8 rows to a retry at 8.
+        r = wgl.analysis(model, hist, capacity=(64, 8), chunk_barriers=8)
+        if r["valid?"] is False:
+            assert r["kernel"]["lossy?"] is False  # False only when lossless
+            c = wgl_cpu.sweep_analysis(model, hist)
+            assert c["valid?"] is False, (seed, r, c)
+        elif r["valid?"] is True:
+            c = wgl_cpu.sweep_analysis(model, hist)
+            assert c["valid?"] is True, (seed, r, c)
