@@ -40,16 +40,22 @@ def init() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def confirm_refutation(model, history, max_configs: int) -> dict:
+def confirm_refutation(
+    model, history, max_configs: int, stop_at_index: int | None = None
+) -> dict:
     """Exact CPU config-set sweep over one refuted history.
 
     The sweep's kills are content-decided, so its verdict is exact; it
     confirms (or, in the ~1e-13 hash-collision case, overturns) a fast
-    device engine's provisional refutation.
+    device engine's provisional refutation.  ``stop_at_index`` bounds the
+    sweep to the prefix ending at the device's failure barrier — a
+    genuine refutation dies by there, so the suffix is never swept.
     """
     from jepsen_tpu.checker import wgl_cpu
 
-    return wgl_cpu.sweep_analysis(model, history, max_configs=max_configs)
+    return wgl_cpu.sweep_analysis(
+        model, history, max_configs=max_configs, stop_at_index=stop_at_index
+    )
 
 
 def probe_backend() -> dict:

@@ -127,9 +127,16 @@ def _shortest_path(adj: np.ndarray, src: int, dst: int) -> list[int] | None:
 
 
 def _find_cycle_through_edge(
-    graph_adj: np.ndarray, a: int, b: int
+    graph_adj: np.ndarray, a: int, b: int, edge_adj: np.ndarray | None = None
 ) -> list[int] | None:
-    """A cycle using edge a→b: b→a path + the edge."""
+    """A cycle using edge a→b: b→a path (over ``graph_adj``) + the edge.
+
+    The hinted edge must exist host-side in ``edge_adj`` (default: the
+    path graph; G-single/G2 pass the rw matrix since their edge is not in
+    the return-path graph) — a stale device hint must surface as
+    unwitnessed, never as a fabricated cycle."""
+    if not (edge_adj if edge_adj is not None else graph_adj)[a, b]:
+        return None
     back = _shortest_path(graph_adj, b, a)
     if back is None:
         return None
@@ -204,14 +211,14 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
                 anomalies.setdefault("G0", []).append(_explain_cycle(g, cyc))
             else:
                 unwitnessed.append("G0")
-        for name, graph_adj, gate in (
-            ("G1c", any_adj, True),
-            ("G-single", any_adj, True),
-            ("G2", full_adj, not flags["G-single"]),
+        for name, graph_adj, edge_adj, gate in (
+            ("G1c", any_adj, any_adj, True),
+            ("G-single", any_adj, g.rw, True),
+            ("G2", full_adj, g.rw, not flags["G-single"]),
         ):
             if flags[name] and gate and name in wanted:
                 cyc = (
-                    _find_cycle_through_edge(graph_adj, *hints[name])
+                    _find_cycle_through_edge(graph_adj, *hints[name], edge_adj=edge_adj)
                     if hints[name]
                     else None
                 )
@@ -352,7 +359,19 @@ def write_anomaly_dir(test, result: Mapping, opts=None, dirname: str = "elle"):
 DEFAULT_ANOMALIES = ["G2", "G1a", "G1b", "internal"]  # tests/cycle/wr.clj:46
 
 
-class ListAppendChecker(Checker):
+class _ElleChecker(Checker):
+    """Shared artifact plumbing for the elle-style checkers."""
+
+    def write_artifacts(self, test, result, opts=None):
+        """Render the elle/ anomaly-explanation directory for a stored
+        run (called per key by independent.checker on the batch path)."""
+        try:
+            write_anomaly_dir(test, result, opts)
+        except OSError:
+            pass
+
+
+class ListAppendChecker(_ElleChecker):
     """Native elle.list-append equivalent (tests/cycle/append.clj:11-22).
 
     Options:
@@ -377,14 +396,6 @@ class ListAppendChecker(Checker):
         self.write_artifacts(test, res, opts)
         return res
 
-    def write_artifacts(self, test, result, opts=None):
-        """Render the elle/ anomaly-explanation directory for a stored
-        run (called per key by independent.checker on the batch path)."""
-        try:
-            write_anomaly_dir(test, result, opts)
-        except OSError:
-            pass
-
     def check_batch(self, test, histories, opts):
         """Check many subhistories in batched device launches (used by
         independent.checker — one vmapped kernel per size bucket)."""
@@ -392,7 +403,7 @@ class ListAppendChecker(Checker):
         return check_graphs(graphs, self.anomalies)
 
 
-class WRRegisterChecker(Checker):
+class WRRegisterChecker(_ElleChecker):
     """Native elle.rw-register equivalent (tests/cycle/wr.clj:15-46)."""
 
     def __init__(
@@ -419,13 +430,6 @@ class WRRegisterChecker(Checker):
         res = check_graph(self._graph(history), self.anomalies)
         self.write_artifacts(test, res, opts)
         return res
-
-    def write_artifacts(self, test, result, opts=None):
-        """See ListAppendChecker.write_artifacts."""
-        try:
-            write_anomaly_dir(test, result, opts)
-        except OSError:
-            pass
 
     def check_batch(self, test, histories, opts):
         """Batched per-key form (see ListAppendChecker.check_batch)."""

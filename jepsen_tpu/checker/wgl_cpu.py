@@ -265,10 +265,18 @@ def sweep_analysis(
     model: m.Model,
     history: Sequence[dict],
     max_configs: int = 200_000,
+    stop_at_index: int | None = None,
 ) -> dict:
     """Exhaustive configuration-set sweep with domination pruning — the
     algorithm the TPU kernel vectorizes (jepsen_tpu.ops.wgl), kept on CPU
-    as its differential-testing oracle."""
+    as its differential-testing oracle.
+
+    ``stop_at_index`` bounds a refutation-confirmation run to the prefix
+    ending at the device's failure barrier (the returning op's history
+    index): a genuine refutation dies by that barrier, so sweeping past
+    it is wasted work.  Surviving past it means the device refutation was
+    a hash-collision artifact — returned as "unknown" (the prefix proves
+    nothing about the suffix)."""
     events, eff_ops, crashed = prepare(model, history)
     barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
 
@@ -328,6 +336,15 @@ def sweep_analysis(
                     {"model": st, "pending": sorted(set(open_ok) - fok)}
                     for (st, fok) in list(seen)[:10]
                 ],
+            }
+        if stop_at_index is not None and i == stop_at_index:
+            # Barriers are ordered by return position, not op id, so the
+            # bound is the IDENTITY of the device's failure barrier (both
+            # sides name it by the returning op's history index).
+            return {
+                "valid?": "unknown",
+                "cause": "confirmation prefix survived past the device failure point",
+                "op": history[i],
             }
     return {"valid?": True, "configs": [{"model": st} for (st, _fok) in list(configs)[:10]]}
 

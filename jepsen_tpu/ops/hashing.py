@@ -52,7 +52,7 @@ def hash_rows(columns, seed: int):
 
 def frontier_update_fast(
     state, fok, fcr, alive, cost, capacity: int, window: int = 4,
-    n_parents: int | None = None,
+    n_parents: int | None = None, max_count: int | None = None,
 ):
     """Frontier dedup + truncation, tuned for the vmapped batch kernel.
 
@@ -100,6 +100,13 @@ def frontier_update_fast(
     engines advance a barrier after ONE tick when its closure is already
     complete instead of burning a second fingerprint-compare tick.
 
+    ``max_count``: a static upper bound on any fired-crashed group count
+    (callers pass the mover-table size).  When given, the buffer prune
+    runs as ``exact_prune_mxu`` — the same content-decided antichain, but
+    with the pairwise pointwise-≤ test as one bf16 matmul on the MXU
+    instead of O(C²·G) vector compares (the wide-capacity tick's
+    dominant cost).
+
     Returns (state', fok', fcr', alive', overflowed, fp, child) — fp is
     an order-insensitive content fingerprint of the surviving set
     (diagnostic only).
@@ -110,15 +117,15 @@ def frontier_update_fast(
     row_cols = [state] + [fok[:, k] for k in range(w)] + [fcr[:, k] for k in range(g)]
     h1 = hash_rows(row_cols, 0xB00B_135)
     h2 = hash_rows(row_cols, 0x1CEB_00DA)
-    key = jnp.where(alive, h1, jnp.uint32(0xFFFFFFFF))
     iota = jnp.arange(n, dtype=jnp.int32)
     # alive rides in the payload's top bit so a sentinel-colliding hash
     # can't resurrect or kill anything.
     payload = jnp.where(alive, iota, iota + jnp.int32(1 << 30))
+    pos = jnp.arange(n)
+    key = jnp.where(alive, h1, jnp.uint32(0xFFFFFFFF))
     k1, k2, spay = jax.lax.sort((key, h2, payload), num_keys=1)
     al = spay < (1 << 30)
     sidx = spay & ((1 << 30) - 1)
-    pos = jnp.arange(n)
     dup = jnp.zeros(n, bool)
     for k in range(1, window + 1):
         same = (
@@ -154,7 +161,10 @@ def frontier_update_fast(
     bfc = fcr[srcB]
     balive = jnp.arange(Cb) < jnp.minimum(n_keep0, Cb)
     spill = n_keep0 > Cb
-    balive = exact_prune(bst, bfo, bfc, balive)
+    if max_count is not None:
+        balive = exact_prune_mxu(bst, bfo, bfc, balive, max_count)
+    else:
+        balive = exact_prune(bst, bfo, bfc, balive)
     rank2 = jnp.cumsum(balive) - 1
     n_keep = jnp.maximum(rank2[-1] + 1, 0)
     pos3 = jnp.where(balive, rank2, capacity + jnp.arange(Cb))
@@ -174,6 +184,44 @@ def frontier_update_fast(
         child = srcB[src2] >= n_parents
     fp = _fingerprint(kst, kfo, kfc, new_alive, w, g)
     return kst, kfo, kfc, new_alive, overflowed, fp, child
+
+
+def exact_prune_mxu(state, fok, fcr, alive, max_count: int):
+    """exact_prune with the pairwise pointwise-≤ test recast as a matmul.
+
+    The dense prune's cost is the [N, N, G] count comparison — vector-unit
+    work that dominates wide-capacity ticks (13.6 s vs 4.0 s pruneless on
+    the cap-2048 straggler stage).  The MXU formulation: encode each
+    row's fired-crashed counts as a cumulative one-hot u[k, c] =
+    (fcr[k] ≤ c) and an exact one-hot v[k, c] = (fcr[k] == c), both
+    [N, G·M] with M = ``max_count``; then (u @ vᵀ)[i, j] counts the
+    groups where fcr_i ≤ fcr_j, and == G ⟺ pointwise ≤.  One bf16
+    matmul (values ≤ G, exact in bf16) replaces the O(N²·G) compare;
+    class equality and tie-breaking stay content-decided, so the result
+    is bit-identical to exact_prune whenever every count < ``max_count``
+    (the callers pass the static mover-table size, a hard upper bound).
+    """
+    n = state.shape[0]
+    g = fcr.shape[1]
+    c = jnp.arange(max_count, dtype=fcr.dtype)
+    u = (fcr[:, :, None] <= c[None, None, :]).reshape(n, g * max_count)
+    v = (fcr[:, :, None] == c[None, None, :]).reshape(n, g * max_count)
+    cnt = jnp.dot(
+        u.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16).T,
+        preferred_element_type=jnp.float32,
+    )
+    le = cnt > g - 0.5  # le[i, j]: fcr_i ≤ fcr_j pointwise
+    same = state[:, None] == state[None, :]
+    for k in range(fok.shape[1]):
+        col = fok[:, k]
+        same &= col[:, None] == col[None, :]
+    idx = jnp.arange(n)
+    earlier = idx[:, None] < idx[None, :]
+    killer = (
+        same & le & (~le.T | earlier) & alive[:, None] & alive[None, :]
+    )
+    return alive & ~killer.any(axis=0)
 
 
 def _fingerprint(kst, kfo, kfc, new_alive, w, g):

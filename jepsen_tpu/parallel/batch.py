@@ -236,7 +236,7 @@ def batch_analysis(
             ]
         W = (P + 31) // 32
         if st_engine == "async":
-            T = wgl.async_ticks(B)
+            T = wgl.async_ticks(B, batch_cap)
             n_actives = np.array([p["bar_active"].sum() for p in sub], np.int32)
             if n_pad != n:
                 n_actives = np.concatenate([n_actives, np.repeat(n_actives[-1:], n_pad - n)])
@@ -289,7 +289,8 @@ def batch_analysis(
             if failed_at[j] < 0 and valid[j]:
                 results[i] = {"valid?": True, "kernel": stats}
             elif failed_at[j] >= 0 and not lossy[j]:
-                op = histories[i][int(packs[k]["bar_opid"][int(failed_at[j])])]
+                op_pos = int(packs[k]["bar_opid"][int(failed_at[j])])
+                op = histories[i][op_pos]
                 res = {"valid?": False, "op": op, "kernel": stats}
                 if st_engine == "exact" or not confirm_refutations:
                     # content-decided kills (or the caller opted out):
@@ -299,10 +300,12 @@ def batch_analysis(
                     # fast-engine refutation: hash-dedup could in
                     # principle have killed a distinct config, so the
                     # exact CPU sweep confirms it — in a worker
-                    # process, concurrent with the remaining stages
+                    # process, concurrent with the remaining stages.
+                    # op_pos (the positional id, same identity the sweep
+                    # enumerates) bounds the sweep to the failure prefix.
                     pool, fut = _submit_confirmation(
                         confirm_workers, model, list(histories[i]),
-                        confirm_max_configs,
+                        confirm_max_configs, op_pos,
                     )
                     confirm_futs[i] = (pool, fut, res)
                     results[i] = res  # placeholder; resolved below
@@ -360,7 +363,10 @@ def batch_analysis(
         else:
             results[i] = {
                 "valid?": "unknown",
-                "cause": "device refutation; exact confirmation exceeded budget",
+                "cause": (
+                    "device refutation; exact confirmation inconclusive: "
+                    + str(cpu_res.get("cause", "budget exceeded"))
+                ),
                 "kernel": dev_res.get("kernel"),
             }
     return [r if r is not None else {"valid?": "unknown"} for r in results]

@@ -344,3 +344,25 @@ def test_chunked_carried_frontier_truncation_is_lossy():
         elif r["valid?"] is True:
             c = wgl_cpu.sweep_analysis(model, hist)
             assert c["valid?"] is True, (seed, r, c)
+
+
+def test_exact_prune_mxu_matches_dense():
+    """The MXU (matmul pointwise-<=) prune must be bit-identical to the
+    dense exact_prune whenever counts < max_count."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from jepsen_tpu.ops.hashing import exact_prune, exact_prune_mxu
+
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        n = int(rng.integers(4, 200))
+        g = int(rng.integers(1, 9))
+        w = int(rng.integers(1, 3))
+        state = jnp.asarray(rng.integers(0, 4, n), jnp.int32)
+        fok = jnp.asarray(rng.integers(0, 3, (n, w)), jnp.uint32)
+        fcr = jnp.asarray(rng.integers(0, 5, (n, g)), jnp.int16)
+        alive = jnp.asarray(rng.random(n) < 0.8)
+        a = np.asarray(exact_prune(state, fok, fcr, alive))
+        b = np.asarray(exact_prune_mxu(state, fok, fcr, alive, max_count=6))
+        assert (a == b).all(), (trial, np.flatnonzero(a != b))

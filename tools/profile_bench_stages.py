@@ -1,0 +1,58 @@
+"""Where does the headline bench's steady-state time go?
+
+Replays bench.py's exact workload through batch_analysis with variant
+kwargs to isolate the ladder stages and the confirmation drain.  Run on
+the real chip.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+CAPS = (128, 512, 2048)
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+
+    pbatch.warm_confirm_pool()
+
+    t0 = time.perf_counter()
+    packs = [wgl.pack(model, hh) for hh in hists]
+    print(f"{'pack x128 (host)':42s} {(time.perf_counter()-t0)*1e3:8.1f} ms")
+
+    for label, kw in [
+        ("cap128 only", dict(capacity=(128,))),
+        ("cap128+512", dict(capacity=(128, 512))),
+        ("full ladder + confirm", dict(capacity=CAPS)),
+        ("full ladder, no confirmations", dict(capacity=CAPS, confirm_refutations=False)),
+    ]:
+        kw.setdefault("cpu_fallback", False)
+        kw.setdefault("exact_escalation", ())
+        pbatch.batch_analysis(model, hists, **kw)  # warm compile
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rs = pbatch.batch_analysis(model, hists, **kw)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        unk = sum(1 for r in rs if r["valid?"] == "unknown")
+        print(f"{label:42s} {best*1e3:8.1f} ms  unknowns={unk}")
+
+
+if __name__ == "__main__":
+    main()

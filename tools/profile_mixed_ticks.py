@@ -1,0 +1,73 @@
+"""Mixed tick budgets: narrow ladder stages get a short budget (they
+either converge fast or escalate anyway); the wide stage keeps the deep
+one. Also: does an even deeper wide budget resolve the last unknowns?"""
+import sys, time
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+
+    import jepsen_tpu.parallel.batch as b
+    orig = wgl.async_ticks
+    mode = sys.argv[1] if len(sys.argv) > 1 else "mixed"
+    if mode == "mixed":
+        # narrow stages 3B/2+32; wide (>=1024) stages 2B+64
+        current_cap = [0]
+        def ticks(B):
+            return (2*B + 64) if current_cap[0] >= 1024 else ((3*B)//2 + 32)
+        wgl.async_ticks = ticks
+        # intercept _launch's capacity via batch_analysis wrapper: patch
+        # async_runner call path instead — simplest: wrap batch_analysis
+        # per-stage by running stages manually
+        kwset = [((128,), False), ((512,), False), ((2048,), True)]
+        def run():
+            pending = hists
+            results = {}
+            for caps, wide in kwset:
+                current_cap[0] = caps[0]
+                rs = b.batch_analysis(model, pending, capacity=caps,
+                                      cpu_fallback=False, exact_escalation=(),
+                                      confirm_refutations=False)
+                nxt = []
+                for hh, r in zip(pending, rs):
+                    if r["valid?"] == "unknown":
+                        nxt.append(hh)
+                    else:
+                        results[id(hh)] = r
+                pending = nxt
+            return results, pending
+        run()  # warm
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter(); _res, pend = run()
+            best = min(best or 9e9, time.perf_counter() - t0)
+        print(f"mixed ticks: {best*1e3:8.1f} ms  unknowns={len(pend)}")
+    else:  # deep wide stage
+        wgl.async_ticks = lambda B: 4*B + 128
+        base = b.batch_analysis(model, hists, capacity=(128, 512),
+                                cpu_fallback=False, exact_escalation=(),
+                                confirm_refutations=False)
+        # restore default for first two stages; only measure final stage depth
+        strag = [hh for hh, r in zip(hists, base) if r["valid?"] == "unknown"]
+        rs = b.batch_analysis(model, strag, capacity=(2048,), cpu_fallback=False,
+                              exact_escalation=(), confirm_refutations=False)
+        unk = sum(1 for r in rs if r["valid?"] == "unknown")
+        print(f"wide stage T=4B+128: unknowns={unk} of {len(strag)}")
+    wgl.async_ticks = orig
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""How much of the wide-capacity async stage is the dense buffer prune?
+
+Runs the 10-straggler cap-2048 stage twice: with frontier_update_fast's
+internal exact_prune as-is, and with it stubbed to identity (soundness
+irrelevant here — this is a cost ablation; dominated bloat may change
+verdicts/overflow, we only read the wall clock).
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import hashing
+from jepsen_tpu.parallel import batch as pbatch
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+    base = pbatch.batch_analysis(
+        model, hists, capacity=(128, 512), cpu_fallback=False,
+        exact_escalation=(), confirm_refutations=False,
+    )
+    strag = [hh for hh, r in zip(hists, base) if r["valid?"] == "unknown"]
+    print(f"{len(strag)} stragglers")
+
+    if "--no-prune" in sys.argv:
+        hashing.exact_prune = lambda s, f, c, a, chunk_rows=0: a
+        label = "cap2048, prune OFF"
+    else:
+        label = "cap2048, prune ON"
+
+    def stage():
+        return pbatch.batch_analysis(
+            model, strag, capacity=(2048,), cpu_fallback=False,
+            exact_escalation=(), confirm_refutations=False)
+
+    rs = stage()
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        rs = stage()
+        best = min(best or 9e9, time.perf_counter() - t0)
+    unk = sum(1 for r in rs if r["valid?"] == "unknown")
+    print(f"{label:42s} {best*1e3:8.1f} ms  unknowns={unk}")
+
+
+if __name__ == "__main__":
+    main()

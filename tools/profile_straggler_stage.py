@@ -1,0 +1,73 @@
+"""What's the cheapest way to resolve the ladder's stragglers?
+
+Isolates the histories still unknown after (128, 512) and times variant
+final stages: async/sync engines at 1024/2048/4096, and per-history
+chunked_analysis with carried frontiers.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+
+    base = pbatch.batch_analysis(
+        model, hists, capacity=(128, 512), cpu_fallback=False,
+        exact_escalation=(), confirm_refutations=False,
+    )
+    strag = [hh for hh, r in zip(hists, base) if r["valid?"] == "unknown"]
+    print(f"{len(strag)} stragglers after (128, 512)")
+
+    which = sys.argv[1:] or None
+    for label, fn in [
+        ("async cap1024 (batched)", lambda: pbatch.batch_analysis(
+            model, strag, capacity=(1024,), cpu_fallback=False,
+            exact_escalation=(), confirm_refutations=False)),
+        ("async cap2048 (batched)", lambda: pbatch.batch_analysis(
+            model, strag, capacity=(2048,), cpu_fallback=False,
+            exact_escalation=(), confirm_refutations=False)),
+        ("sync cap2048 (batched)", lambda: pbatch.batch_analysis(
+            model, strag, capacity=(2048,), cpu_fallback=False,
+            exact_escalation=(), confirm_refutations=False, engine="sync")),
+        ("async cap4096 (batched)", lambda: pbatch.batch_analysis(
+            model, strag, capacity=(4096,), cpu_fallback=False,
+            exact_escalation=(), confirm_refutations=False)),
+        ("chunked (512,2048,4096) cb=16 per hist", lambda: [
+            wgl.analysis(model, hh, capacity=(512, 2048, 4096), chunk_barriers=16)
+            for hh in strag]),
+        ("chunked (512,2048,4096) cb=8 per hist", lambda: [
+            wgl.analysis(model, hh, capacity=(512, 2048, 4096), chunk_barriers=8)
+            for hh in strag]),
+    ]:
+        if which and not any(w in label for w in which):
+            continue
+        rs = fn()  # warm
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            rs = fn()
+            best = min(best or 9e9, time.perf_counter() - t0)
+        unk = sum(1 for r in rs if r["valid?"] == "unknown")
+        print(f"{label:42s} {best*1e3:8.1f} ms  unknowns={unk}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""How does the async tick budget trade wall clock vs verdicts?
+
+The vmapped while_loop runs until EVERY lane is done — straggler lanes
+(lossy ones grinding toward a True-with-loss or the budget) dictate the
+stage. Sweep the budget multiplier and watch time + unknowns.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch as pbatch
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+
+    orig = wgl.async_ticks
+    which = sys.argv[1:]
+    for label, fn in [
+        ("T=2B+64 (default)", orig),
+        ("T=B+32", lambda B: B + 32),
+        ("T=3B/2+32", lambda B: (3 * B) // 2 + 32),
+        ("T=3B+64", lambda B: 3 * B + 64),
+    ]:
+        if which and not any(w in label for w in which):
+            continue
+        wgl.async_ticks = fn
+        kw = dict(capacity=(128, 512, 2048), cpu_fallback=False,
+                  exact_escalation=(), confirm_refutations=False)
+        pbatch.batch_analysis(model, hists, **kw)
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            rs = pbatch.batch_analysis(model, hists, **kw)
+            best = min(best or 9e9, time.perf_counter() - t0)
+        unk = sum(1 for r in rs if r["valid?"] == "unknown")
+        print(f"{label:42s} {best*1e3:8.1f} ms  unknowns={unk}")
+    wgl.async_ticks = orig
+
+
+if __name__ == "__main__":
+    main()
