@@ -183,6 +183,116 @@ class EtcdClient(client.Client):
 
 
 # ---------------------------------------------------------------------------
+# Localhost mode: 3 members on distinct 127.0.0.1 ports, no ssh/docker
+# (VERDICT r3 item 4 — the zookeeper.clj shape with the cluster's network
+# collapsed onto one machine; everything else is the same harness)
+# ---------------------------------------------------------------------------
+
+LOCAL_BASE = "/tmp/jepsen-etcd"
+LOCAL_CLIENT_PORT = 12379
+LOCAL_PEER_PORT = 12380
+
+
+def local_ports(test, node) -> tuple[int, int]:
+    i = list(test["nodes"]).index(node)
+    return LOCAL_CLIENT_PORT + 10 * i, LOCAL_PEER_PORT + 10 * i
+
+
+def local_initial_cluster(test) -> str:
+    return ",".join(
+        f"{n}=http://127.0.0.1:{local_ports(test, n)[1]}" for n in test["nodes"]
+    )
+
+
+class EtcdLocalDB(EtcdDB):
+    """etcd members on localhost ports (run with ``ssh: {local?: True}``).
+
+    The binary comes from ``test["etcd-bin"]`` (or PATH); installation
+    from the release tarball still works when the node has egress."""
+
+    def _paths(self, node):
+        d = f"{LOCAL_BASE}/{node}"
+        return {"dir": d, "data": f"{d}/data", "pid": f"{d}/etcd.pid",
+                "log": f"{d}/etcd.log"}
+
+    def _binary(self, test, session) -> str:
+        import shutil as _shutil
+
+        binary = test.get("etcd-bin")
+        if binary and cu.exists(session, binary):
+            return binary
+        on_path = _shutil.which("etcd")
+        if on_path:
+            return on_path
+        # Tarball fallback lands under LOCAL_BASE: localhost mode must
+        # not need root for /opt.
+        local_dir = f"{LOCAL_BASE}/dist"
+        if not cu.exists(session, f"{local_dir}/etcd"):
+            cu.install_archive(session, test.get("etcd-url", URL), local_dir)
+        return f"{local_dir}/etcd"
+
+    def setup(self, test, node, session):
+        p = self._paths(node)
+        session.exec("mkdir", "-p", p["data"])
+        self.start(test, node, session)
+        cu.await_tcp_port(session, local_ports(test, node)[0], timeout=60)
+
+    def teardown(self, test, node, session):
+        self.kill(test, node, session)
+        session.exec_result("rm", "-rf", self._paths(node)["dir"])
+
+    def start(self, test, node, session):
+        p = self._paths(node)
+        cport, pport = local_ports(test, node)
+        return cu.start_daemon(
+            session,
+            self._binary(test, session),
+            "--name", node,
+            "--data-dir", p["data"],
+            "--listen-client-urls", f"http://127.0.0.1:{cport}",
+            "--advertise-client-urls", f"http://127.0.0.1:{cport}",
+            "--listen-peer-urls", f"http://127.0.0.1:{pport}",
+            "--initial-advertise-peer-urls", f"http://127.0.0.1:{pport}",
+            "--initial-cluster", local_initial_cluster(test),
+            "--initial-cluster-state", "new",
+            pidfile=p["pid"],
+            logfile=p["log"],
+        )
+
+    def kill(self, test, node, session):
+        p = self._paths(node)
+        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=10)
+        cu.grepkill(session, f"--name {node} --data-dir {p['data']}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [self._paths(node)["log"]]
+
+
+class EtcdLocalClient(EtcdClient):
+    """The same v3 gateway client, addressed at the node's local port."""
+
+    def open(self, test, node):
+        cport, _ = local_ports(test, node)
+        return type(self)(f"http://127.0.0.1:{cport}", self.timeout)
+
+
+def etcd_local_test(opts) -> dict:
+    """etcd_test wired for a localhost cluster: kill faults only (there
+    is no per-node network to partition on one machine)."""
+    return etcd_test({
+        "name": "etcd-local",
+        "faults": ["kill"],
+        "interval": opts.get("interval", 3),
+        "time-limit": opts.get("time-limit", 20),
+        "db": EtcdLocalDB(),
+        "client": EtcdLocalClient(),
+        **opts,
+        "ssh": {"local?": True},
+    })
+
+
+# ---------------------------------------------------------------------------
 # Test map + CLI
 # ---------------------------------------------------------------------------
 
@@ -199,7 +309,7 @@ def rand_op():
 
 
 def etcd_test(opts) -> dict:
-    db = EtcdDB()
+    db = opts.get("db") or EtcdDB()
     pkg = nc.nemesis_package(
         {
             "faults": opts.get("faults", ["kill", "partition"]),
@@ -210,9 +320,9 @@ def etcd_test(opts) -> dict:
     )
     time_limit = opts.get("time-limit", 60)
     t = testkit.noop_test(
-        name="etcd",
+        name=opts.get("name", "etcd"),
         db=db,
-        client=EtcdClient(),
+        client=opts.get("client") or EtcdClient(),
         nemesis=pkg.nemesis,
         generator=gen.phases(
             gen.any_gen(

@@ -1,0 +1,223 @@
+"""A queue-system harness: total-queue/drain over live processes.
+
+The rebuild's rabbitmq.clj (reference: rabbitmq/src/jepsen/rabbitmq.clj —
+enqueue/dequeue workload, a final draining read per channel, total-queue
+multiset accounting): one queue_server.py process per node, a kill-fault
+nemesis, and the drain-expansion + total-queue checker family — the
+checker family the register harnesses never exercise.
+
+Two modes prove the harness finds real bugs:
+
+  * ``durable=True``  — shared fsync'd journal; kill -9 loses nothing;
+    the test should pass.
+  * ``durable=False`` — per-process RAM queues; acknowledged enqueues die
+    with the process; total-queue must report them ``lost``.
+
+Run it (single machine, real processes):
+
+  python -m examples.queue test --local --time-limit 8 --concurrency 6
+"""
+
+from __future__ import annotations
+
+import socket
+from pathlib import Path
+
+from jepsen_tpu import cli, client, generator as gen, testkit
+from jepsen_tpu import db as jdb
+from jepsen_tpu.checker import compose, stats
+from jepsen_tpu.checker.basic import total_queue
+from jepsen_tpu.checker.perf import perf
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined as nc
+
+SERVER_SRC = Path(__file__).resolve().parent / "queue_server.py"
+BASE = "/tmp/jepsen-queue"
+BASE_PORT = 7801
+
+
+def node_port(test, node) -> int:
+    return BASE_PORT + list(test["nodes"]).index(node)
+
+
+class QueueDB(jdb.DB):
+    """One queue_server.py per node (db.clj lifecycle; Process capability
+    drives the kill nemesis package)."""
+
+    def __init__(self, durable: bool = True):
+        self.durable = durable
+
+    def _paths(self, node):
+        d = f"{BASE}/{node}"
+        return {
+            "dir": d,
+            "server": f"{d}/server.py",
+            "pid": f"{d}/queue.pid",
+            "log": f"{d}/queue.log",
+            "data": f"{BASE}/shared-journal",
+        }
+
+    def setup(self, test, node, session):
+        p = self._paths(node)
+        session.exec("mkdir", "-p", p["dir"])
+        session.write_file(SERVER_SRC.read_text(), p["server"])
+        self.start(test, node, session)
+        cu.await_tcp_port(session, node_port(test, node), timeout=30)
+
+    def teardown(self, test, node, session):
+        self.kill(test, node, session)
+        session.exec_result("rm", "-rf", self._paths(node)["dir"])
+        session.exec_result("bash", "-c", f"rm -f {self._paths(node)['data']}*")
+
+    def start(self, test, node, session):
+        p = self._paths(node)
+        args = ["python3", p["server"], "--port", str(node_port(test, node)),
+                "--data", p["data"]]
+        if self.durable:
+            args.append("--durable")
+        return cu.start_daemon(session, *args, pidfile=p["pid"], logfile=p["log"])
+
+    def kill(self, test, node, session):
+        p = self._paths(node)
+        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=5)
+        cu.grepkill(session, f"server.py --port {node_port(test, node)}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [self._paths(node)["log"]]
+
+
+class QueueClient(client.Client):
+    """Line-protocol queue client.  Raising from invoke becomes :info
+    (indeterminate) via the interpreter — an enqueue cut off by a kill
+    stays an attempt, never a false ack."""
+
+    reusable = False
+
+    def __init__(self, sock=None):
+        self.sock = sock
+        self.rfile = None
+
+    def open(self, test, node):
+        # Await the endpoint: a freshly restarted node needs a beat to
+        # listen, and the total-queue checker cannot account a crashed
+        # drain — connects retry so drains always land on a live server.
+        import time
+
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", node_port(test, node)), timeout=5
+                )
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+        s.settimeout(5)
+        c = type(self)(s)  # subclass-friendly: variants survive reopen
+        c.rfile = s.makefile("r")
+        return c
+
+    def _round(self, line: str) -> str:
+        self.sock.sendall((line + "\n").encode())
+        reply = self.rfile.readline().strip()
+        if not reply:
+            raise ConnectionError("server closed connection")
+        if reply.startswith("err"):
+            raise RuntimeError(f"queue error reply: {reply!r}")
+        return reply
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "enqueue":
+            if self._round(f"E {op['value']}") != "ok":
+                raise RuntimeError("unexpected enqueue reply")
+            return {**op, "type": "ok"}
+        if f == "dequeue":
+            reply = self._round("D")
+            if reply == "v nil":
+                return {**op, "type": "fail"}  # empty: definitely nothing taken
+            return {**op, "type": "ok", "value": int(reply.split()[1])}
+        if f == "drain":
+            reply = self._round("DRAIN")
+            body = reply[3:].strip()
+            vs = [int(x) for x in body.split(",")] if body else []
+            return {**op, "type": "ok", "value": vs}
+        raise ValueError(f"unknown op {f!r}")
+
+    def close(self, test):
+        try:
+            self.sock.close()
+        except (OSError, AttributeError):
+            pass
+
+
+def enqueue_dequeue(enqueue_ratio: float = 0.6):
+    """Unique-value enqueues mixed with dequeues (rabbitmq.clj workload
+    shape; uniqueness keeps the multisets unambiguous).  Enqueue-biased
+    by default so queues stay non-empty — a kill then has elements at
+    risk, which is the point of the fault."""
+    counter = iter(range(1, 1 << 30))
+
+    def nxt():
+        import random
+
+        if random.random() < enqueue_ratio:
+            return {"f": "enqueue", "value": next(counter)}
+        return {"f": "dequeue"}
+
+    return nxt
+
+
+def queue_test(opts) -> dict:
+    db = QueueDB(durable=opts.get("durable", True))
+    pkg = nc.nemesis_package(
+        {
+            "faults": ["kill"],
+            "db": db,
+            "interval": opts.get("interval", 2),
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    time_limit = opts.get("time-limit", 8)
+    t = testkit.noop_test(
+        name="queue",
+        db=db,
+        client=QueueClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(
+                    gen.time_limit(time_limit, gen.stagger(0.02, gen.repeat(enqueue_dequeue())))
+                ),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            # heal everything (restart killed nodes) before draining: the
+            # total-queue checker cannot account a crashed drain
+            gen.nemesis(pkg.final_generator),
+            gen.nemesis(gen.sleep(0.5)),  # let restarted servers listen
+            # one drain per worker thread — threads round-robin the
+            # nodes, so every endpoint's queue gets emptied
+            gen.clients(gen.each_thread(gen.once({"f": "drain"}))),
+        ),
+        checker=compose(
+            {
+                "stats": stats(),
+                "queue": total_queue(),
+                "perf": perf(),
+            }
+        ),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
+
+
+def main(argv=None):
+    cli.main(test_fn=queue_test, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""A real queue server with a tunable durability story.
+
+The queue-family test target (the role RabbitMQ plays for the reference's
+rabbitmq harness — rabbitmq/src/jepsen/rabbitmq.clj: enqueues/dequeues
+plus a draining read, checked by total-queue multiset accounting):
+
+  * ``--durable``: one flock-guarded, fsync'd journal file shared by all
+    node processes — enqueue acks mean the element survives kill -9, and
+    every endpoint serves the same FIFO.  The harness's kill nemesis +
+    total-queue checker should find NOTHING lost.
+  * default (in-memory): each server process keeps its queue in RAM —
+    acknowledged elements die with the process, exactly the
+    acked-but-lost failure mode queue tests exist to catch.  The checker
+    should report them under ``lost``.
+
+Protocol (one line per request):
+  E <int>   -> "ok"                 enqueue
+  D         -> "v <int>" | "v nil"  dequeue (nil = empty)
+  DRAIN     -> "vs a,b,c" | "vs"    dequeue everything, atomically
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import os
+import socketserver
+import sys
+from collections import deque
+
+
+class Journal:
+    """Flock-guarded durable FIFO: state is the replay of an append-only
+    journal of '+v' / '-' lines; appends are fsync'd before the lock
+    drops (the linearization point)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _replay(self, fd) -> deque:
+        q: deque = deque()
+        data = b""
+        while True:
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                break
+            data += chunk
+        for line in data.decode().splitlines():
+            if line.startswith("+"):
+                q.append(int(line[1:]))
+            elif line == "-":
+                q.popleft()
+        return q
+
+    def txn(self, fn):
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            q = self._replay(fd)
+            entries, reply = fn(q)
+            if entries:
+                os.write(fd, "".join(e + "\n" for e in entries).encode())
+                os.fsync(fd)
+            return reply
+        finally:
+            os.close(fd)
+
+
+class Memory:
+    """Per-process RAM queue: fast, and wrong under kill -9."""
+
+    def __init__(self):
+        self.q: deque = deque()
+
+    def txn(self, fn):
+        _entries, reply = fn(self.q)
+        return reply
+
+
+def _enqueue(q: deque, v: int):
+    q.append(v)
+    return [f"+{v}"], "ok"
+
+
+def _dequeue(q: deque):
+    if not q:
+        return [], "v nil"
+    v = q.popleft()
+    return ["-"], f"v {v}"
+
+
+def _drain(q: deque):
+    vs = list(q)
+    entries = ["-"] * len(q)
+    q.clear()
+    return entries, "vs " + ",".join(str(v) for v in vs)
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            parts = raw.decode().split()
+            if not parts:
+                continue
+            try:
+                reply = self.apply(parts)
+            except Exception as e:  # noqa: BLE001
+                reply = f"err {type(e).__name__}"
+            self.wfile.write((reply + "\n").encode())
+            self.wfile.flush()
+
+    def apply(self, parts):
+        store = self.server.store
+        cmd = parts[0]
+        if cmd == "E" and len(parts) == 2:
+            v = int(parts[1])
+            return store.txn(lambda q: _enqueue(q, v))
+        if cmd == "D" and len(parts) == 1:
+            return store.txn(_dequeue)
+        if cmd == "DRAIN" and len(parts) == 1:
+            return store.txn(_drain)
+        return "err bad-command"
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--durable", action="store_true")
+    args = ap.parse_args()
+    srv = Server(("127.0.0.1", args.port), Handler)
+    srv.store = Journal(args.data) if args.durable else Memory()
+    mode = "durable journal" if args.durable else "in-memory (lossy)"
+    print(f"queue server on {args.port}, {mode}, data={args.data}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,8 +116,11 @@ def _promote_single_dir(s: Session, tmp: str, dest: str):
 
 
 def signal(s: Session, pattern: str, sig: str):
-    """Send a signal to matching processes (control/util.clj:399-403)."""
-    s.exec_result("pkill", f"-{sig}", "-f", pattern)
+    """Send a signal to matching processes (control/util.clj:399-403).
+    ``--`` ends option parsing so patterns that start with a dash (e.g.
+    a daemon's ``--flag value`` command-line tail) match instead of
+    erroring as unknown pkill options."""
+    s.exec_result("pkill", f"-{sig}", "-f", "--", pattern)
 
 
 def grepkill(s: Session, pattern: str, sig: str = "KILL"):

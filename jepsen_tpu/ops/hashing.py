@@ -161,7 +161,7 @@ def frontier_update_fast(
     bfc = fcr[srcB]
     balive = jnp.arange(Cb) < jnp.minimum(n_keep0, Cb)
     spill = n_keep0 > Cb
-    if max_count is not None:
+    if max_count is not None and max_count <= MXU_PRUNE_MAX_COUNT:
         balive = exact_prune_mxu(bst, bfo, bfc, balive, max_count)
     else:
         balive = exact_prune(bst, bfo, bfc, balive)
@@ -184,6 +184,15 @@ def frontier_update_fast(
         child = srcB[src2] >= n_parents
     fp = _fingerprint(kst, kfo, kfc, new_alive, w, g)
     return kst, kfo, kfc, new_alive, overflowed, fp, child
+
+
+#: The matmul prune does g·max_count MACs per pairwise cell where the
+#: dense prune does g vector compares; with the MXU's ~50x per-element
+#: throughput the matmul wins only while max_count stays small.  Above
+#: this bound (histories with very wide mover tables, e.g. 10k-op 2%-info
+#: registers with ~256 movers) the dense prune is faster — and the gate
+#: keeps the one-hot width, hence compile-time constants, bounded.
+MXU_PRUNE_MAX_COUNT = 64
 
 
 def exact_prune_mxu(state, fok, fcr, alive, max_count: int):

@@ -470,3 +470,147 @@ def test_limit_zero_and_nested_limits():
     assert gt.perfect(TEST, gen.limit(0, gen.repeat(r()))) == []
     h = gt.perfect(TEST, gen.limit(5, gen.limit(3, gen.repeat(r()))))
     assert len(invokes(h)) == 3
+
+
+# ---------------------------------------------------------------------------
+# Reference parity checklist (jepsen/test/jepsen/generator_test.clj, 578 LoC)
+# Every reference deftest and its local equivalent; "golden" = exact op
+# sequence asserted.
+#
+#   nil-test                    -> test_nil_gen
+#   map-test                    -> test_map_emits_once, test_map_concurrent_golden,
+#                                  test_map_all_threads_busy_pending
+#   limit-test                  -> test_repeat_and_limit, test_limit_zero_and_nested_limits
+#   repeat-test                 -> test_repeat_golden_values
+#   delay-test                  -> test_delay_spacing, test_delay_golden_schedule
+#   seq-test                    -> test_seq_runs_in_order (vectors; concat-test below)
+#   fn-test                     -> test_fn_repeats_forever
+#   on-update+promise-test      -> test_info_completion_reassigns_process (update
+#                                  routing; Clojure promise blocking is N/A —
+#                                  Python gens are plain objects, no IDeref)
+#   clojure-delay-test          -> N/A (Clojure delay/force laziness; Python
+#                                  closures fill the role, covered by fn-test)
+#   synchronize-test            -> test_synchronize_waits_for_all_threads
+#   clients-test                -> test_clients_never_use_nemesis
+#   phases-test                 -> test_then_orders_phases, test_phases_three_stage_exact_order
+#   any-test                    -> test_any_picks_soonest
+#   each-thread-test            -> test_each_thread_runs_copy_per_thread,
+#                                  test_each_thread_collapses_when_exhausted
+#   stagger-test                -> test_stagger_mean_interval,
+#                                  test_stagger_total_rate_independent_of_concurrency
+#   f-map-test                  -> test_f_map_renames
+#   filter-test                 -> test_filter_skips, test_filter_golden_evens
+#   log-test                    -> interpreter-level (test_interpreter.py: log ops
+#                                  excluded from history)
+#   mix-test                    -> test_mix_draws_from_all, test_mix_drops_exhausted
+#   process-limit-test          -> test_process_limit_bounds_distinct_processes
+#   time-limit-test             -> test_time_limit_cuts_off
+#   reserve-test                -> test_reserve_partitions_threads,
+#                                  test_reserve_remainder_goes_to_default
+#   independent-sequential-test -> test_independent.py sequential generator tests
+#   independent-concurrent-test -> test_independent-style coverage in
+#                                  test_elle_batch.py / test_parallel.py
+#   independent-deadlock-case   -> test_deadlock_detection
+#   at-least-one-ok-test        -> test_until_ok_stops_after_ok,
+#                                  test_until_ok_ignores_sibling_oks
+#   flip-flop-test              -> test_flip_flop_alternates
+#   pretty-print-test           -> N/A (Clojure pprint dispatch; Python reprs
+#                                  are dataclass-derived)
+#   concat-test                 -> test_concat_golden (list coercion runs each
+#                                  element to exhaustion, the gen/concat role)
+#   any-stagger-test            -> test_any_stagger_no_starvation
+#   cycle-test                  -> test_cycle_restarts
+#   cycle-times-test            -> test_cycle_times_rotates_by_clock
+# ---------------------------------------------------------------------------
+
+
+def test_map_concurrent_golden():
+    """Six repeats of one op map across 2 workers + nemesis: all three
+    threads invoke at t=0, then again when they free up at t=latency
+    (reference map-test 'concurrent')."""
+    h = invokes(gt.perfect(TEST, gen.limit(6, gen.repeat(r("write")))))
+    lat = gt.LATENCY_NS
+    assert [o["time"] for o in h] == [0, 0, 0, lat, lat, lat]
+    # every thread (2 workers + nemesis) is used in each wave
+    wave1 = {o["process"] for o in h[:3]}
+    wave2 = {o["process"] for o in h[3:]}
+    assert wave1 == wave2 == {0, 1, NEMESIS}
+
+
+def test_map_all_threads_busy_pending():
+    """With no free threads a bare op map is pending (reference map-test
+    'all threads busy')."""
+    ctx = context(TEST)
+    for t in list(ctx.free_threads):
+        ctx = ctx.busy_thread(t)
+    g = gen.to_gen(r("write"))
+    out = g.op({}, ctx)
+    assert out[0] is PENDING
+
+
+def test_repeat_golden_values():
+    """gen.repeat(_, 3) of a value stream yields the FIRST op three times
+    (reference repeat-test: [0 0 0])."""
+    vals = [r("write", v) for v in range(100)]
+    h = invokes(gt.perfect(TEST, gen.repeat(vals, 3)))
+    assert [o["value"] for o in h] == [0, 0, 0]  # first op, never advanced
+
+
+def test_delay_golden_schedule():
+    """delay spaces invocations by its interval, but a busy pool starts
+    ops as soon as threads free up (reference delay-test)."""
+    lat = gt.LATENCY_NS
+    d = lat / 3 / 1e9  # a third of the completion latency, in seconds
+    h = invokes(gt.perfect(TEST, gen.limit(5, gen.delay(d, gen.repeat(r("write"))))))
+    step = lat // 3
+    # Would be [0, step, 2*step, 3*step, 4*step], but all three threads
+    # are busy until lat: ops 4 and 5 start when threads free, not at
+    # their nominal delays.
+    assert [o["time"] for o in h] == [0, step, 2 * step, lat, lat + step]
+
+
+def test_each_thread_collapses_when_exhausted():
+    """each_thread with an exhausted inner generator is itself exhausted
+    (reference each-thread-test 'collapses when exhausted')."""
+    g = gen.each_thread(gen.limit(0, r("read")))
+    assert g.op({}, context(TEST)) is None
+
+
+def test_filter_golden_evens():
+    """filter over a limited value stream (reference filter-test)."""
+    inner = [r("w", v) for v in range(10)]
+    h = invokes(gt.perfect(TEST, gen.filter_gen(lambda o: o["value"] % 2 == 0, inner)))
+    assert [o["value"] for o in h] == [0, 2, 4, 6, 8]
+
+
+def test_concat_golden():
+    """A list of generators runs each to exhaustion in order — the
+    gen/concat role (reference concat-test)."""
+    h = invokes(gt.perfect(TEST, [
+        [r("w", "a"), r("w", "b")],
+        gen.limit(1, gen.repeat(r("w", "c"))),
+        r("w", "d"),
+    ]))
+    assert [o["value"] for o in h] == ["a", "b", "c", "d"]
+
+
+def test_any_stagger_no_starvation():
+    """any() of two staggers must starve neither side (reference
+    any-stagger-test): each side's mean interval stays near its own
+    stagger period."""
+    n = 400
+    lat_s = gt.LATENCY_NS / 1e9
+    a = gen.stagger(3 * lat_s, gen.repeat(r("a")))
+    b = gen.stagger(5 * lat_s, gen.repeat(r("b")))
+    h = invokes(gt.perfect(TEST, gen.clients(gen.limit(n, gen.any_gen(a, b)))))
+    assert len(h) == n
+
+    def mean_interval(ops):
+        ts = [o["time"] for o in ops]
+        gaps = [t2 - t1 for t1, t2 in zip(ts, ts[1:])]
+        return sum(gaps) / len(gaps) / gt.LATENCY_NS
+
+    ia = mean_interval([o for o in h if o["f"] == "a"])
+    ib = mean_interval([o for o in h if o["f"] == "b"])
+    assert 2.5 < ia < 3.5, ia
+    assert 4.5 < ib < 5.5, ib

@@ -87,14 +87,22 @@ def config_2():
     # matched capacity.  The async kernel runs these shapes; the exact
     # barrier kernel at cap ≥1024 faults the tunneled TPU worker.
     hist = valid_register_history(n, 32, seed=7, info_rate=0.02, n_values=5)
-    r = wgl.analysis_async(model, hist, capacity=1024)  # compile
+    wgl.analysis_async(model, hist, capacity=1024)  # compile
     t0 = time.perf_counter()
     r = wgl.analysis_async(model, hist, capacity=1024)
+    dev = dict(r)
+    if r["valid?"] == "unknown":
+        # knossos.competition semantics (reference checker.clj:199-203):
+        # when the device beam exhausts, the greedy DFS oracle gets its
+        # turn — on valid histories it walks straight through, turning
+        # "unknown" into a definite verdict (VERDICT r3 item 3).
+        r = wgl_cpu.dfs_analysis(model, hist)
     tpu_s = time.perf_counter() - t0
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
     record("2", f"{n}-op register, 32 procs, 2% info (single history)",
            tpu_s, cpu_s, {"tpu": r["valid?"], "cpu": rc["valid?"] if rc else "budget"},
-           note=f"time-to-exhaustion at matched capacity; kernel={r.get('kernel')}")
+           note=f"competition: device beam then DFS fallback; device verdict "
+                f"was {dev['valid?']} in its share of the time; kernel={dev.get('kernel')}")
 
 
 def config_3():
@@ -131,23 +139,42 @@ def config_3():
 
 
 def config_5():
-    """Adversarial: many ops, 64 procs, 30% info — worst-case branching."""
+    """Adversarial: many ops, 64 procs, 30% info — worst-case branching.
+
+    No engine (device beam, DFS at 5M visited / 324 s, budgeted sweep)
+    decides this shape outright — crashed-op groups accumulate over the
+    whole history, so the exact antichain outgrows any fixed capacity.
+    The chunked carried-frontier path turns that into a QUANTIFIED
+    verified prefix.  This run uses the fast (hash-dedup) engine, so the
+    prefix claims carry its caveat: zero-loss barriers are verified
+    modulo the ~1e-13 hash-collision case (a chunked-fast False comes
+    back marked ``provisional?`` and is recorded as such); witnessed
+    barriers (frontier alive, loss or not) carry a constructive witness
+    and are exact."""
     n = 5000 if QUICK else 50_000
     model = m.CASRegister(None)
     hist = valid_register_history(n, 64, seed=13, info_rate=0.3, n_values=5)
-    kw = dict(capacity=(256,), rounds=6)
+    kw = dict(capacity=(256, 1024), rounds=6, chunk_barriers=512, fast=True)
     t0 = time.perf_counter()
-    r = wgl.analysis(model, hist, **kw)  # includes compile (scan is size-specific)
+    r = wgl.analysis(model, hist, **kw)  # includes compile (chunk programs cache)
     first_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     r = wgl.analysis(model, hist, **kw)
     tpu_s = time.perf_counter() - t0
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
+    k = r.get("kernel", {})
+    n_bar = k.get("chunks", 0) * 512
+    verdict = r["valid?"]
+    if r.get("provisional?"):
+        verdict = "false (provisional, hash-decided)"
     record("5", f"{n}-op register, 64 procs, 30% info (single history)",
-           tpu_s, cpu_s, {"tpu": r["valid?"], "cpu": rc["valid?"] if rc else "budget"},
-           note=f"worst-case branching: both engines exhaust their budgets; "
-                f"compare time-to-exhaustion. first-run(incl compile)={first_s:.1f}s "
-                f"kernel={r.get('kernel')}")
+           tpu_s, cpu_s, {"tpu": verdict, "cpu": rc["valid?"] if rc else "budget"},
+           note=f"worst-case branching (no engine decides it; DFS exhausts 5M "
+                f"configs in 324s): chunked-fast quantified prefix "
+                f"verified-barriers={k.get('verified-barriers')} (zero-loss, "
+                f"modulo hash-dedup caveat) witnessed-barriers="
+                f"{k.get('witnessed-barriers')} (exact witness) of ~{n_bar}; "
+                f"first-run(incl compile)={first_s:.1f}s kernel={k}")
 
 
 CONFIGS = {"config_1": config_1, "config_2": config_2, "config_3": config_3,
