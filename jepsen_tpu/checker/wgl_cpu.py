@@ -79,6 +79,7 @@ def prepare(model: m.Model, history: Sequence[dict]):
     nil and learn their value on completion); ``crashed`` is the set of op
     ids that never definitely completed.
     """
+    history = h.materialize(history)
     pairs = h.pair_index(history)
     pure = PURE_FS.get(getattr(model, "name", None), set())
     order: list[tuple[int, int, int]] = []  # (history position, kind, op id)

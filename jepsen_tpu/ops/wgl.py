@@ -95,6 +95,7 @@ def pack(model: m.Model, history: Sequence[dict]):
     tm = tmodels.tensor_model_for(model)
     if tm is None:
         raise NotTensorizable(f"no tensor model for {getattr(model, 'name', model)!r}")
+    history = h.materialize(history)
     events, eff_ops, crashed = wgl_cpu.prepare(model, history)
     if tm.precheck is not None:
         try:

@@ -30,6 +30,8 @@ import shutil
 from pathlib import Path
 from typing import Any, Mapping, Sequence
 
+from jepsen_tpu import history as _h
+
 logger = logging.getLogger(__name__)
 
 BASE_DIR = Path("store")
@@ -62,6 +64,8 @@ def _jsonable(x: Any):
     if isinstance(x, Mapping):
         return {str(k): _jsonable(v) for k, v in x.items()}
     if isinstance(x, (list, tuple, set, frozenset)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, _h.ColumnHistory):
         return [_jsonable(v) for v in x]
     if isinstance(x, (str, int, float, bool)) or x is None:
         return x
@@ -217,7 +221,13 @@ def load_dir(d: Path) -> dict:
         from jepsen_tpu.store import format as fmt
 
         try:
-            test = fmt.read(run)
+            idx = fmt.read_index(run)
+            test = fmt.read(run, index=idx, history=False)
+            cols, fs, extras = fmt.read_columns(run, index=idx)
+            if len(cols["index"]):
+                # the zero-copy path: ops materialize lazily; kernels and
+                # vectorized consumers read the columns directly
+                test["history"] = _h.ColumnHistory(cols, fs, extras)
             test["dir"] = str(d)
             return test
         except fmt.CorruptFile:

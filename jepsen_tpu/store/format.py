@@ -135,39 +135,13 @@ def _pack_chunk(ops: Sequence[Mapping]) -> bytes:
 
 
 def _unpack_chunk(payload: bytes) -> list[dict]:
+    # One decoder for both read paths: ColumnHistory's batch
+    # materializer IS the dict decode (history.py), so the eager read
+    # and the zero-copy read can never diverge.
     from jepsen_tpu import history as h
 
-    _n, side_len = struct.unpack_from("<II", payload)
-    sidecar = json.loads(payload[8 : 8 + side_len].decode())
-    npz = np.load(io.BytesIO(payload[8 + side_len :]))
-    fs = sidecar["fs"]
-    extras = {int(k): v for k, v in sidecar["extras"].items()}
-    type_names = [h.INVOKE, h.OK, h.FAIL, h.INFO]
-    n = len(npz["index"])
-    out = []
-    for i in range(n):
-        extra = extras.get(i, {})
-        v1, v2 = int(npz["value1"][i]), int(npz["value2"][i])
-        if "value" in extra:
-            value = extra["value"]
-        else:
-            value = h.decode_register_value(None, v1, v2)
-            if extra.get("value-tuple?") and isinstance(value, list):
-                value = tuple(value)
-        p = int(npz["process"][i])
-        op = {
-            "index": int(npz["index"][i]),
-            "type": extra.get("type", type_names[int(npz["type"][i])]),
-            "process": extra.get("process", h.NEMESIS if p == -1 else p),
-            "f": fs[int(npz["f"][i])],
-            "value": value,
-            "time": int(npz["time"][i]),
-        }
-        for k, v in extra.items():
-            if k not in ("value", "value-tuple?", "type", "process"):
-                op[k] = v
-        out.append(op)
-    return out
+    cols, fs, extras = _chunk_columns(payload)
+    return h.ColumnHistory(cols, fs, extras).materialized()
 
 
 def _jsonable(x: Any):
@@ -339,20 +313,71 @@ def read_index(path: str | Path) -> dict:
     return scan(path)
 
 
-def read(path: str | Path, index: dict | None = None) -> dict:
-    """Load the full run: test map + history + results."""
+def read(path: str | Path, index: dict | None = None, history: bool = True) -> dict:
+    """Load the full run: test map + history + results.  ``history=False``
+    skips the history blocks (callers on the zero-copy path read them as
+    columns via ``read_columns`` instead)."""
     index = index or read_index(path)
     out: dict = {}
-    history: list = []
+    hist: list = []
     with open(path, "rb") as f:
         for entry in index["blocks"]:
+            if not history and entry["type"] == T_HISTORY:
+                continue
             btype, payload = _read_block(f, entry["offset"])
             if btype == T_TEST:
                 out.update(json.loads(payload.decode()))
             elif btype == T_HISTORY:
-                history.extend(_unpack_chunk(payload))
+                hist.extend(_unpack_chunk(payload))
             elif btype == T_RESULTS:
                 out["results"] = json.loads(payload.decode())
-    if history:
-        out["history"] = history
+    if hist:
+        out["history"] = hist
     return out
+
+
+def _chunk_columns(payload: bytes):
+    """One history chunk's raw columns without materializing op dicts."""
+    _n, side_len = struct.unpack_from("<II", payload)
+    sidecar = json.loads(payload[8 : 8 + side_len].decode())
+    npz = np.load(io.BytesIO(payload[8 + side_len :]))
+    cols = {c: npz[c] for c in _COLS}
+    return cols, sidecar["fs"], {int(k): v for k, v in sidecar["extras"].items()}
+
+
+def read_columns(path: str | Path, index: dict | None = None):
+    """The stored history as concatenated SoA columns — the zero-copy
+    analyze path: no per-op dict is built at load time (ops materialize
+    lazily through jepsen_tpu.history.ColumnHistory, and vectorized
+    consumers read the arrays directly).
+
+    Returns ``(cols, fs, extras)``: int64 column arrays over the whole
+    history, the merged ``f`` vocabulary (per-chunk ids remapped), and
+    ``{position: extra-fields}`` for ops the columns can't fully carry.
+    """
+    index = index or read_index(path)
+    parts: list = []
+    with open(path, "rb") as f:
+        for entry in index["blocks"]:
+            if entry["type"] != T_HISTORY:
+                continue
+            btype, payload = _read_block(f, entry["offset"])
+            parts.append(_chunk_columns(payload))
+    if not parts:
+        return {c: np.zeros(0, np.int64) for c in _COLS}, [], {}
+    fs: list[str] = []
+    f_ids: dict[str, int] = {}
+    extras: dict[int, dict] = {}
+    off = 0
+    all_cols: dict[str, list] = {c: [] for c in _COLS}
+    for cols, chunk_fs, chunk_extras in parts:
+        remap = np.array(
+            [f_ids.setdefault(name, len(f_ids)) for name in chunk_fs], np.int64
+        )
+        for c in _COLS:
+            all_cols[c].append(remap[cols[c]] if c == "f" else cols[c])
+        for k, v in chunk_extras.items():
+            extras[off + k] = v
+        off += len(cols["index"])
+    fs = [name for name, _ in sorted(f_ids.items(), key=lambda kv: kv[1])]
+    return {c: np.concatenate(all_cols[c]) for c in _COLS}, fs, extras

@@ -154,7 +154,8 @@ def config_5():
     n = 5000 if QUICK else 50_000
     model = m.CASRegister(None)
     hist = valid_register_history(n, 64, seed=13, info_rate=0.3, n_values=5)
-    kw = dict(capacity=(256, 1024), rounds=6, chunk_barriers=512, fast=True)
+    cb = 512
+    kw = dict(capacity=(256, 1024), rounds=6, chunk_barriers=cb, fast=True)
     t0 = time.perf_counter()
     r = wgl.analysis(model, hist, **kw)  # includes compile (chunk programs cache)
     first_s = time.perf_counter() - t0
@@ -163,7 +164,7 @@ def config_5():
     tpu_s = time.perf_counter() - t0
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
     k = r.get("kernel", {})
-    n_bar = k.get("chunks", 0) * 512
+    n_bar = k.get("chunks", 0) * cb
     verdict = r["valid?"]
     if r.get("provisional?"):
         verdict = "false (provisional, hash-decided)"
