@@ -24,22 +24,3 @@ Layer map (cf. SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
-
-import os as _os
-
-if _os.environ.get("JAX_PLATFORMS"):
-    # Some platform plugins (the axon TPU tunnel) override the
-    # JAX_PLATFORMS env var; the config flag is authoritative, so honor
-    # the user's env choice by setting it before any backend init.
-    # ``JAX_PLATFORMS=cpu python -m examples.toydb ...`` then really runs
-    # on CPU even where a (possibly unreachable) TPU plugin is installed.
-    # Only fills an UNSET flag: code that set the flag explicitly (test
-    # conftest, the multichip dryrun) must keep winning over inherited
-    # env (e.g. a login shell exporting JAX_PLATFORMS=tpu).
-    try:
-        import jax as _jax
-
-        if not getattr(_jax.config, "jax_platforms", None):
-            _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
-    except Exception:  # pragma: no cover — jax absent or config renamed
-        pass

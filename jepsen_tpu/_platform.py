@@ -1,0 +1,37 @@
+"""Platform selection override for the checker kernels.
+
+Some platform plugins (the axon TPU tunnel) override the JAX_PLATFORMS
+env var by injecting themselves into the ``jax_platforms`` config flag
+at import time — so a user exporting ``JAX_PLATFORMS=cpu`` still gets
+the plugin, and an unreachable TPU hangs every checker import.  The
+plugin-injected flag value is indistinguishable from one set
+deliberately, so this shim honors a framework-owned variable instead:
+
+    JEPSEN_TPU_PLATFORM=cpu python -m examples.toydb test --local ...
+
+``honor_env_platform()`` is called from the modules whose import
+triggers backend initialization (module-level ``jnp`` constants), NOT
+from the package __init__: store/history/web paths stay jax-free and
+import fast.  It sets the config flag unconditionally when the variable
+is present — the variable exists only to express user intent, so there
+is nothing to defer to.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "JEPSEN_TPU_PLATFORM"
+
+
+def honor_env_platform() -> None:
+    want = os.environ.get(ENV_VAR)
+    if not want:
+        return
+    try:
+        import jax
+
+        if getattr(jax.config, "jax_platforms", None) != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:  # pragma: no cover — jax absent or config renamed
+        pass

@@ -28,10 +28,14 @@ from jepsen_tpu.checker import txn_graph as tg
 from jepsen_tpu.ops import closure as cl
 
 # ---------------------------------------------------------------------------
-# Consistency-model hierarchy (simplified from elle.consistency-model)
+# Consistency-model hierarchy (elle.consistency-model's lattice, rebuilt
+# from the Adya / Cerone model relationships it encodes)
 # ---------------------------------------------------------------------------
 
-#: anomaly → weakest consistency models it rules out.
+#: anomaly → weakest consistency models it rules out.  Our G2 evidence is
+#: item anti-dependency cycles (G2-item), which Adya's PL-2.99 already
+#: proscribes — so it rules out repeatable-read, and serializable /
+#: strict-serializable follow through the lattice.
 ANOMALY_RULES_OUT = {
     "G0": ["read-uncommitted"],
     "duplicate-elements": ["read-uncommitted"],
@@ -41,26 +45,52 @@ ANOMALY_RULES_OUT = {
     "G1b": ["read-committed"],
     "G1c": ["read-committed"],
     "internal": ["read-atomic"],
-    "G-single": ["snapshot-isolation"],
-    "G2": ["serializable"],
+    "G-single": ["consistent-view", "snapshot-isolation"],
+    "G2": ["repeatable-read", "serializable"],
 }
 
-#: model → strictly stronger models (transitively closed) — ruling out a
-#: model also rules these out.
-STRONGER_MODELS = {
-    "read-uncommitted": [
-        "read-committed",
-        "read-atomic",
-        "snapshot-isolation",
-        "serializable",
-        "strict-serializable",
-    ],
-    "read-committed": ["snapshot-isolation", "serializable", "strict-serializable"],
-    "read-atomic": ["snapshot-isolation", "serializable", "strict-serializable"],
-    "snapshot-isolation": ["serializable", "strict-serializable"],
+#: DIRECT weaker→stronger edges; STRONGER_MODELS below is the transitive
+#: closure (computed, so adding a model can't silently break the
+#: closure).  Chains follow Adya's PL hierarchy on one side
+#: (read-committed → cursor-stability → repeatable-read → serializable)
+#: and the atomic-snapshot family on the other (monotonic-atomic-view →
+#: read-atomic → causal → parallel-snapshot-isolation →
+#: snapshot-isolation → serializable), meeting at serializable and
+#: topped by strict-serializable.
+_STRONGER_DIRECT = {
+    "read-uncommitted": ["read-committed"],
+    "read-committed": ["cursor-stability", "monotonic-atomic-view", "consistent-view"],
+    "cursor-stability": ["repeatable-read"],
+    "monotonic-atomic-view": ["read-atomic", "repeatable-read"],
+    "consistent-view": ["snapshot-isolation"],
+    "read-atomic": ["causal"],
+    "causal": ["parallel-snapshot-isolation"],
+    "parallel-snapshot-isolation": ["snapshot-isolation"],
+    "repeatable-read": ["serializable"],
+    "snapshot-isolation": ["serializable"],
     "serializable": ["strict-serializable"],
     "strict-serializable": [],
 }
+
+
+def _transitive_closure(direct: Mapping) -> dict:
+    out: dict[str, list] = {}
+    for start in direct:
+        seen: set[str] = set()
+        stack = list(direct[start])
+        while stack:
+            x = stack.pop()
+            if x in seen:
+                continue
+            seen.add(x)
+            stack.extend(direct.get(x, ()))
+        out[start] = sorted(seen)
+    return out
+
+
+#: model → strictly stronger models (transitively closed) — ruling out a
+#: model also rules these out.
+STRONGER_MODELS = _transitive_closure(_STRONGER_DIRECT)
 
 #: Which anomalies each requested headline anomaly expands to
 #: (tests/cycle/wr.clj:43-46: "G2 implies G-single and G1c; G1 implies G1a,
@@ -434,6 +464,155 @@ class WRRegisterChecker(_ElleChecker):
     def check_batch(self, test, histories, opts):
         """Batched per-key form (see ListAppendChecker.check_batch)."""
         return check_graphs([self._graph(hh) for hh in histories], self.anomalies)
+
+
+class CycleChecker(_ElleChecker):
+    """Cycle detection over an ARBITRARY user relation graph — the
+    reference's generic adapter (jepsen/src/jepsen/tests/cycle.clj:10-16,
+    reifying a Checker over elle.core/check with a custom analyzer).
+
+    ``analyzer(history)`` returns ``(nodes, relations, explainer)``:
+
+      nodes      list of op dicts (one graph node per entry)
+      relations  one of: a ``{name: [n, n] bool ndarray}`` mapping (the
+                 scalable form — a 50k-op realtime relation is one
+                 vectorized comparison, never a Python edge list), a bare
+                 [n, n] ndarray, or an iterable of ``(i, j, name)``
+                 tuples for small graphs
+      explainer  ``fn(i, j, relation) -> str`` prose for one edge (may be
+                 None for a generic rendering)
+
+    Any cycle in the combined relation graph is an anomaly (reported
+    under ``"cycle"`` with a recovered witness).  Detection routes by
+    size like the typed checkers: dense MXU closure for small graphs,
+    host Tarjan above SCC_THRESHOLD.
+    """
+
+    def __init__(self, analyzer):
+        self.analyzer = analyzer
+
+    def check(self, test, history, opts):
+        nodes, relations, explainer = self.analyzer(history)
+        n = len(nodes)
+        adj = np.zeros((n, n), dtype=bool)
+        if isinstance(relations, np.ndarray):
+            relations = {"rel": relations}
+        if isinstance(relations, Mapping):
+            for mat in relations.values():
+                adj |= np.asarray(mat, dtype=bool)
+
+            def rel_of(a: int, b: int):
+                for name, mat in relations.items():
+                    if mat[a, b]:
+                        return name
+                return None
+        else:
+            rels: dict[tuple[int, int], Any] = {}
+            for i, j, r in relations:
+                adj[i, j] = True
+                rels.setdefault((int(i), int(j)), r)
+
+            def rel_of(a: int, b: int):
+                return rels.get((a, b))
+        flagged, cycle = self._find_cycle(adj, n)
+        if not flagged:
+            res: dict[str, Any] = {"valid?": True}
+        elif cycle is None:
+            # never a clean True over a flagged graph (same invariant as
+            # _merge_flags): flag and host witness recovery disagree
+            res = {
+                "valid?": "unknown",
+                "unwitnessed-flags": ["cycle"],
+                "cause": (
+                    "device flagged a cycle but witness recovery found "
+                    "none — flag and host graph disagree"
+                ),
+            }
+        else:
+            steps = []
+            for a, b in zip(cycle, cycle[1:] + [cycle[0]]):
+                r = rel_of(a, b)
+                prose = None
+                if explainer is not None:
+                    prose = explainer(a, b, r)
+                steps.append(
+                    {
+                        "type": r,
+                        "from": nodes[a],
+                        "to": nodes[b],
+                        "explanation": prose or f"{r}: node {a} precedes node {b}",
+                    }
+                )
+            res = {
+                "valid?": False,
+                "anomaly-types": ["cycle"],
+                "anomalies": {
+                    "cycle": [{"cycle": [nodes[i] for i in cycle], "steps": steps}]
+                },
+            }
+        self.write_artifacts(test, res, opts)
+        return res
+
+    @staticmethod
+    def _find_cycle(adj: np.ndarray, n: int) -> tuple[bool, list[int] | None]:
+        """(cycle-flagged, witness-cycle-or-None); the witness node list
+        is unclosed."""
+        if n == 0:
+            return False, None
+        if n > SCC_THRESHOLD:
+            from jepsen_tpu.checker.scc import _first_edge_in_cycle, tarjan_scc
+
+            edges = np.argwhere(adj)
+            comp = tarjan_scc(n, [list(np.flatnonzero(adj[v])) for v in range(n)])
+            hit = _first_edge_in_cycle(edges, comp)
+            if hit is None:
+                return False, None
+            cyc = _find_cycle_through_edge(adj, hit[0], hit[1])
+        else:
+            zeros = np.zeros_like(adj)
+            flags, hints = cl.classify_graph(adj, zeros, zeros, zeros)
+            if not flags["G0"]:
+                return False, None
+            cyc = _diag_cycle_at(adj, hints["G0"][0]) if hints["G0"] else None
+        if cyc and len(cyc) > 1 and cyc[0] == cyc[-1]:
+            cyc = cyc[:-1]
+        return True, cyc
+
+
+def realtime_analyzer(history):
+    """Built-in analyzer: realtime precedence between completed client
+    ops (elle.core's realtime graph vocabulary) — op A precedes op B
+    when A's completion lands before B's invocation.  One vectorized
+    comparison (the same dense form txn_graph.realtime_edges uses), not
+    a Python edge list."""
+    from jepsen_tpu import history as h
+
+    pairs = h.pair_index(history)
+    nodes = []
+    inv_pos, comp_pos = [], []
+    for i, o in enumerate(history):
+        if h.is_invoke(o) and h.is_client_op(o):
+            j = int(pairs[i])
+            if j != -1 and history[j]["type"] == h.OK:
+                nodes.append(history[j])
+                inv_pos.append(i)
+                comp_pos.append(j)
+    inv = np.array(inv_pos, dtype=np.int64)
+    comp = np.array(comp_pos, dtype=np.int64)
+    adj = comp[:, None] < inv[None, :]
+
+    def explain(a, b, _r):
+        return (
+            f"op {nodes[a].get('index')} completed before "
+            f"op {nodes[b].get('index')} was invoked"
+        )
+
+    return nodes, {"realtime": adj}, explain
+
+
+def cycle_checker(analyzer) -> Checker:
+    """The reference's ``jepsen.tests.cycle/checker`` entry point."""
+    return CycleChecker(analyzer)
 
 
 def list_append(**kw) -> Checker:

@@ -26,6 +26,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from jepsen_tpu._platform import honor_env_platform
+
+# The module-level constants below initialize the jax backend at import:
+# apply the user's JAX_PLATFORMS env choice first (the axon plugin
+# ignores the env var; see _platform.py).
+honor_env_platform()
+
 _C1 = jnp.uint32(0x85EBCA6B)
 _C2 = jnp.uint32(0xC2B2AE35)
 

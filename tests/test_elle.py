@@ -91,7 +91,9 @@ class TestListAppend:
         )
         assert r["valid?"] is False
         assert "G-single" in r["anomaly-types"]
-        assert "snapshot-isolation" in r["not"]
+        # weakest ruled-out models; snapshot-isolation follows by lattice
+        assert "consistent-view" in r["not"]
+        assert "snapshot-isolation" in r["not"] + r["also-not"]
 
     def test_g2_write_skew(self):
         r = check_append(
@@ -102,7 +104,10 @@ class TestListAppend:
         assert r["valid?"] is False
         assert "G2" in r["anomaly-types"]
         assert "G-single" not in r["anomaly-types"]
-        assert "serializable" in r["not"]
+        # item anti-dependency cycles break repeatable-read (Adya
+        # PL-2.99); serializable follows by lattice
+        assert "repeatable-read" in r["not"]
+        assert "serializable" in r["not"] + r["also-not"]
 
     def test_internal(self):
         r = check_append(
@@ -429,3 +434,115 @@ def test_elle_anomaly_dir_written(tmp_path):
         dk = store.test_dir(test2) / "independent" / str(k) / "elle"
         assert dk.is_dir(), dk
         assert (dk / "G1c.txt").exists()
+
+
+class TestGenericCycleChecker:
+    """The generic relation-graph adapter (reference
+    jepsen/src/jepsen/tests/cycle.clj:10-16): a checker over an
+    arbitrary analyzer; any cycle is an anomaly with a witness."""
+
+    @staticmethod
+    def _analyzer_from_edges(nodes, edges):
+        def analyzer(_history):
+            return (
+                nodes,
+                [(a, b, "dep") for a, b in edges],
+                lambda a, b, r: f"{r}: {a}->{b}",
+            )
+
+        return analyzer
+
+    def _nodes(self, n):
+        from jepsen_tpu import history as h
+
+        return [h.op(h.OK, i, "txn", i, index=i) for i in range(n)]
+
+    def test_acyclic_graph_is_valid(self):
+        nodes = self._nodes(4)
+        chk = elle.cycle_checker(self._analyzer_from_edges(nodes, [(0, 1), (1, 2), (2, 3)]))
+        assert chk.check({}, [], {})["valid?"] is True
+
+    def test_cycle_is_caught_with_witness(self):
+        nodes = self._nodes(4)
+        chk = elle.cycle_checker(self._analyzer_from_edges(nodes, [(0, 1), (1, 2), (2, 0)]))
+        r = chk.check({}, [], {})
+        assert r["valid?"] is False
+        [anom] = r["anomalies"]["cycle"]
+        ids = [o["index"] for o in anom["cycle"]]
+        assert sorted(ids) == [0, 1, 2]
+        assert all("dep:" in s["explanation"] for s in anom["steps"])
+
+    def test_large_graph_routes_to_tarjan(self):
+        import jepsen_tpu.checker.elle as el
+
+        n = el.SCC_THRESHOLD + 5
+        nodes = self._nodes(n)
+        edges = [(i, i + 1) for i in range(n - 1)] + [(n - 1, 0)]
+        r = elle.cycle_checker(self._analyzer_from_edges(nodes, edges)).check({}, [], {})
+        assert r["valid?"] is False
+
+    def test_realtime_analyzer_end_to_end(self, tmp_path):
+        """The built-in realtime analyzer over a real history: a normal
+        history is acyclic; a hand-corrupted realtime order isn't — and
+        the anomaly file lands under the store dir."""
+        from jepsen_tpu import history as h
+
+        hist = h.index([
+            h.op(h.INVOKE, 0, "w", 1, time=0),
+            h.op(h.OK, 0, "w", 1, time=1),
+            h.op(h.INVOKE, 1, "w", 2, time=2),
+            h.op(h.OK, 1, "w", 2, time=3),
+        ])
+        chk = elle.cycle_checker(elle.realtime_analyzer)
+        assert chk.check({}, hist, {})["valid?"] is True
+
+        # An impossible analyzer output (cycle) still renders artifacts.
+        nodes = self._nodes(2)
+        test = {"name": "cyc", "start-time-str": "t",
+                "store-dir": str(tmp_path)}
+        r = elle.cycle_checker(
+            self._analyzer_from_edges(nodes, [(0, 1), (1, 0)])
+        ).check(test, [], {})
+        assert r["valid?"] is False
+        from jepsen_tpu import store
+
+        assert (store.test_dir(test) / "elle" / "cycle.txt").exists()
+
+
+def test_cycle_checker_unwitnessed_flag_is_unknown(monkeypatch):
+    """CycleChecker shares the never-clean-True invariant: a device flag
+    without a recoverable witness answers unknown."""
+    import numpy as np
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu.checker import elle as el
+
+    nodes = [h.op(h.OK, i, "txn", i, index=i) for i in range(3)]
+    chk = el.cycle_checker(lambda _h: (nodes, np.zeros((3, 3), bool), None))
+
+    # Force the flagged-but-unwitnessed shape via the seam itself.
+    monkeypatch.setattr(
+        el.CycleChecker, "_find_cycle", staticmethod(lambda adj, n: (True, None))
+    )
+    r = chk.check({}, [], {})
+    assert r["valid?"] == "unknown"
+    assert r["unwitnessed-flags"] == ["cycle"]
+
+
+def test_cycle_checker_matrix_relations():
+    """The scalable analyzer form: {name: bool matrix} relations."""
+    import numpy as np
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu.checker import elle as el
+
+    nodes = [h.op(h.OK, i, "txn", i, index=i) for i in range(3)]
+    ww = np.zeros((3, 3), bool)
+    ww[0, 1] = ww[1, 2] = True
+    rt = np.zeros((3, 3), bool)
+    rt[2, 0] = True
+    r = el.cycle_checker(lambda _h: (nodes, {"ww": ww, "rt": rt}, None)).check({}, [], {})
+    assert r["valid?"] is False
+    [anom] = r["anomalies"]["cycle"]
+    types = {s["type"] for s in anom["steps"]}
+    assert types == {"ww", "rt"}
