@@ -69,7 +69,45 @@ def home_html(store_dir=None) -> str:
         "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
         "td,th{padding:4px 12px;text-align:left}</style></head><body>"
         "<h1>jepsen-tpu results</h1>"
+        "<p><a href='/suite'>suite overview</a></p>"
         "<table><tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+def suite_html(store_dir=None) -> str:
+    """The test-all comparison view: one row per test NAME, its runs as
+    a compact validity strip (latest first) — scanning a suite's health
+    at a glance, the role of the reference's test-all summary over the
+    home table's run-by-run listing."""
+    rows = []
+    for name, runs in sorted(store.tests(store_dir=store_dir).items()):
+        cells = []
+        ordered = sorted(runs.items(), reverse=True)
+        n_valid = 0
+        for ts, d in ordered:
+            v = _valid_of(d)
+            n_valid += v is True
+            color = VALID_COLORS.get(v, "#eee")
+            cells.append(
+                f"<a href='/files/{html.escape(name)}/{html.escape(ts)}/' "
+                f"title='{html.escape(ts)}: {html.escape(str(v))}' "
+                f"style='display:inline-block;width:14px;height:22px;"
+                f"background:{color};margin-right:2px'></a>"
+            )
+        rows.append(
+            f"<tr><td><a href='/files/{html.escape(name)}/'>{html.escape(name)}</a></td>"
+            f"<td>{n_valid}/{len(ordered)} valid</td>"
+            f"<td>{''.join(cells)}</td></tr>"
+        )
+    return (
+        "<html><head><title>jepsen-tpu suite</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{padding:4px 12px;text-align:left;vertical-align:middle}</style>"
+        "</head><body><h1>suite overview</h1>"
+        "<p><a href='/'>all runs</a></p>"
+        "<table><tr><th>test</th><th>record</th><th>runs (newest first)</th></tr>"
         + "".join(rows)
         + "</table></body></html>"
     )
@@ -103,6 +141,8 @@ class Handler(BaseHTTPRequestHandler):
             base = store.base_dir({"store-dir": self.store_dir} if self.store_dir else None)
             if path in ("/", "/index.html"):
                 self._send(200, home_html(self.store_dir).encode())
+            elif path == "/suite":
+                self._send(200, suite_html(self.store_dir).encode())
             elif path.startswith("/files/"):
                 target = _safe_resolve(base, path[len("/files/"):])
                 if target is None or not target.exists():

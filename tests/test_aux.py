@@ -178,3 +178,41 @@ def test_smartos_variant():
     from jepsen_tpu import os_support
 
     assert hasattr(os_support.smartos(), "setup")
+
+
+def test_web_suite_overview(tmp_path):
+    """/suite: one row per test name with a validity strip — the
+    test-all comparison view."""
+    import urllib.request
+    import threading
+
+    from jepsen_tpu import core, generator as gen, testkit, web
+    from jepsen_tpu.checker import unbridled_optimism
+
+    for name in ("alpha", "beta"):
+        for _ in range(2):
+            t = testkit.noop_test(
+                name=name,
+                generator=gen.clients(gen.limit(4, gen.repeat(lambda: {"f": "read"}))),
+                checker=unbridled_optimism(),
+            )
+            t["store-dir"] = str(tmp_path)
+            core.run_test(t)
+
+    srv = web.make_server("127.0.0.1", 0, str(tmp_path))
+    port = srv.server_address[1]
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/suite", timeout=5
+        ).read().decode()
+        assert "suite overview" in body
+        assert "alpha" in body and "beta" in body
+        assert body.count("2/2 valid") == 2
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5
+        ).read().decode()
+        assert "/suite" in home
+    finally:
+        srv.shutdown()
