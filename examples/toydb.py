@@ -166,6 +166,67 @@ class ToyKVClient(ToyClient):
         raise ValueError(f"unknown op {f!r}")
 
 
+class ToySetClient(ToyClient):
+    """Set vocabulary over the same wire: add/read for the set-full
+    lifecycle checker (the reference's set tests, checker.clj:240-592)."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "add":
+            if self._round(f"A {op['value']}") != "ok":
+                raise RuntimeError("unexpected add reply")
+            return {**op, "type": "ok"}
+        if f == "read":
+            reply = self._round("S")
+            if reply != "s" and not reply.startswith("s "):
+                # raising → :info, never a false definite (empty) read
+                raise RuntimeError(f"unexpected set reply {reply!r}")
+            body = reply[2:].strip()
+            vals = [int(x) for x in body.split(",")] if body else []
+            return {**op, "type": "ok", "value": vals}
+        raise ValueError(f"unknown op {f!r}")
+
+
+def toydb_set_test(opts) -> dict:
+    """set-full element-lifecycle workload against live toydb processes
+    under kill faults: durable fsync'd adds must never be lost."""
+    from jepsen_tpu.workloads import sets
+
+    db = ToyDB()
+    pkg = nc.nemesis_package(
+        {
+            "faults": ["kill"],
+            "db": db,
+            "interval": opts.get("interval", 2),
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    wl = sets.workload_full(opts)
+    time_limit = opts.get("time-limit", 8)
+    t = testkit.noop_test(
+        name="toydb-set",
+        db=db,
+        client=ToySetClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(
+                    gen.time_limit(time_limit, gen.stagger(0.02, wl["generator"]))
+                ),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            gen.nemesis(pkg.final_generator),
+            gen.nemesis(gen.sleep(0.5)),
+            # a final read on every thread so late adds get observed
+            gen.clients(gen.each_thread(gen.once({"f": "read", "value": None}))),
+        ),
+        checker=compose({"stats": stats(), "set": wl["checker"], "perf": perf()}),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
+
+
 def toydb_kv_test(opts) -> dict:
     """Per-key linearizable-register workload against live toydb
     processes: the independent keyspace becomes the TPU batch axis."""

@@ -10,10 +10,12 @@ nemesis + checker verify.
 
 Protocol (one line per request; [k] is an optional key, default "r" —
 each key gets its own locked, fsync'd file, so every key is an
-independent linearizable register):
+independent linearizable register; the set lives in its own file):
   R [k]             -> "v <value>" | "v nil"
   W [k] <int>       -> "ok"
   C [k] <old> <new> -> "ok" | "fail"
+  A <int>           -> "ok"              (set add)
+  S                 -> "s a,b,c" | "s"   (set read)
 """
 
 from __future__ import annotations
@@ -61,6 +63,8 @@ class Handler(socketserver.StreamRequestHandler):
 
     def apply(self, parts):
         cmd, rest = parts[0], parts[1:]
+        if cmd in ("A", "S"):
+            return self.apply_set(cmd, rest)
         want = self.N_ARGS.get(cmd)
         if want is None:
             return "err bad-command"
@@ -76,6 +80,33 @@ class Handler(socketserver.StreamRequestHandler):
             return txn(path, lambda v: (w, "ok"))
         old, new = int(args[0]), int(args[1])
         return txn(path, lambda v: (new, "ok") if v == old else (..., "fail"))
+
+    def apply_set(self, cmd, rest):
+        """The set lives as an append-only, flock-guarded line file —
+        adds are fsync'd before the ack, reads replay it.  The ``.set``
+        suffix cannot alias any register key file: those are always
+        ``{data}-{key}``, and ``.set`` lacks the dash separator."""
+        path = f"{self.server.data_path}.set"
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            if cmd == "A":
+                if len(rest) != 1:
+                    return "err bad-arity"
+                os.write(fd, f"{int(rest[0])}\n".encode())
+                os.fsync(fd)
+                return "ok"
+            data = b""
+            os.lseek(fd, 0, 0)
+            while True:
+                chunk = os.read(fd, 1 << 16)
+                if not chunk:
+                    break
+                data += chunk
+            vals = sorted({int(x) for x in data.decode().split()})
+            return "s " + ",".join(str(v) for v in vals)
+        finally:
+            os.close(fd)
 
 
 class Server(socketserver.ThreadingTCPServer):

@@ -71,3 +71,32 @@ def test_toydb_per_key_end_to_end(tmp_path):
         if o["type"] == h.OK and o["f"] == "read"
     ]
     assert any(v is not None for v in observed), "no read ever saw a write"
+
+
+def test_toydb_set_full_end_to_end(tmp_path):
+    """The set-full lifecycle checker family against LIVE processes with
+    kill faults (reference set tests, checker.clj:294-592): fsync'd adds
+    survive kill -9 — nothing acknowledged may be lost."""
+    from examples.toydb import toydb_set_test
+
+    shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+    t = toydb_set_test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6,
+            "time-limit": 5,
+            "interval": 1.5,
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    s = completed["results"]["set"]
+    kills = [
+        o for o in completed["history"]
+        if o["process"] == h.NEMESIS and o["f"] == "kill" and o["type"] == h.INFO
+    ]
+    assert kills, "the kill nemesis actually fired"
+    assert s["attempt-count"] > 10
+    assert s["lost-count"] == 0, s
+    assert s["valid?"] is True, {k: v for k, v in s.items() if k != "elements"}
