@@ -6,11 +6,18 @@ between knossos's ``:linear``/``:wgl``/``competition`` engines:
   * ``"wgl"``          — the CPU DFS oracle (jepsen_tpu.checker.wgl_cpu);
   * ``"sweep"``        — the CPU configuration-set sweep (the TPU kernel's
     semantics oracle);
-  * ``"tpu"``          — the jit-compiled beam kernel (jepsen_tpu.ops.wgl);
-  * ``"competition"``  — TPU first, falling back to the CPU oracle when the
-    kernel answers "unknown" (capacity overflow or unsupported model) —
-    mirroring knossos.competition's race semantics with a deterministic
-    order instead of racing threads.
+  * ``"tpu"``          — the chunked exact device engine (jepsen_tpu.ops.
+    wgl.analysis: carried-frontier chunk scans, content-decided kills);
+  * ``"competition"``  — the measured-fastest ladder, mirroring
+    knossos.competition's race semantics with a deterministic order
+    instead of racing threads: (1) the async beam kernel at an
+    escalating capacity ladder — a surviving frontier is a constructive
+    witness (True), a lossless death is confirmed against the exact CPU
+    sweep bounded to the failure prefix; (2) on "unknown", the greedy
+    CPU DFS — on valid histories it walks straight through (the 10k-op
+    register that exhausts every fixed-capacity beam resolves here in
+    ~1.4 s); (3) still unknown → the chunked exact device engine, whose
+    refutations are final and whose stats quantify the verified prefix.
 
 On failure, ``final-paths`` / ``configs`` are truncated to 10 entries, as
 the reference does because writing them out "can take *hours*"
@@ -49,14 +56,55 @@ class Linearizable(Checker):
             return wgl_cpu.sweep_analysis(self.model, history)
         from jepsen_tpu.ops import wgl as wgl_tpu
 
-        a = wgl_tpu.analysis(self.model, history, **self.kernel_opts)
         if self.algorithm == "tpu":
-            return a
+            return wgl_tpu.analysis(self.model, history, **self.kernel_opts)
         if self.algorithm == "competition":
-            if a["valid?"] == UNKNOWN:
-                return wgl_cpu.analysis(self.model, history)
-            return a
+            return self._competition(history, wgl_tpu)
         raise ValueError(f"unknown linearizability algorithm {self.algorithm!r}")
+
+    def _competition(self, history, wgl_tpu):
+        """Fast engines first, exact ones on demand (see module doc).
+
+        Tunables ride ``kernel-opts``: ``async-capacity`` sizes the beam
+        ladder (the chunked engine's own ``capacity`` escalation ladder
+        is a separate knob, forwarded untouched), ``confirm-max-configs``
+        bounds the refutation-confirmation sweep (same default as
+        parallel.batch_analysis's confirm_max_configs)."""
+        ladder = self.kernel_opts.get("async-capacity", (256, 1024))
+        if isinstance(ladder, int):
+            ladder = (ladder,)
+        confirm_cap = self.kernel_opts.get("confirm-max-configs", 2_000_000)
+        for cap in ladder:
+            a = wgl_tpu.analysis_async(self.model, history, capacity=int(cap))
+            if a["valid?"] is True:
+                return a
+            if a["valid?"] is False:
+                # fast-engine kills are hash-decided: confirm on the
+                # exact sweep, bounded to the failure prefix
+                stop = (a.get("op") or {}).get("index")
+                c = wgl_cpu.sweep_analysis(
+                    self.model, history, max_configs=confirm_cap, stop_at_index=stop
+                )
+                if c["valid?"] is False:
+                    return {**a, "confirmed?": True}
+                if c["valid?"] is True:
+                    return c  # hash-collision artifact: the sweep wins
+                break  # inconclusive: escalate to the oracles
+            if "not tensorizable" in str(a.get("cause", "")):
+                # no tensor form: every device rung would fail the same
+                # way — the CPU oracle is the only engine
+                return wgl_cpu.analysis(self.model, history)
+        dfs = wgl_cpu.analysis(self.model, history)
+        if dfs["valid?"] != UNKNOWN:
+            return dfs
+        # the exact device engine: final refutations, quantified prefix;
+        # uses its own (chunked) capacity ladder from kernel_opts
+        opts = {k: v for k, v in self.kernel_opts.items()
+                if k not in ("async-capacity", "confirm-max-configs")}
+        a = wgl_tpu.analysis(self.model, history, **opts)
+        if a["valid?"] == UNKNOWN and "not tensorizable" in str(a.get("cause", "")):
+            return dfs  # keep the DFS's informative unknown (budget + op)
+        return a
 
     @staticmethod
     def _truncate(a: Mapping) -> dict:
