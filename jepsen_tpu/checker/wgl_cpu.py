@@ -49,6 +49,7 @@ verdict.
 
 from __future__ import annotations
 
+import bisect
 from typing import Any, Sequence
 
 from jepsen_tpu import history as h
@@ -112,8 +113,15 @@ def _barrier_snapshots(events, eff_ops, crashed):
     """For each return event, snapshot the open ok ops and open crashed
     group counts at that point.  Returns (barriers, group_ops) where
     barriers is a list of (event_pos, op_id, open_ok tuple, open_crashed
-    tuple of ((f, value), count)) and group_ops maps group -> effective op."""
-    open_ok: set[int] = set()
+    tuple of ((f, value), count)) and group_ops maps group -> effective op.
+
+    ``open_ok`` stays sorted by construction — CALL events arrive in
+    position order and an op's id IS its invoke position, so appends are
+    monotone — instead of re-sorting at every barrier; and group tuples
+    use stable insertion order — a per-barrier ``sorted(..., key=repr)``
+    cost the pack of a 100k-op history ~0.9 s for an ordering nothing
+    relies on (consumers key groups through their own index maps)."""
+    open_ok: list[int] = []
     open_crashed: dict[tuple, int] = {}
     group_ops: dict[tuple, dict] = {}
     barriers = []
@@ -124,12 +132,14 @@ def _barrier_snapshots(events, eff_ops, crashed):
                 open_crashed[g] = open_crashed.get(g, 0) + 1
                 group_ops[g] = eff_ops[i]
             else:
-                open_ok.add(i)
+                open_ok.append(i)  # monotone: sorted by construction
         else:
             barriers.append(
-                (pos, i, tuple(sorted(open_ok)), tuple(sorted(open_crashed.items(), key=repr)))
+                (pos, i, tuple(open_ok), tuple(open_crashed.items()))
             )
-            open_ok.discard(i)
+            k = bisect.bisect_left(open_ok, i)
+            if k < len(open_ok) and open_ok[k] == i:
+                del open_ok[k]
     return barriers, group_ops
 
 

@@ -138,18 +138,27 @@ def pack(model: m.Model, history: Sequence[dict]):
 
     bar_quiet = np.zeros(B, bool)
 
+    # Per-op (fcode, v1, v2) memo: an op stays open across many barriers
+    # and was re-encoded at every one (measured 850k _encode_value calls
+    # for a 100k-op history; one per effective op suffices).
+    codes: dict[int, tuple[int, int, int]] = {}
+
+    def op_codes(j: int) -> tuple[int, int, int]:
+        t = codes.get(j)
+        if t is None:
+            oj = eff_ops[j]
+            v1, v2 = _encode_value(oj.get("value"))
+            t = codes[j] = (fcode(oj), v1, v2)
+        return t
+
     for b, (_pos, i, open_ok, open_crashed) in enumerate(barriers):
-        op = eff_ops[i]
         bar_quiet[b] = open_ok == (i,)
-        bar_f[b] = fcode(op)
-        bar_v1[b], bar_v2[b] = _encode_value(op.get("value"))
+        bar_f[b], bar_v1[b], bar_v2[b] = op_codes(i)
         bar_slot[b] = slots[history[i]["process"]]
         bar_opid[b] = i
         for j in open_ok:
             s = slots[history[j]["process"]]
-            oj = eff_ops[j]
-            mov_f[b, s] = fcode(oj)
-            mov_v1[b, s], mov_v2[b, s] = _encode_value(oj.get("value"))
+            mov_f[b, s], mov_v1[b, s], mov_v2[b, s] = op_codes(j)
             mov_open[b, s] = True
         for g, count in open_crashed:
             grp_open[b, gidx[g]] = count
