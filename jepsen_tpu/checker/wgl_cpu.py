@@ -171,7 +171,11 @@ def dfs_analysis(
     if n_barriers == 0:
         return {"valid?": True, "configs": [{"model": model}]}
 
-    empty: tuple = ()
+    # Fired-crash multisets as fixed-vocabulary count tuples (same form
+    # as the sweep): node keys hash without the per-successor
+    # sorted-by-repr canonicalization this replaced.
+    groups, gidx, group_op_list, empty = _group_vocab(group_ops)
+    max_visited = _g_scaled(max_visited, len(groups))
     start = (0, model, frozenset(), empty)
     stack = [start]
     visited = {start}
@@ -204,15 +208,14 @@ def dfs_analysis(
             if not m.is_inconsistent(s2):
                 succs.append((b, s2, fok | {j}, fcr))
         # Fire one crashed op from an available group.
-        fcr_d = dict(fcr)
         for g, open_count in open_crashed:
-            if fcr_d.get(g, 0) >= open_count:
+            gi = gidx[g]
+            if fcr[gi] >= open_count:
                 continue
-            s2 = state.step(group_ops[g])
+            s2 = state.step(group_op_list[gi])
             if not m.is_inconsistent(s2):
-                fcr2 = dict(fcr_d)
-                fcr2[g] = fcr2.get(g, 0) + 1
-                succs.append((b, s2, fok, tuple(sorted(fcr2.items(), key=repr))))
+                fcr2 = fcr[:gi] + (fcr[gi] + 1,) + fcr[gi + 1 :]
+                succs.append((b, s2, fok, fcr2))
         # Fire the returning op itself — pushed last so DFS tries it first.
         s2 = state.step(eff_ops[i])
         if not m.is_inconsistent(s2):
@@ -242,6 +245,26 @@ def dfs_analysis(
 # ---------------------------------------------------------------------------
 # Configuration-set sweep (the TPU kernel's semantics oracle)
 # ---------------------------------------------------------------------------
+
+
+def _group_vocab(group_ops):
+    """Fixed group vocabulary shared by both engines: (groups, gidx,
+    group_op_list, zero-count tuple).  Count tuples are O(G) per config,
+    so the engines scale their exploration budgets by G (see callers) —
+    a group-heavy history answers "unknown" early instead of chewing
+    through gigabytes of wide tuples."""
+    groups = list(group_ops)
+    gidx = {g: k for k, g in enumerate(groups)}
+    group_op_list = [group_ops[g] for g in groups]
+    return groups, gidx, group_op_list, (0,) * len(groups)
+
+
+def _g_scaled(budget: int, n_groups: int, floor: int = 10_000) -> int:
+    """Cap a visited/config budget so total tuple storage stays bounded
+    (~50M counts) however wide the group vocabulary is."""
+    if n_groups <= 64:
+        return budget
+    return max(floor, min(budget, 50_000_000 // n_groups))
 
 
 def _tuple_dominates(a: tuple, b: tuple) -> bool:
@@ -296,10 +319,8 @@ def sweep_analysis(
     barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
     # Fixed group vocabulary: all groups are known after the snapshots,
     # so fired-crash multisets become count TUPLES indexed by group.
-    groups = list(group_ops)
-    gidx = {g: k for k, g in enumerate(groups)}
-    group_op_list = [group_ops[g] for g in groups]
-    zero = (0,) * len(groups)
+    groups, gidx, group_op_list, zero = _group_vocab(group_ops)
+    max_configs = _g_scaled(max_configs, len(groups))
 
     # configs: (state, fok) -> antichain of fired-crashed count tuples
     configs: dict[tuple, _Antichain] = {}
