@@ -27,12 +27,76 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import signal
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+
+# ---------------------------------------------------------------------------
+# Outage guard.  The TPU tunnel can be down (round 4 lost its perf evidence
+# to exactly this: backend init raised deep inside the first device call and
+# the bench died rc=1 with a raw traceback).  A perf harness must degrade to
+# a STRUCTURED failure line the driver can record, so before importing
+# anything that initializes the backend we probe it in a subprocess with a
+# timeout (backend-init hangs are C-level and not reliably interruptible
+# in-process).  JEPSEN_TPU_BENCH_PROBE overrides the probe command (tests
+# simulate outages with it); JEPSEN_TPU_BENCH_PROBE_TIMEOUT the timeout.
+# ---------------------------------------------------------------------------
+_PROBE_SRC = (
+    # honor the same platform override the real bench applies, so a user
+    # forcing JEPSEN_TPU_PLATFORM=cpu probes (and then runs) on CPU
+    # instead of hanging on a dead tunnel
+    "from jepsen_tpu._platform import honor_env_platform; "
+    "honor_env_platform(); import jax; jax.devices()"
+)
+try:
+    _PROBE_TIMEOUT = float(
+        os.environ.get("JEPSEN_TPU_BENCH_PROBE_TIMEOUT", "300")
+    )
+except ValueError:
+    _PROBE_TIMEOUT = 300.0  # malformed override must not crash the bench
+
+
+def _unavailable_line(reason: str) -> str:
+    return json.dumps(
+        {
+            "metric": "linearizability ops verified/sec/chip",
+            "value": 0,
+            "unit": "ops/s",
+            "vs_baseline": 0,
+            "tpu_unavailable": True,
+            "reason": reason[-2000:],
+        }
+    )
+
+
+def _probe_backend() -> str | None:
+    """Returns None if the accelerator backend comes up, else the reason."""
+    cmd = os.environ.get("JEPSEN_TPU_BENCH_PROBE")
+    argv = (
+        ["/bin/sh", "-c", cmd] if cmd else [sys.executable, "-c", _PROBE_SRC]
+    )
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=_PROBE_TIMEOUT,
+            cwd=str(Path(__file__).resolve().parent),
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend probe hung > {_PROBE_TIMEOUT:.0f}s (tunnel down?)"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout or "").strip().splitlines()
+        return "backend probe failed: " + (tail[-1] if tail else f"rc={r.returncode}")
+    return None
+
+
+_reason = _probe_backend()
+if _reason is not None:
+    print(_unavailable_line(_reason))
+    sys.exit(0)
 
 from genhist import corrupt, valid_register_history  # noqa: E402
 
@@ -134,5 +198,26 @@ def main() -> None:
     )
 
 
+def _is_backend_outage(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return any(
+        k in s
+        for k in (
+            "Unable to initialize backend",
+            "UNAVAILABLE",
+            "DEADLINE_EXCEEDED",
+            "Socket closed",
+            "failed to connect",
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — mid-run tunnel drops must
+        # still produce a structured line; real bugs still fail loudly.
+        if _is_backend_outage(e):
+            print(_unavailable_line(f"mid-run backend failure: {e!r}"))
+            sys.exit(0)
+        raise

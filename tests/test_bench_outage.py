@@ -1,0 +1,76 @@
+"""bench.py must never lose a round's evidence to an infra flake.
+
+Round 4's perf number was lost because the TPU tunnel went down and the
+bench died rc=1 with a raw traceback (BENCH_r04.json).  These tests run
+the real script in a subprocess under SIMULATED outages (the probe
+command is overridable precisely for this) and pin the contract: rc=0
+and ONE parseable JSON line carrying ``tpu_unavailable: true``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+BENCH = Path(__file__).resolve().parent.parent / "bench.py"
+
+
+def _run_bench(probe_cmd: str, timeout_s: str | None = None):
+    env = dict(os.environ)
+    env["JEPSEN_TPU_BENCH_PROBE"] = probe_cmd
+    if timeout_s is not None:
+        env["JEPSEN_TPU_BENCH_PROBE_TIMEOUT"] = timeout_s
+    return subprocess.run(
+        [sys.executable, str(BENCH)], capture_output=True, text=True,
+        env=env, timeout=120,
+    )
+
+
+def _assert_outage_line(r):
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one JSON line, got: {r.stdout!r}"
+    rec = json.loads(lines[0])
+    assert rec["tpu_unavailable"] is True
+    assert rec["value"] == 0 and rec["vs_baseline"] == 0
+    assert rec["unit"] == "ops/s"
+    assert rec["reason"]
+    return rec
+
+
+def test_bench_probe_failure_emits_structured_json():
+    """Backend init raising (the round-4 failure mode) -> JSON, rc=0."""
+    r = _run_bench("echo 'RuntimeError: Unable to initialize backend' >&2; exit 1")
+    rec = _assert_outage_line(r)
+    assert "Unable to initialize backend" in rec["reason"]
+
+
+def test_bench_probe_hang_emits_structured_json():
+    """Backend init hanging (tunnel black-holes) -> timeout -> JSON, rc=0."""
+    r = _run_bench("sleep 30", timeout_s="2")
+    rec = _assert_outage_line(r)
+    assert "hung" in rec["reason"]
+
+
+def test_bench_probe_success_proceeds_past_guard():
+    """A healthy probe must NOT short-circuit: the script should get past
+    the guard and into the real bench imports (we don't run the full
+    bench here — just assert no tpu_unavailable line was emitted by the
+    guard by making the run die in a recognizable later way)."""
+    env = dict(os.environ)
+    env["JEPSEN_TPU_BENCH_PROBE"] = "true"  # probe passes instantly
+    # Force the post-guard imports onto CPU so this works tunnel or not.
+    env["JEPSEN_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Shrink the workload via a -c driver that imports bench and checks
+    # the guard outcome only (importing bench as a module never runs
+    # main(); the probe runs at import time).
+    r = subprocess.run(
+        [sys.executable, "-c", "import bench; print('PAST_GUARD')"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=str(BENCH.parent),
+    )
+    assert r.returncode == 0, r.stderr
+    assert "PAST_GUARD" in r.stdout
+    assert "tpu_unavailable" not in r.stdout
