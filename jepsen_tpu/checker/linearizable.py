@@ -80,8 +80,12 @@ class Linearizable(Checker):
                 return a
             if a["valid?"] is False:
                 # fast-engine kills are hash-decided: confirm on the
-                # exact sweep, bounded to the failure prefix
-                stop = (a.get("op") or {}).get("index")
+                # exact sweep, bounded to the failure prefix.  The bound
+                # is the POSITIONAL op id from the kernel stats — the
+                # op's "index" FIELD can differ from its position on
+                # user-supplied histories, silently unbounding the sweep
+                # (advisor r4).
+                stop = a.get("kernel", {}).get("bar-opid")
                 c = wgl_cpu.sweep_analysis(
                     self.model, history, max_configs=confirm_cap, stop_at_index=stop
                 )

@@ -36,6 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu._platform import honor_env_platform
+
+# This module is a backend-initializing entry point in its own right
+# (checker.elle -> ops.closure, never touching ops.hashing), so the
+# JEPSEN_TPU_PLATFORM override must be applied here too (advisor r4).
+honor_env_platform()
+
 MXU_TILE = 128
 
 

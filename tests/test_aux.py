@@ -216,3 +216,27 @@ def test_web_suite_overview(tmp_path):
         assert "/suite" in home
     finally:
         srv.shutdown()
+
+
+def test_platform_override_applies_on_closure_import():
+    """Advisor r4: checker.elle -> ops.closure initializes the jax
+    backend without ever importing ops.hashing, so the
+    JEPSEN_TPU_PLATFORM override must be applied by ops.closure itself.
+    Run in a subprocess (backend init is once-per-process)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JEPSEN_TPU_PLATFORM"] = "cpu"
+    env.pop("JAX_PLATFORMS", None)  # the override, not the env var, must win
+    src = (
+        "import jepsen_tpu.ops.closure, jax; "
+        "print(jax.config.jax_platforms)"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True,
+        env=env, timeout=180,
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "cpu"
