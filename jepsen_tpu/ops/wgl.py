@@ -678,7 +678,9 @@ def chunked_analysis(
         }
         if failed_at >= 0:
             gb = lo + failed_at
-            op = history[int(packed["bar_opid"][gb])]
+            op_pos = int(packed["bar_opid"][gb])
+            op = history[op_pos]
+            stats["bar-opid"] = op_pos  # positional id for stop_at_index
             stats["verified-barriers"] = verified
             # barriers the frontier survived carry a constructive witness
             # (prefix-True), loss or not — death at gb means gb barriers
@@ -784,7 +786,11 @@ def _run_core_async(
     P: int,
     G: int,
     W: int,
-    init_state,
+    bptr0,
+    state0,
+    fok0,
+    fcr0,
+    alive0,
     n_active,
     bar_f,
     bar_v1,
@@ -819,12 +825,26 @@ def _run_core_async(
     same move algebra, same per-barrier filter, True only via a
     surviving frontier, False only when no loss occurred, tick-budget
     exhaustion or overflow → lossy → "unknown".
+
+    CARRIED-FRONTIER RESUME (round 5): the search starts from an explicit
+    (bptr0, frontier) instead of (0, single-config) — the escalation
+    ladder resumes each straggler at its failure point instead of
+    re-running the whole history wider.  The kernel returns, besides the
+    verdict, a RESUME SNAPSHOT: the frontier as it stood at tick entry of
+    the FIRST overflowing tick (exact — no loss has occurred yet — and a
+    superset of that barrier's entry frontier, so re-closing from it at a
+    wider capacity reaches the identical closure), or the final carry
+    when no overflow happened (budget exhaustion; also exact).  A lane
+    resumed from an exact snapshot with a fresh ``lossy`` latch keeps
+    full refutation power: False still means "no loss anywhere on the
+    accepted path".
     """
     eye_g = jnp.eye(G, dtype=I16)
     slot_mask = slot_onehot.sum(axis=1)
 
     def tick(carry):
-        t, bptr, state, fok, fcr, alive, failed_at, lossy, peak = carry
+        (t, bptr, state, fok, fcr, alive, failed_at, lossy, peak,
+         snapped, bsnap, sst, sfo, sfc, sal) = carry
         bc = jnp.clip(bptr, 0, B - 1)
         done = (bptr >= n_active) | (failed_at >= 0)
         # One closure round at barrier bptr.
@@ -838,6 +858,15 @@ def _run_core_async(
             cat_state, cat_fok, cat_fcr, cat_alive, cost, F, n_parents=F,
             max_count=mov_f.shape[-1] + 1,
         )
+        # First overflow: snapshot the PRE-update frontier (exact: lossy
+        # is still False) for the next ladder rung to resume from.
+        take = ovf & ~snapped & ~lossy & ~done
+        snapped2 = snapped | take
+        bsnap2 = jnp.where(take, bc, bsnap)
+        sst2 = jnp.where(take, state, sst)
+        sfo2 = jnp.where(take, fok, sfo)
+        sfc2 = jnp.where(take, fcr, sfc)
+        sal2 = jnp.where(take, alive, sal)
         # frontier_update_fast domination-prunes its own 2C buffer, so a2
         # already marks a duplicate-free antichain (the "+5 resolved
         # histories at cap 128" benefit lives there) — no outer prune
@@ -865,20 +894,21 @@ def _run_core_async(
         bptr2 = jnp.where(adv, bptr + 1, bptr)
         lossy2 = lossy | (ovf & ~done)
         peak2 = jnp.maximum(peak, alive2.sum())
-        return (t + 1, bptr2, state2, fok2, fcr2, alive2, failed2, lossy2, peak2)
+        return (t + 1, bptr2, state2, fok2, fcr2, alive2, failed2, lossy2,
+                peak2, snapped2, bsnap2, sst2, sfo2, sfc2, sal2)
 
-    state0 = jnp.full((F,), init_state, I32)
-    fok0 = jnp.zeros((F, W), U32)
-    fcr0 = jnp.zeros((F, G), I16)
-    alive0 = jnp.zeros((F,), bool).at[0].set(True)
     def cont(carry):
-        t, bptr, _s, _fo, _fc, _a, failed_at, _l, _p = carry
+        t, bptr, _s, _fo, _fc, _a, failed_at = carry[:7]
         running = (bptr < n_active) & (failed_at < 0)
         return (t < T) & running
 
-    carry0 = (jnp.int32(0), jnp.int32(0), state0, fok0, fcr0, alive0,
-              jnp.int32(-1), jnp.bool_(False), jnp.int32(1))
-    (_t, bptr, state, fok, fcr, alive, failed_at, lossy, peak) = jax.lax.while_loop(
+    carry0 = (jnp.int32(0), jnp.asarray(bptr0, I32), state0, fok0, fcr0,
+              alive0, jnp.int32(-1), jnp.bool_(False),
+              jnp.maximum(alive0.sum(), 1).astype(I32),
+              jnp.bool_(False), jnp.asarray(bptr0, I32),
+              state0, fok0, fcr0, alive0)
+    (_t, bptr, state, fok, fcr, alive, failed_at, lossy, peak,
+     snapped, bsnap, sst, sfo, sfc, sal) = jax.lax.while_loop(
         cont, tick, carry0
     )
     finished = bptr >= n_active
@@ -886,7 +916,14 @@ def _run_core_async(
     # Budget exhaustion (neither finished nor failed) is loss.
     lossy_out = lossy | (~finished & (failed_at < 0)) | (failed_at > B)
     failed_out = jnp.where(failed_at > B, jnp.int32(-1), failed_at)
-    return valid, failed_out, lossy_out, peak
+    # No overflow snapshot -> resume from the final carry (exact: the
+    # lane simply ran out of ticks mid-search).
+    bsnap = jnp.where(snapped, bsnap, bptr)
+    sst = jnp.where(snapped, sst, state)
+    sfo = jnp.where(snapped, sfo, fok)
+    sfc = jnp.where(snapped, sfc, fcr)
+    sal = jnp.where(snapped, sal, alive)
+    return valid, failed_out, lossy_out, peak, bsnap, sst, sfo, sfc, sal
 
 
 _run_async = functools.partial(
@@ -898,13 +935,62 @@ _ASYNC_RUNNERS: dict = {}
 
 
 def async_runner(step, F: int, T: int, B: int, P: int, G: int, W: int):
-    """jit(vmap(_run_core_async)) — the batched async-tick checker."""
+    """jit(vmap(_run_core_async)) — the batched async-tick checker.
+
+    Batched inputs (leading lane axis): bptr0, state0, fok0, fcr0,
+    alive0 (the resume frontier — see fresh_frontier for stage one),
+    n_active, then the 12 barrier/mover/group tables; slot tables
+    broadcast."""
     key = (step, F, T, B, P, G, W)
     if key not in _ASYNC_RUNNERS:
         core = functools.partial(_run_core_async, step, F, T, B, P, G, W)
-        axes = (0,) * 14 + (None, None)
+        axes = (0,) * 18 + (None, None)
         _ASYNC_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
     return _ASYNC_RUNNERS[key]
+
+
+def fresh_frontier(n: int, F: int, W: int, G: int, init_states):
+    """Stage-one resume inputs for ``n`` lanes: barrier 0, one alive
+    config per lane holding the lane's initial state."""
+    bptr0 = np.zeros(n, np.int32)
+    state0 = np.zeros((n, F), np.int32)
+    state0[:] = np.asarray(init_states, np.int32)[:, None]
+    fok0 = np.zeros((n, F, W), np.uint32)
+    fcr0 = np.zeros((n, F, G), np.int16)
+    alive0 = np.zeros((n, F), bool)
+    alive0[:, 0] = True
+    return bptr0, state0, fok0, fcr0, alive0
+
+
+def pad_resume(resume, F: int, W: int, G: int):
+    """Re-bucket one lane's saved (bsnap, state, fok, fcr, alive) resume
+    frontier to the next stage's (F, W, G).  Growing pads with dead rows
+    / zero columns; shrinking is safe because a history's own slots and
+    groups always fit its OWN (P, G) — bucket padding beyond them is
+    never set (see pad_packed)."""
+    bsnap, st, fo, fc, al = resume
+    F0, W0 = fo.shape
+    G0 = fc.shape[1]
+    n_alive = int(al.sum())
+    assert n_alive <= F, f"resume frontier {n_alive} exceeds capacity {F}"
+    out_st = np.zeros(F, np.int32)
+    out_fo = np.zeros((F, W), np.uint32)
+    out_fc = np.zeros((F, G), np.int16)
+    out_al = np.zeros(F, bool)
+    k = min(F0, F)
+    out_st[:k] = st[:k]
+    out_fo[:k, : min(W0, W)] = fo[:k, : min(W0, W)]
+    out_fc[:k, : min(G0, G)] = fc[:k, : min(G0, G)]
+    out_al[:k] = al[:k]
+    if F < F0 and al[F:].any():
+        # compact alive rows first instead of truncating live configs
+        sel = np.flatnonzero(al)[:F]
+        out_st[: len(sel)] = st[sel]
+        out_fo[: len(sel), : min(W0, W)] = fo[sel][:, : min(W0, W)]
+        out_fc[: len(sel), : min(G0, G)] = fc[sel][:, : min(G0, G)]
+        out_al[:] = False
+        out_al[: len(sel)] = True
+    return int(bsnap), out_st, out_fo, out_fc, out_al
 
 
 def analysis_async(
@@ -931,15 +1017,23 @@ def analysis_async(
     packed = pad_packed(packed)
     B = packed["B"]
     T = int(ticks) if ticks is not None else async_ticks(B)
-    valid, failed_at, lossy, peak = _run_async(
+    F, W, G = int(capacity), packed["W"], packed["G"]
+    bptr0, st0, fo0, fc0, al0 = fresh_frontier(
+        1, F, W, G, [packed["init_state"]]
+    )
+    valid, failed_at, lossy, peak, _bs, _st, _fo, _fc, _al = _run_async(
         packed["step"],
-        int(capacity),
+        F,
         T,
         B,
         packed["P"],
-        packed["G"],
-        packed["W"],
-        packed["init_state"],
+        G,
+        W,
+        bptr0[0],
+        st0[0],
+        fo0[0],
+        fc0[0],
+        al0[0],
         np.int32(n_active),
         *packed["bar"],
         *packed["mov"],
@@ -957,7 +1051,13 @@ def analysis_async(
     if not lossy:
         op = None
         if 0 <= failed_at < len(packed["bar_opid"]):
-            op = history[int(packed["bar_opid"][failed_at])]
+            op_pos = int(packed["bar_opid"][failed_at])
+            op = history[op_pos]
+            # POSITIONAL id (invoke position in the history, the identity
+            # sweep_analysis's stop_at_index matches) — op.get("index") is
+            # a user-facing field that may differ on unindexed or
+            # re-indexed histories (advisor r4).
+            stats["bar-opid"] = op_pos
         return {"valid?": False, "op": op, "kernel": stats}
     return {
         "valid?": "unknown",

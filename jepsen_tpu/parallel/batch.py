@@ -37,6 +37,9 @@ from jepsen_tpu.ops import wgl
 #: imports its defining module; this one would drag in the kernels).
 _CONFIRM_POOL: ProcessPoolExecutor | None = None
 
+#: one-shot flag for the exact_escalation=None behavior-change warning.
+_WARNED_EXACT_DEFAULT = False
+
 
 def _default_workers(workers: int | None) -> int:
     return workers or min(8, os.cpu_count() or 1)
@@ -142,6 +145,7 @@ def batch_analysis(
     confirm_refutations: bool = True,
     confirm_workers: int | None = None,
     confirm_max_configs: int = 2_000_000,
+    carry_frontier: bool = True,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -168,7 +172,14 @@ def batch_analysis(
     False); a sweep that disagrees (the ~1e-13 collision case) wins.
 
     Escalation is about CAPACITY: each ladder stage re-runs only the
-    still-lossy histories wider.  ``exact_escalation`` optionally appends
+    still-lossy histories wider — and with ``carry_frontier`` (the
+    default, round 5) an async rung RESUMES each straggler from its
+    saved exact pre-loss frontier at its failure barrier instead of
+    re-running the whole history: the verified prefix is never re-paid,
+    and the rung's tick budget shrinks to the max REMAINING barriers.
+    Soundness is unchanged (the snapshot is taken before any loss, so
+    refutations keep their "no loss anywhere" meaning and are still
+    sweep-confirmed).  ``exact_escalation`` optionally appends
     stages on the in-round-domination kernel (frontier_update; ~10x
     slower per lane but content-exact, so its refutations are final);
     wide stages sub-batch automatically.  Behavior change (round 3):
@@ -201,11 +212,36 @@ def batch_analysis(
         raise ValueError(f"unknown engine {engine!r}; expected 'sync' or 'async'")
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     batch_caps = [int(c) for c in capacities]
+    if exact_escalation is None and not cpu_fallback:
+        # Behavior changed in round 3 (None used to mean one implicit
+        # exact stage at 4x the last batch capacity; now it means none).
+        # Callers without the CPU fallback are the ones who can observe
+        # the difference — as extra "unknown"s with no runtime signal —
+        # so give them one (advisor r4).
+        global _WARNED_EXACT_DEFAULT
+        if not _WARNED_EXACT_DEFAULT:
+            _WARNED_EXACT_DEFAULT = True
+            import warnings
+
+            warnings.warn(
+                "exact_escalation=None now means NO exact stages (it "
+                "used to mean one at 4x the last batch capacity); with "
+                "cpu_fallback=False, capacity-bound histories stay "
+                "'unknown'. Pass exact_escalation=() to silence, or an "
+                "explicit ladder to restore exact stages.",
+                stacklevel=2,
+            )
     exact_caps = [int(c) for c in (exact_escalation or ())]
-    def _launch(st_engine: str, batch_cap: int, sub: list[dict]):
+    def _launch(st_engine: str, batch_cap: int, sub: list[dict],
+                sub_resumes: list[tuple | None] | None = None):
         """Stack ``sub`` to common bucket shapes and run one vmapped
-        kernel launch; returns (valid, failed_at, lossy, peak) host
-        arrays of len(sub)."""
+        kernel launch; returns (valid, failed_at, lossy, peak, resumes)
+        host arrays of len(sub).  ``sub_resumes[j]`` optionally carries
+        lane j's saved (bsnap, state, fok, fcr, alive) frontier from the
+        previous rung — the lane resumes there instead of re-running the
+        whole history (round 5: carried-frontier escalation).  The
+        returned ``resumes`` list holds each lane's snapshot for the NEXT
+        rung (async engine only; None otherwise)."""
         B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
         P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
         G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
@@ -235,22 +271,55 @@ def batch_analysis(
                 for k, a in zip(_ARG_ORDER, args)
             ]
         W = (P + 31) // 32
+        out_resumes: list = [None] * n
         if st_engine == "async":
-            T = wgl.async_ticks(B, batch_cap)
             n_actives = np.array([p["bar_active"].sum() for p in sub], np.int32)
+            # Per-lane resume frontiers: fresh single-config at barrier 0,
+            # or the saved snapshot re-bucketed to this stage's shapes.
+            F = batch_cap
+            bptr0, st0, fo0, fc0, al0 = wgl.fresh_frontier(
+                n, F, W, G, [p["init_state"] for p in sub]
+            )
+            if sub_resumes is not None:
+                for j, r in enumerate(sub_resumes):
+                    if r is None:
+                        continue
+                    bs, rst, rfo, rfc, ral = wgl.pad_resume(r, F, W, G)
+                    bptr0[j], st0[j], fo0[j], fc0[j], al0[j] = bs, rst, rfo, rfc, ral
+            # Tick budget from the MAX REMAINING barriers, not the padded
+            # B: resumed lanes skip their verified prefix, so the budget
+            # (and the stage's worst-case wall clock) shrinks with it.
+            b_rem = int(max(1, (n_actives - bptr0[:n]).max()))
+            b_rem = 1 << max(5, (b_rem - 1).bit_length())
+            T = wgl.async_ticks(min(b_rem, B), batch_cap)
             if n_pad != n:
                 n_actives = np.concatenate([n_actives, np.repeat(n_actives[-1:], n_pad - n)])
+                reps = n_pad - n
+                bptr0 = np.concatenate([bptr0, np.repeat(bptr0[-1:], reps)])
+                st0 = np.concatenate([st0, np.repeat(st0[-1:], reps, axis=0)])
+                fo0 = np.concatenate([fo0, np.repeat(fo0[-1:], reps, axis=0)])
+                fc0 = np.concatenate([fc0, np.repeat(fc0[-1:], reps, axis=0)])
+                al0 = np.concatenate([al0, np.repeat(al0[-1:], reps, axis=0)])
             order = ASYNC_ARG_ORDER
             by_name = dict(zip(_ARG_ORDER, args))
-            a_args = [by_name["init_state"], jnp.asarray(n_actives)] + [
-                by_name[k] for k in order[1:]
-            ]
+            a_args = [jnp.asarray(bptr0), jnp.asarray(st0), jnp.asarray(fo0),
+                      jnp.asarray(fc0), jnp.asarray(al0),
+                      jnp.asarray(n_actives)] + [by_name[k] for k in order[1:]]
             if mesh is not None:
                 axis = mesh.axis_names[0]
                 spec = NamedSharding(mesh, PartitionSpec(axis))
-                a_args[1] = jax.device_put(np.asarray(a_args[1]), spec)
+                for ai in range(6):
+                    a_args[ai] = jax.device_put(np.asarray(a_args[ai]), spec)
             runner = wgl.async_runner(sub[0]["step"], batch_cap, T, B, P, G, W)
-            valid, failed_at, lossy, peak = runner(*a_args)
+            valid, failed_at, lossy, peak, bsnap, sst, sfo, sfc, sal = runner(*a_args)
+            if carry_frontier:
+                # snapshots only leave the device when they can be used
+                bsnap, sst = np.asarray(bsnap), np.asarray(sst)
+                sfo, sfc, sal = np.asarray(sfo), np.asarray(sfc), np.asarray(sal)
+                out_resumes = [
+                    (int(bsnap[j]), sst[j], sfo[j], sfc[j], sal[j])
+                    for j in range(n)
+                ]
         elif st_engine == "sync":
             runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
             valid, failed_at, lossy, peak = runner(*args)
@@ -262,10 +331,12 @@ def batch_analysis(
             np.asarray(failed_at)[:n],
             np.asarray(lossy)[:n],
             np.asarray(peak)[:n],
+            out_resumes,
         )
 
     stages = [(engine, c) for c in batch_caps] + [("exact", c) for c in exact_caps]
     pending = list(range(len(packs)))
+    resumes: dict[int, tuple] = {}  # pack idx -> saved resume frontier
     confirm_futs: dict = {}  # history index -> (future, device result)
     for st_engine, batch_cap in stages:
         if not pending:
@@ -275,13 +346,28 @@ def batch_analysis(
         # capacity*lanes ≳ 64k on the exact engine, whose sort and
         # domination buffers are ~10x the fast kernel's per-lane
         # footprint; fast engines get a proportionally larger budget).
-        budget = (16 * 1024) if st_engine == "exact" else (64 * 1024)
+        # The carried-frontier snapshot doubles the async kernel's
+        # resident per-lane frontier, so its budget halves to keep the
+        # old resident bound (re-measure the true threshold on-chip).
+        if st_engine == "exact":
+            budget = 16 * 1024
+        elif st_engine == "async" and carry_frontier:
+            budget = 32 * 1024
+        else:
+            budget = 64 * 1024
         lanes_cap = max(1, budget // batch_cap)
-        outs = [
-            _launch(st_engine, batch_cap, [packs[k] for k in pending[s0 : s0 + lanes_cap]])
-            for s0 in range(0, len(pending), lanes_cap)
-        ]
-        valid, failed_at, lossy, peak = (np.concatenate(x) for x in zip(*outs))
+        outs = []
+        for s0 in range(0, len(pending), lanes_cap):
+            chunk = pending[s0 : s0 + lanes_cap]
+            sub_res = (
+                [resumes.get(k) for k in chunk]
+                if (st_engine == "async" and carry_frontier) else None
+            )
+            outs.append(_launch(st_engine, batch_cap, [packs[k] for k in chunk], sub_res))
+        valid, failed_at, lossy, peak = (
+            np.concatenate([o[i] for o in outs]) for i in range(4)
+        )
+        all_resumes = [r for o in outs for r in o[4]]
         still = []
         for j, k in enumerate(pending):
             i = idxs[k]
@@ -311,6 +397,10 @@ def batch_analysis(
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
+                if st_engine == "async" and carry_frontier and all_resumes[j] is not None:
+                    # resume this lane at its exact pre-loss frontier on
+                    # the next rung instead of re-running from barrier 0
+                    resumes[k] = all_resumes[j]
                 results[i] = {
                     "valid?": "unknown",
                     "cause": "frontier capacity or closure rounds exhausted",
@@ -342,10 +432,24 @@ def batch_analysis(
                 _reset_confirm_pool()
             if cpu_fallback:
                 # the caller asked for CPU fallback on unknowns: confirm
-                # in-process instead (same sweep the worker would run)
-                results[i] = wgl_cpu.sweep_analysis(
-                    model, histories[i], max_configs=confirm_max_configs
-                )
+                # in-process instead (same sweep the worker would run).
+                # If the worker died because the sweep itself raises
+                # deterministically (model bug, malformed history), the
+                # re-run raises the SAME error — degrade this history
+                # alone, never the batch (advisor r4).
+                try:
+                    results[i] = wgl_cpu.sweep_analysis(
+                        model, histories[i], max_configs=confirm_max_configs
+                    )
+                except Exception as e2:  # noqa: BLE001
+                    results[i] = {
+                        "valid?": "unknown",
+                        "cause": (
+                            "device refutation; confirmation sweep raised: "
+                            f"{e2!r}"
+                        ),
+                        "kernel": dev_res.get("kernel"),
+                    }
             else:
                 results[i] = {
                     "valid?": "unknown",

@@ -149,7 +149,8 @@ def test_confirm_future_failure_degrades_to_unknown(monkeypatch):
     monkeypatch.setattr(pb, "_reset_confirm_pool", lambda: reset_calls.append(1))
     hists, expect = histories_mixed(6)
     results = pb.batch_analysis(
-        m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=False
+        m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=False,
+        exact_escalation=(),
     )
     for r, want in zip(results, expect):
         if want is True:
@@ -165,3 +166,160 @@ def test_confirm_future_failure_degrades_to_unknown(monkeypatch):
         m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=True
     )
     assert [r["valid?"] for r in results] == expect
+
+
+def test_inprocess_confirm_sweep_raise_degrades_one_history(monkeypatch):
+    """Advisor r4: if the confirmation worker died because sweep_analysis
+    itself raises deterministically, the in-process fallback re-raises the
+    same error — it must degrade THAT history to unknown, not unwind
+    batch_analysis and lose every other verdict."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    from jepsen_tpu.parallel import batch as pb
+
+    hists, expect = histories_mixed(6)  # calls the real sweep; build first
+
+    class ExplodingFuture:
+        def result(self, timeout=None):
+            raise BrokenProcessPool("worker died")
+
+    class ExplodingPool:
+        def submit(self, fn, *a, **kw):
+            return ExplodingFuture()
+
+    pool = ExplodingPool()
+    monkeypatch.setattr(pb, "_CONFIRM_POOL", pool)
+    monkeypatch.setattr(pb, "_confirm_pool", lambda workers: pool)
+    monkeypatch.setattr(pb, "_reset_confirm_pool", lambda: None)
+
+    def raising_sweep(model, hist, max_configs=None, **kw):
+        raise ValueError("deterministic model bug")
+
+    monkeypatch.setattr(pb.wgl_cpu, "sweep_analysis", raising_sweep)
+    results = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256), cpu_fallback=True
+    )
+    assert len(results) == len(hists)
+    for r, want in zip(results, expect):
+        if want is True:
+            assert r["valid?"] is True  # untouched verdicts survive
+        else:
+            assert r["valid?"] == "unknown"
+            assert "confirmation sweep raised" in r["cause"]
+
+
+def test_exact_escalation_none_warns_once_without_fallback():
+    """Advisor r4: the round-3 behavior change (None -> no exact stages)
+    is only observable to cpu_fallback=False callers as extra unknowns;
+    they get a one-shot warning."""
+    import warnings
+
+    from jepsen_tpu.parallel import batch as pb
+
+    hists = [valid_register_history(10, 2, seed=1, info_rate=0.0)]
+    old = pb._WARNED_EXACT_DEFAULT
+    try:
+        pb._WARNED_EXACT_DEFAULT = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pb.batch_analysis(m.CASRegister(None), hists, capacity=64,
+                              cpu_fallback=False)
+            assert any("exact_escalation" in str(x.message) for x in w)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pb.batch_analysis(m.CASRegister(None), hists, capacity=64,
+                              cpu_fallback=False)
+            assert not w  # one-shot
+        # explicit () and cpu_fallback=True never warn
+        pb._WARNED_EXACT_DEFAULT = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pb.batch_analysis(m.CASRegister(None), hists, capacity=64,
+                              cpu_fallback=False, exact_escalation=())
+            pb.batch_analysis(m.CASRegister(None), hists, capacity=64,
+                              cpu_fallback=True)
+            assert not w
+    finally:
+        pb._WARNED_EXACT_DEFAULT = old
+
+
+def test_carried_frontier_escalation_matches_scratch():
+    """Round-5 carried-frontier escalation: resuming stragglers from
+    their exact pre-loss snapshot at the next rung must produce the same
+    verdicts as re-running from scratch (the snapshot is exact and
+    closure is deterministic, so the wider rung reaches the identical
+    frontier)."""
+    from jepsen_tpu.parallel import batch as pb
+
+    hists, expect = [], []
+    # branch-heavy histories that overflow cap 16 and resolve wider
+    for i in range(8):
+        hist = valid_register_history(60, 6, seed=100 + i, info_rate=0.35)
+        if i % 2:
+            hist = corrupt(hist, seed=i)
+            expect.append(wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"])
+        else:
+            expect.append(True)
+        hists.append(hist)
+
+    kw = dict(capacity=(16, 64, 512), cpu_fallback=False, exact_escalation=())
+    carried = pb.batch_analysis(m.CASRegister(None), hists, carry_frontier=True, **kw)
+    scratch = pb.batch_analysis(m.CASRegister(None), hists, carry_frontier=False, **kw)
+    for i, (c, s, want) in enumerate(zip(carried, scratch, expect)):
+        # neither mode may ever contradict the oracle
+        assert c["valid?"] in (want, "unknown"), (i, c["valid?"], want)
+        assert s["valid?"] in (want, "unknown"), (i, s["valid?"], want)
+    # resumption must not LOSE resolution power vs scratch
+    n_unknown_carried = sum(r["valid?"] == "unknown" for r in carried)
+    n_unknown_scratch = sum(r["valid?"] == "unknown" for r in scratch)
+    assert n_unknown_carried <= n_unknown_scratch, (
+        n_unknown_carried, n_unknown_scratch)
+
+
+def test_carried_frontier_snapshot_resume_single_lane():
+    """Kernel-level resume contract: run at a tiny capacity until lossy,
+    then resume from the returned snapshot at a wide capacity and get the
+    oracle's verdict — without re-running the verified prefix."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.ops import wgl
+
+    hist = corrupt(
+        valid_register_history(80, 6, seed=42, info_rate=0.35), seed=7)
+    truth = wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"]
+    packed = wgl.pack(m.CASRegister(None), hist)
+    n_active = int(packed["bar_active"].sum())
+    packed = wgl.pad_packed(packed)
+    B, P, G, W = packed["B"], packed["P"], packed["G"], packed["W"]
+
+    def run(cap, bptr0, st0, fo0, fc0, al0):
+        T = wgl.async_ticks(B, cap)
+        return wgl._run_async(
+            packed["step"], cap, T, B, P, G, W,
+            bptr0, st0, fo0, fc0, al0, jnp.int32(n_active),
+            *packed["bar"], *packed["mov"], *packed["grp"],
+            packed["grp_open"], jnp.asarray(packed["slot_lane"]),
+            jnp.asarray(packed["slot_onehot"]),
+        )
+
+    bp, st, fo, fc, al = wgl.fresh_frontier(1, 4, W, G, [packed["init_state"]])
+    valid, failed_at, lossy, peak, bs, sst, sfo, sfc, sal = run(
+        4, bp[0], st[0], fo[0], fc[0], al[0])
+    if not bool(lossy):
+        import pytest
+        pytest.skip("cap 4 unexpectedly sufficient; can't exercise resume")
+    assert int(bs) >= 0
+    import numpy as np
+    bs2, rst, rfo, rfc, ral = wgl.pad_resume(
+        (int(bs), np.asarray(sst), np.asarray(sfo), np.asarray(sfc),
+         np.asarray(sal)), 1024, W, G)
+    valid2, failed2, lossy2, _pk, *_ = run(
+        1024, jnp.int32(bs2), jnp.asarray(rst), jnp.asarray(rfo),
+        jnp.asarray(rfc), jnp.asarray(ral))
+    if not bool(lossy2):
+        got = True if bool(valid2) else (False if int(failed2) >= 0 else "unknown")
+        if got is not True and got is not False:
+            return
+        if truth == "unknown":
+            return
+        assert got == truth

@@ -395,3 +395,41 @@ def test_competition_ladder_semantics():
     fifo = linearizable({"model": m.FIFOQueue()})
     hist = [h.op(h.INVOKE, 0, "enqueue", 1), h.op(h.OK, 0, "enqueue", 1)]
     assert fifo.check({}, h.index(hist), {})["valid?"] is True
+
+
+def test_refutation_stats_carry_positional_bar_opid():
+    """Advisor r4: the competition ladder bounds its confirmation sweep
+    with the POSITIONAL op id (what sweep_analysis's stop_at_index
+    matches), not the op's user-facing "index" field — the two differ on
+    re-indexed histories.  The kernels expose the positional id in
+    kernel stats; the ladder must keep working when every index field
+    lies."""
+    bad = corrupt(valid_register_history(40, 3, seed=9, info_rate=0.1), seed=9)
+    # shift every index FIELD so field != position everywhere
+    shifted = [{**o, "index": o.get("index", 0) + 1000} for o in bad]
+
+    a = wgl.analysis_async(m.CASRegister(None), shifted, capacity=512)
+    if a["valid?"] is False:
+        pos = a["kernel"]["bar-opid"]
+        assert 0 <= pos < len(shifted)
+        assert shifted[pos] is not None
+        assert a["op"]["index"] >= 1000  # the op still carries its field
+        # the positional id names the same op by position, not by field
+        assert shifted[pos] == a["op"]
+
+    c = wgl.analysis(m.CASRegister(None), shifted, capacity=(256, 1024))
+    if c["valid?"] is False:
+        pos = c["kernel"]["bar-opid"]
+        assert 0 <= pos < len(shifted)
+        assert shifted[pos] == c["op"]
+
+    # end-to-end: the competition ladder confirms the refutation with the
+    # positional bound — on a lying index field a wrong bound either
+    # unbounds the sweep or spuriously early-unknowns; verdict must stay
+    # False + confirmed.
+    chk = linearizable({"model": m.CASRegister(None)})
+    truth = wgl_cpu.sweep_analysis(m.CASRegister(None), bad)["valid?"]
+    r = chk.check({}, shifted, {})
+    assert r["valid?"] == truth
+    if r["valid?"] is False and "kernel" in r:
+        assert r.get("confirmed?") is True, r
