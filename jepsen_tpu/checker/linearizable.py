@@ -10,14 +10,16 @@ between knossos's ``:linear``/``:wgl``/``competition`` engines:
     wgl.analysis: carried-frontier chunk scans, content-decided kills);
   * ``"competition"``  — the measured-fastest ladder, mirroring
     knossos.competition's race semantics with a deterministic order
-    instead of racing threads: (1) the async beam kernel at an
-    escalating capacity ladder — a surviving frontier is a constructive
-    witness (True), a lossless death is confirmed against the exact CPU
-    sweep bounded to the failure prefix; (2) on "unknown", the greedy
-    CPU DFS — on valid histories it walks straight through (the 10k-op
-    register that exhausts every fixed-capacity beam resolves here in
-    ~1.4 s); (3) still unknown → the chunked exact device engine, whose
-    refutations are final and whose stats quantify the verified prefix.
+    instead of racing threads: (0) the DEVICE greedy witness walk
+    (wgl.greedy_analysis) — one config, no frontier buffers; most valid
+    histories (including the 10k-op register that exhausts every
+    fixed-capacity beam) resolve here in one scan; (1) the async beam
+    kernel at an escalating capacity ladder — a surviving frontier is a
+    constructive witness (True), a lossless death is confirmed against
+    the exact CPU sweep bounded to the failure prefix; (2) on
+    "unknown", the greedy CPU DFS; (3) still unknown → the chunked
+    exact device engine, whose refutations are final and whose stats
+    quantify the verified prefix.
 
 On failure, ``final-paths`` / ``configs`` are truncated to 10 entries, as
 the reference does because writing them out "can take *hours*"
@@ -74,6 +76,17 @@ class Linearizable(Checker):
         if isinstance(ladder, int):
             ladder = (ladder,)
         confirm_cap = self.kernel_opts.get("confirm-max-configs", 2_000_000)
+        # Rung 0: the greedy witness walk — one config, no frontier
+        # buffers, resolves most valid histories (incl. the 10k-op
+        # register that exhausts every fixed-capacity beam) in one scan.
+        # ``greedy-first: False`` in kernel-opts disables it (mirror of
+        # batch_analysis's greedy_first knob).
+        if self.kernel_opts.get("greedy-first", True):
+            g = wgl_tpu.greedy_analysis(self.model, history)
+            if g["valid?"] is True:
+                return g
+            if "not tensorizable" in str(g.get("cause", "")):
+                return wgl_cpu.analysis(self.model, history)
         for cap in ladder:
             a = wgl_tpu.analysis_async(self.model, history, capacity=int(cap))
             if a["valid?"] is True:

@@ -993,6 +993,183 @@ def pad_resume(resume, F: int, W: int, G: int):
     return int(bsnap), out_st, out_fo, out_fc, out_al
 
 
+# ---------------------------------------------------------------------------
+# Greedy witness walk: one config, fire-returning-op-first, one-enabler
+# lookahead — the device-side equivalent of the CPU DFS's greedy path
+# ---------------------------------------------------------------------------
+
+
+def _greedy_core(
+    step,
+    B: int,
+    P: int,
+    G: int,
+    W: int,
+    init_state,
+    n_active,
+    bar_f,
+    bar_v1,
+    bar_v2,
+    bar_slot,
+    mov_f,
+    mov_v1,
+    mov_v2,
+    mov_open,
+    grp_f,
+    grp_v1,
+    grp_v2,
+    grp_open,
+    slot_lane,
+    slot_onehot,
+):
+    """Walk ONE configuration through all barriers, greedily.
+
+    The CPU DFS resolves valid histories by its greedy path — fire the
+    returning op first, backtracking only when stuck
+    (wgl_cpu.dfs_analysis; knossos's observation that valid histories
+    "usually walk straight through").  This kernel is that path as a
+    fixed-shape ``lax.scan``: per barrier, fire the returning op
+    directly if legal, else fire ONE enabling move (an open ok op or a
+    crashed-group op) whose step makes the returning op legal — a
+    two-step lookahead over all P+G movers, evaluated as one vectorized
+    step batch — else the walk is STUCK and escalates.
+
+    Every applied transition is legal, so completion is a constructive
+    witness: ``True`` is exact.  The walk never refutes — stuck means
+    "unknown", it proves nothing (a frontier/DFS engine decides).  Cost
+    is O(B·(P+G)) scalar step evaluations with no frontier buffers at
+    all — the cheapest possible first rung, and the shape that resolves
+    BASELINE config 2 (10k-op valid register) on-device.
+
+    Returns (finished, stuck_at, fired_crashed_total):
+    ``stuck_at`` = barrier index where the walk stuck (-1 = never).
+    """
+    slot_mask = slot_onehot.sum(axis=1)  # [P] uint32 in-lane bit
+    p_iota = jnp.arange(P, dtype=I32)
+
+    def barrier(carry, xs):
+        state, fok, fcr, stuck_at = carry
+        b_idx, bf, bv1, bv2, bslot, mf, mv1, mv2, mopen, gopen = xs
+        done = (stuck_at >= 0) | (b_idx >= n_active)
+        lane = bslot // 32
+        bit = (U32(1) << (bslot % 32).astype(U32))
+        has_bit = (fok[lane] & bit) != 0
+        # Case A: already fired as an earlier barrier's enabler — retire.
+        # Case B: direct fire.
+        s1, legal1 = step(state, bf, bv1, bv2)
+        # Case C: one enabling open ok op, then the returning op.
+        already = (jnp.take(fok, slot_lane) & slot_mask) != 0  # [P]
+        ps2, plegal = step(state, mf, mv1, mv2)
+        ps3, plegal3 = step(ps2, bf, bv1, bv2)
+        pcand = plegal & plegal3 & mopen & ~already & (p_iota != bslot)
+        # Case D: one enabling crashed-group op, then the returning op.
+        gs2, glegal = step(state, grp_f, grp_v1, grp_v2)
+        gs3, glegal3 = step(gs2, bf, bv1, bv2)
+        gcand = glegal & glegal3 & (fcr < gopen) & (gs2 != state)
+        p_any = pcand.any()
+        g_any = gcand.any()
+        p_idx = jnp.argmax(pcand)
+        g_idx = jnp.argmax(gcand)
+        clear = jnp.where(jnp.arange(W) == lane, bit, U32(0))
+        # Priority: A (no step) > B (direct) > C (ok enabler) > D (group).
+        new_state = jnp.where(
+            has_bit, state,
+            jnp.where(legal1, s1,
+                      jnp.where(p_any, ps3[p_idx], gs3[g_idx])))
+        new_fok = jnp.where(
+            has_bit, fok & ~clear,
+            jnp.where(legal1, fok,
+                      jnp.where(p_any, fok | slot_onehot[p_idx], fok)))
+        new_fcr = jnp.where(
+            ~has_bit & ~legal1 & ~p_any & g_any,
+            fcr + (jnp.arange(G) == g_idx).astype(I16), fcr)
+        ok = has_bit | legal1 | p_any | g_any
+        stuck2 = jnp.where(~done & ~ok, b_idx, stuck_at)
+        keep = done | ~ok
+        state2 = jnp.where(keep, state, new_state)
+        fok2 = jnp.where(keep, fok, new_fok)
+        fcr2 = jnp.where(keep, fcr, new_fcr)
+        return (state2, fok2, fcr2, stuck2), None
+
+    carry0 = (
+        jnp.asarray(init_state, I32),
+        jnp.zeros(W, U32),
+        jnp.zeros(G, I16),
+        jnp.int32(-1),
+    )
+    xs = (
+        jnp.arange(B, dtype=I32), bar_f, bar_v1, bar_v2, bar_slot,
+        mov_f, mov_v1, mov_v2, mov_open, grp_open,
+    )
+    (state, fok, fcr, stuck_at), _ = jax.lax.scan(barrier, carry0, xs)
+    finished = stuck_at < 0
+    return finished, stuck_at, fcr.sum().astype(I32)
+
+
+_greedy = functools.partial(
+    jax.jit, static_argnames=("step", "B", "P", "G", "W")
+)(_greedy_core)
+
+#: (step, B, P, G, W) -> jitted vmapped greedy runner.
+_GREEDY_RUNNERS: dict = {}
+
+
+def greedy_runner(step, B: int, P: int, G: int, W: int):
+    """jit(vmap(_greedy_core)) — the batched greedy witness walk."""
+    key = (step, B, P, G, W)
+    if key not in _GREEDY_RUNNERS:
+        core = functools.partial(_greedy_core, step, B, P, G, W)
+        axes = (0,) * 14 + (None, None)
+        _GREEDY_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
+    return _GREEDY_RUNNERS[key]
+
+
+def greedy_analysis(
+    model: m.Model,
+    history: Sequence[dict],
+    max_groups: int = 64,
+    max_procs: int = 128,
+) -> dict:
+    """Single-history greedy witness walk.  ``True`` (with a witness) or
+    ``"unknown"`` — never ``False`` (see _greedy_core)."""
+    try:
+        packed = pack(model, history)
+    except NotTensorizable as e:
+        return {"valid?": "unknown", "cause": f"not tensorizable: {e}"}
+    if packed["B"] == 0:
+        return {"valid?": True}
+    if packed["G"] > max_groups:
+        return {"valid?": "unknown", "cause": f"{packed['G']} crashed-op groups exceeds {max_groups}"}
+    if packed["P"] > max_procs:
+        return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
+    n_active = int(packed["bar_active"].sum())
+    packed = pad_packed(packed)
+    finished, stuck_at, fired = _greedy(
+        packed["step"],
+        packed["B"],
+        packed["P"],
+        packed["G"],
+        packed["W"],
+        packed["init_state"],
+        np.int32(n_active),
+        *packed["bar"],
+        *packed["mov"],
+        *packed["grp"],
+        packed["grp_open"],
+        jnp.asarray(packed["slot_lane"]),
+        jnp.asarray(packed["slot_onehot"]),
+    )
+    stats = {"engine": "greedy", "fired-crashed": int(fired)}
+    if bool(finished):
+        return {"valid?": True, "kernel": stats}
+    return {
+        "valid?": "unknown",
+        "cause": "greedy walk stuck (no single-enabler move)",
+        "op": history[int(packed["bar_opid"][int(stuck_at)])],
+        "kernel": {**stats, "stuck-at": int(stuck_at)},
+    }
+
+
 def analysis_async(
     model: m.Model,
     history: Sequence[dict],

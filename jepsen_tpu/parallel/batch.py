@@ -146,6 +146,7 @@ def batch_analysis(
     confirm_workers: int | None = None,
     confirm_max_configs: int = 2_000_000,
     carry_frontier: bool = True,
+    greedy_first: bool = True,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -159,6 +160,11 @@ def batch_analysis(
     closure depth on the "sync" engine and the exact escalation stages;
     the async engine's closure budget is its tick budget
     (wgl.async_ticks).
+
+    ``greedy_first`` (default) prepends a capacity-1 greedy witness-walk
+    stage (wgl.greedy_runner): most VALID lanes resolve there for the
+    cost of one buffer-free scan, so the beam ladder only pays for the
+    contested lanes.  The walk never refutes, so soundness is untouched.
 
     ``True`` verdicts are sound from every stage (a surviving frontier is
     a constructive witness).  The fast engines dedup by 64-bit row hash,
@@ -272,6 +278,33 @@ def batch_analysis(
             ]
         W = (P + 31) // 32
         out_resumes: list = [None] * n
+        if st_engine == "greedy":
+            # Stage 0: the capacity-1 greedy witness walk — resolves most
+            # VALID lanes for ~nothing (no frontier buffers, one scan).
+            # Never refutes: unresolved lanes report lossy so the stage
+            # loop keeps them pending for the beam ladder.
+            n_actives = np.array([p["bar_active"].sum() for p in sub], np.int32)
+            if n_pad != n:
+                n_actives = np.concatenate([n_actives, np.repeat(n_actives[-1:], n_pad - n)])
+            by_name = dict(zip(_ARG_ORDER, args))
+            # init_state is already stacked/padded/mesh-sharded in args
+            g_args = [by_name["init_state"], jnp.asarray(n_actives)] + [
+                by_name[k] for k in ASYNC_ARG_ORDER[1:]
+            ]
+            if mesh is not None:
+                axis = mesh.axis_names[0]
+                spec = NamedSharding(mesh, PartitionSpec(axis))
+                g_args[1] = jax.device_put(np.asarray(g_args[1]), spec)
+            runner = wgl.greedy_runner(sub[0]["step"], B, P, G, W)
+            finished, _stuck_at, _fired = runner(*g_args)
+            finished = np.asarray(finished)[:n]
+            return (
+                finished,
+                np.full(n, -1, np.int32),
+                ~finished,  # unresolved = lossy -> stays pending
+                np.ones(n, np.int32),
+                out_resumes,
+            )
         if st_engine == "async":
             n_actives = np.array([p["bar_active"].sum() for p in sub], np.int32)
             # Per-lane resume frontiers: fresh single-config at barrier 0,
@@ -335,6 +368,8 @@ def batch_analysis(
         )
 
     stages = [(engine, c) for c in batch_caps] + [("exact", c) for c in exact_caps]
+    if greedy_first and stages:
+        stages = [("greedy", 1)] + stages
     pending = list(range(len(packs)))
     resumes: dict[int, tuple] = {}  # pack idx -> saved resume frontier
     confirm_futs: dict = {}  # history index -> (future, device result)

@@ -433,3 +433,85 @@ def test_refutation_stats_carry_positional_bar_opid():
     assert r["valid?"] == truth
     if r["valid?"] is False and "kernel" in r:
         assert r.get("confirmed?") is True, r
+
+
+def test_greedy_walk_soundness_differential():
+    """The greedy witness walk may only answer True (exact witness) or
+    unknown — never False, and never True on an invalid history."""
+    rng = random.Random(31337)
+    for trial in range(80):
+        hist = random_history(rng)
+        truth = wgl_cpu.brute_analysis(m.CASRegister(None), hist)["valid?"]
+        g = wgl.greedy_analysis(m.CASRegister(None), hist)
+        assert g["valid?"] in (True, "unknown"), (trial, g)
+        if g["valid?"] is True:
+            assert truth is True, (trial, g)
+
+
+def test_greedy_walk_resolves_valid_and_reports_stuck():
+    ok = valid_register_history(120, 6, seed=2, info_rate=0.2)
+    r = wgl.greedy_analysis(m.CASRegister(None), ok)
+    assert r["valid?"] is True
+    assert r["kernel"]["engine"] == "greedy"
+
+    # deterministically-invalid: must NOT claim True; reports stuck site
+    bad = h.index([
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 0, "read", None), h.op(h.OK, 0, "read", 2),
+    ])
+    r = wgl.greedy_analysis(m.CASRegister(None), bad)
+    assert r["valid?"] == "unknown"
+    assert "stuck-at" in r["kernel"]
+
+    # untensorizable model degrades the same way the other engines do
+    r = wgl.greedy_analysis(m.UnorderedQueue(), [
+        h.op(h.INVOKE, 0, "add", 1), h.op(h.OK, 0, "add", 1)])
+    assert r["valid?"] == "unknown"
+    assert "not tensorizable" in r["cause"]
+
+
+def test_greedy_walk_enabler_cases():
+    """The one-enabler lookahead: an open ok op and a crashed-group op
+    each enabling the returning op."""
+    model = m.CASRegister(None)
+    # read returns 2 while an OVERLAPPING ok write(2) is open: greedy
+    # must fire the write as the read's enabler (case C), and the
+    # write's own barrier later retires the already-set bit (case A)
+    hist_ok = h.index([
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 1, "write", 2),
+        h.op(h.INVOKE, 2, "read", None), h.op(h.OK, 2, "read", 2),
+        h.op(h.OK, 1, "write", 2),
+    ])
+    assert wgl.greedy_analysis(model, hist_ok)["valid?"] is True
+    # crashed write(3) as the enabler (case D)
+    hist_crash = h.index([
+        h.op(h.INVOKE, 0, "write", 1), h.op(h.OK, 0, "write", 1),
+        h.op(h.INVOKE, 1, "write", 3), h.op(h.INFO, 1, "write", 3),
+        h.op(h.INVOKE, 2, "read", None), h.op(h.OK, 2, "read", 3),
+    ])
+    r = wgl.greedy_analysis(model, hist_crash)
+    assert r["valid?"] is True
+    assert r["kernel"]["fired-crashed"] == 1
+
+
+def test_greedy_stage_in_batch_ladder():
+    """greedy_first resolves the valid lanes before the beam ladder and
+    never corrupts verdicts on the mixed batch."""
+    from jepsen_tpu.parallel import batch_analysis
+
+    hists, expect = [], []
+    for i in range(10):
+        hh = valid_register_history(40, 4, seed=50 + i, info_rate=0.25)
+        if i % 5 == 4:
+            hh = corrupt(hh, seed=i)
+            expect.append(wgl_cpu.sweep_analysis(m.CASRegister(None), hh)["valid?"])
+        else:
+            expect.append(True)
+        hists.append(hh)
+    on = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256),
+                        greedy_first=True)
+    off = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256),
+                         greedy_first=False)
+    assert [r["valid?"] for r in on] == expect
+    assert [r["valid?"] for r in off] == expect

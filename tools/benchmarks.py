@@ -87,22 +87,33 @@ def config_2():
     # matched capacity.  The async kernel runs these shapes; the exact
     # barrier kernel at cap ≥1024 faults the tunneled TPU worker.
     hist = valid_register_history(n, 32, seed=7, info_rate=0.02, n_values=5)
-    wgl.analysis_async(model, hist, capacity=1024)  # compile
+    # Warm EVERY engine the competition may touch so the timed window
+    # holds no compiles (the fallback ran cold in an earlier draft,
+    # overstating device time).
+    wgl.greedy_analysis(model, hist)
+    wgl.analysis_async(model, hist, capacity=1024)
     t0 = time.perf_counter()
-    r = wgl.analysis_async(model, hist, capacity=1024)
-    dev = dict(r)
+    # Round 5: the DEVICE greedy witness walk decides this valid history
+    # itself (one capacity-1 scan) — the TPU contributes the verdict, not
+    # just a beam exhaustion (VERDICT r4 item 3).  The ladder below it is
+    # the fallback for histories the walk sticks on.
+    r = wgl.greedy_analysis(model, hist)
+    decider = "greedy witness walk"
     if r["valid?"] == "unknown":
-        # knossos.competition semantics (reference checker.clj:199-203):
-        # when the device beam exhausts, the greedy DFS oracle gets its
-        # turn — on valid histories it walks straight through, turning
-        # "unknown" into a definite verdict (VERDICT r3 item 3).
+        r = wgl.analysis_async(model, hist, capacity=1024)
+        decider = "async beam"
+    if r["valid?"] == "unknown":
         r = wgl_cpu.dfs_analysis(model, hist)
+        decider = "cpu greedy dfs"
     tpu_s = time.perf_counter() - t0
+    # the round-4 CPU decider for this config, for the note's comparison
+    dfs_s, _dfs_r = budget(lambda: wgl_cpu.dfs_analysis(model, hist), 60)
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
+    dfs_note = f"{dfs_s:.2f}s" if dfs_s is not None else ">60s (budget)"
     record("2", f"{n}-op register, 32 procs, 2% info (single history)",
            tpu_s, cpu_s, {"tpu": r["valid?"], "cpu": rc["valid?"] if rc else "budget"},
-           note=f"competition: device beam then DFS fallback; device verdict "
-                f"was {dev['valid?']} in its share of the time; kernel={dev.get('kernel')}")
+           note=f"decided by {decider}: kernel={r.get('kernel')}; "
+                f"CPU greedy DFS (round-4 decider) takes {dfs_note}")
 
 
 def config_3():
@@ -154,28 +165,39 @@ def config_5():
     n = 5000 if QUICK else 50_000
     model = m.CASRegister(None)
     hist = valid_register_history(n, 64, seed=13, info_rate=0.3, n_values=5)
-    cb = 512
-    kw = dict(capacity=(256, 1024), rounds=6, chunk_barriers=cb, fast=True)
+    wgl.greedy_analysis(model, hist)  # compile
     t0 = time.perf_counter()
-    r = wgl.analysis(model, hist, **kw)  # includes compile (chunk programs cache)
-    first_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    r = wgl.analysis(model, hist, **kw)
+    # Round 5: the greedy witness walk DECIDES this config (round 4: "no
+    # engine decides it" — DFS exhausted 5M configs in 324 s; the walk
+    # finds a constructive witness in one capacity-1 scan, firing ~191
+    # crashed ops along the way).
+    r = wgl.greedy_analysis(model, hist)
     tpu_s = time.perf_counter() - t0
+    note = f"DEVICE-decided by the greedy witness walk: kernel={r.get('kernel')}"
+    if r["valid?"] == "unknown":
+        # fallback: the chunked carried-frontier quantified prefix.
+        # Warm first — compile must stay out of the timed window.
+        cb = 512
+        kw = dict(capacity=(256, 1024), rounds=6, chunk_barriers=cb, fast=True)
+        t_w = time.perf_counter()
+        wgl.analysis(model, hist, **kw)
+        first_s = time.perf_counter() - t_w
+        t0 = time.perf_counter()
+        r = wgl.analysis(model, hist, **kw)
+        tpu_s += time.perf_counter() - t0
+        k = r.get("kernel", {})
+        note = (f"greedy stuck; chunked-fast quantified prefix "
+                f"verified-barriers={k.get('verified-barriers')} "
+                f"witnessed-barriers={k.get('witnessed-barriers')} of "
+                f"~{k.get('chunks', 0) * cb}; first-run(incl compile)="
+                f"{first_s:.1f}s kernel={k}")
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
-    k = r.get("kernel", {})
-    n_bar = k.get("chunks", 0) * cb
     verdict = r["valid?"]
     if r.get("provisional?"):
         verdict = "false (provisional, hash-decided)"
     record("5", f"{n}-op register, 64 procs, 30% info (single history)",
            tpu_s, cpu_s, {"tpu": verdict, "cpu": rc["valid?"] if rc else "budget"},
-           note=f"worst-case branching (no engine decides it; DFS exhausts 5M "
-                f"configs in 324s): chunked-fast quantified prefix "
-                f"verified-barriers={k.get('verified-barriers')} (zero-loss, "
-                f"modulo hash-dedup caveat) witnessed-barriers="
-                f"{k.get('witnessed-barriers')} (exact witness) of ~{n_bar}; "
-                f"first-run(incl compile)={first_s:.1f}s kernel={k}")
+           note=note)
 
 
 CONFIGS = {"config_1": config_1, "config_2": config_2, "config_3": config_3,
