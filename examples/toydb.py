@@ -39,7 +39,11 @@ def node_port(test, node) -> int:
 class ToyDB(jdb.DB):
     """Install + run one toydb process per node (db.clj lifecycle; all
     nodes share the durable register file, so the service is linearizable
-    across endpoints)."""
+    across endpoints).  ``txn_buffer`` > 0 starts servers in the LOSSY
+    txn mode (see toydb_server module docstring)."""
+
+    def __init__(self, txn_buffer: int = 0):
+        self.txn_buffer = int(txn_buffer)
 
     def _paths(self, node):
         d = f"{BASE}/{node}"
@@ -66,11 +70,15 @@ class ToyDB(jdb.DB):
     # Process capability (db.clj:18-24) — drives the kill nemesis package.
     def start(self, test, node, session):
         p = self._paths(node)
+        extra = (
+            ["--txn-buffer", str(self.txn_buffer)] if self.txn_buffer else []
+        )
         return cu.start_daemon(
             session,
             "python3", p["server"],
             "--port", str(node_port(test, node)),
             "--data", p["data"],
+            *extra,
             pidfile=p["pid"],
             logfile=p["log"],
         )
@@ -185,6 +193,88 @@ class ToySetClient(ToyClient):
             vals = [int(x) for x in body.split(",")] if body else []
             return {**op, "type": "ok", "value": vals}
         raise ValueError(f"unknown op {f!r}")
+
+
+class ToyTxnClient(ToyClient):
+    """Multi-key list-append transactions over the same wire — the elle
+    vocabulary (micro-ops ``["append", k, v]`` / ``["r", k, None]``,
+    reference jepsen/tests/cycle/append.clj:24-28).  Reads come back
+    filled with the observed list."""
+
+    def invoke(self, test, op):
+        if op["f"] != "txn":
+            raise ValueError(f"unknown op {op['f']!r}")
+        mops = op["value"]
+        toks = []
+        for f, k, v in mops:
+            toks.append(f"a:{k}:{v}" if f == "append" else f"r:{k}")
+        reply = self._round("T " + ";".join(toks))
+        if not reply.startswith("t "):
+            raise RuntimeError(f"unexpected txn reply {reply!r}")
+        out_toks = reply[2:].split(";")
+        if len(out_toks) != len(mops):
+            raise RuntimeError(f"txn reply arity mismatch: {reply!r}")
+        done = []
+        for (f, k, v), tok in zip(mops, out_toks):
+            if f == "append":
+                done.append(["append", k, v])
+            else:
+                body = tok.split(":", 2)[2]
+                vals = [int(x) for x in body.split(",")] if body else []
+                done.append(["r", k, vals])
+        return {**op, "type": "ok", "value": done}
+
+
+def toydb_txn_test(opts) -> dict:
+    """elle list-append against LIVE toydb processes — the txn-family
+    harness arc (reference analog: tidb/src/jepsen/tidb/txn.clj with the
+    cycle/append.clj workload).  Durable mode is strict-serializable
+    (every txn applies under sorted per-key file locks, fsync'd before
+    ack) so elle must find nothing; ``lossy: True`` starts the servers
+    with a memory append buffer — acknowledged appends die with
+    ``kill -9`` and never replicate, and elle's dependency graphs catch
+    it (incompatible-order / lost appends), writing the anomaly
+    explanation files under the run's ``elle/`` dir."""
+    from jepsen_tpu.workloads import append as append_wl
+
+    db = ToyDB(txn_buffer=opts.get("txn-buffer", 16) if opts.get("lossy") else 0)
+    pkg = nc.nemesis_package(
+        {
+            "faults": ["kill"],
+            "db": db,
+            "interval": opts.get("interval", 2),
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    wl = append_wl.workload(
+        {
+            "key-count": opts.get("key-count", 4),
+            "max-txn-length": opts.get("max-txn-length", 4),
+            **opts,
+        }
+    )
+    time_limit = opts.get("time-limit", 8)
+    t = testkit.noop_test(
+        name="toydb-txn" + ("-lossy" if opts.get("lossy") else ""),
+        db=db,
+        client=ToyTxnClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(
+                    gen.time_limit(time_limit, gen.stagger(0.02, wl["generator"]))
+                ),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            gen.nemesis(pkg.final_generator),
+        ),
+        checker=compose(
+            {"stats": stats(), "append": wl["checker"], "perf": perf()}
+        ),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
 
 
 def toydb_set_test(opts) -> dict:

@@ -16,6 +16,21 @@ independent linearizable register; the set lives in its own file):
   C [k] <old> <new> -> "ok" | "fail"
   A <int>           -> "ok"              (set add)
   S                 -> "s a,b,c" | "s"   (set read)
+  T a:k:v;r:k;...   -> "t a:k:v;r:k:1,2,3;..."   (multi-key txn)
+
+Transactions (the elle list-append vocabulary, reference:
+jepsen/src/jepsen/tests/cycle/append.clj:24-55): each key holds an
+append-only list in its own ``{data}.txn-{k}`` file; a txn locks every
+involved key file in sorted order (no deadlocks), applies its micro-ops
+in order, fsyncs appended files before the ack, and answers reads with
+the full list.  That is strict-serializable — elle must find nothing.
+
+``--txn-buffer N`` turns on the LOSSY mode the harness exists to catch:
+acknowledged appends sit in process memory until N accumulate for a
+key, then flush.  A ``kill -9`` loses the buffer (acknowledged-but-lost
+appends), and other nodes can't see it at all — two nodes appending to
+one key produce reads with incompatible list orders.  Both are genuine,
+elle-visible anomalies produced by a real running system.
 """
 
 from __future__ import annotations
@@ -25,6 +40,18 @@ import fcntl
 import os
 import socketserver
 import sys
+import threading
+
+
+def read_all(fd) -> str:
+    """Read an fd from its current offset to EOF."""
+    data = b""
+    while True:
+        chunk = os.read(fd, 1 << 16)
+        if not chunk:
+            break
+        data += chunk
+    return data.decode()
 
 
 def txn(path: str, fn):
@@ -63,6 +90,8 @@ class Handler(socketserver.StreamRequestHandler):
 
     def apply(self, parts):
         cmd, rest = parts[0], parts[1:]
+        if cmd == "T":
+            return self.apply_txn(rest)
         if cmd in ("A", "S"):
             return self.apply_set(cmd, rest)
         want = self.N_ARGS.get(cmd)
@@ -81,6 +110,79 @@ class Handler(socketserver.StreamRequestHandler):
         old, new = int(args[0]), int(args[1])
         return txn(path, lambda v: (new, "ok") if v == old else (..., "fail"))
 
+    def apply_txn(self, rest):
+        """Multi-key list-append transaction (module docstring).  The
+        ``.txn-`` path prefix cannot alias register files (``-{key}``,
+        no dot) or the set file (``.set``).
+
+        Durable commits stage a txn's appends and write each key's batch
+        as ONE os.write before fsync: a kill between two same-key
+        appends of one txn would otherwise persist an intermediate
+        version (a G1b elle would rightly flag).  Cross-KEY partial
+        persistence of an indeterminate (:info) txn remains possible in
+        a microsecond window and is benign to the checker: the txn may
+        have happened, and the never-observed key simply grows no
+        dependency edges."""
+        if len(rest) != 1:
+            return "err bad-arity"
+        mops = []
+        for tok in rest[0].split(";"):
+            p = tok.split(":")
+            if p[0] == "a" and len(p) == 3:
+                mops.append(("a", p[1], int(p[2])))
+            elif p[0] == "r" and len(p) >= 2:
+                mops.append(("r", p[1], None))
+            else:
+                return "err bad-mop"
+        buf_n = self.server.txn_buffer
+        fds = {}
+        try:
+            for k in sorted({k for _f, k, _v in mops}):
+                fd = os.open(
+                    f"{self.server.data_path}.txn-{k}",
+                    os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644,
+                )
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                fds[k] = fd
+            views = {}  # key -> logical list (file [+ buffer] + txn appends)
+            staged = {}  # key -> this txn's durable appends
+
+            def view(k):
+                if k not in views:
+                    os.lseek(fds[k], 0, 0)
+                    vals = [int(x) for x in read_all(fds[k]).split()]
+                    if buf_n:
+                        with self.server.txn_buf_lock:
+                            vals += self.server.txn_buf.get(k, [])
+                    views[k] = vals
+                return views[k]
+
+            out = []
+            for f, k, v in mops:
+                if f == "a":
+                    view(k).append(v)
+                    if buf_n:
+                        # LOSSY: ack from memory; flush every buf_n appends
+                        with self.server.txn_buf_lock:
+                            pend = self.server.txn_buf.setdefault(k, [])
+                            pend.append(v)
+                            if len(pend) >= buf_n:
+                                data = "".join(f"{x}\n" for x in pend)
+                                os.write(fds[k], data.encode())
+                                pend.clear()
+                    else:
+                        staged.setdefault(k, []).append(v)
+                    out.append(f"a:{k}:{v}")
+                else:
+                    out.append(f"r:{k}:" + ",".join(str(x) for x in view(k)))
+            for k, vs in staged.items():
+                os.write(fds[k], "".join(f"{x}\n" for x in vs).encode())
+                os.fsync(fds[k])  # durability before the ack
+            return "t " + ";".join(out)
+        finally:
+            for fd in fds.values():
+                os.close(fd)  # releases the locks
+
     def apply_set(self, cmd, rest):
         """The set lives as an append-only, flock-guarded line file —
         adds are fsync'd before the ack, reads replay it.  The ``.set``
@@ -96,14 +198,8 @@ class Handler(socketserver.StreamRequestHandler):
                 os.write(fd, f"{int(rest[0])}\n".encode())
                 os.fsync(fd)
                 return "ok"
-            data = b""
             os.lseek(fd, 0, 0)
-            while True:
-                chunk = os.read(fd, 1 << 16)
-                if not chunk:
-                    break
-                data += chunk
-            vals = sorted({int(x) for x in data.decode().split()})
+            vals = sorted({int(x) for x in read_all(fd).split()})
             return "s " + ",".join(str(v) for v in vals)
         finally:
             os.close(fd)
@@ -118,10 +214,22 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--data", required=True)
+    ap.add_argument(
+        "--txn-buffer", type=int, default=0,
+        help="LOSSY mode: buffer this many appends per key in process "
+             "memory before flushing (0 = durable, fsync before ack)",
+    )
     args = ap.parse_args()
     srv = Server(("127.0.0.1", args.port), Handler)
     srv.data_path = args.data
-    print(f"toydb listening on {args.port}, data={args.data}", flush=True)
+    srv.txn_buffer = args.txn_buffer
+    srv.txn_buf = {}
+    srv.txn_buf_lock = threading.Lock()
+    print(
+        f"toydb listening on {args.port}, data={args.data}"
+        + (f", LOSSY txn-buffer={args.txn_buffer}" if args.txn_buffer else ""),
+        flush=True,
+    )
     srv.serve_forever()
 
 
