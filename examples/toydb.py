@@ -237,7 +237,10 @@ def toydb_txn_test(opts) -> dict:
     explanation files under the run's ``elle/`` dir."""
     from jepsen_tpu.workloads import append as append_wl
 
-    db = ToyDB(txn_buffer=opts.get("txn-buffer", 16) if opts.get("lossy") else 0)
+    # an explicit txn-buffer implies the lossy mode (a silent no-op knob
+    # would masquerade as a passing durable run)
+    lossy = bool(opts.get("lossy") or opts.get("txn-buffer"))
+    db = ToyDB(txn_buffer=int(opts.get("txn-buffer", 16)) if lossy else 0)
     pkg = nc.nemesis_package(
         {
             "faults": ["kill"],
@@ -255,7 +258,7 @@ def toydb_txn_test(opts) -> dict:
     )
     time_limit = opts.get("time-limit", 8)
     t = testkit.noop_test(
-        name="toydb-txn" + ("-lossy" if opts.get("lossy") else ""),
+        name="toydb-txn" + ("-lossy" if lossy else ""),
         db=db,
         client=ToyTxnClient(),
         nemesis=pkg.nemesis,

@@ -168,7 +168,9 @@ def frontier_update_fast(
     bfc = fcr[srcB]
     balive = jnp.arange(Cb) < jnp.minimum(n_keep0, Cb)
     spill = n_keep0 > Cb
-    if max_count is not None and max_count <= MXU_PRUNE_MAX_COUNT:
+    if max_count is not None:
+        # saturating planes: sound at any count (round 5 — wide-mover
+        # histories keep the matmul instead of the dense fallback)
         balive = exact_prune_mxu(bst, bfo, bfc, balive, max_count)
     else:
         balive = exact_prune(bst, bfo, bfc, balive)
@@ -193,12 +195,12 @@ def frontier_update_fast(
     return kst, kfo, kfc, new_alive, overflowed, fp, child
 
 
-#: The matmul prune does g·max_count MACs per pairwise cell where the
-#: dense prune does g vector compares; with the MXU's ~50x per-element
-#: throughput the matmul wins only while max_count stays small.  Above
-#: this bound (histories with very wide mover tables, e.g. 10k-op 2%-info
-#: registers with ~256 movers) the dense prune is faster — and the gate
-#: keeps the one-hot width, hence compile-time constants, bounded.
+#: One-hot plane count for the matmul prune.  Counts at or above the
+#: last plane compare SATURATING (see exact_prune_mxu): the test stays
+#: sound at any true count, exact below M-1 — so the plane count is a
+#: cost/precision knob, not a correctness gate.  64 planes measured
+#: fastest on the headline wide stage; round 4's hard gate (dense
+#: fallback past mover width 64) is gone.
 MXU_PRUNE_MAX_COUNT = 64
 
 
@@ -209,19 +211,31 @@ def exact_prune_mxu(state, fok, fcr, alive, max_count: int):
     work that dominates wide-capacity ticks (13.6 s vs 4.0 s pruneless on
     the cap-2048 straggler stage).  The MXU formulation: encode each
     row's fired-crashed counts as a cumulative one-hot u[k, c] =
-    (fcr[k] ≤ c) and an exact one-hot v[k, c] = (fcr[k] == c), both
-    [N, G·M] with M = ``max_count``; then (u @ vᵀ)[i, j] counts the
-    groups where fcr_i ≤ fcr_j, and == G ⟺ pointwise ≤.  One bf16
-    matmul (values ≤ G, exact in bf16) replaces the O(N²·G) compare;
-    class equality and tie-breaking stay content-decided, so the result
-    is bit-identical to exact_prune whenever every count < ``max_count``
-    (the callers pass the static mover-table size, a hard upper bound).
+    (fcr[k] ≤ c) and a SATURATING exact one-hot v[k, c] =
+    (min(fcr[k], M-1) == c), both [N, G·M] with M = min(``max_count``,
+    MXU_PRUNE_MAX_COUNT); then (u @ vᵀ)[i, j] counts the groups where
+    fcr_i ≤ min(fcr_j, M-1), and == G ⟹ pointwise fcr_i ≤ fcr_j.  One
+    bf16 matmul (values ≤ G, exact in bf16) replaces the O(N²·G)
+    compare; class equality and tie-breaking stay content-decided.
+
+    Saturation soundness (round 5, replacing the round-4 dense fallback
+    past mover width 64): the computed indicator implies true pointwise
+    ≤ at ANY count — min(fcr_j, M-1) ≤ fcr_j, so a kill is always a
+    genuine domination/duplicate.  When some count reaches M-1 a true
+    domination can be MISSED (u's planes are all-zero for counts ≥ M),
+    which only bloats the frontier (overflow → lossy → escalate, never
+    a wrong verdict).  Ties stay order-stable: mutual-≤ (equality)
+    detected via the saturating test forces every count < M on both
+    rows, where the test is exact.  Below M-1 everywhere, the result is
+    bit-identical to exact_prune.
     """
     n = state.shape[0]
     g = fcr.shape[1]
-    c = jnp.arange(max_count, dtype=fcr.dtype)
-    u = (fcr[:, :, None] <= c[None, None, :]).reshape(n, g * max_count)
-    v = (fcr[:, :, None] == c[None, None, :]).reshape(n, g * max_count)
+    m = min(int(max_count), MXU_PRUNE_MAX_COUNT)
+    c = jnp.arange(m, dtype=fcr.dtype)
+    sat = jnp.minimum(fcr, m - 1)
+    u = (fcr[:, :, None] <= c[None, None, :]).reshape(n, g * m)
+    v = (sat[:, :, None] == c[None, None, :]).reshape(n, g * m)
     cnt = jnp.dot(
         u.astype(jnp.bfloat16),
         v.astype(jnp.bfloat16).T,

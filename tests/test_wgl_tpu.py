@@ -368,6 +368,53 @@ def test_exact_prune_mxu_matches_dense():
         assert (a == b).all(), (trial, np.flatnonzero(a != b))
 
 
+def test_exact_prune_mxu_saturating_wide_counts():
+    """Round 5 (VERDICT item 5): past MXU_PRUNE_MAX_COUNT the matmul
+    prune SATURATES instead of falling back to the dense compare.  Sound
+    at any count: every kill it makes is one the dense prune also makes
+    (never kills a non-dominated row); exact below the last plane."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from jepsen_tpu.ops.hashing import (
+        MXU_PRUNE_MAX_COUNT,
+        exact_prune,
+        exact_prune_mxu,
+    )
+
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        n = int(rng.integers(4, 128))
+        g = int(rng.integers(1, 6))
+        # counts straddle the saturation boundary, up to 256-wide movers
+        hi = int(rng.integers(MXU_PRUNE_MAX_COUNT - 2, 256))
+        state = jnp.asarray(rng.integers(0, 3, n), jnp.int32)
+        fok = jnp.asarray(rng.integers(0, 2, (n, 1)), jnp.uint32)
+        fcr = jnp.asarray(rng.integers(0, hi, (n, g)), jnp.int16)
+        alive = jnp.asarray(rng.random(n) < 0.85)
+        dense = np.asarray(exact_prune(state, fok, fcr, alive))
+        mxu = np.asarray(exact_prune_mxu(state, fok, fcr, alive, max_count=256))
+        # soundness: mxu kills ⊆ dense kills (every mxu kill is genuine)
+        al = np.asarray(alive)
+        killed_by_mxu = al & ~mxu
+        killed_by_dense = al & ~dense
+        assert not (killed_by_mxu & ~killed_by_dense).any(), (
+            trial, np.flatnonzero(killed_by_mxu & ~killed_by_dense))
+
+    # exactness below the boundary: identical verdicts
+    for trial in range(10):
+        n = int(rng.integers(4, 100))
+        g = int(rng.integers(1, 5))
+        state = jnp.asarray(rng.integers(0, 3, n), jnp.int32)
+        fok = jnp.asarray(rng.integers(0, 2, (n, 1)), jnp.uint32)
+        fcr = jnp.asarray(
+            rng.integers(0, MXU_PRUNE_MAX_COUNT - 1, (n, g)), jnp.int16)
+        alive = jnp.asarray(rng.random(n) < 0.85)
+        dense = np.asarray(exact_prune(state, fok, fcr, alive))
+        mxu = np.asarray(exact_prune_mxu(state, fok, fcr, alive, max_count=256))
+        assert (dense == mxu).all(), trial
+
+
 def test_competition_ladder_semantics():
     """The competition front-end: async beam first (True = witness,
     False = sweep-confirmed), DFS on unknown, chunked exact last
