@@ -43,6 +43,7 @@ canonicalization into (f, value) groups, and fewest-fired-first compaction
 
 from __future__ import annotations
 
+import bisect
 import functools
 from typing import Sequence
 
@@ -91,10 +92,30 @@ def pack(model: m.Model, history: Sequence[dict]):
 
     Raises NotTensorizable when the model has no tensor step function or
     ops carry values the int32 columns can't hold.
+
+    A stored ``ColumnHistory`` takes the COLUMN-NATIVE path (round 5,
+    VERDICT item 7): the event/effective-op pass and the barrier tables
+    are built straight from the SoA columns — the store→kernel chain
+    materializes no per-op dicts at all (the ``.jepsen`` file's encoded
+    (value1, value2) pairs ARE the kernel's value columns; knossos
+    ``complete`` semantics applied by swapping in the completion's
+    pair).  Falls back to the dict path when the model has a precheck
+    (it consumes op dicts) or extras override client-op fields.
     """
     tm = tmodels.tensor_model_for(model)
     if tm is None:
         raise NotTensorizable(f"no tensor model for {getattr(model, 'name', model)!r}")
+    if (
+        isinstance(history, h.ColumnHistory)
+        and tm.precheck is None
+        and history.positional()
+        and not any(
+            {"value", "type", "process"} & e.keys()
+            for i, e in history.extras.items()
+            if history.cols["process"][i] != -1  # -1 = the nemesis sentinel
+        )
+    ):
+        return _pack_columns(tm, model, history)
     history = h.materialize(history)
     events, eff_ops, crashed = wgl_cpu.prepare(model, history)
     if tm.precheck is not None:
@@ -163,9 +184,6 @@ def pack(model: m.Model, history: Sequence[dict]):
         for g, count in open_crashed:
             grp_open[b, gidx[g]] = count
 
-    if B and grp_open.max(initial=0) > 32767:
-        raise NotTensorizable("crashed-group open count exceeds int16 range")
-
     grp_f = np.zeros(G, np.int32)
     grp_v1 = np.zeros(G, np.int32)
     grp_v2 = np.zeros(G, np.int32)
@@ -173,11 +191,24 @@ def pack(model: m.Model, history: Sequence[dict]):
         grp_f[k] = fcode(group_ops[g])
         grp_v1[k], grp_v2[k] = _encode_value(group_ops[g].get("value"))
 
+    return _finish_pack(
+        tm, model, B, P, G, W, bar_quiet,
+        (bar_f, bar_v1, bar_v2, bar_slot), bar_opid,
+        (mov_f, mov_v1, mov_v2, mov_open),
+        (grp_f, grp_v1, grp_v2), grp_open,
+    )
+
+
+def _finish_pack(tm, model, B, P, G, W, bar_quiet, bar, bar_opid, mov, grp, grp_open):
+    """Shared tail of both pack paths: the int16 count gate, the slot
+    one-hot layout, and the kernel-table contract (one copy — the dict
+    and column paths must never drift)."""
+    if B and grp_open.max(initial=0) > 32767:
+        raise NotTensorizable("crashed-group open count exceeds int16 range")
     slot_lane = np.arange(P, dtype=np.int32) // 32
     slot_onehot = np.zeros((P, W), np.uint32)
     for p in range(P):
         slot_onehot[p, p // 32] = np.uint32(1) << np.uint32(p % 32)
-
     return {
         "B": B,
         "P": P,
@@ -187,14 +218,149 @@ def pack(model: m.Model, history: Sequence[dict]):
         "step": tm.step,
         "bar_active": np.ones(B, bool),
         "bar_quiet": bar_quiet,
-        "bar": (bar_f, bar_v1, bar_v2, bar_slot),
+        "bar": bar,
         "bar_opid": bar_opid,
-        "mov": (mov_f, mov_v1, mov_v2, mov_open),
-        "grp": (grp_f, grp_v1, grp_v2),
+        "mov": mov,
+        "grp": grp,
         "grp_open": grp_open,
         "slot_lane": slot_lane,
         "slot_onehot": slot_onehot,
     }
+
+
+def _pack_columns(tm, model, ch):
+    """Column-native pack: one pass over a ColumnHistory's SoA columns.
+
+    Mirrors the dict path exactly (prepare → _barrier_snapshots → table
+    fill, wgl_cpu.prepare semantics: fail ops dropped, crashed pure ops
+    dropped, completion values become effective values) but the working
+    values are the stored encoded ``(value1, value2)`` int pairs — no op
+    dict is ever built.  Group keys are ``(f_code, v1, v2)`` triples;
+    group ORDER is sorted on the triple (the dict path sorts on repr of
+    the python values), which only permutes the grp columns — verdict-
+    irrelevant, every reference to a group goes through its index."""
+    cols, fs = ch.cols, ch.fs
+    n = len(ch)
+    # ColumnHistory._TYPE_NAMES order
+    T_INVOKE, T_OK, T_FAIL, T_INFO = 0, 1, 2, 3
+    typl = np.asarray(cols["type"]).tolist()
+    procl = np.asarray(cols["process"]).tolist()
+    fl = np.asarray(cols["f"]).tolist()
+    v1l = np.asarray(cols["value1"]).tolist()
+    v2l = np.asarray(cols["value2"]).tolist()
+    fmap = [tm.f_codes.get(name) for name in fs]
+    pure = wgl_cpu.PURE_FS.get(getattr(model, "name", None), set())
+    pure_idx = {k for k, name in enumerate(fs) if name in pure}
+    NILi = int(h.NIL)
+
+    # pair matching (pair_index semantics, on plain ints)
+    pair = [-1] * n
+    open_by_p: dict = {}
+    for i in range(n):
+        if typl[i] == T_INVOKE:
+            open_by_p[procl[i]] = i
+        else:
+            j = open_by_p.pop(procl[i], None)
+            if j is not None:
+                pair[j] = i
+                pair[i] = j
+
+    # effective ops + event order (wgl_cpu.prepare, columnar)
+    order: list[tuple[int, int, int]] = []
+    eff: dict[int, tuple[int, int, int]] = {}  # opid -> (code, v1, v2)
+    crashed: set[int] = set()
+    for i in range(n):
+        # only -1 is the nemesis sentinel; other negative ints are
+        # legitimate (if odd) client process ids the dict path includes
+        if typl[i] != T_INVOKE or procl[i] == -1:
+            continue
+        j = pair[i]
+        ctype = typl[j] if j != -1 else T_INFO
+        if ctype == T_FAIL:
+            continue
+        fi = fl[i]
+        if ctype == T_INFO and fi in pure_idx:
+            continue
+        code = fmap[fi]
+        if code is None:
+            raise NotTensorizable(f"model {tm.name} has no f code for {fs[fi]!r}")
+        ev1, ev2 = v1l[i], v2l[i]
+        if ctype == T_OK and not (v1l[j] == NILi and v2l[j] == NILi):
+            ev1, ev2 = v1l[j], v2l[j]  # knossos complete: learn the value
+        eff[i] = (code, ev1, ev2)
+        order.append((i, wgl_cpu.CALL, i))
+        if ctype == T_OK:
+            order.append((j, wgl_cpu.RET, i))
+        else:
+            crashed.add(i)
+    order.sort()
+
+    # slots: one in-flight ok op per process
+    slots: dict = {}
+    for i in eff:
+        if i not in crashed:
+            p = procl[i]
+            if p not in slots:
+                slots[p] = len(slots)
+    P = max(1, len(slots))
+    W = (P + 31) // 32
+    B = sum(1 for _pos, kind, _i in order if kind == wgl_cpu.RET)
+
+    # group vocabulary over the whole history (deterministic triple sort)
+    groups = sorted({eff[i] for i in crashed})
+    gidx = {g: k for k, g in enumerate(groups)}
+    G = max(1, len(groups))
+
+    bar_f = np.zeros(B, np.int32)
+    bar_v1 = np.zeros(B, np.int32)
+    bar_v2 = np.zeros(B, np.int32)
+    bar_slot = np.zeros(B, np.int32)
+    bar_opid = np.zeros(B, np.int32)
+    mov_f = np.zeros((B, P), np.int32)
+    mov_v1 = np.zeros((B, P), np.int32)
+    mov_v2 = np.zeros((B, P), np.int32)
+    mov_open = np.zeros((B, P), bool)
+    grp_open = np.zeros((B, G), np.int32)
+    bar_quiet = np.zeros(B, bool)
+
+    open_ok: list[int] = []
+    open_crashed: dict[tuple, int] = {}
+    b = 0
+    for _pos, kind, i in order:
+        if kind == wgl_cpu.CALL:
+            if i in crashed:
+                g = eff[i]
+                open_crashed[g] = open_crashed.get(g, 0) + 1
+            else:
+                open_ok.append(i)
+        else:
+            bar_quiet[b] = open_ok == [i]
+            bar_f[b], bar_v1[b], bar_v2[b] = eff[i]
+            bar_slot[b] = slots[procl[i]]
+            bar_opid[b] = i
+            for jj in open_ok:
+                s = slots[procl[jj]]
+                mov_f[b, s], mov_v1[b, s], mov_v2[b, s] = eff[jj]
+                mov_open[b, s] = True
+            for g, count in open_crashed.items():
+                grp_open[b, gidx[g]] = count
+            b += 1
+            k = bisect.bisect_left(open_ok, i)
+            if k < len(open_ok) and open_ok[k] == i:
+                del open_ok[k]
+
+    grp_f = np.zeros(G, np.int32)
+    grp_v1 = np.zeros(G, np.int32)
+    grp_v2 = np.zeros(G, np.int32)
+    for g, k in gidx.items():
+        grp_f[k], grp_v1[k], grp_v2[k] = g
+
+    return _finish_pack(
+        tm, model, B, P, G, W, bar_quiet,
+        (bar_f, bar_v1, bar_v2, bar_slot), bar_opid,
+        (mov_f, mov_v1, mov_v2, mov_open),
+        (grp_f, grp_v1, grp_v2), grp_open,
+    )
 
 
 def _encode_state(tm, model) -> int:
