@@ -42,8 +42,11 @@ class ToyDB(jdb.DB):
     across endpoints).  ``txn_buffer`` > 0 starts servers in the LOSSY
     txn mode (see toydb_server module docstring)."""
 
-    def __init__(self, txn_buffer: int = 0):
+    def __init__(self, txn_buffer: int = 0, no_wal: bool = False,
+                 seed: str | None = None):
         self.txn_buffer = int(txn_buffer)
+        self.no_wal = bool(no_wal)
+        self.seed = seed
 
     def _paths(self, node):
         d = f"{BASE}/{node}"
@@ -73,6 +76,10 @@ class ToyDB(jdb.DB):
         extra = (
             ["--txn-buffer", str(self.txn_buffer)] if self.txn_buffer else []
         )
+        if self.no_wal:
+            extra.append("--no-wal")
+        if self.seed:
+            extra += ["--seed", self.seed]
         return cu.start_daemon(
             session,
             "python3", p["server"],
@@ -241,6 +248,77 @@ def toydb_txn_test(opts) -> dict:
     # would masquerade as a passing durable run)
     lossy = bool(opts.get("lossy") or opts.get("txn-buffer"))
     db = ToyDB(txn_buffer=int(opts.get("txn-buffer", 16)) if lossy else 0)
+    wl = append_wl.workload(
+        {
+            "key-count": opts.get("key-count", 4),
+            "max-txn-length": opts.get("max-txn-length", 4),
+            **opts,
+        }
+    )
+    return _toydb_faulted_test(
+        opts, "toydb-txn" + ("-lossy" if lossy else ""),
+        db, ToyTxnClient(), wl["generator"], {"append": wl["checker"]},
+    )
+
+
+class ToyWrClient(ToyClient):
+    """elle rw-register transactions (``["w", k, v]`` / ``["r", k, None]``
+    micro-ops, reference jepsen/tests/cycle/wr.clj) over the WAL'd
+    register-txn wire (X command)."""
+
+    def invoke(self, test, op):
+        if op["f"] != "txn":
+            raise ValueError(f"unknown op {op['f']!r}")
+        mops = op["value"]
+        toks = [f"w:{k}:{v}" if f == "w" else f"g:{k}" for f, k, v in mops]
+        reply = self._round("X " + ";".join(toks))
+        if not reply.startswith("x "):
+            raise RuntimeError(f"unexpected regtxn reply {reply!r}")
+        out_toks = reply[2:].split(";")
+        if len(out_toks) != len(mops):
+            raise RuntimeError(f"regtxn reply arity mismatch: {reply!r}")
+        done = []
+        for (f, k, v), tok in zip(mops, out_toks):
+            if f == "w":
+                done.append(["w", k, v])
+            else:
+                body = tok.split(":", 2)[2]
+                done.append(["r", k, None if body == "nil" else int(body)])
+        return {**op, "type": "ok", "value": done}
+
+
+class ToyBankClient(ToyClient):
+    """Bank ops (reference jepsen/tests/bank.clj:20-44) over the same
+    wire: a read is an atomic all-account snapshot txn; a transfer is a
+    single conditional ``t`` micro-op (the server refuses overdrafts,
+    so balances stay non-negative)."""
+
+    def invoke(self, test, op):
+        accounts = test.get("accounts", [])
+        if op["f"] == "read":
+            toks = ";".join(f"g:{a}" for a in accounts)
+            reply = self._round("X " + toks)
+            if not reply.startswith("x "):
+                raise RuntimeError(f"unexpected bank read reply {reply!r}")
+            balances = {}
+            for a, tok in zip(accounts, reply[2:].split(";")):
+                body = tok.split(":", 2)[2]
+                balances[a] = 0 if body == "nil" else int(body)
+            return {**op, "type": "ok", "value": balances}
+        if op["f"] == "transfer":
+            v = op["value"]
+            reply = self._round(f"X t:{v['from']}:{v['to']}:{v['amount']}")
+            if reply == "x t:fail":
+                return {**op, "type": "fail"}  # definite refusal (overdraft)
+            if not reply.startswith("x t:"):
+                raise RuntimeError(f"unexpected transfer reply {reply!r}")
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown op {op['f']!r}")
+
+
+def _toydb_faulted_test(opts, name, db, client_obj, workload_gen, checkers) -> dict:
+    """The canonical shape shared by every faulted toydb harness:
+    workload ∥ kill faults, heal, check."""
     pkg = nc.nemesis_package(
         {
             "faults": ["kill"],
@@ -249,34 +327,67 @@ def toydb_txn_test(opts) -> dict:
             "kill": {"targets": ("one", "minority")},
         }
     )
-    wl = append_wl.workload(
-        {
-            "key-count": opts.get("key-count", 4),
-            "max-txn-length": opts.get("max-txn-length", 4),
-            **opts,
-        }
-    )
     time_limit = opts.get("time-limit", 8)
     t = testkit.noop_test(
-        name="toydb-txn" + ("-lossy" if lossy else ""),
+        name=name,
         db=db,
-        client=ToyTxnClient(),
+        client=client_obj,
         nemesis=pkg.nemesis,
         generator=gen.phases(
             gen.any_gen(
                 gen.clients(
-                    gen.time_limit(time_limit, gen.stagger(0.02, wl["generator"]))
+                    gen.time_limit(time_limit, gen.stagger(0.02, workload_gen))
                 ),
                 gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
             ),
             gen.nemesis(pkg.final_generator),
         ),
-        checker=compose(
-            {"stats": stats(), "append": wl["checker"], "perf": perf()}
-        ),
+        checker=compose({"stats": stats(), "perf": perf(), **checkers}),
     )
     t.update(opts)
     t["plot"] = pkg.perf
+    return t
+
+
+def toydb_wr_test(opts) -> dict:
+    """elle rw-register against LIVE toydb processes: write/read
+    transactions through the WAL, kill faults, the G0..G2 anomaly
+    vocabulary on the graph."""
+    from jepsen_tpu.workloads import wr as wr_wl
+
+    wl = wr_wl.workload({"key-count": opts.get("key-count", 3), **opts})
+    return _toydb_faulted_test(
+        opts, "toydb-wr", ToyDB(), ToyWrClient(),
+        wl["generator"], {"wr": wl["checker"]},
+    )
+
+
+def toydb_bank_test(opts) -> dict:
+    """The bank workload against LIVE toydb processes: total money must
+    be conserved through kill -9 schedules.  The WAL makes transfers
+    atomic (one appended line + fsync is the commit point); ``torn:
+    True`` starts the servers with --no-wal, whose sequential per-key
+    commits tear under kills — and every subsequent read's wrong total
+    is evidence (reference bank.clj:57-121)."""
+    from jepsen_tpu.workloads import bank as bank_wl
+
+    wl = bank_wl.workload(opts)
+    total = wl["total-amount"]
+    accounts = wl["accounts"]
+    # spread the initial total so transfers mostly succeed (all-in-one
+    # seeding makes most transfers overdraft-refusals)
+    share, rem = divmod(total, len(accounts))
+    seed = ",".join(
+        f"{a}:{share + (1 if i < rem else 0)}" for i, a in enumerate(accounts)
+    )
+    db = ToyDB(seed=seed, no_wal=bool(opts.get("torn")))
+    t = _toydb_faulted_test(
+        opts, "toydb-bank" + ("-torn" if opts.get("torn") else ""),
+        db, ToyBankClient(), wl["generator"], {"bank": wl["checker"]},
+    )
+    t["accounts"] = accounts
+    t["total-amount"] = total
+    t["max-transfer"] = wl["max-transfer"]
     return t
 
 

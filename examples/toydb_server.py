@@ -31,6 +31,24 @@ key, then flush.  A ``kill -9`` loses the buffer (acknowledged-but-lost
 appends), and other nodes can't see it at all — two nodes appending to
 one key produce reads with incompatible list orders.  Both are genuine,
 elle-visible anomalies produced by a real running system.
+
+REGISTER transactions (the elle rw-register vocabulary and the bank
+workload) ride a second namespace with a WRITE-AHEAD LOG:
+
+  X w:k:v;g:k;t:a:b:n   -> "x w:k:v;g:k:3;t:a:b:n"   ("t:fail" on overdraft)
+
+``w`` sets register k, ``g`` reads it, ``t`` transfers n from a to b
+(refused when it would overdraw).  State is the replay of
+``{data}.wal``; a txn's mutations commit as ONE appended line + fsync
+under the WAL lock — the atomic commit point (a kill can only tear the
+trailing line, which replay discards as uncommitted).  Multi-key
+atomicity is therefore exact: the bank invariant (total conserved)
+holds through any kill schedule.
+
+``--no-wal`` is the deliberately-broken mode: register state lives in
+per-key files committed SEQUENTIALLY (with ``--torn-delay-ms`` widening
+the window); a kill between the two halves of a transfer tears it —
+money appears or vanishes — which the bank checker catches.
 """
 
 from __future__ import annotations
@@ -41,6 +59,7 @@ import os
 import socketserver
 import sys
 import threading
+import time
 
 
 def read_all(fd) -> str:
@@ -92,6 +111,8 @@ class Handler(socketserver.StreamRequestHandler):
         cmd, rest = parts[0], parts[1:]
         if cmd == "T":
             return self.apply_txn(rest)
+        if cmd == "X":
+            return self.apply_regtxn(rest)
         if cmd in ("A", "S"):
             return self.apply_set(cmd, rest)
         want = self.N_ARGS.get(cmd)
@@ -183,6 +204,154 @@ class Handler(socketserver.StreamRequestHandler):
             for fd in fds.values():
                 os.close(fd)  # releases the locks
 
+    @staticmethod
+    def _parse_regmops(raw):
+        mops = []
+        for tok in raw.split(";"):
+            p = tok.split(":")
+            if p[0] == "w" and len(p) == 3:
+                mops.append(("w", p[1], int(p[2])))
+            elif p[0] == "g" and len(p) >= 2:
+                mops.append(("g", p[1], None))
+            elif p[0] == "t" and len(p) == 4:
+                mops.append(("t", p[1], p[2], int(p[3])))
+            else:
+                return None
+        return mops
+
+    def apply_regtxn(self, rest):
+        """Register transactions (module docstring): WAL-committed by
+        default, torn per-key files under --no-wal."""
+        if len(rest) != 1:
+            return "err bad-arity"
+        mops = self._parse_regmops(rest[0])
+        if mops is None:
+            return "err bad-mop"
+        if self.server.no_wal:
+            return self._regtxn_files(mops)
+        return self._regtxn_wal(mops)
+
+    @staticmethod
+    def _wal_replay(state, data: str) -> int:
+        """Apply every COMPLETE line of ``data`` to ``state``; returns the
+        byte count consumed (a torn trailing line — a mid-write kill —
+        is uncommitted by definition and left for no one)."""
+        consumed = 0
+        for line in data.splitlines(keepends=True):
+            if not line.endswith("\n"):
+                break
+            for tok in line.strip().split(";"):
+                p = tok.split(":")
+                if p[0] == "w":
+                    state[p[1]] = int(p[2])
+                elif p[0] == "t":
+                    a, b, n = p[1], p[2], int(p[3])
+                    state[a] = state.get(a, 0) - n
+                    state[b] = state.get(b, 0) + n
+            consumed += len(line)
+        return consumed
+
+    def _regtxn_wal(self, mops):
+        srv = self.server
+        fd = os.open(f"{srv.data_path}.wal", os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            with srv.wal_lock:
+                # refresh the cache from whatever other nodes committed
+                os.lseek(fd, srv.wal_offset, 0)
+                data = read_all(fd)
+                consumed = self._wal_replay(srv.wal_state, data)
+                srv.wal_offset += consumed
+                if consumed < len(data):
+                    # torn uncommitted tail (a writer died mid-append):
+                    # discard it NOW, before our O_APPEND write would glue
+                    # onto it and corrupt the line framing cluster-wide
+                    os.ftruncate(fd, srv.wal_offset)
+                # mutate a working copy; the cache only advances on a
+                # successful commit (a failed write must not leave the
+                # in-memory state ahead of the WAL)
+                st = dict(srv.wal_state)
+                out, muts = [], []
+                for mop in mops:
+                    if mop[0] == "g":
+                        v = st.get(mop[1])
+                        out.append(f"g:{mop[1]}:{'nil' if v is None else v}")
+                    elif mop[0] == "w":
+                        _f, k, v = mop
+                        st[k] = v
+                        muts.append(f"w:{k}:{v}")
+                        out.append(f"w:{k}:{v}")
+                    else:
+                        _f, a, b, n = mop
+                        if st.get(a, 0) < n:
+                            out.append("t:fail")
+                        else:
+                            st[a] = st.get(a, 0) - n
+                            st[b] = st.get(b, 0) + n
+                            muts.append(f"t:{a}:{b}:{n}")
+                            out.append(f"t:{a}:{b}:{n}")
+                if muts:
+                    rec = (";".join(muts) + "\n").encode()
+                    written = os.write(fd, rec)
+                    if written != len(rec):  # ENOSPC-style short write:
+                        # roll back the partial record; cache untouched
+                        os.ftruncate(fd, srv.wal_offset)
+                        return "err short-write"
+                    os.fsync(fd)  # the atomic commit point
+                    srv.wal_offset += len(rec)
+                srv.wal_state = st
+                return "x " + ";".join(out)
+        finally:
+            os.close(fd)
+
+    def _regtxn_files(self, mops):
+        """--no-wal: per-key register files committed sequentially — the
+        torn-transfer window the bank checker exists to catch."""
+        keys = sorted(
+            {k for mop in mops for k in (mop[1:3] if mop[0] == "t" else [mop[1]])}
+        )
+        fds = {}
+        try:
+            for k in keys:
+                fd = os.open(f"{self.server.data_path}.breg-{k}",
+                             os.O_RDWR | os.O_CREAT, 0o644)
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                fds[k] = fd
+            vals = {}
+            for k, fd in fds.items():
+                raw = read_all(fd).strip()
+                vals[k] = int(raw) if raw else None
+            out, dirty = [], []
+            for mop in mops:
+                if mop[0] == "g":
+                    v = vals.get(mop[1])
+                    out.append(f"g:{mop[1]}:{'nil' if v is None else v}")
+                elif mop[0] == "w":
+                    _f, k, v = mop
+                    vals[k] = v
+                    dirty.append(k)
+                    out.append(f"w:{k}:{v}")
+                else:
+                    _f, a, b, n = mop
+                    if (vals.get(a) or 0) < n:
+                        out.append("t:fail")
+                    else:
+                        vals[a] = (vals.get(a) or 0) - n
+                        vals[b] = (vals.get(b) or 0) + n
+                        dirty += [a, b]
+                        out.append(f"t:{a}:{b}:{n}")
+            for i, k in enumerate(dict.fromkeys(dirty)):
+                if i:
+                    time.sleep(self.server.torn_delay)  # widen the tear
+                os.lseek(fds[k], 0, 0)
+                os.ftruncate(fds[k], 0)
+                os.write(fds[k], str(vals[k]).encode())
+                os.fsync(fds[k])
+            return "x " + ";".join(out)
+        finally:
+            for fd in fds.values():
+                os.close(fd)
+
     def apply_set(self, cmd, rest):
         """The set lives as an append-only, flock-guarded line file —
         adds are fsync'd before the ack, reads replay it.  The ``.set``
@@ -219,12 +388,55 @@ def main():
         help="LOSSY mode: buffer this many appends per key in process "
              "memory before flushing (0 = durable, fsync before ack)",
     )
+    ap.add_argument(
+        "--no-wal", action="store_true",
+        help="TORN mode for register txns: per-key files committed "
+             "sequentially instead of one WAL append",
+    )
+    ap.add_argument(
+        "--torn-delay-ms", type=float, default=25.0,
+        help="--no-wal only: sleep between per-key commits (widens the "
+             "torn-transfer window so kill faults actually land in it)",
+    )
+    ap.add_argument(
+        "--seed", default=None,
+        help="seed registers once if the store is empty, as "
+             "comma-separated k:v pairs (e.g. 0:13,1:13 — the bank "
+             "workload's initial balances)",
+    )
     args = ap.parse_args()
     srv = Server(("127.0.0.1", args.port), Handler)
     srv.data_path = args.data
     srv.txn_buffer = args.txn_buffer
     srv.txn_buf = {}
     srv.txn_buf_lock = threading.Lock()
+    srv.no_wal = args.no_wal
+    srv.torn_delay = args.torn_delay_ms / 1000.0
+    srv.wal_state = {}
+    srv.wal_offset = 0
+    srv.wal_lock = threading.Lock()
+    if args.seed:
+        pairs = [p.split(":") for p in args.seed.split(",")]
+        if args.no_wal:
+            for k, v in pairs:
+                fd = os.open(f"{args.data}.breg-{k}", os.O_RDWR | os.O_CREAT, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                    if not read_all(fd).strip():
+                        os.write(fd, v.encode())
+                        os.fsync(fd)
+                finally:
+                    os.close(fd)
+        else:
+            fd = os.open(f"{args.data}.wal", os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                if os.fstat(fd).st_size == 0:
+                    rec = ";".join(f"w:{k}:{v}" for k, v in pairs)
+                    os.write(fd, f"{rec}\n".encode())
+                    os.fsync(fd)
+            finally:
+                os.close(fd)
     print(
         f"toydb listening on {args.port}, data={args.data}"
         + (f", LOSSY txn-buffer={args.txn_buffer}" if args.txn_buffer else ""),
