@@ -198,3 +198,15 @@ def serve(host="0.0.0.0", port=8080, store_dir=None):
         srv.serve_forever()
     finally:
         srv.server_close()
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Serve the store web UI.")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--store-dir", default=None)
+    a = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    serve(a.host, a.port, a.store_dir)

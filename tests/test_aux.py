@@ -240,3 +240,27 @@ def test_platform_override_applies_on_closure_import():
     )
     assert r.returncode == 0, r.stderr
     assert r.stdout.strip() == "cpu"
+
+
+def test_docker_bin_up_generates_compose(tmp_path):
+    """docker/bin/up --compose-only: the template-driven compose
+    generation (reference docker/bin parity) — N nodes + control with
+    correct dependencies, without needing a docker daemon."""
+    import pathlib
+    import shutil
+    import subprocess
+
+    src = pathlib.Path(__file__).resolve().parent.parent / "docker"
+    work = tmp_path / "docker"
+    shutil.copytree(src, work)
+    r = subprocess.run(
+        ["bash", str(work / "bin" / "up"), "--compose-only", "-n", "4"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    text = (work / "docker-compose.generated.yml").read_text()
+    for svc in ("n1:", "n2:", "n3:", "n4:", "control:"):
+        assert svc in text
+    assert "n5:" not in text
+    assert "depends_on: [n1, n2, n3, n4]" in text
+    assert "NET_ADMIN" in text
