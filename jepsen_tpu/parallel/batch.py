@@ -41,6 +41,30 @@ _CONFIRM_POOL: ProcessPoolExecutor | None = None
 _WARNED_EXACT_DEFAULT = False
 
 
+#: exact-engine frontier rows per launch (sub-batch bound; see the stage
+#: loop's budget comment — re-measure the true threshold on-chip).
+_EXACT_LANE_BUDGET = 16 * 1024
+
+
+def _resolve_confirmation(res: dict, cpu_res: dict) -> dict:
+    """Fold an exact-sweep confirmation verdict into the device result
+    (shared by the worker and device confirm paths)."""
+    if cpu_res["valid?"] is False:
+        return {**res, "confirmed?": True}
+    if cpu_res["valid?"] is True:
+        # the ~1e-13 case: a hash collision killed a live config; the
+        # exact sweep's witness wins
+        return cpu_res
+    return {
+        "valid?": "unknown",
+        "cause": (
+            "device refutation; exact confirmation inconclusive: "
+            + str(cpu_res.get("cause", "budget exceeded"))
+        ),
+        "kernel": res.get("kernel"),
+    }
+
+
 def _default_workers(workers: int | None) -> int:
     return workers or min(8, os.cpu_count() or 1)
 
@@ -176,6 +200,12 @@ def batch_analysis(
     so soundness costs almost no wall clock.  A sweep that exceeds
     ``confirm_max_configs`` leaves the verdict "unknown" (never a wrong
     False); a sweep that disagrees (the ~1e-13 collision case) wins.
+    ``confirm_refutations="device"`` confirms on the ACCELERATOR
+    instead: one batched exact-kernel (content-decided kills) launch per
+    capacity bucket over the failure prefixes after the ladder drains —
+    no CPU sweeps on the happy path, which matters on single-core hosts
+    where the worker sweeps time-share the driver's core; the rare
+    disagreeing/lossy lane falls back to the bounded CPU sweep.
 
     Escalation is about CAPACITY: each ladder stage re-runs only the
     still-lossy histories wider — and with ``carry_frontier`` (the
@@ -216,6 +246,11 @@ def batch_analysis(
 
     if engine not in ("sync", "async"):
         raise ValueError(f"unknown engine {engine!r}; expected 'sync' or 'async'")
+    if confirm_refutations not in (True, False, "device"):
+        raise ValueError(
+            f"unknown confirm_refutations {confirm_refutations!r}; "
+            "expected True (worker sweeps), False, or 'device'"
+        )
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     batch_caps = [int(c) for c in capacities]
     if exact_escalation is None and not cpu_fallback:
@@ -373,6 +408,7 @@ def batch_analysis(
     pending = list(range(len(packs)))
     resumes: dict[int, tuple] = {}  # pack idx -> saved resume frontier
     confirm_futs: dict = {}  # history index -> (future, device result)
+    device_confirms: list[tuple] = []  # (pack idx, failed_at, cap, result)
     for st_engine, batch_cap in stages:
         if not pending:
             break
@@ -385,7 +421,7 @@ def batch_analysis(
         # resident per-lane frontier, so its budget halves to keep the
         # old resident bound (re-measure the true threshold on-chip).
         if st_engine == "exact":
-            budget = 16 * 1024
+            budget = _EXACT_LANE_BUDGET
         elif st_engine == "async" and carry_frontier:
             budget = 32 * 1024
         else:
@@ -417,6 +453,14 @@ def batch_analysis(
                     # content-decided kills (or the caller opted out):
                     # the refutation is final
                     results[i] = res
+                elif confirm_refutations == "device":
+                    # confirm on the accelerator: queue for one batched
+                    # exact-kernel launch over the failure prefix after
+                    # the ladder drains (no CPU sweeps at all on the
+                    # happy path — the drain tail was the 1-core host's
+                    # serial sweeps)
+                    device_confirms.append((k, int(failed_at[j]), batch_cap, res))
+                    results[i] = res  # placeholder; resolved below
                 else:
                     # fast-engine refutation: hash-dedup could in
                     # principle have killed a distinct config, so the
@@ -443,9 +487,53 @@ def batch_analysis(
                 }
         pending = still
 
+    device_resolved: set[int] = set()
+    if device_confirms:
+        # One batched exact-engine launch per capacity bucket over the
+        # failure PREFIXES: content-decided kills make a lossless exact
+        # death a FINAL refutation.  The fast engine refuted losslessly,
+        # so (modulo the ~1e-13 hash-collision case) the true frontier
+        # fit its capacity; a surviving or lossy exact run IS that rare
+        # case and falls back to the exact CPU sweep.
+        by_cap: dict[int, list[tuple]] = {}
+        for k, fat, cap, res in device_confirms:
+            by_cap.setdefault(cap, []).append((k, fat, res))
+        for cap, group in sorted(by_cap.items()):
+            masked = []
+            for k, fat, _res in group:
+                p = dict(packs[k])
+                act = p["bar_active"].copy()
+                act[fat + 1 :] = False  # refutation needs only the prefix
+                p["bar_active"] = act
+                masked.append(p)
+            lanes_cap = max(1, _EXACT_LANE_BUDGET // cap)
+            for s0 in range(0, len(group), lanes_cap):
+                sub = masked[s0 : s0 + lanes_cap]
+                gvalid, gfailed, glossy, _pk, _rs = _launch("exact", cap, sub)
+                for (k, fat, res), v, f2, lz in zip(
+                    group[s0 : s0 + lanes_cap], gvalid, gfailed, glossy
+                ):
+                    i = idxs[k]
+                    device_resolved.add(i)
+                    if f2 >= 0 and not lz:
+                        res["confirmed?"] = True
+                        results[i] = res
+                    else:
+                        # hash-collision artifact or exact-engine loss:
+                        # the exact CPU sweep decides (bounded to the
+                        # original failure barrier)
+                        op_pos = int(packs[k]["bar_opid"][fat])
+                        cpu_res = wgl_cpu.sweep_analysis(
+                            model, histories[i],
+                            max_configs=confirm_max_configs,
+                            stop_at_index=op_pos,
+                        )
+                        results[i] = _resolve_confirmation(res, cpu_res)
+
     if cpu_fallback:
         for i, r in enumerate(results):
-            if r is not None and r["valid?"] == "unknown" and i not in confirm_futs:
+            if (r is not None and r["valid?"] == "unknown"
+                    and i not in confirm_futs and i not in device_resolved):
                 # The config-set sweep, not the DFS: DFS backtracking goes
                 # exponential on exactly the histories that overflow the
                 # kernel (info-heavy invalid ones); the sweep is the same
@@ -492,20 +580,5 @@ def batch_analysis(
                     "kernel": dev_res.get("kernel"),
                 }
             continue
-        if cpu_res["valid?"] is False:
-            dev_res["confirmed?"] = True
-            results[i] = dev_res
-        elif cpu_res["valid?"] is True:
-            # the 1e-13 case: a hash collision killed a live config;
-            # the exact sweep's witness wins
-            results[i] = cpu_res
-        else:
-            results[i] = {
-                "valid?": "unknown",
-                "cause": (
-                    "device refutation; exact confirmation inconclusive: "
-                    + str(cpu_res.get("cause", "budget exceeded"))
-                ),
-                "kernel": dev_res.get("kernel"),
-            }
+        results[i] = _resolve_confirmation(dev_res, cpu_res)
     return [r if r is not None else {"valid?": "unknown"} for r in results]

@@ -323,3 +323,25 @@ def test_carried_frontier_snapshot_resume_single_lane():
         if truth == "unknown":
             return
         assert got == truth
+
+
+def test_device_confirmation_mode():
+    """confirm_refutations="device": refutations confirmed by one
+    batched exact-kernel prefix launch instead of CPU worker sweeps —
+    verdicts must match the worker mode exactly, with confirmed? set."""
+    from jepsen_tpu.parallel import batch as pb
+
+    hists, expect = histories_mixed(9)
+    dev = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256),
+        confirm_refutations="device", cpu_fallback=False, exact_escalation=(),
+    )
+    wrk = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256),
+        confirm_refutations=True, cpu_fallback=False, exact_escalation=(),
+    )
+    for i, (d, w, want) in enumerate(zip(dev, wrk, expect)):
+        assert d["valid?"] in (want, "unknown"), (i, d["valid?"], want)
+        assert d["valid?"] == w["valid?"], (i, d["valid?"], w["valid?"])
+        if d["valid?"] is False:
+            assert d.get("confirmed?") is True, (i, d)

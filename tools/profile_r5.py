@@ -1,0 +1,71 @@
+"""Round-5 ablation: greedy rung, carried frontiers, saturating prune.
+
+Run on the real chip (or CPU with JEPSEN_TPU_PLATFORM=cpu for shape
+checks): measures the bench workload end-to-end under each feature
+toggle so PERF.md's round-5 story carries chip numbers.
+
+  python tools/profile_r5.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+sys.path.insert(0, str(ROOT))
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.parallel import batch_analysis  # noqa: E402
+from jepsen_tpu.parallel.batch import warm_confirm_pool  # noqa: E402
+
+QUICK = "--quick" in sys.argv
+N = 32 if QUICK else 128
+OPS = 100
+PROCS = 8
+CAPS = (128, 512, 2048)
+
+
+def bench_hists():
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=0.3, n_values=8)
+        if i % 4 == 3:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+    return hists
+
+
+def run(label, **kw):
+    model = m.CASRegister(None)
+    hists = bench_hists()
+    kw = dict(capacity=CAPS, exact_escalation=(), cpu_fallback=False, **kw)
+    batch_analysis(model, hists, **kw)  # warm/compile
+    t0 = time.perf_counter()
+    res = batch_analysis(model, hists, **kw)
+    dt = time.perf_counter() - t0
+    unknowns = sum(1 for r in res if r["valid?"] == "unknown")
+    n_false = sum(1 for r in res if r["valid?"] is False)
+    print(json.dumps({"ablation": label, "s": round(dt, 2),
+                      "unknowns": unknowns, "false": n_false}), flush=True)
+    return dt, unknowns
+
+
+def main():
+    warm_confirm_pool()
+    run("full (greedy + carry + sat-prune)")
+    run("no greedy rung", greedy_first=False)
+    run("no carried frontier", carry_frontier=False)
+    run("neither", greedy_first=False, carry_frontier=False)
+    # the confirmation drain: CPU worker sweeps (overlapped, but they
+    # time-share the 1-core host) vs one batched exact prefix launch
+    run("device confirmation", confirm_refutations="device")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Reproduce + bisect the cap>=1024 exact-kernel TPU worker fault.
+
+ROADMAP (r4): "the exact barrier kernel faults the tunneled TPU worker
+at cap >= 1024 on B=16384 scans (reproducible; the async engine runs
+those shapes)".  This script isolates the boundary: it sweeps
+(capacity, barriers) on the exact batched runner in SUBPROCESSES (a
+worker fault must not kill the sweep) and prints one JSON line per
+cell: ok / fault, wall seconds, and the error tail on fault.
+
+  python tools/repro_exact_fault.py             # the sweep
+  python tools/repro_exact_fault.py --cell 1024 16384   # one cell, in-process
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+sys.path.insert(0, str(ROOT))
+
+CAPS = (512, 1024, 2048)
+BARS = (4096, 8192, 16384)
+
+
+def run_cell(cap: int, n_ops: int) -> None:
+    from genhist import valid_register_history
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import wgl
+
+    hist = valid_register_history(n_ops // 2, 32, seed=7, info_rate=0.02,
+                                  n_values=5)
+    packed = wgl.pack(m.CASRegister(None), hist)
+    packed = wgl.pad_packed(packed)
+    B, P, G, W = packed["B"], packed["P"], packed["G"], packed["W"]
+    runner = wgl.exact_batched_runner(packed["step"], cap, 8, P, G, W)
+    import numpy as np
+
+    args = [
+        np.asarray(a)[None]
+        for a in (
+            [packed["init_state"], packed["bar_active"]]
+            + list(packed["bar"]) + list(packed["mov"])
+            + list(packed["grp"]) + [packed["grp_open"]]
+        )
+    ]
+    args += [packed["slot_lane"], packed["slot_onehot"]]
+    t0 = time.perf_counter()
+    valid, failed_at, lossy, peak = runner(*args)
+    print(json.dumps({
+        "cap": cap, "B": B, "ok": True,
+        "s": round(time.perf_counter() - t0, 1),
+        "valid": bool(valid[0]), "lossy": bool(lossy[0]),
+    }))
+
+
+def main() -> None:
+    if "--cell" in sys.argv:
+        i = sys.argv.index("--cell")
+        run_cell(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+        return
+    for n_ops in BARS:
+        for cap in CAPS:
+            t0 = time.perf_counter()
+            p = subprocess.run(
+                [sys.executable, __file__, "--cell", str(cap), str(n_ops)],
+                capture_output=True, text=True, timeout=1200,
+            )
+            if p.returncode == 0 and p.stdout.strip():
+                print(p.stdout.strip(), flush=True)
+            else:
+                tail = (p.stderr or "").strip().splitlines()[-3:]
+                print(json.dumps({
+                    "cap": cap, "n_ops": n_ops, "ok": False,
+                    "rc": p.returncode,
+                    "s": round(time.perf_counter() - t0, 1),
+                    "error_tail": tail,
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
