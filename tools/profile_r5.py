@@ -25,10 +25,11 @@ from jepsen_tpu.parallel import batch_analysis  # noqa: E402
 from jepsen_tpu.parallel.batch import warm_confirm_pool  # noqa: E402
 
 QUICK = "--quick" in sys.argv
-N = 32 if QUICK else 128
-OPS = 100
-PROCS = 8
-CAPS = (128, 512, 2048)
+TINY = "--tiny" in sys.argv  # smoke the script logic on a CPU backend
+N = 8 if TINY else 32 if QUICK else 128
+OPS = 40 if TINY else 100
+PROCS = 4 if TINY else 8
+CAPS = (16, 64) if TINY else (128, 512, 2048)
 
 
 def bench_hists():
