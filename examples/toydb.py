@@ -43,10 +43,11 @@ class ToyDB(jdb.DB):
     txn mode (see toydb_server module docstring)."""
 
     def __init__(self, txn_buffer: int = 0, no_wal: bool = False,
-                 seed: str | None = None):
+                 seed: str | None = None, reg_buffer: int = 0):
         self.txn_buffer = int(txn_buffer)
         self.no_wal = bool(no_wal)
         self.seed = seed
+        self.reg_buffer = int(reg_buffer)
 
     def _paths(self, node):
         d = f"{BASE}/{node}"
@@ -80,6 +81,8 @@ class ToyDB(jdb.DB):
             extra.append("--no-wal")
         if self.seed:
             extra += ["--seed", self.seed]
+        if self.reg_buffer:
+            extra += ["--reg-buffer", str(self.reg_buffer)]
         return cu.start_daemon(
             session,
             "python3", p["server"],
@@ -359,6 +362,25 @@ def toydb_wr_test(opts) -> dict:
     return _toydb_faulted_test(
         opts, "toydb-wr", ToyDB(), ToyWrClient(),
         wl["generator"], {"wr": wl["checker"]},
+    )
+
+
+def toydb_longfork_test(opts) -> dict:
+    """The long-fork (parallel snapshot isolation) workload against LIVE
+    toydb processes (reference: jepsen/tests/long_fork.clj): unique
+    single-key writes + whole-group snapshot reads over the register-txn
+    wire.  The WAL serializes everything, so the durable mode shows no
+    forks; ``fork: True`` starts the servers with --reg-buffer — each
+    node overlays its own unflushed writes on the shared prefix, two
+    nodes' reads become ⊆-incomparable, and the checker's linear-time
+    verifier names the forked read pair."""
+    from jepsen_tpu.workloads import long_fork
+
+    wl = long_fork.workload(opts)
+    db = ToyDB(reg_buffer=int(opts.get("reg-buffer", 4)) if opts.get("fork") else 0)
+    return _toydb_faulted_test(
+        opts, "toydb-longfork" + ("-forked" if opts.get("fork") else ""),
+        db, ToyWrClient(), wl["generator"], {"long-fork": wl["checker"]},
     )
 
 

@@ -49,6 +49,13 @@ holds through any kill schedule.
 per-key files committed SEQUENTIALLY (with ``--torn-delay-ms`` widening
 the window); a kill between the two halves of a transfer tears it —
 money appears or vanishes — which the bank checker catches.
+
+``--reg-buffer N`` is the OTHER register failure mode: a node acks
+mutations from a local buffer and flushes them to the WAL only every N
+mutations.  Each node's view is then WAL-prefix + its OWN unflushed
+writes — two nodes' views are ⊆-incomparable, which is precisely the
+long-fork (parallel snapshot isolation) anomaly the long-fork checker
+detects; kills also lose acknowledged buffered writes.
 """
 
 from __future__ import annotations
@@ -269,8 +276,13 @@ class Handler(socketserver.StreamRequestHandler):
                     os.ftruncate(fd, srv.wal_offset)
                 # mutate a working copy; the cache only advances on a
                 # successful commit (a failed write must not leave the
-                # in-memory state ahead of the WAL)
+                # in-memory state ahead of the WAL).  In --reg-buffer
+                # mode the view also overlays this node's unflushed
+                # mutations (the long-fork mechanism: other nodes can't
+                # see them).
                 st = dict(srv.wal_state)
+                if srv.reg_muts:
+                    self._wal_replay(st, ";".join(srv.reg_muts) + "\n")
                 out, muts = [], []
                 for mop in mops:
                     if mop[0] == "g":
@@ -290,16 +302,32 @@ class Handler(socketserver.StreamRequestHandler):
                             st[b] = st.get(b, 0) + n
                             muts.append(f"t:{a}:{b}:{n}")
                             out.append(f"t:{a}:{b}:{n}")
-                if muts:
-                    rec = (";".join(muts) + "\n").encode()
+                # One commit block for both modes: durable commits this
+                # txn's muts; buffered mode accumulates and commits the
+                # whole buffer every reg_buffer muts (st then equals
+                # WAL replay + all local muts = the new committed state).
+                if muts and srv.reg_buffer:
+                    srv.reg_muts.extend(muts)
+                    to_commit = (
+                        srv.reg_muts if len(srv.reg_muts) >= srv.reg_buffer else []
+                    )
+                else:
+                    to_commit = muts
+                if to_commit:
+                    rec = (";".join(to_commit) + "\n").encode()
                     written = os.write(fd, rec)
                     if written != len(rec):  # ENOSPC-style short write:
-                        # roll back the partial record; cache untouched
+                        # roll back the partial record AND this txn's
+                        # buffered muts (the txn errors; its writes must
+                        # not linger in the overlay and commit later)
                         os.ftruncate(fd, srv.wal_offset)
+                        if srv.reg_buffer:
+                            del srv.reg_muts[len(srv.reg_muts) - len(muts):]
                         return "err short-write"
                     os.fsync(fd)  # the atomic commit point
                     srv.wal_offset += len(rec)
-                srv.wal_state = st
+                    srv.wal_state = st
+                    srv.reg_muts = []
                 return "x " + ";".join(out)
         finally:
             os.close(fd)
@@ -399,6 +427,12 @@ def main():
              "torn-transfer window so kill faults actually land in it)",
     )
     ap.add_argument(
+        "--reg-buffer", type=int, default=0,
+        help="LONG-FORK mode for register txns: ack mutations from a "
+             "node-local buffer, flushing to the WAL every N (0 = "
+             "durable, fsync before ack)",
+    )
+    ap.add_argument(
         "--seed", default=None,
         help="seed registers once if the store is empty, as "
              "comma-separated k:v pairs (e.g. 0:13,1:13 — the bank "
@@ -412,6 +446,8 @@ def main():
     srv.txn_buf_lock = threading.Lock()
     srv.no_wal = args.no_wal
     srv.torn_delay = args.torn_delay_ms / 1000.0
+    srv.reg_buffer = args.reg_buffer
+    srv.reg_muts = []
     srv.wal_state = {}
     srv.wal_offset = 0
     srv.wal_lock = threading.Lock()

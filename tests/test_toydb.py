@@ -265,3 +265,46 @@ def test_toydb_bank_torn_mode_is_caught(tmp_path):
     assert last["valid?"] is False, "torn transfers must be caught"
     assert last["bad-read-count"] > 0
     assert any("total" in e for r in last["bad-reads"] for e in r["errors"])
+
+
+def test_toydb_long_fork_durable_and_forked(tmp_path):
+    """Long-fork live: the WAL'd durable mode shows no forks; the
+    --reg-buffer mode's node-local write overlays produce genuinely
+    incomparable snapshot reads that the checker names."""
+    from examples.toydb import toydb_longfork_test
+
+    shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+    t = toydb_longfork_test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6,
+            "time-limit": 5,
+            "interval": 1.5,
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    res = completed["results"]["long-fork"]
+    assert res["valid?"] is True, res
+
+    # forked mode: two attempts bound the schedule-luck flake rate
+    last = None
+    for _attempt in range(2):
+        shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+        t = toydb_longfork_test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 8,
+                "time-limit": 6,
+                "interval": 2.5,
+                "fork": True,
+                "ssh": {"local?": True},
+                "store-dir": str(tmp_path),
+            }
+        )
+        completed = core.run_test(t)
+        last = completed["results"]["long-fork"]
+        if last["valid?"] is False:
+            break
+    assert last["valid?"] is False, last
