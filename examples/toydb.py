@@ -365,6 +365,44 @@ def toydb_wr_test(opts) -> dict:
     )
 
 
+class ToyCounterClient(ToyClient):
+    """Monotonic-counter ops over the register-txn wire: ``inc`` is the
+    atomic ``d`` micro-op (answers the post-increment count), ``read``
+    the plain ``g``."""
+
+    KEY = "ctr"
+
+    def invoke(self, test, op):
+        if op["f"] == "inc":
+            reply = self._round(f"X d:{self.KEY}:1")
+            if not reply.startswith("x d:"):
+                raise RuntimeError(f"unexpected inc reply {reply!r}")
+            return {**op, "type": "ok", "value": int(reply.rsplit(":", 1)[1])}
+        if op["f"] == "read":
+            reply = self._round(f"X g:{self.KEY}")
+            if not reply.startswith("x g:"):
+                raise RuntimeError(f"unexpected read reply {reply!r}")
+            body = reply.rsplit(":", 1)[1]
+            return {**op, "type": "ok", "value": 0 if body == "nil" else int(body)}
+        raise ValueError(f"unknown op {op['f']!r}")
+
+
+def toydb_monotonic_test(opts) -> dict:
+    """The monotonic-counter workload (the cockroach/tidb harness
+    pattern) against LIVE toydb processes: WAL'd increments never run
+    backwards; ``fork: True`` (node-local write buffering) makes reads
+    on different nodes observe diverged counts — a real-time regression
+    the checker reports as ``nonmonotonic``."""
+    from jepsen_tpu.workloads import monotonic
+
+    wl = monotonic.workload(opts)
+    db = ToyDB(reg_buffer=int(opts.get("reg-buffer", 4)) if opts.get("fork") else 0)
+    return _toydb_faulted_test(
+        opts, "toydb-monotonic" + ("-forked" if opts.get("fork") else ""),
+        db, ToyCounterClient(), wl["generator"], {"monotonic": wl["checker"]},
+    )
+
+
 def toydb_longfork_test(opts) -> dict:
     """The long-fork (parallel snapshot isolation) workload against LIVE
     toydb processes (reference: jepsen/tests/long_fork.clj): unique

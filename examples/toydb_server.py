@@ -35,10 +35,11 @@ elle-visible anomalies produced by a real running system.
 REGISTER transactions (the elle rw-register vocabulary and the bank
 workload) ride a second namespace with a WRITE-AHEAD LOG:
 
-  X w:k:v;g:k;t:a:b:n   -> "x w:k:v;g:k:3;t:a:b:n"   ("t:fail" on overdraft)
+  X w:k:v;g:k;t:a:b:n;d:k:n -> "x w:k:v;g:k:3;t:a:b:n;d:k:7"
 
 ``w`` sets register k, ``g`` reads it, ``t`` transfers n from a to b
-(refused when it would overdraw).  State is the replay of
+(refused when it would overdraw — "t:fail"), ``d`` adds n to counter k
+and answers the post-increment value.  State is the replay of
 ``{data}.wal``; a txn's mutations commit as ONE appended line + fsync
 under the WAL lock — the atomic commit point (a kill can only tear the
 trailing line, which replay discards as uncommitted).  Multi-key
@@ -222,6 +223,8 @@ class Handler(socketserver.StreamRequestHandler):
                 mops.append(("g", p[1], None))
             elif p[0] == "t" and len(p) == 4:
                 mops.append(("t", p[1], p[2], int(p[3])))
+            elif p[0] == "d" and len(p) == 3:
+                mops.append(("d", p[1], int(p[2])))
             else:
                 return None
         return mops
@@ -255,6 +258,8 @@ class Handler(socketserver.StreamRequestHandler):
                     a, b, n = p[1], p[2], int(p[3])
                     state[a] = state.get(a, 0) - n
                     state[b] = state.get(b, 0) + n
+                elif p[0] == "d":
+                    state[p[1]] = state.get(p[1], 0) + int(p[2])
             consumed += len(line)
         return consumed
 
@@ -293,6 +298,11 @@ class Handler(socketserver.StreamRequestHandler):
                         st[k] = v
                         muts.append(f"w:{k}:{v}")
                         out.append(f"w:{k}:{v}")
+                    elif mop[0] == "d":
+                        _f, k, n = mop
+                        st[k] = st.get(k, 0) + n
+                        muts.append(f"d:{k}:{n}")
+                        out.append(f"d:{k}:{st[k]}")
                     else:
                         _f, a, b, n = mop
                         if st.get(a, 0) < n:
@@ -359,6 +369,11 @@ class Handler(socketserver.StreamRequestHandler):
                     vals[k] = v
                     dirty.append(k)
                     out.append(f"w:{k}:{v}")
+                elif mop[0] == "d":
+                    _f, k, n = mop
+                    vals[k] = (vals.get(k) or 0) + n
+                    dirty.append(k)
+                    out.append(f"d:{k}:{vals[k]}")
                 else:
                     _f, a, b, n = mop
                     if (vals.get(a) or 0) < n:

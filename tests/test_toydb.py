@@ -308,3 +308,47 @@ def test_toydb_long_fork_durable_and_forked(tmp_path):
         if last["valid?"] is False:
             break
     assert last["valid?"] is False, last
+
+
+def test_toydb_monotonic_durable_and_forked(tmp_path):
+    """Monotonic counter live: WAL'd increments never regress; the
+    fork mode's diverged node views produce a real-time nonmonotonic
+    read pair the checker names."""
+    from examples.toydb import toydb_monotonic_test
+
+    shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+    t = toydb_monotonic_test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6,
+            "time-limit": 5,
+            "interval": 1.5,
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    res = completed["results"]["monotonic"]
+    assert res["reads"] > 10 and res["incs"] > 10
+    assert res["valid?"] is True, res.get("errors")
+
+    last = None
+    for _attempt in range(2):
+        shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+        t = toydb_monotonic_test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 8,
+                "time-limit": 6,
+                "interval": 2.5,
+                "fork": True,
+                "ssh": {"local?": True},
+                "store-dir": str(tmp_path),
+            }
+        )
+        completed = core.run_test(t)
+        last = completed["results"]["monotonic"]
+        if last["valid?"] is False:
+            break
+    assert last["valid?"] is False, last
+    assert any(e["type"] == "nonmonotonic" for e in last["errors"])
