@@ -365,6 +365,47 @@ def toydb_wr_test(opts) -> dict:
     )
 
 
+class ToyCRClient(ToyClient):
+    """causal-reverse ops over the list-append wire: ``insert`` appends
+    to one shared list, ``read`` snapshots it (reference:
+    jepsen/tests/causal_reverse.clj's insert/read vocabulary)."""
+
+    KEY = "cr"
+
+    def invoke(self, test, op):
+        if op["f"] == "insert":
+            reply = self._round(f"T a:{self.KEY}:{op['value']}")
+            if not reply.startswith("t a:"):
+                raise RuntimeError(f"unexpected insert reply {reply!r}")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            reply = self._round(f"T r:{self.KEY}")
+            if not reply.startswith("t r:"):
+                raise RuntimeError(f"unexpected read reply {reply!r}")
+            body = reply.split(":", 2)[2]
+            vals = [int(x) for x in body.split(",")] if body else []
+            return {**op, "type": "ok", "value": vals}
+        raise ValueError(f"unknown op {op['f']!r}")
+
+
+def toydb_causal_reverse_test(opts) -> dict:
+    """causal-reverse against LIVE toydb processes: monotone inserts
+    must never be observed out of order.  Durable appends under one
+    flock preserve order; ``lossy: True`` (the txn-buffer mode) lets a
+    node ack inserts into local memory other nodes can't see — a read
+    elsewhere observes a LATER insert while missing an earlier
+    acknowledged one, the reversal the checker reports."""
+    from jepsen_tpu.workloads import causal
+
+    lossy = bool(opts.get("lossy") or opts.get("txn-buffer"))
+    db = ToyDB(txn_buffer=int(opts.get("txn-buffer", 4)) if lossy else 0)
+    wl = causal.reverse_workload(opts)
+    return _toydb_faulted_test(
+        opts, "toydb-causal-reverse" + ("-lossy" if lossy else ""),
+        db, ToyCRClient(), wl["generator"], {"causal-reverse": wl["checker"]},
+    )
+
+
 class ToyCounterClient(ToyClient):
     """Monotonic-counter ops over the register-txn wire: ``inc`` is the
     atomic ``d`` micro-op (answers the post-increment count), ``read``

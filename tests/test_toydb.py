@@ -352,3 +352,48 @@ def test_toydb_monotonic_durable_and_forked(tmp_path):
             break
     assert last["valid?"] is False, last
     assert any(e["type"] == "nonmonotonic" for e in last["errors"])
+
+
+def test_toydb_causal_reverse_durable_and_lossy(tmp_path):
+    """causal-reverse live: ordered inserts never observed reversed in
+    durable mode; the lossy buffer mode's invisible local inserts
+    produce a genuine reversal the checker names."""
+    from examples.toydb import toydb_causal_reverse_test
+
+    shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+    t = toydb_causal_reverse_test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6,
+            "time-limit": 5,
+            "interval": 1.5,
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    res = completed["results"]["causal-reverse"]
+    reads = [o for o in completed["history"] if o["type"] == h.OK and o["f"] == "read"]
+    assert len(reads) > 10
+    assert res["valid?"] is True, res.get("errors")
+
+    last = None
+    for _attempt in range(2):
+        shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+        t = toydb_causal_reverse_test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 8,
+                "time-limit": 6,
+                "interval": 2.5,
+                "lossy": True,
+                "ssh": {"local?": True},
+                "store-dir": str(tmp_path),
+            }
+        )
+        completed = core.run_test(t)
+        last = completed["results"]["causal-reverse"]
+        if last["valid?"] is False:
+            break
+    assert last["valid?"] is False, last
+    assert "missed earlier acked" in last["errors"][0]["error"]
