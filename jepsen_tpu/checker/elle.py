@@ -51,24 +51,43 @@ ANOMALY_RULES_OUT = {
 
 #: DIRECT weaker→stronger edges; STRONGER_MODELS below is the transitive
 #: closure (computed, so adding a model can't silently break the
-#: closure).  Chains follow Adya's PL hierarchy on one side
-#: (read-committed → cursor-stability → repeatable-read → serializable)
-#: and the atomic-snapshot family on the other (monotonic-atomic-view →
-#: read-atomic → causal → parallel-snapshot-isolation →
-#: snapshot-isolation → serializable), meeting at serializable and
-#: topped by strict-serializable.
+#: closure).  Chains follow Adya's PL hierarchy (thesis Fig. 4-3) on
+#: one side — read-committed → {cursor-stability, monotonic-view};
+#: PL-2L → PL-MSR / PL-CV → PL-FCV → PL-SI; PL-FCV → PL-3U
+#: (update-serializable) → PL-3 — and the atomic-snapshot family on the
+#: other (monotonic-atomic-view → read-atomic → causal →
+#: parallel-snapshot-isolation → snapshot-isolation), meeting at
+#: serializable; session-strengthened variants (Daudjee & Salem)
+#: interpose between the snapshot/serializable levels and
+#: strict-serializable at the top.
 _STRONGER_DIRECT = {
     "read-uncommitted": ["read-committed"],
-    "read-committed": ["cursor-stability", "monotonic-atomic-view", "consistent-view"],
+    "read-committed": [
+        "cursor-stability", "monotonic-atomic-view", "monotonic-view",
+    ],
     "cursor-stability": ["repeatable-read"],
+    # Adya PL-2L: reads observe a monotonically growing prefix of commits
+    "monotonic-view": ["monotonic-snapshot-read", "consistent-view"],
+    # Adya PL-MSR: reads are snapshots that advance monotonically
+    "monotonic-snapshot-read": ["snapshot-isolation"],
     "monotonic-atomic-view": ["read-atomic", "repeatable-read"],
-    "consistent-view": ["snapshot-isolation"],
+    # Adya PL-CV → PL-FCV → PL-SI
+    "consistent-view": ["forward-consistent-view"],
+    "forward-consistent-view": ["snapshot-isolation", "update-serializable"],
+    # Adya PL-3U: serializable with respect to update transactions
+    "update-serializable": ["serializable"],
     "read-atomic": ["causal"],
     "causal": ["parallel-snapshot-isolation"],
     "parallel-snapshot-isolation": ["snapshot-isolation"],
     "repeatable-read": ["serializable"],
-    "snapshot-isolation": ["serializable"],
-    "serializable": ["strict-serializable"],
+    # PL-SI sits below PL-3 in Adya's proscribed-phenomena ordering, and
+    # below its own session-strengthened ladder (Daudjee & Salem:
+    # per-session real-time order, then global real-time order)
+    "snapshot-isolation": ["serializable", "strong-session-snapshot-isolation"],
+    "strong-session-snapshot-isolation": ["strong-snapshot-isolation"],
+    "strong-snapshot-isolation": ["strict-serializable"],
+    "serializable": ["strong-session-serializable"],
+    "strong-session-serializable": ["strict-serializable"],
     "strict-serializable": [],
 }
 

@@ -546,3 +546,45 @@ def test_cycle_checker_matrix_relations():
     [anom] = r["anomalies"]["cycle"]
     types = {s["type"] for s in anom["steps"]}
     assert types == {"ww", "rt"}
+
+
+def test_consistency_lattice_structure():
+    """Round-5 lattice widening (Adya PL-2L/PL-MSR/PL-FCV/PL-3U +
+    Daudjee-Salem session ladders): the graph must stay a DAG with
+    strict-serializable as the single top, and every anomaly's
+    ruled-out set must still flow up to strict-serializable."""
+    from jepsen_tpu.checker.elle import (
+        ANOMALY_RULES_OUT,
+        STRONGER_MODELS,
+        _STRONGER_DIRECT,
+        models_ruled_out,
+    )
+
+    # every edge target is a known model
+    for src, dsts in _STRONGER_DIRECT.items():
+        for d in dsts:
+            assert d in _STRONGER_DIRECT, (src, d)
+    # acyclic: no model is in its own closure
+    for m, ups in STRONGER_MODELS.items():
+        assert m not in ups, m
+    # single top: everything below strict-serializable reaches it
+    for m in _STRONGER_DIRECT:
+        if m != "strict-serializable":
+            assert "strict-serializable" in STRONGER_MODELS[m], m
+    # 18 models (13-model core + PL-2L, PL-MSR, PL-FCV, PL-3U, session SIs)
+    assert len(_STRONGER_DIRECT) >= 18
+    # Adya chains hold transitively
+    assert "snapshot-isolation" in STRONGER_MODELS["monotonic-view"]
+    assert "serializable" in STRONGER_MODELS["forward-consistent-view"]
+    assert "strong-snapshot-isolation" in STRONGER_MODELS["snapshot-isolation"]
+    # ruling out G-single still implies serializable is gone (CV -> FCV
+    # -> SI -> serializable), and G0 takes out everything
+    weakest, also = models_ruled_out(["G-single"])
+    assert "consistent-view" in weakest
+    assert "serializable" in also and "strict-serializable" in also
+    weakest, also = models_ruled_out(["G0"])
+    assert weakest == ["read-uncommitted"]
+    assert "strong-session-serializable" in also
+    for a in ANOMALY_RULES_OUT:
+        w, al = models_ruled_out([a])
+        assert "strict-serializable" in (set(w) | set(al)), a
