@@ -17,6 +17,7 @@ Run it (single machine, real processes):
 from __future__ import annotations
 
 import socket
+import time
 from pathlib import Path
 
 from jepsen_tpu import checker, cli, client, core, db as jdb, generator as gen
@@ -136,6 +137,13 @@ class ToyClient(client.Client):
         if not reply.startswith("v "):
             raise RuntimeError(f"unexpected read reply {reply!r}")
         return None if reply == "v nil" else int(reply.split()[1])
+
+    @staticmethod
+    def _g_value(tok: str):
+        """The value of one ``g:{k}:{nil|int}`` reply token (the X wire's
+        register read)."""
+        body = tok.split(":", 2)[2]
+        return None if body == "nil" else int(body)
 
     def invoke(self, test, op):
         f, v = op["f"], op.get("value")
@@ -285,8 +293,7 @@ class ToyWrClient(ToyClient):
             if f == "w":
                 done.append(["w", k, v])
             else:
-                body = tok.split(":", 2)[2]
-                done.append(["r", k, None if body == "nil" else int(body)])
+                done.append(["r", k, self._g_value(tok)])
         return {**op, "type": "ok", "value": done}
 
 
@@ -303,10 +310,10 @@ class ToyBankClient(ToyClient):
             reply = self._round("X " + toks)
             if not reply.startswith("x "):
                 raise RuntimeError(f"unexpected bank read reply {reply!r}")
-            balances = {}
-            for a, tok in zip(accounts, reply[2:].split(";")):
-                body = tok.split(":", 2)[2]
-                balances[a] = 0 if body == "nil" else int(body)
+            balances = {
+                a: self._g_value(tok) or 0
+                for a, tok in zip(accounts, reply[2:].split(";"))
+            }
             return {**op, "type": "ok", "value": balances}
         if op["f"] == "transfer":
             v = op["value"]
@@ -403,6 +410,80 @@ def toydb_causal_reverse_test(opts) -> dict:
     return _toydb_faulted_test(
         opts, "toydb-causal-reverse" + ("-lossy" if lossy else ""),
         db, ToyCRClient(), wl["generator"], {"causal-reverse": wl["checker"]},
+    )
+
+
+class ToyAdyaClient(ToyClient):
+    """Adya write-skew ops (reference jepsen/tests/adya.clj:30-60): each
+    txn reads a key's two rows and inserts its own iff the OTHER is
+    absent.  Atomic mode does it in ONE server txn (the conditional
+    ``i`` micro-op under the WAL lock — serializable, no skew
+    possible); ``split`` mode does the read and the insert as separate
+    txns, the classic application-level race that manufactures G2 on
+    any system weaker than one giant lock."""
+
+    split = False
+    think_s = 0.05
+
+    def invoke(self, test, op):
+        v = op["value"]
+        k, rid = v["key"], v["id"]
+        ka, kb = f"ad{k}a", f"ad{k}b"
+        mine, other = (ka, kb) if rid == 1 else (kb, ka)
+
+        def parse_read(reply):
+            # [ka row, kb row] in request order
+            return [
+                self._g_value(tok)
+                for tok in reply[2:].split(";") if tok.startswith("g:")
+            ]
+
+        if self.split:
+            r1 = self._round(f"X g:{ka};g:{kb}")
+            if not r1.startswith("x "):
+                raise RuntimeError(f"unexpected adya read reply {r1!r}")
+            read = parse_read(r1)
+            other_row = read[1] if rid == 1 else read[0]
+            if other_row is not None:
+                return {**op, "type": "fail", "value": {**v, "read": read}}
+            # app "think time" between predicate read and insert — the
+            # window real applications open when they split a
+            # read-then-write across transactions
+            time.sleep(self.think_s)
+            r2 = self._round(f"X w:{mine}:{rid}")
+            if not r2.startswith("x w:"):
+                raise RuntimeError(f"unexpected adya insert reply {r2!r}")
+            return {**op, "type": "ok", "value": {**v, "read": read}}
+        reply = self._round(f"X g:{ka};g:{kb};i:{other}:{mine}:{rid}")
+        if not reply.startswith("x "):
+            raise RuntimeError(f"unexpected adya txn reply {reply!r}")
+        read = parse_read(reply)
+        ok = not reply.endswith("i:fail")
+        return {
+            **op,
+            "type": "ok" if ok else "fail",
+            "value": {**v, "read": read},
+        }
+
+
+class ToySplitAdyaClient(ToyAdyaClient):
+    split = True
+
+
+def toydb_adya_test(opts) -> dict:
+    """Adya G2 (write skew) against LIVE toydb processes.  Atomic mode
+    (the conditional insert inside one WAL txn) is serializable and
+    shows nothing; ``split: True`` performs the predicate read and the
+    insert as separate transactions — two clients race, both observe
+    the other row absent, both insert: a genuine G2 the checker names
+    (adya.clj:62-87)."""
+    from jepsen_tpu.workloads import adya
+
+    wl = adya.workload(opts)
+    client = ToySplitAdyaClient() if opts.get("split") else ToyAdyaClient()
+    return _toydb_faulted_test(
+        opts, "toydb-adya" + ("-split" if opts.get("split") else ""),
+        ToyDB(), client, wl["generator"], {"adya": wl["checker"]},
     )
 
 

@@ -225,6 +225,10 @@ class Handler(socketserver.StreamRequestHandler):
                 mops.append(("t", p[1], p[2], int(p[3])))
             elif p[0] == "d" and len(p) == 3:
                 mops.append(("d", p[1], int(p[2])))
+            elif p[0] == "i" and len(p) == 4:
+                # conditional insert: write k_write=v iff k_check absent
+                # (the atomic form of the adya predicate-insert)
+                mops.append(("i", p[1], p[2], int(p[3])))
             else:
                 return None
         return mops
@@ -303,6 +307,14 @@ class Handler(socketserver.StreamRequestHandler):
                         st[k] = st.get(k, 0) + n
                         muts.append(f"d:{k}:{n}")
                         out.append(f"d:{k}:{st[k]}")
+                    elif mop[0] == "i":
+                        _f, kc, kw, v = mop
+                        if st.get(kc) is None:
+                            st[kw] = v
+                            muts.append(f"w:{kw}:{v}")
+                            out.append(f"i:{kc}:{kw}:{v}")
+                        else:
+                            out.append("i:fail")
                     else:
                         _f, a, b, n = mop
                         if st.get(a, 0) < n:
@@ -346,7 +358,8 @@ class Handler(socketserver.StreamRequestHandler):
         """--no-wal: per-key register files committed sequentially — the
         torn-transfer window the bank checker exists to catch."""
         keys = sorted(
-            {k for mop in mops for k in (mop[1:3] if mop[0] == "t" else [mop[1]])}
+            {k for mop in mops
+             for k in (mop[1:3] if mop[0] in ("t", "i") else [mop[1]])}
         )
         fds = {}
         try:
@@ -374,6 +387,14 @@ class Handler(socketserver.StreamRequestHandler):
                     vals[k] = (vals.get(k) or 0) + n
                     dirty.append(k)
                     out.append(f"d:{k}:{vals[k]}")
+                elif mop[0] == "i":
+                    _f, kc, kw, v = mop
+                    if vals.get(kc) is None:
+                        vals[kw] = v
+                        dirty.append(kw)
+                        out.append(f"i:{kc}:{kw}:{v}")
+                    else:
+                        out.append("i:fail")
                 else:
                     _f, a, b, n = mop
                     if (vals.get(a) or 0) < n:

@@ -14,7 +14,7 @@ ok iff its insert committed.
 
 from __future__ import annotations
 
-import itertools
+import dataclasses
 from typing import Mapping
 
 from jepsen_tpu import generator as gen
@@ -22,18 +22,43 @@ from jepsen_tpu import history as h
 from jepsen_tpu.checker import Checker
 
 
+@dataclasses.dataclass(frozen=True)
+class _AdyaGen(gen.Gen):
+    """Emit id-1 then id-2 for each key, advancing ONLY on dispatched
+    invoke events (the _LongForkGen idiom): the interpreter peeks op()
+    speculatively and may discard the result, so stateful closures drop
+    ops — the original list/queue forms silently emitted only id-1 per
+    key, which the live toydb adya harness caught (no key ever had both
+    transactions, so write skew was undetectable by construction)."""
+
+    key: int = 0
+    rid: int = 1
+
+    def op(self, test, ctx):
+        o = gen.fill_in_op(
+            {"f": "txn", "value": {"key": self.key, "id": self.rid}}, ctx
+        )
+        return (o, self)
+
+    def update(self, test, ctx, event):
+        v = event.get("value") if isinstance(event.get("value"), dict) else None
+        if (
+            event.get("type") == "invoke"
+            and event.get("f") == "txn"
+            and v is not None
+            and v.get("key") == self.key
+            and v.get("id") == self.rid
+        ):
+            if self.rid == 1:
+                return dataclasses.replace(self, rid=2)
+            return dataclasses.replace(self, key=self.key + 1, rid=1)
+        return self
+
+
 def generator() -> gen.Gen:
-    """Two ops per key, one for each row id (adya.clj:30-60)."""
-    counter = itertools.count()
-
-    def pair():
-        k = next(counter)
-        return [
-            {"f": "txn", "value": {"key": k, "id": 1}},
-            {"f": "txn", "value": {"key": k, "id": 2}},
-        ]
-
-    return gen.repeat(pair)
+    """Two ops per key, one for each row id (adya.clj:30-60), advanced
+    by invoke events only."""
+    return _AdyaGen()
 
 
 class G2Checker(Checker):

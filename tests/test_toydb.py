@@ -397,3 +397,49 @@ def test_toydb_causal_reverse_durable_and_lossy(tmp_path):
             break
     assert last["valid?"] is False, last
     assert "missed earlier acked" in last["errors"][0]["error"]
+
+
+def test_toydb_adya_atomic_and_split(tmp_path):
+    """Write skew live: the atomic conditional-insert txn is
+    serializable under the WAL (no G2 possible); the split
+    read-then-insert client manufactures genuine G2 pairs the checker
+    names."""
+    from examples.toydb import toydb_adya_test
+
+    shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+    t = toydb_adya_test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6,
+            "time-limit": 5,
+            "interval": 1.5,
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    res = completed["results"]["adya"]
+    oks = [o for o in completed["history"] if o["type"] == h.OK and o["f"] == "txn"]
+    assert len(oks) > 10
+    assert res["valid?"] is True, res
+
+    last = None
+    for _attempt in range(2):
+        shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
+        t = toydb_adya_test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 8,
+                "time-limit": 6,
+                "interval": 2.5,
+                "split": True,
+                "ssh": {"local?": True},
+                "store-dir": str(tmp_path),
+            }
+        )
+        completed = core.run_test(t)
+        last = completed["results"]["adya"]
+        if last["valid?"] is False:
+            break
+    assert last["valid?"] is False, last
+    assert last["anomaly-count"] > 0
