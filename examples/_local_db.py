@@ -1,0 +1,87 @@
+"""Shared local-process DB lifecycle for the example harnesses.
+
+Every example "database" here is one python server process per node on
+the local remote: install = write the server source into the node dir
+and daemonize it; wreck = SIGKILL + grepkill.  The lifecycle (and its
+fussy details — pidfile daemons, await-port, log downloads, data-file
+cleanup) is identical across toydb/queue/quorum, so it lives once:
+subclasses set the class attrs and add flags via ``extra_args``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import util as cu
+
+
+class LocalProcessDB(jdb.DB):
+    """db.clj lifecycle over a local python daemon per node; implements
+    the Process capability (start/kill) that the kill-fault package
+    drives."""
+
+    #: subclasses set these
+    base: str  # working dir, e.g. /tmp/jepsen-toydb
+    base_port: int
+    server_src: Path
+    proc_name: str = "db"  # pid/log file prefix
+    #: shared data file name under ``base`` (all nodes one store), or
+    #: None for per-node data inside each node dir (real replication)
+    shared_data: str | None = None
+
+    def node_port(self, test, node) -> int:
+        return self.base_port + list(test["nodes"]).index(node)
+
+    def _paths(self, node):
+        d = f"{self.base}/{node}"
+        return {
+            "dir": d,
+            "server": f"{d}/server.py",
+            "pid": f"{d}/{self.proc_name}.pid",
+            "log": f"{d}/{self.proc_name}.log",
+            "data": (
+                f"{self.base}/{self.shared_data}"
+                if self.shared_data else f"{d}/replica-data"
+            ),
+        }
+
+    def extra_args(self) -> list[str]:
+        """Additional server CLI flags (modes, seeds)."""
+        return []
+
+    def setup(self, test, node, session):
+        p = self._paths(node)
+        session.exec("mkdir", "-p", p["dir"])
+        session.write_file(self.server_src.read_text(), p["server"])
+        self.start(test, node, session)
+        cu.await_tcp_port(session, self.node_port(test, node), timeout=30)
+
+    def teardown(self, test, node, session):
+        self.kill(test, node, session)
+        session.exec_result("rm", "-rf", self._paths(node)["dir"])
+        if self.shared_data:
+            session.exec_result(
+                "bash", "-c", f"rm -f {self._paths(node)['data']}*"
+            )
+
+    def start(self, test, node, session):
+        p = self._paths(node)
+        return cu.start_daemon(
+            session,
+            "python3", p["server"],
+            "--port", str(self.node_port(test, node)),
+            "--data", p["data"],
+            *self.extra_args(),
+            pidfile=p["pid"],
+            logfile=p["log"],
+        )
+
+    def kill(self, test, node, session):
+        p = self._paths(node)
+        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=5)
+        cu.grepkill(session, f"server.py --port {self.node_port(test, node)}")
+        return "killed"
+
+    def log_files(self, test, node):
+        return [self._paths(node)["log"]]

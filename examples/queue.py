@@ -23,8 +23,8 @@ from __future__ import annotations
 import socket
 from pathlib import Path
 
+from examples._local_db import LocalProcessDB
 from jepsen_tpu import cli, client, generator as gen, testkit
-from jepsen_tpu import db as jdb
 from jepsen_tpu.checker import compose, stats
 from jepsen_tpu.checker.basic import total_queue
 from jepsen_tpu.checker.perf import perf
@@ -40,51 +40,21 @@ def node_port(test, node) -> int:
     return BASE_PORT + list(test["nodes"]).index(node)
 
 
-class QueueDB(jdb.DB):
+class QueueDB(LocalProcessDB):
     """One queue_server.py per node (db.clj lifecycle; Process capability
     drives the kill nemesis package)."""
+
+    base = BASE
+    base_port = BASE_PORT
+    server_src = SERVER_SRC
+    proc_name = "queue"
+    shared_data = "shared-journal"
 
     def __init__(self, durable: bool = True):
         self.durable = durable
 
-    def _paths(self, node):
-        d = f"{BASE}/{node}"
-        return {
-            "dir": d,
-            "server": f"{d}/server.py",
-            "pid": f"{d}/queue.pid",
-            "log": f"{d}/queue.log",
-            "data": f"{BASE}/shared-journal",
-        }
-
-    def setup(self, test, node, session):
-        p = self._paths(node)
-        session.exec("mkdir", "-p", p["dir"])
-        session.write_file(SERVER_SRC.read_text(), p["server"])
-        self.start(test, node, session)
-        cu.await_tcp_port(session, node_port(test, node), timeout=30)
-
-    def teardown(self, test, node, session):
-        self.kill(test, node, session)
-        session.exec_result("rm", "-rf", self._paths(node)["dir"])
-        session.exec_result("bash", "-c", f"rm -f {self._paths(node)['data']}*")
-
-    def start(self, test, node, session):
-        p = self._paths(node)
-        args = ["python3", p["server"], "--port", str(node_port(test, node)),
-                "--data", p["data"]]
-        if self.durable:
-            args.append("--durable")
-        return cu.start_daemon(session, *args, pidfile=p["pid"], logfile=p["log"])
-
-    def kill(self, test, node, session):
-        p = self._paths(node)
-        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=5)
-        cu.grepkill(session, f"server.py --port {node_port(test, node)}")
-        return "killed"
-
-    def log_files(self, test, node):
-        return [self._paths(node)["log"]]
+    def extra_args(self):
+        return ["--durable"] if self.durable else []
 
 
 class QueueClient(client.Client):

@@ -1,0 +1,218 @@
+"""A genuinely REPLICATED register harness: ABD majority quorums over
+real per-node replicas.
+
+toydb's nodes share one durable file (shared storage); here every node
+owns its own state and consistency comes from quorum intersection — the
+Attiya–Bar-Noy–Dolev register, the algorithm quorum stores
+(Cassandra/Dynamo at QUORUM/QUORUM) implement.  This is the canonical
+jepsen scenario: linearizability of a replicated register under
+process-kill faults, decided by the TPU checker.
+
+  * write(v): phase 1 reads stamps from a majority, picks
+    ``(max_c + 1, client-id)``; phase 2 stores ``(stamp, v)`` on a
+    majority.  ABD theorem: linearizable.
+  * read(): phase 1 reads a majority, takes the max-stamp value;
+    phase 2 WRITES BACK that value to a majority before returning it
+    (the half people skip; skipping it breaks linearizability).
+  * ``write_one: True`` is the deliberately-broken mode — Cassandra's
+    consistency-ANY shape: a write is acked after ONE replica stores
+    it.  A later read's random majority can simply MISS that replica
+    (quorum intersection no longer holds: 1 + 3 < 5 + 1), so an
+    acknowledged write is invisible to later reads — which the
+    linearizable checker refutes with a concrete witness op.  (Replica
+    state itself is fsync'd and survives kill -9; the bug is the
+    missing intersection, not data loss.)
+
+Anything short of a majority answering → raise → the interpreter
+records an indeterminate :info (a crashed quorum op may still land).
+
+Run: python -m examples.quorum test --local --time-limit 10 --concurrency 6
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import socket
+from pathlib import Path
+
+from examples._local_db import LocalProcessDB
+from jepsen_tpu import cli, client, generator as gen, models, testkit
+from jepsen_tpu.checker import compose, stats
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.checker.perf import perf
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined as nc
+
+SERVER_SRC = Path(__file__).resolve().parent / "quorum_server.py"
+BASE = "/tmp/jepsen-quorum"
+BASE_PORT = 7751
+
+
+def node_port(test, node) -> int:
+    return BASE_PORT + list(test["nodes"]).index(node)
+
+
+class QuorumDB(LocalProcessDB):
+    """One replica process per node, each with its OWN fsync'd data file
+    (genuine replication — no shared storage): while a replica is down,
+    quorums simply form from the survivors."""
+
+    base = BASE
+    base_port = BASE_PORT
+    server_src = SERVER_SRC
+    proc_name = "quorum"
+    shared_data = None  # per-node replica data: the point
+
+
+class QuorumClient(client.Client):
+    """Client-side ABD over short per-phase connections (a wedged replica
+    must cost one timeout, not a held socket)."""
+
+    reusable = True  # no per-process connection state to crash
+    write_one = False
+
+    def __init__(self, cid: int = 0):
+        self.cid = cid
+
+    def open(self, test, node):
+        c = type(self)(cid=random.randrange(1, 1 << 30))
+        c.write_one = self.write_one
+        return c
+
+    @staticmethod
+    def _round(port: int, line: str, timeout: float = 1.0) -> str | None:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+                s.settimeout(timeout)
+                s.sendall((line + "\n").encode())
+                f = s.makefile("r")
+                reply = f.readline().strip()
+                return reply or None
+        except OSError:
+            return None
+
+    def _phase(self, test, line, need: int, stop_after: int | None = None):
+        """Send ``line`` to replicas in a RANDOM order (quorums should
+        not be biased toward the first nodes); collect up to
+        ``stop_after`` replies (default: all).  Raises (→ :info) below
+        ``need``."""
+        replies = []
+        nodes = list(test["nodes"])
+        random.shuffle(nodes)
+        for node in nodes:
+            r = self._round(node_port(test, node), line)
+            if r is not None and not r.startswith("err"):
+                replies.append(r)
+                if stop_after is not None and len(replies) >= stop_after:
+                    break
+        if len(replies) < need:
+            raise RuntimeError(
+                f"quorum failed: {len(replies)}/{need} replicas answered"
+            )
+        return replies
+
+    @staticmethod
+    def _parse_ts(reply: str):
+        # "ts <c> <cid> v <val|nil>"
+        p = reply.split()
+        return (int(p[1]), int(p[2])), (None if p[4] == "nil" else int(p[4]))
+
+    def invoke(self, test, op):
+        n = len(test["nodes"])
+        majority = n // 2 + 1
+        if op["f"] == "write":
+            stamps = [
+                self._parse_ts(r)
+                for r in self._phase(test, "G", majority, stop_after=majority)
+            ]
+            c = max(s[0][0] for s in stamps) + 1
+            line = f"S {c} {self.cid} {op['value']}"
+            if self.write_one:
+                # consistency ANY: ack after ONE replica has it
+                self._phase(test, line, 1, stop_after=1)
+            else:
+                self._phase(test, line, majority)
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            # R = majority (a random one): ABD needs no more, and
+            # quorum INTERSECTION — not coverage — is what makes it
+            # linearizable.  (Querying all replicas would mask the
+            # write-one mode's bug: some quorum must be able to miss.)
+            stamps = [
+                self._parse_ts(r)
+                for r in self._phase(test, "G", majority, stop_after=majority)
+            ]
+            (c, cid), val = max(stamps, key=lambda s: s[0])
+            # ABD phase 2: write back before returning, so a
+            # half-propagated write becomes majority-visible the moment
+            # anyone OBSERVES it — without this, two sequential reads
+            # can see new-then-old.
+            self._phase(
+                test, f"S {c} {cid} {'nil' if val is None else val}", majority
+            )
+            return {**op, "type": "ok", "value": val}
+        raise ValueError(f"unknown op {op['f']!r}")
+
+
+class QuorumWriteOneClient(QuorumClient):
+    write_one = True
+
+
+_next_value = itertools.count(1)
+
+
+def rand_op():
+    if random.random() < 0.5:
+        return {"f": "read"}
+    # unique write values: a stale read can then never be explained by
+    # a coincidental second write of the same value
+    return {"f": "write", "value": next(_next_value)}
+
+
+def quorum_test(opts) -> dict:
+    """ABD register under kill faults (majority stays alive: targets
+    one/minority).  ``write_one: True`` swaps in the broken client."""
+    db = QuorumDB()
+    pkg = nc.nemesis_package(
+        {
+            "faults": ["kill"],
+            "db": db,
+            "interval": opts.get("interval", 2),
+            "kill": {"targets": ("one", "minority")},
+        }
+    )
+    time_limit = opts.get("time-limit", 10)
+    t = testkit.noop_test(
+        name="quorum" + ("-write-one" if opts.get("write_one") else ""),
+        db=db,
+        client=QuorumWriteOneClient() if opts.get("write_one") else QuorumClient(),
+        nemesis=pkg.nemesis,
+        generator=gen.phases(
+            gen.any_gen(
+                gen.clients(
+                    gen.time_limit(time_limit, gen.stagger(0.03, gen.repeat(rand_op)))
+                ),
+                gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
+            ),
+            gen.nemesis(pkg.final_generator),
+        ),
+        checker=compose(
+            {
+                "stats": stats(),
+                "linear": linearizable({"model": models.CASRegister(None)}),
+                "perf": perf(),
+            }
+        ),
+    )
+    t.update(opts)
+    t["plot"] = pkg.perf
+    return t
+
+
+def main(argv=None):
+    cli.main(test_fn=quorum_test, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""quorumkv: one replica of an ABD-style quorum register.
+
+Unlike toydb (all nodes share one durable file — shared storage), every
+quorumkv node owns its OWN fsync'd ``(stamp, value)`` file: the system
+is genuinely replicated, and consistency comes from the CLIENT's
+majority quorums (examples/quorum.py — the Attiya-Bar-Noy-Dolev
+register, the shape Cassandra/Dynamo clients speak).  A replica is
+deliberately dumb: it answers its local state and stores
+monotonically-newer stamps, nothing else.
+
+Protocol (one line per request):
+  G           -> "ts <c> <cid> v <val|nil>"     (local stamp + value)
+  S <c> <cid> <val|nil> -> "ok"    (store iff (c, cid) > local, fsync)
+
+Stamps are Lamport pairs ``(counter, client-id)`` ordered
+lexicographically — the replica enforces monotonicity so a stale phase-2
+write-back can never regress a newer value.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import os
+import socketserver
+import sys
+
+
+def _lock(path):
+    """Exclusive lock on a STABLE lockfile — the data file itself is
+    atomically replaced on store, and flocking a replaced inode would
+    serialize nothing (two stores could interleave on stale reads and
+    regress the stamp)."""
+    lfd = os.open(path + ".lock", os.O_RDWR | os.O_CREAT, 0o644)
+    fcntl.flock(lfd, fcntl.LOCK_EX)
+    return lfd
+
+
+def _read(path):
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(256).decode().strip()
+    except FileNotFoundError:
+        raw = ""
+    if not raw:
+        return (0, 0, None)
+    c, cid, val = raw.split()
+    return (int(c), int(cid), None if val == "nil" else int(val))
+
+
+def load(path):
+    lfd = _lock(path)
+    try:
+        return _read(path)
+    finally:
+        os.close(lfd)
+
+
+def store(path, c, cid, val):
+    lfd = _lock(path)
+    try:
+        cur_c, cur_cid, _cur_val = _read(path)
+        if (c, cid) > (cur_c, cur_cid):
+            # crash-atomic replace: a truncate-then-write window would
+            # let a kill -9 erase the replica's whole durable state —
+            # the old record must stay readable until the new one is
+            # fully on disk
+            tmp = path + ".tmp"
+            tfd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                os.write(tfd, f"{c} {cid} {'nil' if val is None else val}".encode())
+                os.fsync(tfd)
+            finally:
+                os.close(tfd)
+            os.replace(tmp, path)
+        return "ok"
+    finally:
+        os.close(lfd)
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            parts = raw.decode().split()
+            if not parts:
+                continue
+            try:
+                if parts[0] == "G" and len(parts) == 1:
+                    c, cid, val = load(self.server.data_path)
+                    reply = f"ts {c} {cid} v {'nil' if val is None else val}"
+                elif parts[0] == "S" and len(parts) == 4:
+                    val = None if parts[3] == "nil" else int(parts[3])
+                    reply = store(self.server.data_path, int(parts[1]), int(parts[2]), val)
+                else:
+                    reply = "err bad-command"
+            except Exception as e:  # noqa: BLE001
+                reply = f"err {type(e).__name__}"
+            self.wfile.write((reply + "\n").encode())
+            self.wfile.flush()
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True)
+    args = ap.parse_args()
+    srv = Server(("127.0.0.1", args.port), Handler)
+    srv.data_path = args.data
+    print(f"quorumkv replica listening on {args.port}, data={args.data}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

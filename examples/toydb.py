@@ -20,7 +20,8 @@ import socket
 import time
 from pathlib import Path
 
-from jepsen_tpu import checker, cli, client, core, db as jdb, generator as gen
+from examples._local_db import LocalProcessDB
+from jepsen_tpu import checker, cli, client, core, generator as gen
 from jepsen_tpu import models, testkit
 from jepsen_tpu.checker import compose, stats, timeline
 from jepsen_tpu.checker.linearizable import linearizable
@@ -37,11 +38,17 @@ def node_port(test, node) -> int:
     return BASE_PORT + list(test["nodes"]).index(node)
 
 
-class ToyDB(jdb.DB):
+class ToyDB(LocalProcessDB):
     """Install + run one toydb process per node (db.clj lifecycle; all
     nodes share the durable register file, so the service is linearizable
     across endpoints).  ``txn_buffer`` > 0 starts servers in the LOSSY
     txn mode (see toydb_server module docstring)."""
+
+    base = BASE
+    base_port = BASE_PORT
+    server_src = SERVER_SRC
+    proc_name = "toydb"
+    shared_data = "shared-register"
 
     def __init__(self, txn_buffer: int = 0, no_wal: bool = False,
                  seed: str | None = None, reg_buffer: int = 0):
@@ -50,31 +57,7 @@ class ToyDB(jdb.DB):
         self.seed = seed
         self.reg_buffer = int(reg_buffer)
 
-    def _paths(self, node):
-        d = f"{BASE}/{node}"
-        return {
-            "dir": d,
-            "server": f"{d}/server.py",
-            "pid": f"{d}/toydb.pid",
-            "log": f"{d}/toydb.log",
-            "data": f"{BASE}/shared-register",
-        }
-
-    def setup(self, test, node, session):
-        p = self._paths(node)
-        session.exec("mkdir", "-p", p["dir"])
-        session.write_file(SERVER_SRC.read_text(), p["server"])
-        self.start(test, node, session)
-        cu.await_tcp_port(session, node_port(test, node), timeout=30)
-
-    def teardown(self, test, node, session):
-        self.kill(test, node, session)
-        session.exec_result("rm", "-rf", self._paths(node)["dir"])
-        session.exec_result("bash", "-c", f"rm -f {self._paths(node)['data']}*")
-
-    # Process capability (db.clj:18-24) — drives the kill nemesis package.
-    def start(self, test, node, session):
-        p = self._paths(node)
+    def extra_args(self):
         extra = (
             ["--txn-buffer", str(self.txn_buffer)] if self.txn_buffer else []
         )
@@ -84,24 +67,7 @@ class ToyDB(jdb.DB):
             extra += ["--seed", self.seed]
         if self.reg_buffer:
             extra += ["--reg-buffer", str(self.reg_buffer)]
-        return cu.start_daemon(
-            session,
-            "python3", p["server"],
-            "--port", str(node_port(test, node)),
-            "--data", p["data"],
-            *extra,
-            pidfile=p["pid"],
-            logfile=p["log"],
-        )
-
-    def kill(self, test, node, session):
-        p = self._paths(node)
-        cu.stop_daemon(session, p["pid"], signal="KILL", timeout=5)
-        cu.grepkill(session, f"server.py --port {node_port(test, node)}")
-        return "killed"
-
-    def log_files(self, test, node):
-        return [self._paths(node)["log"]]
+        return extra
 
 
 class ToyClient(client.Client):
