@@ -83,5 +83,23 @@ class LocalProcessDB(jdb.DB):
         cu.grepkill(session, f"server.py --port {self.node_port(test, node)}")
         return "killed"
 
+    # Pause capability (db.clj:26-29): SIGSTOP gray failures — the
+    # process is alive but unresponsive; clients time out instead of
+    # getting connection-refused.  No root tooling needed, so this runs
+    # LIVE in any sandbox.
+    def pause(self, test, node, session):
+        p = self._paths(node)
+        session.exec_result(
+            "bash", "-c", f"kill -STOP $(cat {p['pid']}) 2>/dev/null"
+        )
+        return "paused"
+
+    def resume(self, test, node, session):
+        p = self._paths(node)
+        session.exec_result(
+            "bash", "-c", f"kill -CONT $(cat {p['pid']}) 2>/dev/null"
+        )
+        return "resumed"
+
     def log_files(self, test, node):
         return [self._paths(node)["log"]]

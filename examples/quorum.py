@@ -176,10 +176,13 @@ def quorum_test(opts) -> dict:
     db = QuorumDB()
     pkg = nc.nemesis_package(
         {
-            "faults": ["kill"],
+            # kill (crash + restart) AND pause (SIGSTOP gray failure —
+            # alive but unresponsive; quorum clients time out past it)
+            "faults": opts.get("faults", ["kill", "pause"]),
             "db": db,
             "interval": opts.get("interval", 2),
             "kill": {"targets": ("one", "minority")},
+            "pause": {"targets": ("one", "minority")},
         }
     )
     time_limit = opts.get("time-limit", 10)

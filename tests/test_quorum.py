@@ -15,14 +15,16 @@ NODES = ["n1", "n2", "n3", "n4", "n5"]
 
 def test_quorum_abd_linearizable_under_kills(tmp_path):
     """Full ABD (majority writes, read write-back) is provably
-    linearizable while a majority survives; the kill nemesis shoots a
-    minority and the checker must find nothing."""
+    linearizable while a majority survives; the kill nemesis crashes a
+    minority, the pause nemesis SIGSTOPs a minority (gray failure —
+    first LIVE exercise of the Pause fault family), and the checker
+    must find nothing."""
     shutil.rmtree("/tmp/jepsen-quorum", ignore_errors=True)
     t = quorum_test(
         {
             "nodes": NODES,
             "concurrency": 6,
-            "time-limit": 6,
+            "time-limit": 8,
             "interval": 1.5,
             "ssh": {"local?": True},
             "store-dir": str(tmp_path),
@@ -35,8 +37,13 @@ def test_quorum_abd_linearizable_under_kills(tmp_path):
         o for o in hist
         if o["process"] == h.NEMESIS and o["f"] == "kill" and o["type"] == h.INFO
     ]
+    pauses = [
+        o for o in hist
+        if o["process"] == h.NEMESIS and o["f"] == "pause" and o["type"] == h.INFO
+    ]
     assert len(oks) > 20, "real quorum ops succeeded"
     assert kills, "the kill nemesis actually fired"
+    assert pauses, "the pause nemesis actually fired"
     # teeth: reads really observed replicated writes
     assert any(
         o["f"] == "read" and o.get("value") is not None for o in oks
