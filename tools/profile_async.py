@@ -61,10 +61,15 @@ dt, out = timeit("sync", runner, *sync_args)
 lossy = np.asarray(out[2])
 print(f"sync  cap={CAP} R=8:   {dt*1e3:8.1f} ms  ({total_ops/dt:10,.0f} ops/s) lossy={lossy.sum()}/{L}")
 
-# async
+# async (round-5 signature: explicit resume frontier per lane)
 T = wgl.async_ticks(B)
+n_lanes = stacked["init_state"].shape[0]
+bp0, st0, fo0, fc0, al0 = wgl.fresh_frontier(
+    n_lanes, CAP, W, G, stacked["init_state"]
+)
 async_args = [
-    jnp.asarray(stacked["init_state"]),
+    jnp.asarray(bp0), jnp.asarray(st0), jnp.asarray(fo0),
+    jnp.asarray(fc0), jnp.asarray(al0),
     jnp.asarray(n_actives),
     *(jnp.asarray(stacked[k]) for k in pbatch.ASYNC_ARG_ORDER[1:]),
 ]

@@ -27,7 +27,7 @@ def main():
     if mode == "mixed":
         # narrow stages 3B/2+32; wide (>=1024) stages 2B+64
         current_cap = [0]
-        def ticks(B):
+        def ticks(B, capacity=None):
             return (2*B + 64) if current_cap[0] >= 1024 else ((3*B)//2 + 32)
         wgl.async_ticks = ticks
         # intercept _launch's capacity via batch_analysis wrapper: patch
@@ -57,7 +57,7 @@ def main():
             best = min(best or 9e9, time.perf_counter() - t0)
         print(f"mixed ticks: {best*1e3:8.1f} ms  unknowns={len(pend)}")
     else:  # deep wide stage
-        wgl.async_ticks = lambda B: 4*B + 128
+        wgl.async_ticks = lambda B, capacity=None: 4*B + 128
         base = b.batch_analysis(model, hists, capacity=(128, 512),
                                 cpu_fallback=False, exact_escalation=(),
                                 confirm_refutations=False)
