@@ -87,25 +87,28 @@ def config_2():
     # matched capacity.  The async kernel runs these shapes; the exact
     # barrier kernel at cap ≥1024 faults the tunneled TPU worker.
     hist = valid_register_history(n, 32, seed=7, info_rate=0.02, n_values=5)
-    # Warm EVERY engine the competition may touch so the timed window
-    # holds no compiles (the fallback ran cold in an earlier draft,
-    # overstating device time).
-    wgl.greedy_analysis(model, hist)
-    wgl.analysis_async(model, hist, capacity=1024)
+    wgl.greedy_analysis(model, hist)  # warm rung 0
     t0 = time.perf_counter()
     # Round 5: the DEVICE greedy witness walk decides this valid history
     # itself (one capacity-1 scan) — the TPU contributes the verdict, not
     # just a beam exhaustion (VERDICT r4 item 3).  The ladder below it is
-    # the fallback for histories the walk sticks on.
+    # the fallback for histories the walk sticks on, warmed LAZILY (its
+    # warm-up alone takes minutes on a CPU backend; only pay it when the
+    # walk actually sticks).
     r = wgl.greedy_analysis(model, hist)
     decider = "greedy witness walk"
+    tpu_s = time.perf_counter() - t0
     if r["valid?"] == "unknown":
+        wgl.analysis_async(model, hist, capacity=1024)  # warm
+        t0 = time.perf_counter()
         r = wgl.analysis_async(model, hist, capacity=1024)
+        tpu_s += time.perf_counter() - t0
         decider = "async beam"
     if r["valid?"] == "unknown":
+        t0 = time.perf_counter()
         r = wgl_cpu.dfs_analysis(model, hist)
+        tpu_s += time.perf_counter() - t0
         decider = "cpu greedy dfs"
-    tpu_s = time.perf_counter() - t0
     # the round-4 CPU decider for this config, for the note's comparison
     dfs_s, _dfs_r = budget(lambda: wgl_cpu.dfs_analysis(model, hist), 60)
     cpu_s, rc = budget(lambda: wgl_cpu.sweep_analysis(model, hist), 300)
