@@ -384,7 +384,7 @@ def pad_packed(packed: dict, B: int | None = None, P: int | None = None, G: int 
     open, so the kernel's behavior is unchanged.  Explicit targets override
     the buckets (used to align a batch of histories on common shapes)."""
     B0, P0, G0 = packed["B"], packed["P"], packed["G"]
-    B = B if B is not None else 1 << max(6, (B0 - 1).bit_length())
+    B = B if B is not None else pad_B(B0)
     P = P if P is not None else _bucket(P0, [8, 16, 32, 64, 128])
     G = G if G is not None else _bucket(G0, [4, 8, 16, 32, 64])
     assert B >= B0 and P >= P0 and G >= G0
@@ -708,6 +708,41 @@ def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int):
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
+
+
+def exact_scan_safe(B: int, capacity: int) -> bool:
+    """Measured fault boundary of the batched exact runner (the round-4
+    "cap >= 1024 faults the tunneled TPU worker" cliff, isolated by
+    tools/repro_exact_fault.py on the v5e chip, round 5):
+
+    | cap \\ barriers | 2048 | 4096 | 8192 |
+    |---|---|---|---|
+    | 512  | ok | ok | FAULT |
+    | 1024 | ok | FAULT | FAULT |
+    | 2048 | ok | FAULT | FAULT |
+
+    The crash ("TPU worker process crashed or restarted ... kernel
+    fault") needs BOTH a long barrier scan and a wide frontier: every
+    B <= 2048 cell is fine (including cap 2048 — 4M rows), while the
+    same 4M rows at B = 4096 faults.  Callers must route shapes where
+    this returns False to the async engine (which executes them — see
+    PERF.md) or to chunked_analysis (whose chunk scans keep B <= the
+    chunk size, far below the cliff)."""
+    rows = capacity * B
+    if B >= 8192:  # faulted at EVERY measured cap; untested below 512
+        return False
+    if B >= 4096 and rows >= (4 << 20):
+        return False
+    if rows >= (8 << 20):  # untested headroom beyond the measured grid
+        return False
+    return True
+
+
+def pad_B(B: int) -> int:
+    """The barrier-table padding the batched launch sites apply (power
+    of two, floor 64).  exact_scan_safe callers must check the PADDED
+    shape — the one actually launched — so this lives next to it."""
+    return 1 << max(6, (B - 1).bit_length())
 
 
 def _chunk_bounds(quiet, B0: int, target: int) -> list[tuple[int, int]]:

@@ -45,6 +45,13 @@ _WARNED_EXACT_DEFAULT = False
 #: loop's budget comment — re-measure the true threshold on-chip).
 _EXACT_LANE_BUDGET = 16 * 1024
 
+#: fast-engine frontier rows per launch; the carried-frontier variant
+#: halves it because the resume snapshot doubles the async kernel's
+#: resident per-lane footprint (tests shrink these to force multi-chunk
+#: stages on small workloads).
+_FAST_LANE_BUDGET = 64 * 1024
+_CARRY_LANE_BUDGET = 32 * 1024
+
 
 def _resolve_confirmation(res: dict, cpu_res: dict) -> dict:
     """Fold an exact-sweep confirmation verdict into the device result
@@ -288,7 +295,7 @@ def batch_analysis(
         ``np.asarray`` here is a tunnel round-trip, and fetching every
         lane's full padded frontier after every rung was measured at
         ~0.8 s on the bench ladder (chip ablation, round 5)."""
-        B = 1 << max(6, (max(p["B"] for p in sub) - 1).bit_length())
+        B = wgl.pad_B(max(p["B"] for p in sub))
         P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
         G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
         stacked = _stack(sub, B, P, G)
@@ -414,6 +421,30 @@ def batch_analysis(
     for si, (st_engine, batch_cap) in enumerate(stages):
         if not pending:
             break
+        # Measured-shape guard (round 5): the batched exact runner
+        # faults the TPU worker on long-scan x wide-frontier shapes
+        # (boundary table in wgl.exact_scan_safe).  Lanes past the
+        # boundary take the chunked exact path — short chunk scans with
+        # a carried frontier, same content-decided kills — instead of
+        # joining the batched launch.  Unsafe-ness is monotone in
+        # capacity, so such a lane is handled ONCE with the full
+        # remaining exact ladder (chunked_analysis escalates only the
+        # overflowing chunks) and never re-enters a later rung.
+        if st_engine == "exact":
+            safe = []
+            exact_ladder = [c for e, c in stages[si:] if e == "exact"]
+            for k in pending:
+                if wgl.exact_scan_safe(wgl.pad_B(packs[k]["B"]), batch_cap):
+                    safe.append(k)
+                    continue
+                i = idxs[k]
+                results[i] = wgl.chunked_analysis(
+                    model, histories[i], packs[k], exact_ladder,
+                    rounds=int(rounds), fast=False,
+                )
+            pending = safe
+            if not pending:
+                continue
         # Bound total frontier rows per launch so wide-capacity stages
         # sub-batch instead of faulting the TPU worker (observed at
         # capacity*lanes ≳ 64k on the exact engine, whose sort and
@@ -425,9 +456,9 @@ def batch_analysis(
         if st_engine == "exact":
             budget = _EXACT_LANE_BUDGET
         elif st_engine == "async" and carry_frontier:
-            budget = 32 * 1024
+            budget = _CARRY_LANE_BUDGET
         else:
-            budget = 64 * 1024
+            budget = _FAST_LANE_BUDGET
         lanes_cap = max(1, budget // batch_cap)
         # Carried-frontier fetch (round 5): resume snapshots leave the
         # device only for lanes that STAY pending, and only when a later
@@ -530,12 +561,41 @@ def batch_analysis(
             by_cap.setdefault(cap, []).append((k, fat, res))
         for cap, group in sorted(by_cap.items()):
             masked = []
-            for k, fat, _res in group:
+            safe_group = []
+            for k, fat, res in group:
                 p = dict(packs[k])
                 act = p["bar_active"].copy()
                 act[fat + 1 :] = False  # refutation needs only the prefix
                 p["bar_active"] = act
-                masked.append(p)
+                if wgl.exact_scan_safe(wgl.pad_B(p["B"]), cap):
+                    safe_group.append((k, fat, res))
+                    masked.append(p)
+                    continue
+                # Past the exact runner's measured fault boundary (see
+                # wgl.exact_scan_safe): confirm via the chunked exact
+                # path — short chunk scans, same content-decided kills.
+                # An exact no-loss death anywhere in the prefix is a
+                # final refutation; a surviving or lossy chunked run is
+                # the collision/loss case and falls to the bounded CPU
+                # sweep, exactly like the batched launch below.
+                i = idxs[k]
+                device_resolved.add(i)
+                r = wgl.chunked_analysis(
+                    model, histories[i], p, [cap], rounds=int(rounds),
+                    fast=False,
+                )
+                if r["valid?"] is False:
+                    res["confirmed?"] = True
+                    results[i] = res
+                else:
+                    op_pos = int(packs[k]["bar_opid"][fat])
+                    cpu_res = wgl_cpu.sweep_analysis(
+                        model, histories[i],
+                        max_configs=confirm_max_configs,
+                        stop_at_index=op_pos,
+                    )
+                    results[i] = _resolve_confirmation(res, cpu_res)
+            group = safe_group
             lanes_cap = max(1, _EXACT_LANE_BUDGET // cap)
             for s0 in range(0, len(group), lanes_cap):
                 sub = masked[s0 : s0 + lanes_cap]

@@ -325,6 +325,104 @@ def test_carried_frontier_snapshot_resume_single_lane():
         assert got == truth
 
 
+def test_carried_frontier_multi_chunk_stage(monkeypatch):
+    """When a rung splits into several sub-batch chunks, each chunk's
+    resume snapshot is fetched immediately after ITS launch (at most one
+    chunk's snapshot device-resident — the resident-row bound the lane
+    budget enforces) and pending lanes still resume correctly on the
+    next rung.  Shrinks the lane budgets so 8 histories at cap 16 split
+    into multiple chunks."""
+    from jepsen_tpu.parallel import batch as pb
+
+    monkeypatch.setattr(pb, "_CARRY_LANE_BUDGET", 48)   # 48//16 = 3 lanes/chunk
+    monkeypatch.setattr(pb, "_FAST_LANE_BUDGET", 48)
+
+    hists, expect = [], []
+    for i in range(8):
+        hist = valid_register_history(60, 6, seed=300 + i, info_rate=0.35)
+        if i % 2:
+            hist = corrupt(hist, seed=i)
+            expect.append(wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"])
+        else:
+            expect.append(True)
+        hists.append(hist)
+
+    res = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(16, 64, 512),
+        cpu_fallback=False, exact_escalation=(), carry_frontier=True,
+    )
+    for i, (r, want) in enumerate(zip(res, expect)):
+        assert r["valid?"] in (want, "unknown"), (i, r["valid?"], want)
+    # the multi-chunk path must not lose resolution power: the wider
+    # rungs decide at least the histories the single-chunk ladder does
+    n_unknown = sum(r["valid?"] == "unknown" for r in res)
+    assert n_unknown <= 2, [r["valid?"] for r in res]
+
+
+def test_exact_scan_safe_measured_boundary():
+    """Pins the chip-measured fault table (tools/repro_exact_fault.py,
+    round 5): every B<=2048 cell ok; B=4096 faults at cap>=1024;
+    B=8192 faults at every measured cap."""
+    from jepsen_tpu.ops import wgl
+
+    ok = [(2048, 512), (2048, 1024), (2048, 2048), (4096, 512)]
+    fault = [(4096, 1024), (4096, 2048), (8192, 512), (8192, 1024),
+             (8192, 2048)]
+    for B, cap in ok:
+        assert wgl.exact_scan_safe(B, cap), (B, cap)
+    for B, cap in fault:
+        assert not wgl.exact_scan_safe(B, cap), (B, cap)
+    # small shapes (the batch ladder's bread and butter) are never routed
+    assert wgl.exact_scan_safe(128, 2048)
+    # untested headroom beyond the grid is routed conservatively:
+    # B=8192 faulted at EVERY measured cap, so no capacity makes it safe
+    assert not wgl.exact_scan_safe(8192, 256)
+    assert not wgl.exact_scan_safe(16384, 64)
+    assert not wgl.exact_scan_safe(2048, 8192)
+    # the guard checks the PADDED launch shape
+    assert wgl.pad_B(100) == 128 and wgl.pad_B(4096) == 4096
+
+
+def test_exact_fault_guard_routes_to_chunked(monkeypatch):
+    """With every shape declared unsafe, exact ladder stages and device
+    confirmation must route through the chunked exact path and still
+    produce oracle-correct verdicts (the guard changes the execution
+    plan, never the answer)."""
+    from jepsen_tpu.ops import wgl as wgl_mod
+    from jepsen_tpu.parallel import batch as pb
+
+    monkeypatch.setattr(wgl_mod, "exact_scan_safe", lambda B, cap: False)
+
+    hists, expect = [], []
+    for i in range(6):
+        hist = valid_register_history(40, 5, seed=500 + i, info_rate=0.2)
+        if i % 2:
+            hist = corrupt(hist, seed=i)
+            expect.append(wgl_cpu.sweep_analysis(m.CASRegister(None), hist)["valid?"])
+        else:
+            expect.append(True)
+        hists.append(hist)
+
+    # exact ladder stage: a tiny fast ladder leaves stragglers for the
+    # exact stage, which must use chunked_analysis under the patch
+    res = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(8,), exact_escalation=(256,),
+        cpu_fallback=False, confirm_refutations=False,
+    )
+    for i, (r, want) in enumerate(zip(res, expect)):
+        assert r["valid?"] in (want, "unknown"), (i, r["valid?"], want)
+
+    # device confirmation: refutations confirmed via the chunked path
+    res2 = pb.batch_analysis(
+        m.CASRegister(None), hists, capacity=(256,), exact_escalation=(),
+        cpu_fallback=False, confirm_refutations="device",
+    )
+    for i, (r, want) in enumerate(zip(res2, expect)):
+        assert r["valid?"] in (want, "unknown"), (i, r["valid?"], want)
+        if r["valid?"] is False:
+            assert r.get("confirmed?") or "cause" in r
+
+
 def test_device_confirmation_mode():
     """confirm_refutations="device": refutations confirmed by one
     batched exact-kernel prefix launch instead of CPU worker sweeps —
