@@ -710,7 +710,7 @@ def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int):
 # ---------------------------------------------------------------------------
 
 
-def exact_scan_safe(B: int, capacity: int) -> bool:
+def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
     """Measured fault boundary of the batched exact runner (the round-4
     "cap >= 1024 faults the tunneled TPU worker" cliff, isolated by
     tools/repro_exact_fault.py on the v5e chip, round 5):
@@ -724,11 +724,16 @@ def exact_scan_safe(B: int, capacity: int) -> bool:
     The crash ("TPU worker process crashed or restarted ... kernel
     fault") needs BOTH a long barrier scan and a wide frontier: every
     B <= 2048 cell is fine (including cap 2048 — 4M rows), while the
-    same 4M rows at B = 4096 faults.  Callers must route shapes where
-    this returns False to the async engine (which executes them — see
-    PERF.md) or to chunked_analysis (whose chunk scans keep B <= the
-    chunk size, far below the cliff)."""
-    rows = capacity * B
+    same 4M rows at B = 4096 faults.  The grid was measured on
+    SINGLE-lane launches; under vmap the live sort/domination buffers
+    multiply by the lane count, so callers pass the launch's PADDED
+    lane count and the effective width ``lanes * capacity`` is tested
+    (conservative for multi-lane launches — the safe fallbacks cost
+    only time).  Callers must route shapes where this returns False to
+    the async engine (which executes them — see PERF.md) or to
+    chunked_analysis (whose chunk scans keep B <= the chunk size, far
+    below the cliff)."""
+    rows = capacity * max(1, lanes) * B
     if B >= 8192:  # faulted at EVERY measured cap; untested below 512
         return False
     if B >= 4096 and rows >= (4 << 20):

@@ -433,8 +433,15 @@ def batch_analysis(
         if st_engine == "exact":
             safe = []
             exact_ladder = [c for e, c in stages[si:] if e == "exact"]
+            # the launch pads its batch axis to a power of two >= 8, so
+            # the guard sees the PADDED lane count the kernel actually
+            # holds resident (the fault grid is single-lane; vmap
+            # multiplies the live buffers by the lane count)
+            n_lanes = min(max(1, _EXACT_LANE_BUDGET // batch_cap), len(pending))
+            n_lanes = 1 << max(3, (n_lanes - 1).bit_length())
             for k in pending:
-                if wgl.exact_scan_safe(wgl.pad_B(packs[k]["B"]), batch_cap):
+                if wgl.exact_scan_safe(
+                        wgl.pad_B(packs[k]["B"]), batch_cap, n_lanes):
                     safe.append(k)
                     continue
                 i = idxs[k]
@@ -549,6 +556,25 @@ def batch_analysis(
         pending = still
 
     device_resolved: set[int] = set()
+
+    def _finish_confirmation(k: int, fat: int, res: dict, exact_died: bool) -> None:
+        """Resolve one device-mode confirmation: an exact lossless death
+        makes the refutation final; otherwise (collision artifact or
+        loss) the bounded CPU sweep decides (shared by the batched
+        launch and the unsafe-shape chunked fallback)."""
+        i = idxs[k]
+        device_resolved.add(i)
+        if exact_died:
+            res["confirmed?"] = True
+            results[i] = res
+            return
+        op_pos = int(packs[k]["bar_opid"][fat])
+        cpu_res = wgl_cpu.sweep_analysis(
+            model, histories[i], max_configs=confirm_max_configs,
+            stop_at_index=op_pos,
+        )
+        results[i] = _resolve_confirmation(res, cpu_res)
+
     if device_confirms:
         # One batched exact-engine launch per capacity bucket over the
         # failure PREFIXES: content-decided kills make a lossless exact
@@ -562,12 +588,15 @@ def batch_analysis(
         for cap, group in sorted(by_cap.items()):
             masked = []
             safe_group = []
+            lanes_cap = max(1, _EXACT_LANE_BUDGET // cap)
+            n_lanes = min(lanes_cap, len(group))
+            n_lanes = 1 << max(3, (n_lanes - 1).bit_length())
             for k, fat, res in group:
                 p = dict(packs[k])
                 act = p["bar_active"].copy()
                 act[fat + 1 :] = False  # refutation needs only the prefix
                 p["bar_active"] = act
-                if wgl.exact_scan_safe(wgl.pad_B(p["B"]), cap):
+                if wgl.exact_scan_safe(wgl.pad_B(p["B"]), cap, n_lanes):
                     safe_group.append((k, fat, res))
                     masked.append(p)
                     continue
@@ -576,49 +605,21 @@ def batch_analysis(
                 # path — short chunk scans, same content-decided kills.
                 # An exact no-loss death anywhere in the prefix is a
                 # final refutation; a surviving or lossy chunked run is
-                # the collision/loss case and falls to the bounded CPU
-                # sweep, exactly like the batched launch below.
-                i = idxs[k]
-                device_resolved.add(i)
+                # the collision/loss case, resolved like the batched
+                # launch below.
                 r = wgl.chunked_analysis(
-                    model, histories[i], p, [cap], rounds=int(rounds),
+                    model, histories[idxs[k]], p, [cap], rounds=int(rounds),
                     fast=False,
                 )
-                if r["valid?"] is False:
-                    res["confirmed?"] = True
-                    results[i] = res
-                else:
-                    op_pos = int(packs[k]["bar_opid"][fat])
-                    cpu_res = wgl_cpu.sweep_analysis(
-                        model, histories[i],
-                        max_configs=confirm_max_configs,
-                        stop_at_index=op_pos,
-                    )
-                    results[i] = _resolve_confirmation(res, cpu_res)
+                _finish_confirmation(k, fat, res, r["valid?"] is False)
             group = safe_group
-            lanes_cap = max(1, _EXACT_LANE_BUDGET // cap)
             for s0 in range(0, len(group), lanes_cap):
                 sub = masked[s0 : s0 + lanes_cap]
                 gvalid, gfailed, glossy, _pk, _rs = _launch("exact", cap, sub)
                 for (k, fat, res), v, f2, lz in zip(
                     group[s0 : s0 + lanes_cap], gvalid, gfailed, glossy
                 ):
-                    i = idxs[k]
-                    device_resolved.add(i)
-                    if f2 >= 0 and not lz:
-                        res["confirmed?"] = True
-                        results[i] = res
-                    else:
-                        # hash-collision artifact or exact-engine loss:
-                        # the exact CPU sweep decides (bounded to the
-                        # original failure barrier)
-                        op_pos = int(packs[k]["bar_opid"][fat])
-                        cpu_res = wgl_cpu.sweep_analysis(
-                            model, histories[i],
-                            max_configs=confirm_max_configs,
-                            stop_at_index=op_pos,
-                        )
-                        results[i] = _resolve_confirmation(res, cpu_res)
+                    _finish_confirmation(k, fat, res, f2 >= 0 and not lz)
 
     if cpu_fallback:
         for i, r in enumerate(results):

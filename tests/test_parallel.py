@@ -374,6 +374,11 @@ def test_exact_scan_safe_measured_boundary():
         assert not wgl.exact_scan_safe(B, cap), (B, cap)
     # small shapes (the batch ladder's bread and butter) are never routed
     assert wgl.exact_scan_safe(128, 2048)
+    # the grid is single-lane: a vmapped launch multiplies the live
+    # buffers by the (padded) lane count, so the effective width is
+    # lanes*cap — 32 lanes at cap 512 on B=4096 is far off-grid
+    assert not wgl.exact_scan_safe(4096, 512, lanes=32)
+    assert wgl.exact_scan_safe(128, 2048, lanes=8)  # bench exact stages
     # untested headroom beyond the grid is routed conservatively:
     # B=8192 faulted at EVERY measured cap, so no capacity makes it safe
     assert not wgl.exact_scan_safe(8192, 256)
@@ -391,7 +396,8 @@ def test_exact_fault_guard_routes_to_chunked(monkeypatch):
     from jepsen_tpu.ops import wgl as wgl_mod
     from jepsen_tpu.parallel import batch as pb
 
-    monkeypatch.setattr(wgl_mod, "exact_scan_safe", lambda B, cap: False)
+    monkeypatch.setattr(
+        wgl_mod, "exact_scan_safe", lambda B, cap, lanes=1: False)
 
     hists, expect = [], []
     for i in range(6):

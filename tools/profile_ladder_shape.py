@@ -1,0 +1,63 @@
+"""Which capacity ladder shape wins on the headline workload?
+
+With carried frontiers (round 5), escalation no longer re-pays the
+verified prefix — so the round-2-era (128, 512, 2048) shape (chosen to
+amortize re-runs) deserves a re-measurement against fewer/wider rungs.
+Run on the real chip; confirmations on (the production path).
+
+  python tools/profile_ladder_shape.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+sys.path.insert(0, str(ROOT))
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.parallel import batch_analysis  # noqa: E402
+from jepsen_tpu.parallel.batch import warm_confirm_pool  # noqa: E402
+
+N, OPS, PROCS, INFO, NV, CORR = 128, 100, 8, 0.3, 8, 4
+
+LADDERS = [
+    (128, 512, 2048),   # production default
+    (128, 1024),
+    (256, 2048),
+    (128, 2048),
+    (256, 1024, 4096),
+    (512, 2048),
+]
+
+
+def main():
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(N):
+        hh = valid_register_history(OPS, PROCS, seed=i, info_rate=INFO, n_values=NV)
+        if i % CORR == CORR - 1:
+            hh = corrupt(hh, seed=i)
+        hists.append(hh)
+    warm_confirm_pool()
+    for caps in LADDERS:
+        kw = dict(capacity=caps, exact_escalation=(), cpu_fallback=False)
+        batch_analysis(model, hists, **kw)  # warm/compile
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            rs = batch_analysis(model, hists, **kw)
+            best = min(best or 9e9, time.perf_counter() - t0)
+        unk = sum(1 for r in rs if r["valid?"] == "unknown")
+        print(json.dumps({"ladder": list(caps), "s": round(best, 2),
+                          "unknowns": unk}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

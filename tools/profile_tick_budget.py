@@ -30,12 +30,26 @@ def main():
         hists.append(hh)
 
     orig = wgl.async_ticks
+
+    def wide(formula):
+        """Vary only the WIDE-stage (cap >= 1024) budget; narrow stages
+        keep the tuned default.  With carried frontiers the resumed
+        rungs see small remaining-B, so round-4's 'wide needs 2B+64'
+        deserves re-measurement."""
+        def fn(B, capacity=None):
+            if capacity is not None and capacity < 1024:
+                return orig(B, capacity)
+            return formula(B)
+        return fn
+
     which = sys.argv[1:]
     for label, fn in [
-        ("T=2B+64 (default)", orig),
-        ("T=B+32", lambda B: B + 32),
-        ("T=3B/2+32", lambda B: (3 * B) // 2 + 32),
-        ("T=3B+64", lambda B: 3 * B + 64),
+        ("default (narrow 3B/2+32, wide 2B+64)", orig),
+        ("all T=B+32", lambda B, capacity=None: B + 32),
+        ("all T=3B/2+32", lambda B, capacity=None: (3 * B) // 2 + 32),
+        ("all T=3B+64", lambda B, capacity=None: 3 * B + 64),
+        ("wide T=B+64", wide(lambda B: B + 64)),
+        ("wide T=3B/2+32", wide(lambda B: (3 * B) // 2 + 32)),
     ]:
         if which and not any(w in label for w in which):
             continue
