@@ -216,7 +216,7 @@ def _explain_cycle(g: tg.TxnGraph, cycle: list[int]) -> dict:
                 "type": et,
                 "from": g.nodes[i].op,
                 "to": g.nodes[j].op,
-                "explanation": g.explanations.get((et, i, j), et),
+                "explanation": g.explain(et, i, j),
             }
         )
     return {"cycle": [g.nodes[i].op for i in cycle], "steps": steps}
