@@ -61,10 +61,25 @@ ANOMALY_RULES_OUT = {
 #: interpose between the snapshot/serializable levels and
 #: strict-serializable at the top.
 _STRONGER_DIRECT = {
-    "read-uncommitted": ["read-committed"],
+    # Daudjee & Salem session ladders exist at every isolation level
+    # ("Lazy Database Replication with Ordering Guarantees" for SI,
+    # "Maintaining Transaction Isolation Guarantees ..." for RC): the
+    # strong-session-X / strong-X variants add per-session then global
+    # real-time ordering to X, and the ladders are pointwise ordered
+    # (X <= Y implies strong-session-X <= strong-session-Y etc.).
+    "read-uncommitted": ["read-committed", "strong-session-read-uncommitted"],
+    "strong-session-read-uncommitted": [
+        "strong-read-uncommitted", "strong-session-read-committed",
+    ],
+    "strong-read-uncommitted": ["strong-read-committed"],
     "read-committed": [
         "cursor-stability", "monotonic-atomic-view", "monotonic-view",
+        "strong-session-read-committed",
     ],
+    "strong-session-read-committed": [
+        "strong-read-committed", "strong-session-snapshot-isolation",
+    ],
+    "strong-read-committed": ["strong-snapshot-isolation"],
     "cursor-stability": ["repeatable-read"],
     # Adya PL-2L: reads observe a monotonically growing prefix of commits
     "monotonic-view": ["monotonic-snapshot-read", "consistent-view"],
@@ -77,14 +92,21 @@ _STRONGER_DIRECT = {
     # Adya PL-3U: serializable with respect to update transactions
     "update-serializable": ["serializable"],
     "read-atomic": ["causal"],
-    "causal": ["parallel-snapshot-isolation"],
+    # Cerone et al.'s atomic-visibility chain (A Framework for
+    # Transactional Consistency Models with Atomic Visibility): RA ⊂
+    # causal ⊂ {prefix, PSI} ⊂ SI — prefix and PSI are incomparable
+    # siblings between causal and snapshot-isolation
+    "causal": ["parallel-snapshot-isolation", "prefix"],
+    "prefix": ["snapshot-isolation"],
     "parallel-snapshot-isolation": ["snapshot-isolation"],
     "repeatable-read": ["serializable"],
     # PL-SI sits below PL-3 in Adya's proscribed-phenomena ordering, and
     # below its own session-strengthened ladder (Daudjee & Salem:
     # per-session real-time order, then global real-time order)
     "snapshot-isolation": ["serializable", "strong-session-snapshot-isolation"],
-    "strong-session-snapshot-isolation": ["strong-snapshot-isolation"],
+    "strong-session-snapshot-isolation": [
+        "strong-snapshot-isolation", "strong-session-serializable",
+    ],
     "strong-snapshot-isolation": ["strict-serializable"],
     "serializable": ["strong-session-serializable"],
     "strong-session-serializable": ["strict-serializable"],

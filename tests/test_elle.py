@@ -571,12 +571,28 @@ def test_consistency_lattice_structure():
     for m in _STRONGER_DIRECT:
         if m != "strict-serializable":
             assert "strict-serializable" in STRONGER_MODELS[m], m
-    # 18 models (13-model core + PL-2L, PL-MSR, PL-FCV, PL-3U, session SIs)
-    assert len(_STRONGER_DIRECT) >= 18
+    # 23 models (13-model core + PL-2L, PL-MSR, PL-FCV, PL-3U, session
+    # SIs + round-5 widening: prefix and the RC/RU session ladders)
+    assert len(_STRONGER_DIRECT) >= 23
     # Adya chains hold transitively
     assert "snapshot-isolation" in STRONGER_MODELS["monotonic-view"]
     assert "serializable" in STRONGER_MODELS["forward-consistent-view"]
     assert "strong-snapshot-isolation" in STRONGER_MODELS["snapshot-isolation"]
+    # Cerone: prefix sits strictly between causal and snapshot-isolation,
+    # incomparable with parallel-snapshot-isolation
+    assert "prefix" in STRONGER_MODELS["causal"]
+    assert "snapshot-isolation" in STRONGER_MODELS["prefix"]
+    assert "prefix" not in STRONGER_MODELS["parallel-snapshot-isolation"]
+    assert "parallel-snapshot-isolation" not in STRONGER_MODELS["prefix"]
+    # session ladders are pointwise ordered (RC <= SI <= SER lifts)
+    assert "strong-session-snapshot-isolation" in STRONGER_MODELS["strong-session-read-committed"]
+    assert "strong-session-serializable" in STRONGER_MODELS["strong-session-snapshot-isolation"]
+    assert "strong-read-committed" in STRONGER_MODELS["strong-read-uncommitted"]
+    # G1a takes out the whole read-committed session ladder
+    w_g1a, al_g1a = models_ruled_out(["G1a"])
+    assert "read-committed" in w_g1a
+    assert "strong-session-read-committed" in al_g1a
+    assert "strong-read-committed" in al_g1a
     # ruling out G-single still implies serializable is gone (CV -> FCV
     # -> SI -> serializable), and G0 takes out everything
     weakest, also = models_ruled_out(["G-single"])
