@@ -43,6 +43,7 @@ from jepsen_tpu.checker.linearizable import linearizable
 from jepsen_tpu.checker.perf import perf
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.nemesis import combined as nc
+from jepsen_tpu.nemesis import membership as nmem
 
 SERVER_SRC = Path(__file__).resolve().parent / "quorum_server.py"
 BASE = "/tmp/jepsen-quorum"
@@ -159,6 +160,74 @@ class QuorumWriteOneClient(QuorumClient):
     write_one = True
 
 
+class QuorumMembership(nmem.MembershipState):
+    """Live cluster membership over the quorum replicas: ``shrink``
+    cleanly stops a replica process, ``grow`` restarts it (reference
+    seam: jepsen/src/jepsen/nemesis/membership.clj's grow/shrink state
+    machine, driven here against REAL processes).
+
+    ABD stays linearizable as long as quorums intersect over the FIXED
+    node set, so the machine keeps at most a minority down: it heals
+    (grows) its own shrinks before shrinking again, and only shrinks
+    when the observed view shows FULL strength — the checker then has
+    to find nothing.  Views are observed, not assumed: a node's view is
+    its own liveness (its port answers a stamp probe), merged by union;
+    ops stay pending until the merged view actually reflects them
+    (membership/state.clj's resolve-op contract).
+
+    The machine only ever grows nodes IT shrank (``self.shrunk``), so a
+    composed kill nemesis's crash windows are never silently healed.
+    Caveat for composition with other node-downing faults: the view
+    refreshes on an interval, so a shrink decided on a view captured
+    just before a kill can transiently exceed the minority bound until
+    both resolve — inherent to observed-view membership (the reference
+    marks its membership nemesis experimental for the same reasons)."""
+
+    def __init__(self, db: "QuorumDB"):
+        self.db = db
+        self.shrunk: set = set()
+
+    def node_view(self, test, node):
+        r = QuorumClient._round(node_port(test, node), "G", timeout=0.4)
+        ok = r is not None and not r.startswith("err")
+        return frozenset({node}) if ok else None
+
+    def merge_views(self, test, views):
+        return frozenset(n for n, v in views.items() if v)
+
+    def fs(self):
+        return {"grow", "shrink"}
+
+    def op(self, test):
+        nodes = list(test["nodes"])
+        view = self.view if self.view is not None else frozenset()
+        if self.shrunk:
+            # heal our own shrinks first — and ONLY our own: nodes a
+            # composed kill nemesis downed are its to restart
+            return {"type": "info", "f": "grow",
+                    "value": random.choice(sorted(self.shrunk))}
+        if len(view) == len(nodes) and (len(nodes) - 1) // 2 >= 1:
+            return {"type": "info", "f": "shrink", "value": random.choice(nodes)}
+        return None
+
+    def invoke(self, test, op):
+        node = op["value"]
+        session = test["sessions"][node]
+        if op["f"] == "shrink":
+            self.db.kill(test, node, session)
+            self.shrunk.add(node)
+            return f"stopped {node}"
+        self.db.start(test, node, session)
+        self.shrunk.discard(node)
+        return f"restarted {node}"
+
+    def resolve_op(self, test, op, view) -> bool:
+        if view is None:
+            return False
+        node = op["value"]
+        return (node not in view) if op["f"] == "shrink" else (node in view)
+
+
 _next_value = itertools.count(1)
 
 
@@ -174,17 +243,28 @@ def quorum_test(opts) -> dict:
     """ABD register under kill faults (majority stays alive: targets
     one/minority).  ``write_one: True`` swaps in the broken client."""
     db = QuorumDB()
-    pkg = nc.nemesis_package(
-        {
-            # kill (crash + restart) AND pause (SIGSTOP gray failure —
-            # alive but unresponsive; quorum clients time out past it)
-            "faults": opts.get("faults", ["kill", "pause"]),
-            "db": db,
-            "interval": opts.get("interval", 2),
-            "kill": {"targets": ("one", "minority")},
-            "pause": {"targets": ("one", "minority")},
-        }
-    )
+    faults = list(opts.get("faults", ["kill", "pause"]))
+    pkgs = []
+    if "membership" in faults:
+        # live grow/shrink of the replica set, bounded to a minority
+        pkgs.append(nmem.membership_package(
+            QuorumMembership(db),
+            {"interval": opts.get("interval", 2), "view-interval": 1.0},
+        ))
+        faults = [f for f in faults if f != "membership"]
+    if faults:
+        pkgs.append(nc.nemesis_package(
+            {
+                # kill (crash + restart) AND pause (SIGSTOP gray failure —
+                # alive but unresponsive; quorum clients time out past it)
+                "faults": faults,
+                "db": db,
+                "interval": opts.get("interval", 2),
+                "kill": {"targets": ("one", "minority")},
+                "pause": {"targets": ("one", "minority")},
+            }
+        ))
+    pkg = pkgs[0] if len(pkgs) == 1 else nc.compose_packages(pkgs)
     time_limit = opts.get("time-limit", 10)
     t = testkit.noop_test(
         name="quorum" + ("-write-one" if opts.get("write_one") else ""),
@@ -198,7 +278,8 @@ def quorum_test(opts) -> dict:
                 ),
                 gen.nemesis(gen.time_limit(time_limit, pkg.generator)),
             ),
-            gen.nemesis(pkg.final_generator),
+            *((gen.nemesis(pkg.final_generator),)
+              if pkg.final_generator is not None else ()),
         ),
         checker=compose(
             {

@@ -295,10 +295,20 @@ def nemesis_package(opts: Mapping | None = None) -> Package:
     pkgs: list[Package | None] = []
     if "partition" in faults:
         pkgs.append(partition_package({"interval": interval, **opts.get("partition", {})}))
-    if faults & {"kill", "pause"}:
+    # one db_package call per family so each honors ITS OWN opt map —
+    # a single call fed opts["kill"] silently applied kill's targets to
+    # pause too, making the "pause" opt map dead config
+    if "kill" in faults:
         pkgs.append(
             db_package(
-                {"interval": interval, "faults": faults & {"kill", "pause"}, **opts.get("kill", {})},
+                {"interval": interval, "faults": {"kill"}, **opts.get("kill", {})},
+                db=db,
+            )
+        )
+    if "pause" in faults:
+        pkgs.append(
+            db_package(
+                {"interval": interval, "faults": {"pause"}, **opts.get("pause", {})},
                 db=db,
             )
         )

@@ -52,6 +52,44 @@ def test_quorum_abd_linearizable_under_kills(tmp_path):
         completed["results"]["linear"].get("op"))
 
 
+def test_quorum_membership_nemesis_live(tmp_path):
+    """LIVE drive of the membership nemesis (the one nemesis family
+    never exercised against real processes): the state machine shrinks
+    a replica, waits for the observed view to reflect it, grows it
+    back — while ABD clients keep running.  Bounded to a minority, the
+    register must stay linearizable."""
+    shutil.rmtree("/tmp/jepsen-quorum", ignore_errors=True)
+    t = quorum_test(
+        {
+            "nodes": NODES,
+            "concurrency": 6,
+            "time-limit": 10,
+            "interval": 1.2,
+            "faults": ["membership"],
+            "ssh": {"local?": True},
+            "store-dir": str(tmp_path),
+        }
+    )
+    completed = core.run_test(t)
+    hist = completed["history"]
+    oks = [o for o in hist if o["type"] == h.OK and o["process"] != h.NEMESIS]
+    shrinks = [
+        o for o in hist
+        if o["process"] == h.NEMESIS and o["f"] == "shrink" and o["type"] == h.INFO
+    ]
+    grows = [
+        o for o in hist
+        if o["process"] == h.NEMESIS and o["f"] == "grow" and o["type"] == h.INFO
+    ]
+    assert len(oks) > 20, "real quorum ops succeeded under membership churn"
+    assert shrinks, "the membership machine actually shrank the cluster"
+    assert grows, "a shrunk replica was grown back (view-resolved)"
+    # the grow proves resolution: it only fires after the merged view
+    # reflected the shrink (pending ops block further membership ops)
+    assert completed["results"]["linear"]["valid?"] is True, (
+        completed["results"]["linear"].get("op"))
+
+
 def test_quorum_write_one_is_refuted(tmp_path):
     """Cassandra-ANY shape: a write acked after ONE replica stores it.
     Read quorums miss it (and kills erase it) — the linearizable
