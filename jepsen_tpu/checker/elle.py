@@ -3,10 +3,12 @@
 The reference delegates transactional anomaly detection to the external
 `elle 0.1.3` library through thin adapters (jepsen/src/jepsen/tests/cycle/
 append.clj, wr.clj).  This module is the native rebuild: dependency-graph
-inference happens host-side (jepsen_tpu.checker.txn_graph), cycle detection
-runs as batched boolean matrix powering on the TPU MXU
-(jepsen_tpu.ops.closure), and witness cycles for explanations are recovered
-by BFS over the device-computed closure.
+inference happens host-side (jepsen_tpu.checker.txn_graph), cycle
+classification routes to the measured-fastest backend (CYCLE_BACKEND —
+host sparse SCC by default after the round-5 chip measurements; batched
+boolean matrix powering on the TPU MXU via jepsen_tpu.ops.closure as the
+explicit opt-in and the multi-chip mesh-sharded path), and witness cycles
+for explanations are recovered by BFS over the host adjacency.
 
 Result shape follows elle's: ``{"valid?": bool, "anomaly-types": [...],
 "anomalies": {type: [explanation, ...]}, "not": [models ruled out],
@@ -322,33 +324,74 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
     return out
 
 
-#: Above this many nodes a single graph classifies via host SCC (O(V+E))
-#: instead of the dense MXU closure (O(n³ log n)) — batches of small
-#: per-key graphs stay on the device, one big sparse graph doesn't
-#: (measured: 10k-node dense closure ~34 s vs Tarjan ~0.5 s).
+#: Above this many nodes a graph NEVER classifies on the dense MXU
+#: closure (O(n³ log n) vs Tarjan's O(V+E); measured r03: 10k-node dense
+#: closure ~34 s vs Tarjan ~0.5 s) — even under ``backend="device"``.
 SCC_THRESHOLD = 1024
 
+#: Default cycle-classification backend.  Round-5 chip-day measurement
+#: (tools/ crossover sweep, PERF.md "Elle"): host SCC wins at EVERY
+#: single-chip shape, batched or not — 1024×48-txn graphs 0.96 s host
+#: vs 3.4 s device, 64×700-txn 1.2 s vs 10.5 s — sparse O(V+E) with no
+#: tunnel round-trips beats the dense closure throughout, so the
+#: competition routes to the host by default.  The device kernels
+#: remain as an explicit backend ("device") and as the mesh-sharded
+#: closure path for giant graphs across a multi-chip mesh
+#: (ops/closure.transitive_closure_sharded, dryrun-validated).
+CYCLE_BACKEND = "host"
 
-def check_graph(g: tg.TxnGraph, requested: Sequence[str]) -> dict:
+
+def _device_classify(n: int, backend: str | None) -> bool:
+    b = backend or CYCLE_BACKEND
+    if b not in ("host", "device"):
+        raise ValueError(f"unknown cycle backend {b!r}; expected 'host' or 'device'")
+    return b == "device" and n <= SCC_THRESHOLD
+
+
+def check_graph(
+    g: tg.TxnGraph, requested: Sequence[str], backend: str | None = None
+) -> dict:
     """Classify cycles + merge inference anomalies into an elle-style
-    result.  Backend picked by shape, the way the reference's competition
-    checker picks algorithms (checker.clj:199-203)."""
+    result.  Backend picked by measurement, the way the reference's
+    competition checker picks algorithms (checker.clj:199-203); see
+    CYCLE_BACKEND."""
     if not g.n:
         return _merge_flags(g, dict(cl._EMPTY_FLAGS), dict(cl._EMPTY_HINTS), requested)
-    if g.n > SCC_THRESHOLD:
+    if _device_classify(g.n, backend):
+        flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
+    else:
         from jepsen_tpu.checker.scc import classify_graph_scc
 
         flags, hints = classify_graph_scc(g.ww, g.wr, g.rw, g.extra)
-    else:
-        flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
     return _merge_flags(g, flags, hints, requested)
 
 
-def check_graphs(graphs: Sequence[tg.TxnGraph], requested: Sequence[str]) -> list[dict]:
-    """Classify MANY graphs in batched device launches (the per-key
-    scale-out path — jepsen_tpu.ops.closure.classify_graphs buckets by
-    padded size and vmaps each bucket)."""
-    results = cl.classify_graphs([(g.ww, g.wr, g.rw, g.extra) for g in graphs])
+def check_graphs(
+    graphs: Sequence[tg.TxnGraph],
+    requested: Sequence[str],
+    backend: str | None = None,
+) -> list[dict]:
+    """Classify MANY graphs (the per-key scale-out path).  Default
+    backend is the host SCC loop (measured fastest at every single-chip
+    shape — see CYCLE_BACKEND); ``backend="device"`` runs the bucketed
+    vmapped MXU closures (ops.closure.classify_graphs) instead."""
+    results: list = [None] * len(graphs)
+    dev_idx = [i for i, g in enumerate(graphs) if _device_classify(g.n, backend)]
+    if dev_idx:
+        # routed per graph: an oversized graph (> SCC_THRESHOLD) goes
+        # host without cancelling the device opt-in for the others
+        dev_out = cl.classify_graphs(
+            [(graphs[i].ww, graphs[i].wr, graphs[i].rw, graphs[i].extra)
+             for i in dev_idx]
+        )
+        for i, r in zip(dev_idx, dev_out):
+            results[i] = r
+    if len(dev_idx) < len(graphs):
+        from jepsen_tpu.checker.scc import classify_graph_scc
+
+        for i, g in enumerate(graphs):
+            if results[i] is None:
+                results[i] = classify_graph_scc(g.ww, g.wr, g.rw, g.extra)
     return [
         _merge_flags(g, flags, hints, requested)
         for g, (flags, hints) in zip(graphs, results)
@@ -524,9 +567,10 @@ class CycleChecker(_ElleChecker):
                  None for a generic rendering)
 
     Any cycle in the combined relation graph is an anomaly (reported
-    under ``"cycle"`` with a recovered witness).  Detection routes by
-    size like the typed checkers: dense MXU closure for small graphs,
-    host Tarjan above SCC_THRESHOLD.
+    under ``"cycle"`` with a recovered witness).  Detection routes like
+    the typed checkers: host Tarjan by default (the measured winner at
+    every single-chip shape — see CYCLE_BACKEND), the dense MXU closure
+    when the device backend is opted in for graphs ≤ SCC_THRESHOLD.
     """
 
     def __init__(self, analyzer):
@@ -600,7 +644,13 @@ class CycleChecker(_ElleChecker):
         is unclosed."""
         if n == 0:
             return False, None
-        if n > SCC_THRESHOLD:
+        if _device_classify(n, None):
+            zeros = np.zeros_like(adj)
+            flags, hints = cl.classify_graph(adj, zeros, zeros, zeros)
+            if not flags["G0"]:
+                return False, None
+            cyc = _diag_cycle_at(adj, hints["G0"][0]) if hints["G0"] else None
+        else:
             from jepsen_tpu.checker.scc import _first_edge_in_cycle, tarjan_scc
 
             edges = np.argwhere(adj)
@@ -609,12 +659,6 @@ class CycleChecker(_ElleChecker):
             if hit is None:
                 return False, None
             cyc = _find_cycle_through_edge(adj, hit[0], hit[1])
-        else:
-            zeros = np.zeros_like(adj)
-            flags, hints = cl.classify_graph(adj, zeros, zeros, zeros)
-            if not flags["G0"]:
-                return False, None
-            cyc = _diag_cycle_at(adj, hints["G0"][0]) if hints["G0"] else None
         if cyc and len(cyc) > 1 and cyc[0] == cyc[-1]:
             cyc = cyc[:-1]
         return True, cyc

@@ -1,10 +1,15 @@
-"""Host-side SCC cycle classification for large sparse dependency graphs.
+"""Host-side SCC cycle classification for dependency graphs.
 
-The MXU matrix-powering closure (jepsen_tpu.ops.closure) is the right
-backend for BATCHES of small per-key graphs; one big sparse graph (10k+
-txns) is Tarjan territory — O(V+E) beats O(n³ log n) no matter how fast
-the systolic array is.  The elle checkers pick per shape, the way the
-reference's competition checker picks algorithms (checker.clj:199-203).
+This is the elle checkers' DEFAULT cycle backend (round-5 chip-day
+measurement): sparse O(V+E) beats the dense MXU closure's O(n³ log n)
+at every single-chip shape, batched per-key graphs included — 1024
+48-txn graphs classify in 0.96 s here vs 3.4 s on the vmapped device
+closure, and the gap widens with graph size (64×700-txn: 1.2 s vs
+10.5 s).  The device kernels (jepsen_tpu.ops.closure) remain as an
+explicit ``backend="device"`` opt-in and as the mesh-sharded closure
+for giant graphs across a multi-chip mesh.  The elle checkers pick per
+measurement, the way the reference's competition checker picks
+algorithms (checker.clj:199-203).
 
 Classification is exact, matching ops/closure.py's semantics:
 

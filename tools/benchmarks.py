@@ -152,6 +152,44 @@ def config_3():
            None, {"tpu": rb["valid?"], "anomalies": rb.get("anomaly-types")})
 
 
+def config_3c():
+    """Batched per-key elle — the scale-out shape (independent.clj's
+    per-key batch axis).  Measures BOTH backends on the same graphs:
+    the vmapped MXU closures (``backend="device"``) and the host SCC
+    loop that round-5 measurement made the production default (elle.py
+    CYCLE_BACKEND — sparse O(V+E) beats the dense closure at every
+    single-chip shape; the row records the evidence)."""
+    from jepsen_tpu.checker.scc import classify_graph_scc
+    from jepsen_tpu.ops import closure as cl
+
+    N = 256 if QUICK else 1024
+    graphs = []
+    for i in range(N):
+        hist = append_history(48, n_keys=3, n_procs=8, seed=1000 + i)
+        g = tg.list_append_graph(hist, ())
+        graphs.append((g.ww, g.wr, g.rw, g.extra))
+    cl.classify_graphs(graphs)  # compile
+    t0 = time.perf_counter()
+    dev = cl.classify_graphs(graphs)
+    tpu_s = time.perf_counter() - t0
+
+    def cpu():
+        return [classify_graph_scc(*g) for g in graphs]
+
+    cpu_s, host = budget(cpu, 300)
+    agree = (
+        "budget" if host is None
+        else all(d[0] == h[0] for d, h in zip(dev, host))
+    )
+    record("3c", f"elle batched per-key: {N} graphs (48 txns each), cycle phase",
+           tpu_s, cpu_s,
+           {"flags-agree": agree},
+           note="per-key scale-out shape, both backends on the same graphs: "
+                "vmapped MXU closures vs the host SCC loop (the measured "
+                "production default, elle.py CYCLE_BACKEND); speedup < 1 is "
+                "WHY the competition routes to the host on single-chip setups")
+
+
 def config_5():
     """Adversarial: many ops, 64 procs, 30% info — worst-case branching.
 
@@ -204,7 +242,7 @@ def config_5():
 
 
 CONFIGS = {"config_1": config_1, "config_2": config_2, "config_3": config_3,
-           "config_5": config_5}
+           "config_3c": config_3c, "config_5": config_5}
 
 
 def main():
