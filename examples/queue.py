@@ -153,7 +153,9 @@ def queue_test(opts) -> dict:
     )
     time_limit = opts.get("time-limit", 8)
     t = testkit.noop_test(
-        name="queue",
+        # the lossy mode stores under its own name: a refuted run next
+        # to a valid one must read as two MODES, not a flaky harness
+        name="queue" if opts.get("durable", True) else "queue-lossy",
         db=db,
         client=QueueClient(),
         nemesis=pkg.nemesis,
