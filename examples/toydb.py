@@ -51,11 +51,13 @@ class ToyDB(LocalProcessDB):
     shared_data = "shared-register"
 
     def __init__(self, txn_buffer: int = 0, no_wal: bool = False,
-                 seed: str | None = None, reg_buffer: int = 0):
+                 seed: str | None = None, reg_buffer: int = 0,
+                 torn_delay_ms: float | None = None):
         self.txn_buffer = int(txn_buffer)
         self.no_wal = bool(no_wal)
         self.seed = seed
         self.reg_buffer = int(reg_buffer)
+        self.torn_delay_ms = torn_delay_ms
 
     def extra_args(self):
         extra = (
@@ -63,6 +65,8 @@ class ToyDB(LocalProcessDB):
         )
         if self.no_wal:
             extra.append("--no-wal")
+        if self.torn_delay_ms is not None:
+            extra += ["--torn-delay-ms", str(self.torn_delay_ms)]
         if self.seed:
             extra += ["--seed", self.seed]
         if self.reg_buffer:
@@ -528,7 +532,8 @@ def toydb_bank_test(opts) -> dict:
     seed = ",".join(
         f"{a}:{share + (1 if i < rem else 0)}" for i, a in enumerate(accounts)
     )
-    db = ToyDB(seed=seed, no_wal=bool(opts.get("torn")))
+    db = ToyDB(seed=seed, no_wal=bool(opts.get("torn")),
+               torn_delay_ms=opts.get("torn-delay-ms"))
     t = _toydb_faulted_test(
         opts, "toydb-bank" + ("-torn" if opts.get("torn") else ""),
         db, ToyBankClient(), wl["generator"], {"bank": wl["checker"]},

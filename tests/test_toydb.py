@@ -238,13 +238,15 @@ def test_toydb_bank_torn_mode_is_caught(tmp_path):
     """--no-wal: sequential per-key commits tear under kill -9 — totals
     drift and the bank checker names the bad reads (a real atomicity
     bug in a real running system, caught).  A tear needs a kill to land
-    inside the (widened) commit window, so the fault schedule is a
-    coin-flip per kill; two attempts bound the flake rate while keeping
-    the bug real rather than scripted."""
+    inside the commit window; the per-run hit rate was MEASURED at
+    ~1/3 with the default 25 ms window (3 consecutive 2-attempt CI
+    failures on round-5 chip day), so the test widens the window to
+    80 ms and takes 4 attempts — the bug stays real rather than
+    scripted, with a flake rate well under 1%."""
     from examples.toydb import toydb_bank_test
 
     last = None
-    for _attempt in range(2):
+    for _attempt in range(4):
         shutil.rmtree("/tmp/jepsen-toydb", ignore_errors=True)
         t = toydb_bank_test(
             {
@@ -253,6 +255,7 @@ def test_toydb_bank_torn_mode_is_caught(tmp_path):
                 "time-limit": 10,
                 "interval": 0.7,
                 "torn": True,
+                "torn-delay-ms": 80.0,
                 "ssh": {"local?": True},
                 "store-dir": str(tmp_path),
             }

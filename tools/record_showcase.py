@@ -89,6 +89,9 @@ def main():
         want=False)
     run("toydb elle rw-register", toydb_wr_test)
     run("toydb bank", toydb_bank_test)
+    run("toydb bank (TORN, no WAL)", toydb_bank_test,
+        {"torn": True, "torn-delay-ms": 80.0, "concurrency": 8,
+         "interval": 0.7, "time-limit": 10}, want=False, attempts=4)
     run("toydb long-fork", toydb_longfork_test)
     run("toydb monotonic", toydb_monotonic_test)
     run("toydb causal-reverse", toydb_causal_reverse_test)
