@@ -92,10 +92,19 @@ def main():
     run("toydb bank (TORN, no WAL)", toydb_bank_test,
         {"torn": True, "torn-delay-ms": 80.0, "concurrency": 8,
          "interval": 0.7, "time-limit": 10}, want=False, attempts=4)
+    caught = {"concurrency": 8, "time-limit": 6, "interval": 2.5}
     run("toydb long-fork", toydb_longfork_test)
+    run("toydb long-fork (FORKED)", toydb_longfork_test,
+        {**caught, "fork": True}, want=False, attempts=4)
     run("toydb monotonic", toydb_monotonic_test)
+    run("toydb monotonic (FORKED)", toydb_monotonic_test,
+        {**caught, "fork": True}, want=False, attempts=4)
     run("toydb causal-reverse", toydb_causal_reverse_test)
+    run("toydb causal-reverse (LOSSY)", toydb_causal_reverse_test,
+        {**caught, "lossy": True}, want=False, attempts=4)
     run("toydb adya", toydb_adya_test)
+    run("toydb adya (SPLIT, G2)", toydb_adya_test,
+        {**caught, "split": True}, want=False, attempts=4)
     run("queue durable", queue_test, tmp="/tmp/jepsen-queue")
     run("queue LOSSY", queue_test, {"durable": False}, want=False,
         tmp="/tmp/jepsen-queue")
