@@ -1,0 +1,74 @@
+"""Unit tests for tools/record_showcase.py's run() contract: caught-bug
+modes retry until refuted, non-matching attempts' store dirs are
+deleted, and a final mismatch is reported (the judged store must never
+carry a contradictory run for a deliberately-broken mode)."""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "record_showcase", ROOT / "tools" / "record_showcase.py"
+)
+rs = importlib.util.module_from_spec(spec)
+sys.modules["record_showcase"] = rs
+spec.loader.exec_module(rs)
+
+
+def _fake_family(tmp_path, verdicts):
+    """A test_fn + core.run_test stand-in: each call pops the next
+    verdict and 'stores' a run dir."""
+    calls = {"n": 0}
+
+    def test_fn(opts):
+        return dict(opts)
+
+    def fake_run_test(t):
+        i = calls["n"]
+        calls["n"] += 1
+        d = tmp_path / f"run-{i}"
+        d.mkdir()
+        return {
+            "results": {"check": {"valid?": verdicts[i]}},
+            "dir": str(d),
+        }
+
+    return test_fn, fake_run_test, calls
+
+
+def test_caught_mode_retries_and_deletes_mismatches(tmp_path, monkeypatch):
+    test_fn, fake_run, calls = _fake_family(tmp_path, [True, True, False])
+    monkeypatch.setattr(rs.core, "run_test", fake_run)
+    rs.MISMATCHES.clear()
+    last = rs.run("fam", test_fn, want=False, attempts=4, tmp=str(tmp_path / "nope"))
+    assert calls["n"] == 3, "stopped as soon as the bug manifested"
+    assert last == {"check": False}
+    assert rs.MISMATCHES == []
+    # the two valid?-True attempts' store dirs were deleted; the
+    # refuted run survives
+    assert not (tmp_path / "run-0").exists()
+    assert not (tmp_path / "run-1").exists()
+    assert (tmp_path / "run-2").exists()
+
+
+def test_final_mismatch_is_reported(tmp_path, monkeypatch):
+    test_fn, fake_run, calls = _fake_family(tmp_path, [True, True])
+    monkeypatch.setattr(rs.core, "run_test", fake_run)
+    rs.MISMATCHES.clear()
+    rs.run("fam2", test_fn, want=False, attempts=2, tmp=str(tmp_path / "nope"))
+    assert len(rs.MISMATCHES) == 1 and "fam2" in rs.MISMATCHES[0]
+    rs.MISMATCHES.clear()
+
+
+def test_valid_mode_runs_once(tmp_path, monkeypatch):
+    test_fn, fake_run, calls = _fake_family(tmp_path, [True])
+    monkeypatch.setattr(rs.core, "run_test", fake_run)
+    rs.MISMATCHES.clear()
+    last = rs.run("fam3", test_fn, tmp=str(tmp_path / "nope"))
+    assert calls["n"] == 1
+    assert last == {"check": True}
+    assert (tmp_path / "run-0").exists()
