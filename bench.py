@@ -31,6 +31,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -101,6 +102,7 @@ if _reason is not None:
 from genhist import corrupt, valid_register_history  # noqa: E402
 
 from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu import obs  # noqa: E402
 from jepsen_tpu.checker import wgl_cpu  # noqa: E402
 from jepsen_tpu.parallel import batch_analysis  # noqa: E402
 from jepsen_tpu.parallel.batch import warm_confirm_pool  # noqa: E402
@@ -154,9 +156,25 @@ def main() -> None:
     # workers so pool startup stays outside the timed window.
     warm_confirm_pool()
     batch_analysis(model, hists, **kw)
-    t0 = time.perf_counter()
-    tpu_results = batch_analysis(model, hists, **kw)
-    tpu_s = time.perf_counter() - t0
+    # Telemetry rides the measured run (per-stage spans only — a dozen
+    # events, noise relative to the kernel launches): the ladder-stage
+    # table lands in the JSON line so every perf PR reports through it.
+    # JEPSEN_TPU_TELEMETRY=0 turns it off.
+    tele_dir = (
+        Path(tempfile.mkdtemp(prefix="jepsen-tpu-bench-telemetry-"))
+        if obs.env_enabled(True) else None
+    )
+    with obs.recording(tele_dir, enabled=tele_dir is not None) as rec:
+        t0 = time.perf_counter()
+        tpu_results = batch_analysis(model, hists, **kw)
+        tpu_s = time.perf_counter() - t0
+    telemetry = None
+    if rec is not None and rec.summary is not None:
+        telemetry = {
+            "ladder": rec.summary["ladder"],
+            "counters": rec.summary["counters"],
+            "file": str(tele_dir / "telemetry.json"),
+        }
 
     # CPU baseline on a deterministic sample, extrapolated (the full set
     # at the budget cap alone would take >20 min).
@@ -181,21 +199,20 @@ def main() -> None:
 
     value = total_ops / tpu_s
     baseline = total_ops / cpu_s
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "linearizability ops verified/sec/chip "
-                    f"({N_HISTORIES}x{OPS_PER_HISTORY}-op batch, {PROCS} procs, "
-                    f"{int(INFO_RATE*100)}% info, 1/{CORRUPT_EVERY} corrupted; "
-                    f"tpu unknowns {unknowns}, cpu {CPU_SAMPLE}-sample budget-capped {cap_hits})"
-                ),
-                "value": round(value, 1),
-                "unit": "ops/s",
-                "vs_baseline": round(value / baseline, 2),
-            }
-        )
-    )
+    line = {
+        "metric": (
+            "linearizability ops verified/sec/chip "
+            f"({N_HISTORIES}x{OPS_PER_HISTORY}-op batch, {PROCS} procs, "
+            f"{int(INFO_RATE*100)}% info, 1/{CORRUPT_EVERY} corrupted; "
+            f"tpu unknowns {unknowns}, cpu {CPU_SAMPLE}-sample budget-capped {cap_hits})"
+        ),
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(value / baseline, 2),
+    }
+    if telemetry is not None:
+        line["telemetry"] = telemetry
+    print(json.dumps(line))
 
 
 def _is_backend_outage(e: BaseException) -> bool:

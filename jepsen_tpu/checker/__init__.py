@@ -19,6 +19,7 @@ import threading
 import traceback
 from typing import Any, Callable, Mapping, Sequence
 
+from jepsen_tpu import obs
 from jepsen_tpu.utils import bounded_pmap
 
 UNKNOWN = "unknown"
@@ -71,16 +72,38 @@ def checker(fn: Callable) -> Checker:
     return FnChecker(fn)
 
 
-def check_safe(chk: Checker, test, history, opts=None) -> dict:
+def checker_name(chk: Checker) -> str:
+    """A human-attributable name for a checker: its ``name`` attribute
+    (FnChecker, or anything that sets one) else the class name."""
+    n = getattr(chk, "name", None)
+    if n:
+        return str(n)
+    return type(chk).__name__
+
+
+def check_safe(chk: Checker, test, history, opts=None, name: str | None = None) -> dict:
     """check, but exceptions become ``{"valid?": "unknown", "error": ...}``
-    (checker.clj:74-85)."""
-    try:
-        result = chk.check(test, history, opts or {})
-        if result is None:
-            return {"valid?": True}
+    (checker.clj:74-85).
+
+    The failure names WHICH checker raised (``"checker"`` key) so composed
+    results stay attributable, and each check emits a telemetry span with
+    the checker's name, duration, and verdict (``name`` lets Compose pass
+    the map key the caller knows the checker by)."""
+    name = name or checker_name(chk)
+    with obs.span("checker.check", checker=name) as sp:
+        try:
+            result = chk.check(test, history, opts or {})
+            if result is None:
+                result = {"valid?": True}
+        except Exception:  # noqa: BLE001 - contract: never propagate
+            obs.counter("checker.errors", checker=name)
+            result = {
+                "valid?": UNKNOWN,
+                "checker": name,
+                "error": traceback.format_exc(),
+            }
+        sp.set(valid=result.get("valid?"))
         return result
-    except Exception:  # noqa: BLE001 - contract: never propagate
-        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
 
 
 class Noop(Checker):
@@ -114,7 +137,8 @@ class Compose(Checker):
     def check(self, test, history, opts):
         items = list(self.checker_map.items())
         results = bounded_pmap(
-            lambda kv: (kv[0], check_safe(kv[1], test, history, opts)), items
+            lambda kv: (kv[0], check_safe(kv[1], test, history, opts, name=kv[0])),
+            items,
         )
         out = dict(results)
         out["valid?"] = merge_valid(r["valid?"] for _, r in results)

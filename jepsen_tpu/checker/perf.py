@@ -396,3 +396,80 @@ def perf(opts: Mapping | None = None) -> Checker:
     from jepsen_tpu.checker import compose
 
     return compose({"latency-graph": latency_graph(), "rate-graph": rate_graph_checker()})
+
+
+# ---------------------------------------------------------------------------
+# Telemetry-backed checker-time artifact
+# ---------------------------------------------------------------------------
+
+VALID_BAR_COLORS = {True: "#81BF67", False: "#FF1E90", "unknown": "#FFA400"}
+
+
+def checker_time_svg(rows: Sequence[tuple]) -> str:
+    """Horizontal bar chart of per-checker ``check()`` wall time, colored
+    by verdict.  ``rows`` is ``[(name, seconds, valid), ...]`` — the
+    telemetry recording's ``checker.check`` spans."""
+    rows = sorted(rows, key=lambda r: -r[1])
+    bar_h, gap, ml, mr, mt = 22, 6, 170, 90, 40
+    w = 760
+    h = mt + len(rows) * (bar_h + gap) + 16
+    vmax = max((r[1] for r in rows), default=1.0) or 1.0
+    plot_w = w - ml - mr
+    e = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" height="{h}" '
+        f'font-family="Helvetica,Arial,sans-serif" font-size="11">',
+        f'<rect width="{w}" height="{h}" fill="white"/>',
+        f'<text x="{w / 2:.0f}" y="18" text-anchor="middle" font-size="13" '
+        f'font-weight="bold">checker time (telemetry)</text>',
+    ]
+    for i, (name, seconds, valid) in enumerate(rows):
+        y = mt + i * (bar_h + gap)
+        bw = max(1.0, seconds / vmax * plot_w)
+        color = VALID_BAR_COLORS.get(valid, "#888")
+        e.append(
+            f'<text x="{ml - 8}" y="{y + bar_h - 7}" text-anchor="end">'
+            f"{_esc(str(name))}</text>"
+        )
+        e.append(
+            f'<rect x="{ml}" y="{y}" width="{bw:.1f}" height="{bar_h}" '
+            f'fill="{color}"/>'
+        )
+        e.append(
+            f'<text x="{ml + bw + 6:.1f}" y="{y + bar_h - 7}">'
+            f"{seconds:.3f}s</text>"
+        )
+    e.append("</svg>")
+    return "\n".join(e)
+
+
+def checker_times_from_events(events: Sequence[Mapping]) -> list[tuple]:
+    """Aggregate a telemetry event stream's checker.check spans into
+    ``(name, total seconds, last verdict)`` rows."""
+    agg: dict = {}
+    for ev in events:
+        if ev.get("type") != "span" or ev.get("name") != "checker.check":
+            continue
+        attrs = ev.get("attrs") or {}
+        name = str(attrs.get("checker", "?"))
+        sec, valid = agg.get(name, (0.0, None))
+        agg[name] = (sec + float(ev.get("dur") or 0.0), attrs.get("valid", valid))
+    return [(n, s, v) for n, (s, v) in agg.items()]
+
+
+def write_checker_times(test: Mapping, events: Sequence[Mapping], opts=None):
+    """Write ``checker-times.svg`` into the test's store dir — the
+    telemetry-backed "where did analysis time go" artifact, next to the
+    latency/rate graphs.  Returns the path, or None without data/store."""
+    rows = checker_times_from_events(events)
+    if not rows or not (test.get("name") and test.get("start-time-str")):
+        return None
+    try:
+        d = store.test_dir(test)
+        sub = (opts or {}).get("subdirectory")
+        d = d / sub if sub else d
+        d.mkdir(parents=True, exist_ok=True)
+        path = Path(d) / "checker-times.svg"
+        path.write_text(checker_time_svg(rows))
+        return path
+    except OSError:
+        return None

@@ -54,6 +54,7 @@ from typing import Any, Sequence
 
 from jepsen_tpu import history as h
 from jepsen_tpu import models as m
+from jepsen_tpu import obs
 
 #: fs that never change model state; crashed ops with these fs are dropped.
 PURE_FS = {
@@ -165,6 +166,14 @@ def dfs_analysis(
     reached, or ``{"valid?": "unknown", "cause": ...}`` past the node
     budget.
     """
+    with obs.span("wgl_cpu.dfs") as sp:
+        stats: dict = {}
+        out = _dfs_analysis(model, history, max_visited, stats)
+        sp.set(valid=out.get("valid?"), **stats)
+        return out
+
+
+def _dfs_analysis(model, history, max_visited, stats: dict) -> dict:
     events, eff_ops, crashed = prepare(model, history)
     barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
     n_barriers = len(barriers)
@@ -185,6 +194,7 @@ def dfs_analysis(
     while stack:
         b, state, fok, fcr = stack.pop()
         if b >= n_barriers:
+            stats.update(visited=len(visited), barriers=n_barriers)
             return {"valid?": True, "configs": [{"model": state}]}
         if b > deepest:
             deepest = b
@@ -226,12 +236,14 @@ def dfs_analysis(
                 visited.add(nxt)
                 stack.append(nxt)
         if len(visited) > max_visited:
+            stats.update(visited=len(visited), barriers=n_barriers, deepest=deepest)
             return {
                 "valid?": "unknown",
                 "cause": f"visited more than {max_visited} configurations",
                 "op": history[barriers[deepest][1]],
             }
 
+    stats.update(visited=len(visited), barriers=n_barriers, deepest=deepest)
     return {
         "valid?": False,
         "op": history[barriers[deepest][1]],
@@ -315,6 +327,14 @@ def sweep_analysis(
     it is wasted work.  Surviving past it means the device refutation was
     a hash-collision artifact — returned as "unknown" (the prefix proves
     nothing about the suffix)."""
+    with obs.span("wgl_cpu.sweep") as sp:
+        stats: dict = {}
+        out = _sweep_analysis(model, history, max_configs, stop_at_index, stats)
+        sp.set(valid=out.get("valid?"), **stats)
+        return out
+
+
+def _sweep_analysis(model, history, max_configs, stop_at_index, stats: dict) -> dict:
     events, eff_ops, crashed = prepare(model, history)
     barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
     # Fixed group vocabulary: all groups are known after the snapshots,
@@ -327,6 +347,9 @@ def sweep_analysis(
     ac = _Antichain()
     ac.add(zero)
     configs[(model, frozenset())] = ac
+    explored = 0  # closure work across barriers (telemetry)
+    peak = 1      # peak per-barrier frontier occupancy (telemetry)
+    stats.update(barriers=len(barriers), groups=len(groups))
 
     for _pos, i, open_ok, open_crashed in barriers:
         bar_open = [(gidx[g], c) for g, c in open_crashed]
@@ -358,11 +381,18 @@ def sweep_analysis(
                     work.append((s2, fok2, fcr2))
                     count += 1
                     if count > max_configs:
+                        stats.update(
+                            configs_explored=explored + count,
+                            peak_configs=max(peak, count),
+                        )
                         return {
                             "valid?": "unknown",
                             "cause": f"configuration set exceeded {max_configs}",
                             "op": history[i],
                         }
+        explored += count
+        peak = max(peak, count)
+        stats.update(configs_explored=explored, peak_configs=peak)
         # Keep configs that fired i; retire i.
         configs = {}
         for (st, fok), a in seen.items():

@@ -67,6 +67,13 @@ def add_test_opts(p: argparse.ArgumentParser):
     p.add_argument("--leave-db-running", action="store_true",
                    help="skip DB teardown at the end")
     p.add_argument("--store-dir", default=None, help="where test runs are stored")
+    tele = p.add_mutually_exclusive_group()
+    tele.add_argument("--telemetry", dest="telemetry", action="store_true",
+                      default=None,
+                      help="record telemetry.jsonl/.json into the store dir "
+                           "(default: on; env JEPSEN_TPU_TELEMETRY)")
+    tele.add_argument("--no-telemetry", dest="telemetry", action="store_false",
+                      help="disable telemetry recording for this run")
 
 
 def options_to_test_opts(opts: argparse.Namespace) -> dict:
@@ -109,10 +116,22 @@ def _exit_code(result: Mapping) -> int:
     return EXIT_INVALID
 
 
+def _apply_telemetry_opt(test: Mapping, opts) -> dict:
+    """Pin the CLI's telemetry choice onto the built test map — harness
+    test_fns copy options selectively, so the flag is applied after the
+    map is built, on every command path.  Tri-state: an unset flag leaves
+    the map alone so obs.enabled_for falls through to the
+    JEPSEN_TPU_TELEMETRY env var (default on for run/analyze)."""
+    t = dict(test)
+    if getattr(opts, "telemetry", None) is not None:
+        t["telemetry?"] = opts.telemetry
+    return t
+
+
 def _cmd_test(test_fn: Callable, opts) -> int:
     code = EXIT_VALID
     for i in range(opts.test_count):
-        test = test_fn(options_to_test_opts(opts))
+        test = _apply_telemetry_opt(test_fn(options_to_test_opts(opts)), opts)
         completed = core.run_test(test)
         c = _exit_code(completed.get("results"))
         code = max(code, c)
@@ -142,6 +161,7 @@ def _cmd_analyze(test_fn: Callable, opts) -> int:
     merged = {**cli_test, **{k: v for k, v in stored.items() if k in
                              ("name", "start-time-str", "history")}}
     merged.setdefault("start-time-str", store.time_str())
+    merged = _apply_telemetry_opt(merged, opts)
     completed = core.analyze(merged)
     core.log_results(completed)
     print(completed["results"].get("valid?"))
@@ -155,6 +175,7 @@ def _cmd_test_all(suite_fn: Callable, opts) -> int:
     rows = []
     code = EXIT_VALID
     for test in suite_fn(options_to_test_opts(opts)):
+        test = _apply_telemetry_opt(test, opts)
         try:
             completed = core.run_test(test)
             c = _exit_code(completed.get("results"))

@@ -29,7 +29,7 @@ from typing import Any, Mapping
 
 from jepsen_tpu import checker as chk
 from jepsen_tpu import client as jclient
-from jepsen_tpu import control, db as jdb, history as h, net as jnet, store
+from jepsen_tpu import control, db as jdb, history as h, net as jnet, obs, store
 from jepsen_tpu.generator import interpreter
 from jepsen_tpu.utils import real_pmap, relative_time
 
@@ -138,21 +138,42 @@ def analyze(test: Mapping, *, capture: bool = True) -> dict:
 
     ``capture`` tees the harness log to the run's jepsen.log
     (store.clj:436-464); run_test passes False because its own capture
-    already spans the analysis."""
+    already spans the analysis.  A standalone analyze (CLI ``analyze``)
+    opens its own telemetry recording into the store dir; under run_test
+    the spans nest into the run's already-open recording."""
     test = dict(test)
     cm = (
         store.capture_logging(test) if capture else contextlib.nullcontext()
     )
-    with cm:
-        test["history"] = h.index(test.get("history") or [])
-        checker = test.get("checker")
-        if checker is not None:
-            results = chk.check_safe(checker, test, test["history"])
-        else:
-            results = {"valid?": True}
-        test["results"] = results
-        store.save_2(test)
+    with cm, obs.recording(store.test_dir(test), enabled=obs.enabled_for(test)):
+        with obs.span("phase.analyze") as sp:
+            test["history"] = h.index(test.get("history") or [])
+            checker = test.get("checker")
+            if checker is not None:
+                results = chk.check_safe(checker, test, test["history"])
+            else:
+                results = {"valid?": True}
+            sp.set(valid=results.get("valid?"))
+            test["results"] = results
+        _write_checker_times(test)
+        with obs.span("phase.save-results"):
+            store.save_2(test)
     return test
+
+
+def _write_checker_times(test: Mapping) -> None:
+    """Telemetry-backed checker-time artifact, next to the latency graphs
+    (checker/perf.py renders it from the recording's checker.check spans)."""
+    rec = obs.active()
+    if rec is None:
+        return
+    try:
+        from jepsen_tpu.checker import perf
+
+        perf.write_checker_times(test, rec.events)
+    except Exception:  # noqa: BLE001 — an observability artifact must
+        # never fail the analysis that produced the verdict
+        logger.debug("couldn't write checker-times artifact", exc_info=True)
 
 
 def log_results(test: Mapping):
@@ -173,8 +194,13 @@ def run_test(test: Mapping) -> dict:
     test = prepare_test(test)
     with contextlib.ExitStack() as stack:
         # Tee the whole run's log — setup through analysis — into the
-        # store dir (store.clj:436-464).
+        # store dir (store.clj:436-464), and open the run's telemetry
+        # recording next to it (telemetry.jsonl + rolled-up
+        # telemetry.json on close).
         stack.enter_context(store.capture_logging(test))
+        stack.enter_context(
+            obs.recording(store.test_dir(test), enabled=obs.enabled_for(test))
+        )
         return _run_test_captured(test)
 
 
@@ -185,32 +211,37 @@ def _run_test_captured(test: dict) -> dict:
         os_ = test.get("os")
         database = test.get("db")
         try:
-            if os_ is not None:
-                control.on_nodes(test, os_.setup)
-            if database is not None:
-                jdb.cycle_db(test)
-            with relative_time():
+            with obs.span("phase.db-cycle", nodes=len(test.get("nodes") or [])):
+                if os_ is not None:
+                    control.on_nodes(test, os_.setup)
+                if database is not None:
+                    jdb.cycle_db(test)
+            with relative_time(), obs.span("phase.run-case") as sp:
                 history = run_case(test)
+                sp.set(ops=len(history))
             test = dict(test)
             test["history"] = history
-            store.save_1(test)
+            with obs.span("phase.save-history"):
+                store.save_1(test)
         finally:
             # Logs are snarfed even when the run crashed — debugging a
             # crash needs them most (core.clj:150-166 shutdown hook).
             try:
-                snarf_logs(test)
+                with obs.span("phase.snarf-logs"):
+                    snarf_logs(test)
             except Exception:  # noqa: BLE001
                 logger.exception("log download failed")
-            try:
-                if database is not None and not test.get("leave-db-running?"):
-                    control.on_nodes(test, database.teardown)
-            except Exception:  # noqa: BLE001
-                logger.exception("db teardown failed")
-            try:
-                if os_ is not None:
-                    control.on_nodes(test, os_.teardown)
-            except Exception:  # noqa: BLE001
-                logger.exception("os teardown failed")
+            with obs.span("phase.teardown"):
+                try:
+                    if database is not None and not test.get("leave-db-running?"):
+                        control.on_nodes(test, database.teardown)
+                except Exception:  # noqa: BLE001
+                    logger.exception("db teardown failed")
+                try:
+                    if os_ is not None:
+                        control.on_nodes(test, os_.teardown)
+                except Exception:  # noqa: BLE001
+                    logger.exception("os teardown failed")
     test = analyze(test, capture=False)
     log_results(test)
     return test

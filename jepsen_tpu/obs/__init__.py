@@ -1,0 +1,274 @@
+"""Unified telemetry: structured spans + metrics for the run/check pipeline.
+
+The harness's observability seam (ROADMAP "makes a hot path measurably
+faster" enabler): a zero-dependency structured-event API that every layer
+emits through —
+
+  * ``span(name, **attrs)``   — a context manager timing a region with
+    monotonic wall times; spans nest (the enclosing span becomes the
+    ``parent``) and accept late attributes via ``.set(...)``;
+  * ``counter(name, n)``      — a monotonically accumulated count;
+  * ``gauge(name, value)``    — a point-in-time measurement;
+  * ``event(name, **attrs)``  — a bare structured event.
+
+Events stream append-only into ``telemetry.jsonl`` in the active
+recording directory (one JSON object per line, crash-readable at any
+point, like ``history.jsonl``; opening a new recording replaces a prior
+stream), and on close a rolled-up ``telemetry.json`` lands next to it
+(per-phase wall time, per-checker time + verdict, the ladder-stage
+table — see ``obs.summary``).
+
+The API is PROCESS-GLOBAL with a no-op fast path: when no recording is
+active, ``span()`` returns a shared singleton and ``counter``/``gauge``
+return immediately after one global read — the interpreter and kernel
+hot loops pay ~nothing when telemetry is off, so call sites never need
+their own guards.
+
+Toggles: the test-map key ``"telemetry?"`` (set by the CLI's
+``--telemetry/--no-telemetry``) wins; otherwise the env var
+``JEPSEN_TPU_TELEMETRY`` (``0``/``false``/``off`` disable); default ON
+for ``run``/``analyze``.  ``core.run_test`` opens the recording into the
+run's store directory alongside ``jepsen.log``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+from jepsen_tpu.obs.summary import summarize
+
+__all__ = [
+    "ENV_VAR", "Recorder", "active", "counter", "enabled_for",
+    "env_enabled", "event", "gauge", "recording", "span", "span_event",
+    "summarize",
+]
+
+ENV_VAR = "JEPSEN_TPU_TELEMETRY"
+
+_FALSY = {"0", "false", "no", "off"}
+
+#: the active recorder; None is the disabled fast path.
+_RECORDER: "Recorder | None" = None
+
+_STACK = threading.local()  # per-thread open-span stack (for parent links)
+
+
+def env_enabled(default: bool = True) -> bool:
+    """The JEPSEN_TPU_TELEMETRY env toggle (bench/tools entry points)."""
+    v = os.environ.get(ENV_VAR)
+    if v is None:
+        return default
+    return v.strip().lower() not in _FALSY
+
+
+def enabled_for(test: Mapping | None) -> bool:
+    """Resolve the toggle for a test map: ``"telemetry?"`` wins, then the
+    env var, then the default (on for run/analyze)."""
+    if test is not None:
+        v = test.get("telemetry?")
+        if v is not None:
+            return bool(v)
+    return env_enabled(True)
+
+
+def active() -> "Recorder | None":
+    """The currently-installed recorder, or None."""
+    return _RECORDER
+
+
+class Recorder:
+    """Appends events to ``<dir>/telemetry.jsonl``; ``close()`` rolls them
+    up into ``<dir>/telemetry.json``.  Thread-safe (checkers run composed
+    in a thread pool).
+
+    A new recording TRUNCATES any previous telemetry.jsonl in the
+    directory: the jsonl is the rollup's source of truth, so re-analyzing
+    a stored run must replace the stream, not append a second one the
+    summarizer would double-count."""
+
+    def __init__(self, directory: Path | str):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.path = self.dir / "telemetry.jsonl"
+        self.events: list[dict] = []
+        self.summary: dict | None = None
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self.emit({"type": "meta", "version": 1, "wall-clock": time.time(),
+                   "pid": os.getpid()})
+
+    def now(self) -> float:
+        """Seconds since the recording opened (monotonic)."""
+        return time.monotonic() - self._t0
+
+    def emit(self, ev: dict) -> None:
+        line = json.dumps(ev, separators=(",", ":"), default=str)
+        with self._lock:
+            self.events.append(ev)
+            self._fh.write(line + "\n")
+
+    def close(self) -> dict:
+        with self._lock:
+            self._fh.flush()
+            self._fh.close()
+        self.summary = summarize(self.events)
+        tmp = self.dir / "telemetry.json.tmp"
+        tmp.write_text(json.dumps(self.summary, indent=1, default=str))
+        os.replace(tmp, self.dir / "telemetry.json")
+        return self.summary
+
+
+@contextlib.contextmanager
+def recording(directory: Path | str | None, *, enabled: bool = True):
+    """Install a process-global recorder writing into ``directory``.
+
+    Nesting passes through: when a recording is already active (run_test's
+    covers analyze's), the inner call yields the outer recorder and closes
+    nothing — spans just keep accumulating into the one file.  With
+    ``enabled=False`` (or no directory) nothing is installed and nothing
+    is written.
+    """
+    global _RECORDER
+    if not enabled or directory is None:
+        yield _RECORDER
+        return
+    if _RECORDER is not None:
+        yield _RECORDER
+        return
+    r = Recorder(directory)
+    _RECORDER = r
+    try:
+        yield r
+    finally:
+        _RECORDER = None
+        r.close()
+
+
+class _NoopSpan:
+    """The disabled fast path: one shared instance, no state, no writes."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_r", "name", "attrs", "_start")
+
+    def __init__(self, r: Recorder, name: str, attrs: dict):
+        self._r = r
+        self.name = name
+        self.attrs = attrs
+        self._start = 0.0
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span (verdicts, counts)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        stack = getattr(_STACK, "spans", None)
+        if stack is None:
+            stack = _STACK.spans = []
+        stack.append(self)
+        self._start = self._r.now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = self._r.now() - self._start
+        stack = getattr(_STACK, "spans", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        parent = stack[-1].name if stack else None
+        ev: dict[str, Any] = {
+            "type": "span", "name": self.name, "t": round(self._start, 6),
+            "dur": round(dur, 6),
+        }
+        if parent is not None:
+            ev["parent"] = parent
+        if exc_type is not None:
+            ev["err"] = exc_type.__name__
+        if self.attrs:
+            ev["attrs"] = self.attrs
+        self._r.emit(ev)
+        return False
+
+
+def span(name: str, **attrs):
+    """Time a region: ``with obs.span("phase.analyze", n=3) as sp: ...``.
+    Returns the shared no-op singleton when telemetry is off."""
+    r = _RECORDER
+    if r is None:
+        return NOOP_SPAN
+    return _Span(r, name, attrs)
+
+
+def span_event(name: str, seconds: float, **attrs) -> None:
+    """Emit an already-measured span directly (for regions with multiple
+    exit paths where a context manager would force restructuring).  The
+    event is identical to a ``span()`` one, minus the parent link."""
+    r = _RECORDER
+    if r is None:
+        return
+    now = r.now()
+    ev: dict[str, Any] = {
+        "type": "span", "name": name,
+        "t": round(max(0.0, now - seconds), 6), "dur": round(seconds, 6),
+    }
+    if attrs:
+        ev["attrs"] = attrs
+    r.emit(ev)
+
+
+def counter(name: str, n: int = 1, **attrs) -> None:
+    """Accumulate a count (summed per name in the summary)."""
+    r = _RECORDER
+    if r is None:
+        return
+    ev: dict[str, Any] = {"type": "counter", "name": name,
+                          "t": round(r.now(), 6), "n": n}
+    if attrs:
+        ev["attrs"] = attrs
+    r.emit(ev)
+
+
+def gauge(name: str, value, **attrs) -> None:
+    """Record a point-in-time value (last write per name wins in the
+    summary; every sample stays in the JSONL)."""
+    r = _RECORDER
+    if r is None:
+        return
+    ev: dict[str, Any] = {"type": "gauge", "name": name,
+                          "t": round(r.now(), 6), "value": value}
+    if attrs:
+        ev["attrs"] = attrs
+    r.emit(ev)
+
+
+def event(name: str, **attrs) -> None:
+    """A bare structured event (kept in the JSONL, not summarized)."""
+    r = _RECORDER
+    if r is None:
+        return
+    ev: dict[str, Any] = {"type": "event", "name": name,
+                          "t": round(r.now(), 6)}
+    if attrs:
+        ev["attrs"] = attrs
+    r.emit(ev)

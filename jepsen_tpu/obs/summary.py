@@ -1,0 +1,188 @@
+"""Roll a telemetry event stream up into the ``telemetry.json`` summary.
+
+The summary is the artifact the web UI's run page, ``bench.py``'s JSON
+line, and ``tools/trace_summarize.py`` all render: a fixed shape that
+later perf PRs report against.
+
+  {"version": 1,
+   "wall_s":   <last event end, seconds since recording start>,
+   "phases":   [{"phase", "wall_s", "count"}, ...]      # phase.* spans
+   "checkers": [{"checker", "seconds", "count", "valid"}, ...]
+   "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
+                 "resolved", "refuted", "unknowns_remaining",
+                 "launches", "compile_launches", "compile_s",
+                 "execute_s", "peak_frontier", "lossy"}, ...]
+   "counters": {name: total}
+   "gauges":   {name: last value}
+   "spans":    {name: {"count", "total_s", "max_s"}}}
+
+The ladder table mirrors parallel.batch_analysis's capacity-escalation
+stages: one row per rung with the quantities the beam-search literature
+instruments (frontier occupancy, truncation/loss, per-stage utilization)
+plus the compile-vs-execute split ("compile_s" sums launches that hit a
+fresh (engine, shape) bucket — compile + first execute; "execute_s" sums
+warm launches).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+#: ladder.stage span attributes copied verbatim into the stage table.
+_STAGE_KEYS = (
+    "resolved", "refuted", "unknowns_remaining", "launches",
+    "compile_launches", "compile_s", "execute_s", "peak_frontier", "lossy",
+)
+
+
+def _r(x: float) -> float:
+    return round(float(x), 6)
+
+
+def summarize(events: Iterable[Mapping]) -> dict:
+    spans: dict[str, dict] = {}
+    phases: list[dict] = []
+    phase_by_name: dict[str, dict] = {}
+    checkers: dict[str, dict] = {}
+    ladder: list[dict] = []
+    counters: dict[str, float] = {}
+    gauges: dict[str, object] = {}
+    wall = 0.0
+    for ev in events:
+        et = ev.get("type")
+        t = float(ev.get("t") or 0.0)
+        if et == "span":
+            name = str(ev.get("name"))
+            dur = float(ev.get("dur") or 0.0)
+            wall = max(wall, t + dur)
+            s = spans.setdefault(name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += dur
+            s["max_s"] = max(s["max_s"], dur)
+            attrs = ev.get("attrs") or {}
+            if name.startswith("phase."):
+                p = phase_by_name.get(name)
+                if p is None:
+                    p = phase_by_name[name] = {
+                        "phase": name[len("phase."):], "wall_s": 0.0, "count": 0,
+                    }
+                    phases.append(p)  # first-seen order = lifecycle order
+                p["wall_s"] += dur
+                p["count"] += 1
+                if ev.get("err"):
+                    p["error"] = ev["err"]
+            elif name == "checker.check":
+                cn = str(attrs.get("checker", "?"))
+                c = checkers.setdefault(
+                    cn, {"checker": cn, "seconds": 0.0, "count": 0, "valid": None}
+                )
+                c["seconds"] += dur
+                c["count"] += 1
+                if "valid" in attrs:
+                    c["valid"] = attrs["valid"]
+                if ev.get("err"):
+                    c["error"] = ev["err"]
+            elif name == "ladder.stage":
+                row = {
+                    "stage": attrs.get("stage"),
+                    "engine": attrs.get("engine"),
+                    "capacity": attrs.get("capacity"),
+                    "lanes": attrs.get("lanes"),
+                    "seconds": _r(dur),
+                }
+                for k in _STAGE_KEYS:
+                    if k in attrs:
+                        row[k] = attrs[k]
+                ladder.append(row)
+        elif et == "counter":
+            wall = max(wall, t)
+            name = str(ev.get("name"))
+            counters[name] = counters.get(name, 0) + (ev.get("n") or 1)
+        elif et == "gauge":
+            wall = max(wall, t)
+            gauges[str(ev.get("name"))] = ev.get("value")
+    for p in phases:
+        p["wall_s"] = _r(p["wall_s"])
+    out_checkers = sorted(checkers.values(), key=lambda c: -c["seconds"])
+    for c in out_checkers:
+        c["seconds"] = _r(c["seconds"])
+    ladder.sort(key=lambda r: (r["stage"] is None, r["stage"]))
+    for name, s in spans.items():
+        s["total_s"] = _r(s["total_s"])
+        s["max_s"] = _r(s["max_s"])
+    return {
+        "version": 1,
+        "wall_s": _r(wall),
+        "phases": phases,
+        "checkers": out_checkers,
+        "ladder": ladder,
+        "counters": counters,
+        "gauges": gauges,
+        "spans": spans,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Text rendering (tools/trace_summarize.py and profile scripts)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_row(cells, widths) -> str:
+    return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+
+def _table(headers: list[str], rows: list[list]) -> str:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+        for i, h in enumerate(headers)
+    ]
+    lines = [_fmt_row(headers, widths), _fmt_row(["-" * w for w in widths], widths)]
+    lines += [_fmt_row(r, widths) for r in rows]
+    return "\n".join(lines)
+
+
+def format_summary(summary: Mapping) -> str:
+    """Human-readable phase / checker / ladder tables for a summary dict."""
+    parts: list[str] = [f"telemetry summary (wall {summary.get('wall_s', 0)} s)"]
+    if summary.get("phases"):
+        parts.append("\nphases:")
+        parts.append(_table(
+            ["phase", "wall_s", "count"],
+            [[p["phase"], p["wall_s"], p["count"]] for p in summary["phases"]],
+        ))
+    if summary.get("checkers"):
+        parts.append("\ncheckers:")
+        parts.append(_table(
+            ["checker", "seconds", "count", "valid?"],
+            [[c["checker"], c["seconds"], c["count"], c.get("valid")]
+             for c in summary["checkers"]],
+        ))
+    if summary.get("ladder"):
+        headers = ["stage", "engine", "capacity", "lanes", "seconds",
+                   "resolved", "refuted", "unknowns", "launches",
+                   "compile_s", "execute_s", "peak", "lossy"]
+        rows = []
+        for r in summary["ladder"]:
+            rows.append([
+                r.get("stage"), r.get("engine"), r.get("capacity"),
+                r.get("lanes"), r.get("seconds"), r.get("resolved", ""),
+                r.get("refuted", ""), r.get("unknowns_remaining", ""),
+                r.get("launches", ""), r.get("compile_s", ""),
+                r.get("execute_s", ""), r.get("peak_frontier", ""),
+                r.get("lossy", ""),
+            ])
+        parts.append("\nladder stages:")
+        parts.append(_table(headers, rows))
+    if summary.get("counters"):
+        parts.append("\ncounters:")
+        parts.append(_table(
+            ["counter", "total"],
+            [[k, v] for k, v in sorted(summary["counters"].items())],
+        ))
+    if summary.get("gauges"):
+        parts.append("\ngauges:")
+        parts.append(_table(
+            ["gauge", "value"],
+            [[k, v] for k, v in sorted(summary["gauges"].items())],
+        ))
+    return "\n".join(parts) + "\n"

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import bisect
 import functools
+import time
 from typing import Sequence
 
 import numpy as np
@@ -54,6 +55,7 @@ import jax.numpy as jnp
 
 from jepsen_tpu import history as h
 from jepsen_tpu import models as m
+from jepsen_tpu import obs
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.models import tensor as tmodels
 from jepsen_tpu.ops.hashing import (
@@ -826,6 +828,18 @@ def chunked_analysis(
     peak_g = 1
     verified = 0
     launches = 0
+    t0 = time.perf_counter()
+
+    def _emit(valid, stats: dict) -> None:
+        """One telemetry span per chunked run: the frontier-sweep stats the
+        beam-search literature instruments (occupancy, loss, escalations)."""
+        obs.span_event(
+            "wgl.chunked", time.perf_counter() - t0, valid=valid,
+            chunks=stats.get("chunks"), launches=stats.get("launches"),
+            peak_frontier=stats.get("frontier-peak"),
+            capacity=stats.get("capacity"), lossy=stats.get("lossy?"),
+            verified_barriers=stats.get("verified-barriers"),
+        )
 
     for lo, hi in bounds:
         Bc = 1 << max(5, (hi - lo - 1).bit_length())
@@ -874,10 +888,13 @@ def chunked_analysis(
             failed_at, lossy, peak = int(failed_at), bool(lossy), int(peak)
             peak_g = max(peak_g, peak)
             if lossy and idx + 1 < len(caps):
+                obs.counter("wgl.chunk.escalations")
                 idx += 1  # re-run THIS chunk wider, from the same frontier
                 continue
             break
         lossy_any |= trunc  # input truncation of the ACCEPTED attempt
+        if trunc:
+            obs.counter("wgl.frontier.truncations")
         stats = {
             "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": lossy or lossy_any,
             "chunks": len(bounds), "launches": launches,
@@ -893,6 +910,7 @@ def chunked_analysis(
             # were witnessed
             stats["witnessed-barriers"] = gb
             if lossy or lossy_any:
+                _emit("unknown", stats)
                 return {
                     "valid?": "unknown",
                     "cause": "frontier capacity or closure rounds exhausted",
@@ -902,6 +920,7 @@ def chunked_analysis(
             res = {"valid?": False, "op": op, "kernel": stats}
             if fast:
                 res["provisional?"] = True  # hash-decided kills
+            _emit(False, stats)
             return res
         lossy_any |= lossy
         if not lossy_any:
@@ -918,6 +937,7 @@ def chunked_analysis(
         "chunks": len(bounds), "launches": launches, "verified-barriers": verified,
         "witnessed-barriers": B0,  # the survivor IS the whole-history witness
     }
+    _emit(True, stats)
     return {"valid?": True, "kernel": stats}
 
 
@@ -1401,6 +1421,7 @@ def analysis_async(
     B = packed["B"]
     T = int(ticks) if ticks is not None else async_ticks(B)
     F, W, G = int(capacity), packed["W"], packed["G"]
+    t0 = time.perf_counter()
     bptr0, st0, fo0, fc0, al0 = fresh_frontier(
         1, F, W, G, [packed["init_state"]]
     )
@@ -1429,6 +1450,10 @@ def analysis_async(
     failed_at = int(failed_at)
     lossy = bool(lossy)
     stats = {"frontier-peak": int(peak), "capacity": int(capacity), "ticks": T, "lossy?": lossy}
+    obs.span_event(
+        "wgl.async", time.perf_counter() - t0, valid=valid, lossy=lossy,
+        peak_frontier=int(peak), capacity=int(capacity), ticks=T,
+    )
     if valid:
         return {"valid?": True, "kernel": stats}
     if not lossy:

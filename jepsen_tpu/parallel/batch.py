@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import time
 from concurrent.futures import ProcessPoolExecutor
 from concurrent.futures.process import BrokenProcessPool
 from typing import Sequence
@@ -24,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from jepsen_tpu import _confirm_worker
+from jepsen_tpu import _confirm_worker, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.ops import wgl
@@ -39,6 +40,13 @@ _CONFIRM_POOL: ProcessPoolExecutor | None = None
 
 #: one-shot flag for the exact_escalation=None behavior-change warning.
 _WARNED_EXACT_DEFAULT = False
+
+#: (step, engine, shape...) buckets already launched this process — a
+#: launch whose bucket is fresh pays jit trace+compile (the runner caches
+#: in ops/wgl.py key on the same step + static shapes), so its wall time
+#: lands in the telemetry stage table's compile_s column (compile + first
+#: execute); warm buckets land in execute_s.
+_SEEN_SHAPES: set[tuple] = set()
 
 
 #: exact-engine frontier rows per launch (sub-batch bound; see the stage
@@ -239,6 +247,7 @@ def batch_analysis(
     results: list[dict | None] = [None] * len(histories)
     packs: list[dict] = []
     idxs: list[int] = []
+    t_pack = time.perf_counter()
     for i, hist in enumerate(histories):
         try:
             p = wgl.pack(model, hist)
@@ -250,6 +259,10 @@ def batch_analysis(
         else:
             packs.append(p)
             idxs.append(i)
+    obs.span_event(
+        "ladder.pack", time.perf_counter() - t_pack,
+        histories=len(histories), tensorizable=len(packs),
+    )
 
     if engine not in ("sync", "async"):
         raise ValueError(f"unknown engine {engine!r}; expected 'sync' or 'async'")
@@ -280,8 +293,44 @@ def batch_analysis(
                 stacklevel=2,
             )
     exact_caps = [int(c) for c in (exact_escalation or ())]
+
+    #: per-stage launch accounting for the telemetry stage table; "_key"
+    #: is the launched (engine, shape) bucket, set at each runner site.
+    launch_acc: dict = {}
+
+    def _reset_launch_acc() -> None:
+        launch_acc.update(
+            launches=0, compile_launches=0, compile_s=0.0, execute_s=0.0
+        )
+
+    _reset_launch_acc()
+
     def _launch(st_engine: str, batch_cap: int, sub: list[dict],
                 sub_resumes: list[tuple | None] | None = None):
+        """Instrumented wrapper over the kernel launch: times the launch,
+        classifies it compile (fresh shape bucket) vs execute, and emits a
+        ladder.launch telemetry span."""
+        with obs.span(
+            "ladder.launch", engine=st_engine, capacity=batch_cap, lanes=len(sub)
+        ) as sp:
+            t0 = time.perf_counter()
+            out = _launch_impl(st_engine, batch_cap, sub, sub_resumes)
+            dt = time.perf_counter() - t0
+            key = launch_acc.pop("_key", None)
+            compiled = key is not None and key not in _SEEN_SHAPES
+            if key is not None:
+                _SEEN_SHAPES.add(key)
+            launch_acc["launches"] += 1
+            if compiled:
+                launch_acc["compile_launches"] += 1
+                launch_acc["compile_s"] += dt
+            else:
+                launch_acc["execute_s"] += dt
+            sp.set(compiled=compiled)
+        return out
+
+    def _launch_impl(st_engine: str, batch_cap: int, sub: list[dict],
+                     sub_resumes: list[tuple | None] | None = None):
         """Stack ``sub`` to common bucket shapes and run one vmapped
         kernel launch; returns (valid, failed_at, lossy, peak, snap)
         with host arrays of len(sub).  ``sub_resumes[j]`` optionally
@@ -342,6 +391,7 @@ def batch_analysis(
                 axis = mesh.axis_names[0]
                 spec = NamedSharding(mesh, PartitionSpec(axis))
                 g_args[1] = jax.device_put(np.asarray(g_args[1]), spec)
+            launch_acc["_key"] = (sub[0]["step"], "greedy", B, P, G, W, n_pad)
             runner = wgl.greedy_runner(sub[0]["step"], B, P, G, W)
             finished, _stuck_at, _fired = runner(*g_args)
             finished = np.asarray(finished)[:n]
@@ -390,6 +440,7 @@ def batch_analysis(
                 spec = NamedSharding(mesh, PartitionSpec(axis))
                 for ai in range(6):
                     a_args[ai] = jax.device_put(np.asarray(a_args[ai]), spec)
+            launch_acc["_key"] = (sub[0]["step"], "async", batch_cap, T, B, P, G, W, n_pad)
             runner = wgl.async_runner(sub[0]["step"], batch_cap, T, B, P, G, W)
             valid, failed_at, lossy, peak, bsnap, sst, sfo, sfc, sal = runner(*a_args)
             if carry_frontier:
@@ -398,9 +449,11 @@ def batch_analysis(
                 # async rung exists to resume on)
                 snap = (bsnap, sst, sfo, sfc, sal)
         elif st_engine == "sync":
+            launch_acc["_key"] = (sub[0]["step"], "sync", batch_cap, int(rounds), B, P, G, W, n_pad)
             runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
             valid, failed_at, lossy, peak = runner(*args)
         else:  # "exact": content-compare dedup/domination — may refute
+            launch_acc["_key"] = (sub[0]["step"], "exact", batch_cap, int(rounds), B, P, G, W, n_pad)
             runner = wgl.exact_batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
             valid, failed_at, lossy, peak = runner(*args)
         return (
@@ -409,6 +462,18 @@ def batch_analysis(
             np.asarray(lossy)[:n],
             np.asarray(peak)[:n],
             snap,
+        )
+
+    def _emit_stage(t_stage: float, stage_attrs: dict, **extra) -> None:
+        """One ladder.stage telemetry span per rung: wall time, lanes in,
+        verdict counts, and the stage's compile/execute launch split."""
+        obs.span_event(
+            "ladder.stage", time.perf_counter() - t_stage,
+            launches=launch_acc["launches"],
+            compile_launches=launch_acc["compile_launches"],
+            compile_s=round(launch_acc["compile_s"], 6),
+            execute_s=round(launch_acc["execute_s"], 6),
+            **stage_attrs, **extra,
         )
 
     stages = [(engine, c) for c in batch_caps] + [("exact", c) for c in exact_caps]
@@ -421,6 +486,11 @@ def batch_analysis(
     for si, (st_engine, batch_cap) in enumerate(stages):
         if not pending:
             break
+        _reset_launch_acc()
+        t_stage = time.perf_counter()
+        stage_attrs = dict(
+            stage=si, engine=st_engine, capacity=batch_cap, lanes=len(pending)
+        )
         # Measured-shape guard (round 5): the batched exact runner
         # faults the TPU worker on long-scan x wide-frontier shapes
         # (boundary table in wgl.exact_scan_safe).  Lanes past the
@@ -451,6 +521,7 @@ def batch_analysis(
                 )
             pending = safe
             if not pending:
+                _emit_stage(t_stage, stage_attrs, unknowns_remaining=0)
                 continue
         # Bound total frontier rows per launch so wide-capacity stages
         # sub-batch instead of faulting the TPU worker (observed at
@@ -512,12 +583,15 @@ def batch_analysis(
             np.concatenate([o[i] for o in outs]) for i in range(4)
         )
         still = []
+        n_true = n_refuted = 0
         for j, k in enumerate(pending):
             i = idxs[k]
             stats = {"frontier-peak": int(peak[j]), "capacity": batch_cap, "lossy?": bool(lossy[j])}
             if failed_at[j] < 0 and valid[j]:
+                n_true += 1
                 results[i] = {"valid?": True, "kernel": stats}
             elif failed_at[j] >= 0 and not lossy[j]:
+                n_refuted += 1
                 op_pos = int(packs[k]["bar_opid"][int(failed_at[j])])
                 op = histories[i][op_pos]
                 res = {"valid?": False, "op": op, "kernel": stats}
@@ -544,7 +618,8 @@ def batch_analysis(
                         confirm_workers, model, list(histories[i]),
                         confirm_max_configs, op_pos,
                     )
-                    confirm_futs[i] = (pool, fut, res)
+                    obs.counter("confirm.submitted")
+                    confirm_futs[i] = (pool, fut, res, time.perf_counter())
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
@@ -554,6 +629,38 @@ def batch_analysis(
                     "kernel": stats,
                 }
         pending = still
+        _emit_stage(
+            t_stage, stage_attrs, resolved=n_true, refuted=n_refuted,
+            unknowns_remaining=len(still), peak_frontier=int(peak.max()),
+            lossy=int(lossy.sum()),
+        )
+        obs.gauge(
+            "ladder.unknowns_remaining", len(still), stage=si, capacity=batch_cap
+        )
+
+    if pending:
+        # The lanes the whole ladder failed to resolve: close the
+        # documented "extra unknowns with no runtime signal" gap — a final
+        # gauge plus an attributable cause in each unknown result (these
+        # are exactly the lanes a pre-round-3 implicit exact stage might
+        # have resolved when cpu_fallback is off).
+        obs.gauge("ladder.unknowns_remaining", len(pending), final=True)
+        if exact_caps:
+            note = (
+                f"capacity ladder {tuple(batch_caps)} and exact escalation "
+                f"{tuple(exact_caps)} exhausted"
+            )
+        else:
+            note = (
+                f"capacity ladder {tuple(batch_caps)} exhausted with no "
+                "exact-escalation stages (exact_escalation=None means none "
+                "since round 3)"
+            )
+        for k in pending:
+            i = idxs[k]
+            r = results[i]
+            if r is not None and r.get("valid?") == "unknown" and r.get("cause"):
+                r["cause"] = f"{r['cause']}; {note}"
 
     device_resolved: set[int] = set()
 
@@ -582,6 +689,8 @@ def batch_analysis(
         # so (modulo the ~1e-13 hash-collision case) the true frontier
         # fit its capacity; a surviving or lossy exact run IS that rare
         # case and falls back to the exact CPU sweep.
+        _reset_launch_acc()
+        t_conf = time.perf_counter()
         by_cap: dict[int, list[tuple]] = {}
         for k, fat, cap, res in device_confirms:
             by_cap.setdefault(cap, []).append((k, fat, res))
@@ -620,8 +729,14 @@ def batch_analysis(
                     group[s0 : s0 + lanes_cap], gvalid, gfailed, glossy
                 ):
                     _finish_confirmation(k, fat, res, f2 >= 0 and not lz)
+        obs.span_event(
+            "ladder.confirm.device", time.perf_counter() - t_conf,
+            refutations=len(device_confirms), launches=launch_acc["launches"],
+        )
 
     if cpu_fallback:
+        t_fb = time.perf_counter()
+        n_fb = 0
         for i, r in enumerate(results):
             if (r is not None and r["valid?"] == "unknown"
                     and i not in confirm_futs and i not in device_resolved):
@@ -629,9 +744,15 @@ def batch_analysis(
                 # exponential on exactly the histories that overflow the
                 # kernel (info-heavy invalid ones); the sweep is the same
                 # frontier algorithm the kernel runs and degrades linearly.
+                n_fb += 1
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
+        if n_fb:
+            obs.span_event(
+                "ladder.cpu-fallback", time.perf_counter() - t_fb, histories=n_fb
+            )
 
-    for i, (pool, fut, dev_res) in confirm_futs.items():
+    t_drain = time.perf_counter()
+    for i, (pool, fut, dev_res, t_submit) in confirm_futs.items():
         try:
             if fut is None:
                 raise BrokenProcessPool("no confirmation worker available")
@@ -671,5 +792,16 @@ def batch_analysis(
                     "kernel": dev_res.get("kernel"),
                 }
             continue
+        # Queue latency: submit-to-resolution — how much of the sweep ran
+        # concurrently with the remaining ladder stages vs in the drain.
+        obs.gauge(
+            "confirm.queue_latency_s",
+            round(time.perf_counter() - t_submit, 6), history=i,
+        )
         results[i] = _resolve_confirmation(dev_res, cpu_res)
+    if confirm_futs:
+        obs.span_event(
+            "ladder.confirm.drain", time.perf_counter() - t_drain,
+            confirmations=len(confirm_futs),
+        )
     return [r if r is not None else {"valid?": "unknown"} for r in results]

@@ -122,6 +122,64 @@ def _safe_resolve(base: Path, rel: str) -> Path | None:
     return None
 
 
+def _telemetry_table(headers: list, rows: list[list]) -> str:
+    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in r) + "</tr>"
+        for r in rows
+    )
+    return (
+        "<table style='border-collapse:collapse;margin-bottom:12px'>"
+        f"<tr>{head}</tr>{body}</table>"
+    )
+
+
+def telemetry_html(run_dir: Path) -> str:
+    """The run page's phase / checker / ladder-stage timing tables,
+    rendered from the run's ``telemetry.json`` (the obs.summary rollup).
+    Empty string when the run carries no telemetry."""
+    p = Path(run_dir) / "telemetry.json"
+    if not p.exists():
+        return ""
+    try:
+        s = json.loads(p.read_text())
+    except (OSError, ValueError):
+        return ""
+    parts = [f"<h2>telemetry</h2><p>total wall: {s.get('wall_s', 0)} s</p>"]
+    if s.get("phases"):
+        parts.append("<h3>phases</h3>")
+        parts.append(_telemetry_table(
+            ["phase", "wall (s)", "count"],
+            [[p_["phase"], p_["wall_s"], p_["count"]] for p_ in s["phases"]],
+        ))
+    if s.get("checkers"):
+        parts.append("<h3>checkers</h3>")
+        parts.append(_telemetry_table(
+            ["checker", "seconds", "count", "valid?"],
+            [[c["checker"], c["seconds"], c["count"], c.get("valid")]
+             for c in s["checkers"]],
+        ))
+    if s.get("ladder"):
+        parts.append("<h3>ladder stages</h3>")
+        parts.append(_telemetry_table(
+            ["stage", "engine", "capacity", "lanes", "seconds", "resolved",
+             "refuted", "unknowns left", "launches", "compile (s)",
+             "execute (s)", "peak frontier", "lossy"],
+            [[r.get("stage"), r.get("engine"), r.get("capacity"),
+              r.get("lanes"), r.get("seconds"), r.get("resolved", ""),
+              r.get("refuted", ""), r.get("unknowns_remaining", ""),
+              r.get("launches", ""), r.get("compile_s", ""),
+              r.get("execute_s", ""), r.get("peak_frontier", ""),
+              r.get("lossy", "")] for r in s["ladder"]],
+        ))
+    if s.get("counters"):
+        parts.append("<h3>counters</h3>")
+        parts.append(_telemetry_table(
+            ["counter", "total"], sorted(s["counters"].items())
+        ))
+    return "".join(parts)
+
+
 class Handler(BaseHTTPRequestHandler):
     store_dir = None
 
@@ -154,7 +212,18 @@ class Handler(BaseHTTPRequestHandler):
                         f"{html.escape(e.name)}</a></li>"
                         for e in entries
                     )
-                    self._send(200, f"<html><body><ul>{items}</ul></body></html>".encode())
+                    # The run page: a run dir with telemetry renders its
+                    # phase/stage timing tables above the file listing.
+                    tele = telemetry_html(target)
+                    self._send(
+                        200,
+                        (
+                            "<html><head><style>body{font-family:sans-serif}"
+                            "td,th{padding:2px 10px;text-align:left;"
+                            "border-bottom:1px solid #ddd}</style></head>"
+                            f"<body>{tele}<ul>{items}</ul></body></html>"
+                        ).encode(),
+                    )
                 else:
                     guessed, _ = mimetypes.guess_type(str(target))
                     if guessed is None or guessed.startswith("text/"):

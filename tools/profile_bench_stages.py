@@ -3,9 +3,16 @@
 Replays bench.py's exact workload through batch_analysis with variant
 kwargs to isolate the ladder stages and the confirmation drain.  Run on
 the real chip.
+
+Reference consumer of the obs telemetry API: each variant's best run is
+recorded through jepsen_tpu.obs, and its ladder-stage table (per-rung
+wall time, compile/execute split, unknowns remaining) prints below the
+headline number — the structured replacement for the ad-hoc timers the
+pre-obs version of this script carried.
 """
 
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -14,6 +21,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from genhist import corrupt, valid_register_history
 from jepsen_tpu import models as m
+from jepsen_tpu import obs
+from jepsen_tpu.obs.summary import format_summary
 from jepsen_tpu.ops import wgl
 from jepsen_tpu.parallel import batch as pbatch
 
@@ -32,7 +41,7 @@ def main():
     pbatch.warm_confirm_pool()
 
     t0 = time.perf_counter()
-    packs = [wgl.pack(model, hh) for hh in hists]
+    [wgl.pack(model, hh) for hh in hists]
     print(f"{'pack x128 (host)':42s} {(time.perf_counter()-t0)*1e3:8.1f} ms")
 
     for label, kw in [
@@ -44,14 +53,25 @@ def main():
         kw.setdefault("cpu_fallback", False)
         kw.setdefault("exact_escalation", ())
         pbatch.batch_analysis(model, hists, **kw)  # warm compile
+        # JEPSEN_TPU_TELEMETRY=0 keeps even the span emission out of the
+        # timed window (same toggle bench.py honors).
+        record = obs.env_enabled(True)
         best = None
+        best_summary = None
         for _ in range(3):
-            t0 = time.perf_counter()
-            rs = pbatch.batch_analysis(model, hists, **kw)
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
+            d = tempfile.mkdtemp(prefix="profile-stages-") if record else None
+            with obs.recording(d, enabled=record) as rec:
+                t0 = time.perf_counter()
+                rs = pbatch.batch_analysis(model, hists, **kw)
+                dt = time.perf_counter() - t0
+            if best is None or dt < best:
+                best = dt
+                best_summary = rec.summary if rec is not None else None
         unk = sum(1 for r in rs if r["valid?"] == "unknown")
         print(f"{label:42s} {best*1e3:8.1f} ms  unknowns={unk}")
+        if best_summary and best_summary.get("ladder"):
+            print(format_summary({"ladder": best_summary["ladder"],
+                                  "wall_s": best_summary["wall_s"]}))
 
 
 if __name__ == "__main__":

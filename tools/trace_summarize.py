@@ -1,0 +1,67 @@
+"""Pretty-print a telemetry.jsonl (or telemetry.json) as summary tables.
+
+The reference consumer of the obs API's on-disk artifacts: point it at a
+run's store directory (or either telemetry file directly) and it prints
+the same phase / checker / ladder-stage tables the web UI renders.
+
+  python tools/trace_summarize.py store/my-test/latest
+  python tools/trace_summarize.py store/my-test/2026.../telemetry.jsonl
+  python tools/trace_summarize.py --json telemetry.jsonl   # re-rolled summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jepsen_tpu.obs.summary import format_summary, summarize  # noqa: E402
+
+
+def load_summary(path: Path) -> dict:
+    """Resolve a run dir / telemetry.jsonl / telemetry.json into a summary
+    dict.  JSONL is always re-rolled (it is the source of truth; the .json
+    rollup may be stale after a crash)."""
+    path = Path(path)
+    if path.is_dir():
+        jsonl = path / "telemetry.jsonl"
+        rolled = path / "telemetry.json"
+        if jsonl.exists():
+            path = jsonl
+        elif rolled.exists():
+            path = rolled
+        else:
+            raise FileNotFoundError(f"no telemetry.jsonl/.json in {path}")
+    if path.suffix == ".jsonl":
+        events = [
+            json.loads(line)
+            for line in path.read_text().splitlines()
+            if line.strip()
+        ]
+        return summarize(events)
+    return json.loads(path.read_text())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run directory, telemetry.jsonl, or telemetry.json")
+    ap.add_argument("--json", action="store_true",
+                    help="print the rolled-up summary as JSON instead of tables")
+    opts = ap.parse_args(argv)
+    try:
+        summary = load_summary(Path(opts.path))
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if opts.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(format_summary(summary), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
