@@ -571,10 +571,20 @@ class CycleChecker(_ElleChecker):
     the typed checkers: host Tarjan by default (the measured winner at
     every single-chip shape — see CYCLE_BACKEND), the dense MXU closure
     when the device backend is opted in for graphs ≤ SCC_THRESHOLD.
+
+    ``backend`` pins this checker instance's routing ("host"|"device"),
+    matching the per-call ``backend`` on check_graph/check_graphs —
+    per-instance opt-in without mutating the CYCLE_BACKEND module
+    global.  None (the default) defers to CYCLE_BACKEND.
     """
 
-    def __init__(self, analyzer):
+    def __init__(self, analyzer, backend: str | None = None):
+        if backend is not None and backend not in ("host", "device"):
+            raise ValueError(
+                f"unknown cycle backend {backend!r}; expected 'host' or 'device'"
+            )
         self.analyzer = analyzer
+        self.backend = backend
 
     def check(self, test, history, opts):
         nodes, relations, explainer = self.analyzer(history)
@@ -599,7 +609,7 @@ class CycleChecker(_ElleChecker):
 
             def rel_of(a: int, b: int):
                 return rels.get((a, b))
-        flagged, cycle = self._find_cycle(adj, n)
+        flagged, cycle = self._find_cycle(adj, n, self.backend)
         if not flagged:
             res: dict[str, Any] = {"valid?": True}
         elif cycle is None:
@@ -639,12 +649,14 @@ class CycleChecker(_ElleChecker):
         return res
 
     @staticmethod
-    def _find_cycle(adj: np.ndarray, n: int) -> tuple[bool, list[int] | None]:
+    def _find_cycle(
+        adj: np.ndarray, n: int, backend: str | None = None
+    ) -> tuple[bool, list[int] | None]:
         """(cycle-flagged, witness-cycle-or-None); the witness node list
-        is unclosed."""
+        is unclosed.  ``backend`` routes like check_graph's."""
         if n == 0:
             return False, None
-        if _device_classify(n, None):
+        if _device_classify(n, backend):
             zeros = np.zeros_like(adj)
             flags, hints = cl.classify_graph(adj, zeros, zeros, zeros)
             if not flags["G0"]:
@@ -695,9 +707,9 @@ def realtime_analyzer(history):
     return nodes, {"realtime": adj}, explain
 
 
-def cycle_checker(analyzer) -> Checker:
+def cycle_checker(analyzer, backend: str | None = None) -> Checker:
     """The reference's ``jepsen.tests.cycle/checker`` entry point."""
-    return CycleChecker(analyzer)
+    return CycleChecker(analyzer, backend=backend)
 
 
 def list_append(**kw) -> Checker:

@@ -316,6 +316,7 @@ def sweep_analysis(
     history: Sequence[dict],
     max_configs: int = 200_000,
     stop_at_index: int | None = None,
+    stats: dict | None = None,
 ) -> dict:
     """Exhaustive configuration-set sweep with domination pruning — the
     algorithm the TPU kernel vectorizes (jepsen_tpu.ops.wgl), kept on CPU
@@ -326,11 +327,16 @@ def sweep_analysis(
     index): a genuine refutation dies by that barrier, so sweeping past
     it is wasted work.  Surviving past it means the device refutation was
     a hash-collision artifact — returned as "unknown" (the prefix proves
-    nothing about the suffix)."""
+    nothing about the suffix).
+
+    ``stats``: an optional dict the sweep fills with its work counters
+    (barriers, groups, configs_explored, peak_configs) — the same
+    attributes the telemetry span carries; bench.py's fixed-work metric
+    reads configs_explored from it."""
     with obs.span("wgl_cpu.sweep") as sp:
-        stats: dict = {}
-        out = _sweep_analysis(model, history, max_configs, stop_at_index, stats)
-        sp.set(valid=out.get("valid?"), **stats)
+        st: dict = {} if stats is None else stats
+        out = _sweep_analysis(model, history, max_configs, stop_at_index, st)
+        sp.set(valid=out.get("valid?"), **st)
         return out
 
 

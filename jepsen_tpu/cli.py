@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 from typing import Callable, Mapping
 
@@ -74,6 +75,11 @@ def add_test_opts(p: argparse.ArgumentParser):
                            "(default: on; env JEPSEN_TPU_TELEMETRY)")
     tele.add_argument("--no-telemetry", dest="telemetry", action="store_false",
                       help="disable telemetry recording for this run")
+    p.add_argument("--dedup-backend", choices=("sort", "bucket"), default=None,
+                   help="frontier dedup backend for the TPU checker's "
+                        "ladder rungs: 'sort' (multi-key hash sort) or "
+                        "'bucket' (packed radix buckets); default: env "
+                        "JEPSEN_TPU_DEDUP_BACKEND, else 'sort'")
 
 
 def options_to_test_opts(opts: argparse.Namespace) -> dict:
@@ -248,6 +254,12 @@ def run_cli(
         level=logging.INFO,
         format="%(asctime)s %(levelname)-5s %(name)s: %(message)s",
     )
+    if getattr(opts, "dedup_backend", None):
+        # The checkers resolve the backend from this env var at call
+        # time (ops.hashing.resolve_dedup_backend), so the flag reaches
+        # every engine — batched ladder, chunked escalations, confirm
+        # launches — without threading through each test map.
+        os.environ["JEPSEN_TPU_DEDUP_BACKEND"] = opts.dedup_backend
     try:
         if opts.command == "test":
             return _cmd_test(test_fn, opts)

@@ -11,7 +11,9 @@ later perf PRs report against.
    "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
                  "resolved", "refuted", "unknowns_remaining",
                  "launches", "compile_launches", "compile_s",
-                 "execute_s", "peak_frontier", "lossy"}, ...]
+                 "execute_s", "peak_frontier", "lossy", "dedup"}, ...]
+   "dedup":    [{"backend", "candidates", "capacity", "probes",
+                 "per_round_us"}, ...]                  # dedup.round spans
    "counters": {name: total}
    "gauges":   {name: last value}
    "spans":    {name: {"count", "total_s", "max_s"}}}
@@ -32,6 +34,7 @@ from typing import Iterable, Mapping
 _STAGE_KEYS = (
     "resolved", "refuted", "unknowns_remaining", "launches",
     "compile_launches", "compile_s", "execute_s", "peak_frontier", "lossy",
+    "dedup",
 )
 
 
@@ -45,6 +48,7 @@ def summarize(events: Iterable[Mapping]) -> dict:
     phase_by_name: dict[str, dict] = {}
     checkers: dict[str, dict] = {}
     ladder: list[dict] = []
+    dedup: dict[tuple, dict] = {}
     counters: dict[str, float] = {}
     gauges: dict[str, object] = {}
     wall = 0.0
@@ -94,6 +98,22 @@ def summarize(events: Iterable[Mapping]) -> dict:
                     if k in attrs:
                         row[k] = attrs[k]
                 ladder.append(row)
+            elif name == "dedup.round":
+                # per-round dedup timing probes (ops.hashing.
+                # dedup_round_probe): one table row per (backend, shape),
+                # averaging repeated probes
+                key = (
+                    attrs.get("backend"), attrs.get("candidates"),
+                    attrs.get("capacity"),
+                )
+                d = dedup.setdefault(key, {
+                    "backend": attrs.get("backend"),
+                    "candidates": attrs.get("candidates"),
+                    "capacity": attrs.get("capacity"),
+                    "probes": 0, "_total_us": 0.0,
+                })
+                d["probes"] += 1
+                d["_total_us"] += float(attrs.get("per_round_us") or dur * 1e6)
         elif et == "counter":
             wall = max(wall, t)
             name = str(ev.get("name"))
@@ -107,6 +127,11 @@ def summarize(events: Iterable[Mapping]) -> dict:
     for c in out_checkers:
         c["seconds"] = _r(c["seconds"])
     ladder.sort(key=lambda r: (r["stage"] is None, r["stage"]))
+    out_dedup = []
+    for d in dedup.values():
+        d["per_round_us"] = round(d.pop("_total_us") / max(1, d["probes"]), 1)
+        out_dedup.append(d)
+    out_dedup.sort(key=lambda d: (str(d["backend"]), d["candidates"] or 0))
     for name, s in spans.items():
         s["total_s"] = _r(s["total_s"])
         s["max_s"] = _r(s["max_s"])
@@ -116,6 +141,7 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "phases": phases,
         "checkers": out_checkers,
         "ladder": ladder,
+        "dedup": out_dedup,
         "counters": counters,
         "gauges": gauges,
         "spans": spans,
@@ -160,7 +186,7 @@ def format_summary(summary: Mapping) -> str:
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",
                    "resolved", "refuted", "unknowns", "launches",
-                   "compile_s", "execute_s", "peak", "lossy"]
+                   "compile_s", "execute_s", "peak", "lossy", "dedup"]
         rows = []
         for r in summary["ladder"]:
             rows.append([
@@ -169,10 +195,18 @@ def format_summary(summary: Mapping) -> str:
                 r.get("refuted", ""), r.get("unknowns_remaining", ""),
                 r.get("launches", ""), r.get("compile_s", ""),
                 r.get("execute_s", ""), r.get("peak_frontier", ""),
-                r.get("lossy", ""),
+                r.get("lossy", ""), r.get("dedup", ""),
             ])
         parts.append("\nladder stages:")
         parts.append(_table(headers, rows))
+    if summary.get("dedup"):
+        parts.append("\ndedup rounds (per-round probe, sort vs bucket):")
+        parts.append(_table(
+            ["backend", "candidates", "capacity", "probes", "per_round_us"],
+            [[d.get("backend"), d.get("candidates"), d.get("capacity"),
+              d.get("probes"), d.get("per_round_us")]
+             for d in summary["dedup"]],
+        ))
     if summary.get("counters"):
         parts.append("\ncounters:")
         parts.append(_table(

@@ -61,6 +61,7 @@ from jepsen_tpu.models import tensor as tmodels
 from jepsen_tpu.ops.hashing import (
     frontier_update,
     frontier_update_fast,
+    resolve_dedup_backend,
 )
 
 
@@ -513,6 +514,7 @@ def _scan_chunk_core(
     grp_open,
     slot_lane,
     slot_onehot,
+    dedup: str = "sort",
 ):
     """Scan a frontier over a chunk of barriers, starting from an explicit
     frontier and returning the final one.
@@ -549,12 +551,13 @@ def _scan_chunk_core(
             # doubled the hot loop's prune cost for zero alive change).
             state2, fok2, fcr2, alive2, ovf, fp2, child = frontier_update_fast(
                 cat_state, cat_fok, cat_fcr, cat_alive, cost, F, n_parents=F,
-                max_count=xmov_f.shape[-1] + 1,
+                max_count=xmov_f.shape[-1] + 1, dedup_backend=dedup,
             )
             changed2 = (alive2 & child).any()
         else:
             state2, fok2, fcr2, alive2, ovf, fp2 = frontier_update(
-                cat_state, cat_fok, cat_fcr, cat_alive, cost, F
+                cat_state, cat_fok, cat_fcr, cat_alive, cost, F,
+                dedup_backend=dedup,
             )
             changed2 = ~(fp2 == fp).all()
         return (state2, fok2, fcr2, alive2, r + 1, changed2, lossy | ovf, fp2, xs)
@@ -643,6 +646,7 @@ def _run_core(
     grp_open,
     slot_lane,
     slot_onehot,
+    dedup: str = "sort",
 ):
     """Scan the frontier over all barriers from the initial single-config
     frontier.  Returns (any_alive, failed_at, lossy, peak_frontier)."""
@@ -656,24 +660,26 @@ def _run_core(
         bar_active, bar_f, bar_v1, bar_v2, bar_slot,
         mov_f, mov_v1, mov_v2, mov_open,
         grp_f, grp_v1, grp_v2, grp_open,
-        slot_lane, slot_onehot,
+        slot_lane, slot_onehot, dedup=dedup,
     )
     return alive.any(), failed_at, lossy, peak
 
 
 _run = functools.partial(
-    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast")
+    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast", "dedup")
 )(_run_core)
 
 _scan_chunk = functools.partial(
-    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast")
+    jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast", "dedup")
 )(_scan_chunk_core)
 
-#: (step, F, R, P, G, W) -> jitted vmapped runner over a leading batch axis.
+#: (step, F, R, P, G, W, fast, dedup) -> jitted vmapped runner over a
+#: leading batch axis.
 _BATCH_RUNNERS: dict = {}
 
 
-def batched_runner(step, F: int, R: int, P: int, G: int, W: int):
+def batched_runner(step, F: int, R: int, P: int, G: int, W: int,
+                   dedup: str = "sort"):
     """A jit(vmap(_run_core)) specialised to the given static shapes: checks
     a stack of same-shape packed histories in one device program (BASELINE
     config 4: hundreds of recorded histories vmapped across a slice).
@@ -682,26 +688,29 @@ def batched_runner(step, F: int, R: int, P: int, G: int, W: int):
     Uses the fast hash-lane frontier update: under vmap, multi-key sorts
     and full-table gathers dominate wall clock; stragglers that overflow
     its capacity escalate to the exact path or the CPU oracle
-    (jepsen_tpu.parallel.batch)."""
-    key = (step, F, R, P, G, W, True)
+    (jepsen_tpu.parallel.batch).  ``dedup`` selects the per-round dedup
+    backend (jepsen_tpu.ops.hashing, "sort"|"bucket")."""
+    key = (step, F, R, P, G, W, True, dedup)
     if key not in _BATCH_RUNNERS:
-        core = functools.partial(_run_core, step, F, R, P, G, W, True)
+        core = functools.partial(_run_core, step, F, R, P, G, W, True, dedup=dedup)
         axes = (0,) * 14 + (None, None)
         _BATCH_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
     return _BATCH_RUNNERS[key]
 
 
-def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int):
+def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int,
+                         dedup: str = "sort"):
     """jit(vmap(_run_core)) with the EXACT frontier update (sorted windowed
     (state, fok) compares + two-stage domination — kills are content
-    compares, never hash-identity).  One launch replaces the former Python
+    compares, never hash-identity, under BOTH dedup backends).  One launch
+    replaces the former Python
     loop of per-history exact escalations: every straggler and every
     fast-engine refutation confirms in the same vmapped program, so the
     escalation stage costs one launch instead of ~60% of bench wall clock
     (round-2 profile)."""
-    key = (step, F, R, P, G, W, False)
+    key = (step, F, R, P, G, W, False, dedup)
     if key not in _BATCH_RUNNERS:
-        core = functools.partial(_run_core, step, F, R, P, G, W, False)
+        core = functools.partial(_run_core, step, F, R, P, G, W, False, dedup=dedup)
         axes = (0,) * 14 + (None, None)
         _BATCH_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
     return _BATCH_RUNNERS[key]
@@ -729,9 +738,14 @@ def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
     same 4M rows at B = 4096 faults.  The grid was measured on
     SINGLE-lane launches; under vmap the live sort/domination buffers
     multiply by the lane count, so callers pass the launch's PADDED
-    lane count and the effective width ``lanes * capacity`` is tested
-    (conservative for multi-lane launches — the safe fallbacks cost
-    only time).  Callers must route shapes where this returns False to
+    lane count and the effective width ``lanes * capacity`` is tested.
+    NOTE the lanes x capacity product model is an INFERENCE from the
+    single-lane grid, not a measurement — no multi-lane fault point has
+    been observed to confirm it (round-5 advisor).  It is conservative
+    by construction, and the cost of that conservatism is routing, not
+    correctness: multi-lane launches that would in fact be safe are
+    sent to the chunked/async paths and pay only time (see PERF.md
+    "Honest limits").  Callers must route shapes where this returns False to
     the async engine (which executes them — see PERF.md) or to
     chunked_analysis (whose chunk scans keep B <= the chunk size, far
     below the cliff)."""
@@ -783,6 +797,7 @@ def chunked_analysis(
     rounds: int = 8,
     chunk_barriers: int = 512,
     fast: bool = False,
+    dedup_backend: str | None = None,
 ) -> dict:
     """Decide linearizability as a chain of chunk scans with a carried
     frontier (history decomposition — VERDICT round-2 item #2).
@@ -807,7 +822,11 @@ def chunked_analysis(
     are hash-decided, collision ~1e-13) and is marked ``provisional?``
     for the caller to confirm, the way batch_analysis confirms
     fast-engine refutations.
+
+    ``dedup_backend`` selects the per-round dedup backend for every
+    chunk scan (None → env/default via resolve_dedup_backend).
     """
+    dedup = resolve_dedup_backend(dedup_backend)
     B0 = packed["B"]
     quiet = packed["bar_quiet"]
     packed = pad_packed(packed, B=B0)  # bucket P/G; keep B for slicing
@@ -838,7 +857,7 @@ def chunked_analysis(
             chunks=stats.get("chunks"), launches=stats.get("launches"),
             peak_frontier=stats.get("frontier-peak"),
             capacity=stats.get("capacity"), lossy=stats.get("lossy?"),
-            verified_barriers=stats.get("verified-barriers"),
+            verified_barriers=stats.get("verified-barriers"), dedup=dedup,
         )
 
     for lo, hi in bounds:
@@ -882,7 +901,7 @@ def chunked_analysis(
                 packed["step"], F, int(rounds), P, G, W, fast,
                 jnp.asarray(st0), jnp.asarray(fo0), jnp.asarray(fc0),
                 jnp.asarray(al0), *c_args, *grp_args, c_grp_open,
-                slot_lane, slot_onehot,
+                slot_lane, slot_onehot, dedup=dedup,
             )
             launches += 1
             failed_at, lossy, peak = int(failed_at), bool(lossy), int(peak)
@@ -950,6 +969,7 @@ def analysis(
     max_procs: int = 128,
     chunk_barriers: int = 512,
     fast: bool = False,
+    dedup_backend: str | None = None,
 ) -> dict:
     """Decide linearizability on the accelerator.
 
@@ -977,7 +997,8 @@ def analysis(
         return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     return chunked_analysis(
-        model, history, packed, capacities, rounds, chunk_barriers, fast=fast
+        model, history, packed, capacities, rounds, chunk_barriers, fast=fast,
+        dedup_backend=dedup_backend,
     )
 
 
@@ -1032,6 +1053,7 @@ def _run_core_async(
     grp_open,
     slot_lane,
     slot_onehot,
+    dedup: str = "sort",
 ):
     """Lane-asynchronous barrier stepping.
 
@@ -1082,7 +1104,7 @@ def _run_core_async(
         )
         s2, fo2, fc2, a2, ovf, _fp, child = frontier_update_fast(
             cat_state, cat_fok, cat_fcr, cat_alive, cost, F, n_parents=F,
-            max_count=mov_f.shape[-1] + 1,
+            max_count=mov_f.shape[-1] + 1, dedup_backend=dedup,
         )
         # First overflow: snapshot the PRE-update frontier (exact: lossy
         # is still False) for the next ladder rung to resume from.
@@ -1153,23 +1175,26 @@ def _run_core_async(
 
 
 _run_async = functools.partial(
-    jax.jit, static_argnames=("step", "F", "T", "B", "P", "G", "W")
+    jax.jit, static_argnames=("step", "F", "T", "B", "P", "G", "W", "dedup")
 )(_run_core_async)
 
-#: (step, F, T, B, P, G, W) -> jitted vmapped async runner.
+#: (step, F, T, B, P, G, W, dedup) -> jitted vmapped async runner.
 _ASYNC_RUNNERS: dict = {}
 
 
-def async_runner(step, F: int, T: int, B: int, P: int, G: int, W: int):
+def async_runner(step, F: int, T: int, B: int, P: int, G: int, W: int,
+                 dedup: str = "sort"):
     """jit(vmap(_run_core_async)) — the batched async-tick checker.
 
     Batched inputs (leading lane axis): bptr0, state0, fok0, fcr0,
     alive0 (the resume frontier — see fresh_frontier for stage one),
     n_active, then the 12 barrier/mover/group tables; slot tables
-    broadcast."""
-    key = (step, F, T, B, P, G, W)
+    broadcast.  ``dedup`` selects the per-round dedup backend."""
+    key = (step, F, T, B, P, G, W, dedup)
     if key not in _ASYNC_RUNNERS:
-        core = functools.partial(_run_core_async, step, F, T, B, P, G, W)
+        core = functools.partial(
+            _run_core_async, step, F, T, B, P, G, W, dedup=dedup
+        )
         axes = (0,) * 18 + (None, None)
         _ASYNC_RUNNERS[key] = jax.jit(jax.vmap(core, in_axes=axes))
     return _ASYNC_RUNNERS[key]
@@ -1403,9 +1428,11 @@ def analysis_async(
     ticks: int | None = None,
     max_groups: int = 64,
     max_procs: int = 128,
+    dedup_backend: str | None = None,
 ) -> dict:
     """Single-history front-end for the async-tick kernel (testing and
     shape exploration; the batched path drives async_runner directly)."""
+    dedup = resolve_dedup_backend(dedup_backend)
     try:
         packed = pack(model, history)
     except NotTensorizable as e:
@@ -1445,6 +1472,7 @@ def analysis_async(
         packed["grp_open"],
         jnp.asarray(packed["slot_lane"]),
         jnp.asarray(packed["slot_onehot"]),
+        dedup=dedup,
     )
     valid = bool(valid)
     failed_at = int(failed_at)
@@ -1452,7 +1480,7 @@ def analysis_async(
     stats = {"frontier-peak": int(peak), "capacity": int(capacity), "ticks": T, "lossy?": lossy}
     obs.span_event(
         "wgl.async", time.perf_counter() - t0, valid=valid, lossy=lossy,
-        peak_frontier=int(peak), capacity=int(capacity), ticks=T,
+        peak_frontier=int(peak), capacity=int(capacity), ticks=T, dedup=dedup,
     )
     if valid:
         return {"valid?": True, "kernel": stats}

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jepsen_tpu import _confirm_worker, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
-from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops import hashing, wgl
 
 #: lazily created, reused across batch_analysis calls (spawn startup is
 #: ~seconds; the pool is harmless idle and dies with the process).
@@ -49,6 +49,12 @@ _WARNED_EXACT_DEFAULT = False
 _SEEN_SHAPES: set[tuple] = set()
 
 
+#: dedup shapes already probed this process (the telemetry-gated
+#: dedup.round probe at the end of batch_analysis): one probe per shape
+#: per process — repeated ladder runs don't re-pay the probe.
+_PROBED_DEDUP_SHAPES: set[tuple] = set()
+
+
 #: exact-engine frontier rows per launch (sub-batch bound; see the stage
 #: loop's budget comment — re-measure the true threshold on-chip).
 _EXACT_LANE_BUDGET = 16 * 1024
@@ -59,6 +65,20 @@ _EXACT_LANE_BUDGET = 16 * 1024
 #: stages on small workloads).
 _FAST_LANE_BUDGET = 64 * 1024
 _CARRY_LANE_BUDGET = 32 * 1024
+
+
+def _stays_pending(valid, fat, lossy) -> bool:
+    """Whether one lane's (valid, failed_at, lossy) launch outcome leaves
+    it PENDING for the next ladder rung — neither resolved True
+    (survived all barriers) nor a lossless refutation.  The single
+    predicate behind both the snapshot-fetch lane filter and the
+    still-classification loop; keep them in sync by keeping them HERE
+    (round-5 advisor: the duplicated predicate desyncs silently)."""
+    if fat < 0 and valid:
+        return False  # resolved True
+    if fat >= 0 and not lossy:
+        return False  # lossless refutation (final or confirmation-bound)
+    return True
 
 
 def _resolve_confirmation(res: dict, cpu_res: dict) -> dict:
@@ -186,6 +206,7 @@ def batch_analysis(
     confirm_max_configs: int = 2_000_000,
     carry_frontier: bool = True,
     greedy_first: bool = True,
+    dedup_backend: str | None = None,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -243,7 +264,18 @@ def batch_analysis(
     explicitly.  Remaining unknowns fall back to the CPU config-set
     sweep when ``cpu_fallback``.  Returns one knossos-shaped result per
     history, in order.
+
+    ``dedup_backend`` selects the per-round frontier dedup backend for
+    every rung — "sort" (multi-key hash sort) or "bucket" (packed radix
+    buckets; see jepsen_tpu.ops.hashing).  None resolves through the
+    JEPSEN_TPU_DEDUP_BACKEND env var, then the module default.  Verdict
+    semantics are backend-independent: fast-engine refutations are
+    hash-decided (and confirmed) either way, exact-engine kills are
+    content-decided either way.  (The greedy rung walks a single
+    configuration — no frontier, nothing to dedup — so the backend
+    choice is moot there by construction.)
     """
+    dedup = hashing.resolve_dedup_backend(dedup_backend)
     results: list[dict | None] = [None] * len(histories)
     packs: list[dict] = []
     idxs: list[int] = []
@@ -440,8 +472,8 @@ def batch_analysis(
                 spec = NamedSharding(mesh, PartitionSpec(axis))
                 for ai in range(6):
                     a_args[ai] = jax.device_put(np.asarray(a_args[ai]), spec)
-            launch_acc["_key"] = (sub[0]["step"], "async", batch_cap, T, B, P, G, W, n_pad)
-            runner = wgl.async_runner(sub[0]["step"], batch_cap, T, B, P, G, W)
+            launch_acc["_key"] = (sub[0]["step"], "async", batch_cap, T, B, P, G, W, n_pad, dedup)
+            runner = wgl.async_runner(sub[0]["step"], batch_cap, T, B, P, G, W, dedup)
             valid, failed_at, lossy, peak, bsnap, sst, sfo, sfc, sal = runner(*a_args)
             if carry_frontier:
                 # keep the snapshot ON-DEVICE; the stage loop fetches
@@ -449,12 +481,12 @@ def batch_analysis(
                 # async rung exists to resume on)
                 snap = (bsnap, sst, sfo, sfc, sal)
         elif st_engine == "sync":
-            launch_acc["_key"] = (sub[0]["step"], "sync", batch_cap, int(rounds), B, P, G, W, n_pad)
-            runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
+            launch_acc["_key"] = (sub[0]["step"], "sync", batch_cap, int(rounds), B, P, G, W, n_pad, dedup)
+            runner = wgl.batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W, dedup)
             valid, failed_at, lossy, peak = runner(*args)
         else:  # "exact": content-compare dedup/domination — may refute
-            launch_acc["_key"] = (sub[0]["step"], "exact", batch_cap, int(rounds), B, P, G, W, n_pad)
-            runner = wgl.exact_batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W)
+            launch_acc["_key"] = (sub[0]["step"], "exact", batch_cap, int(rounds), B, P, G, W, n_pad, dedup)
+            runner = wgl.exact_batched_runner(sub[0]["step"], batch_cap, int(rounds), P, G, W, dedup)
             valid, failed_at, lossy, peak = runner(*args)
         return (
             np.asarray(valid)[:n],
@@ -489,7 +521,8 @@ def batch_analysis(
         _reset_launch_acc()
         t_stage = time.perf_counter()
         stage_attrs = dict(
-            stage=si, engine=st_engine, capacity=batch_cap, lanes=len(pending)
+            stage=si, engine=st_engine, capacity=batch_cap,
+            lanes=len(pending), dedup=dedup,
         )
         # Measured-shape guard (round 5): the batched exact runner
         # faults the TPU worker on long-scan x wide-frontier shapes
@@ -517,7 +550,7 @@ def batch_analysis(
                 i = idxs[k]
                 results[i] = wgl.chunked_analysis(
                     model, histories[i], packs[k], exact_ladder,
-                    rounds=int(rounds), fast=False,
+                    rounds=int(rounds), fast=False, dedup_backend=dedup,
                 )
             pending = safe
             if not pending:
@@ -566,8 +599,7 @@ def batch_analysis(
             if fetch_snaps and snap is not None:
                 local = [
                     jl for jl in range(len(chunk))
-                    if not (fat[jl] < 0 and v[jl])      # resolved True
-                    and not (fat[jl] >= 0 and not lz[jl])  # refuted
+                    if _stays_pending(v[jl], fat[jl], lz[jl])
                 ]
                 if local:
                     sel = jnp.asarray(np.asarray(local, np.int32))
@@ -587,10 +619,13 @@ def batch_analysis(
         for j, k in enumerate(pending):
             i = idxs[k]
             stats = {"frontier-peak": int(peak[j]), "capacity": batch_cap, "lossy?": bool(lossy[j])}
-            if failed_at[j] < 0 and valid[j]:
+            # the SAME predicate the snapshot fetch filtered on — a lane
+            # fetched there is exactly a lane classified pending here
+            pending_lane = _stays_pending(valid[j], failed_at[j], lossy[j])
+            if not pending_lane and failed_at[j] < 0:
                 n_true += 1
                 results[i] = {"valid?": True, "kernel": stats}
-            elif failed_at[j] >= 0 and not lossy[j]:
+            elif not pending_lane:
                 n_refuted += 1
                 op_pos = int(packs[k]["bar_opid"][int(failed_at[j])])
                 op = histories[i][op_pos]
@@ -718,7 +753,7 @@ def batch_analysis(
                 # launch below.
                 r = wgl.chunked_analysis(
                     model, histories[idxs[k]], p, [cap], rounds=int(rounds),
-                    fast=False,
+                    fast=False, dedup_backend=dedup,
                 )
                 _finish_confirmation(k, fat, res, r["valid?"] is False)
             group = safe_group
@@ -804,4 +839,24 @@ def batch_analysis(
             "ladder.confirm.drain", time.perf_counter() - t_drain,
             confirmations=len(confirm_futs),
         )
+
+    if packs and batch_caps and obs.active() is not None:
+        # Per-round dedup timing for this run's first-rung candidate
+        # shape, BOTH backends (one dedup.round span each): the sort-vs-
+        # bucket comparison the kernel rounds themselves can't emit
+        # (they run inside a jitted scan), surfaced in telemetry.json's
+        # "dedup" table and tools/trace_summarize.py.  Telemetry-gated
+        # AND once per shape per process: a couple ms, never a
+        # recurring tax on long runs.
+        pP = wgl._bucket(max(p["P"] for p in packs), [8, 16, 32, 64, 128])
+        pG = wgl._bucket(max(p["G"] for p in packs), [4, 8, 16, 32, 64])
+        shape = (batch_caps[0], pP, pG)
+        if shape not in _PROBED_DEDUP_SHAPES:
+            _PROBED_DEDUP_SHAPES.add(shape)
+            t_probe = time.perf_counter()
+            hashing.dedup_round_probe(batch_caps[0], pP, pG, (pP + 31) // 32)
+            obs.span_event(
+                "ladder.dedup-probe", time.perf_counter() - t_probe,
+                capacity=batch_caps[0], active_backend=dedup,
+            )
     return [r if r is not None else {"valid?": "unknown"} for r in results]

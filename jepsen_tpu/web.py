@@ -164,13 +164,20 @@ def telemetry_html(run_dir: Path) -> str:
         parts.append(_telemetry_table(
             ["stage", "engine", "capacity", "lanes", "seconds", "resolved",
              "refuted", "unknowns left", "launches", "compile (s)",
-             "execute (s)", "peak frontier", "lossy"],
+             "execute (s)", "peak frontier", "lossy", "dedup"],
             [[r.get("stage"), r.get("engine"), r.get("capacity"),
               r.get("lanes"), r.get("seconds"), r.get("resolved", ""),
               r.get("refuted", ""), r.get("unknowns_remaining", ""),
               r.get("launches", ""), r.get("compile_s", ""),
               r.get("execute_s", ""), r.get("peak_frontier", ""),
-              r.get("lossy", "")] for r in s["ladder"]],
+              r.get("lossy", ""), r.get("dedup", "")] for r in s["ladder"]],
+        ))
+    if s.get("dedup"):
+        parts.append("<h3>dedup rounds (sort vs bucket probe)</h3>")
+        parts.append(_telemetry_table(
+            ["backend", "candidates", "capacity", "probes", "per round (µs)"],
+            [[d.get("backend"), d.get("candidates"), d.get("capacity"),
+              d.get("probes"), d.get("per_round_us")] for d in s["dedup"]],
         ))
     if s.get("counters"):
         parts.append("<h3>counters</h3>")

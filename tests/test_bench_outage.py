@@ -53,6 +53,41 @@ def test_bench_probe_hang_emits_structured_json():
     assert "hung" in rec["reason"]
 
 
+def test_bench_fixed_work_metric_deterministic():
+    """The fixed-work secondary metric: its WORK (configs explored) must
+    be bit-identical across runs on the same histories — that is the
+    whole point (the wall-clock vs_baseline denominator swings ±20%;
+    configs/sec only carries timer noise) — and the JSON fragment must
+    carry the contract keys."""
+    env = dict(os.environ)
+    env["JEPSEN_TPU_BENCH_PROBE"] = "true"
+    env["JEPSEN_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    src = (
+        "import bench, json, sys\n"
+        "sys.path.insert(0, 'tools')\n"
+        "from genhist import valid_register_history, corrupt\n"
+        "from jepsen_tpu import models as m\n"
+        "hists = [valid_register_history(40, 4, seed=i, info_rate=0.2)"
+        " for i in range(3)]\n"
+        "hists[2] = corrupt(hists[2], seed=2)\n"
+        "a = bench.fixed_work_metric(m.CASRegister(None), hists)\n"
+        "b = bench.fixed_work_metric(m.CASRegister(None), hists)\n"
+        "print(json.dumps([a, b]))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True,
+        env=env, timeout=300, cwd=str(BENCH.parent),
+    )
+    assert r.returncode == 0, r.stderr
+    a, b = json.loads(r.stdout.strip().splitlines()[-1])
+    for rec in (a, b):
+        assert set(rec) == {"metric", "configs", "seconds", "value"}
+        assert rec["configs"] > 0 and rec["value"] > 0
+        assert "configs explored/sec" in rec["metric"]
+    assert a["configs"] == b["configs"], "fixed work is not deterministic"
+
+
 def test_bench_probe_success_proceeds_past_guard():
     """A healthy probe must NOT short-circuit: the script should get past
     the guard and into the real bench imports (we don't run the full

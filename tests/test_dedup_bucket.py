@@ -1,0 +1,316 @@
+"""Differential suite for the bucketed dedup/compaction backend
+(jepsen_tpu.ops.hashing, ``dedup_backend="bucket"``): same frontiers
+through sort-dedup and bucket-dedup must keep identical survivor sets,
+ladder verdicts must agree across backends, and bucket overflow must
+degrade to bloat/fallback — never to a dropped row."""
+
+import pathlib
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+import jax.numpy as jnp
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.ops import hashing as hx
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import batch_analysis
+from test_wgl_cpu import random_history
+
+
+def _content(state, fok, fcr, alive):
+    """The surviving frontier as a content set (order-independent)."""
+    state, fok, fcr, alive = (np.asarray(a) for a in (state, fok, fcr, alive))
+    return {
+        (int(state[i]), tuple(int(x) for x in fok[i]),
+         tuple(int(x) for x in fcr[i]))
+        for i in np.flatnonzero(alive)
+    }
+
+
+def _candidates(seed, capacity=64, P=4, G=3, W=1):
+    return hx.probe_candidates(capacity, P, G, W, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Backend selection
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_dedup_backend(monkeypatch):
+    monkeypatch.delenv(hx.DEDUP_BACKEND_ENV, raising=False)
+    assert hx.resolve_dedup_backend() == "sort"
+    assert hx.resolve_dedup_backend("bucket") == "bucket"
+    monkeypatch.setenv(hx.DEDUP_BACKEND_ENV, "bucket")
+    assert hx.resolve_dedup_backend() == "bucket"
+    assert hx.resolve_dedup_backend("sort") == "sort"  # explicit wins
+    with pytest.raises(ValueError):
+        hx.resolve_dedup_backend("radix")
+    monkeypatch.setenv(hx.DEDUP_BACKEND_ENV, "bogus")
+    with pytest.raises(ValueError):
+        hx.resolve_dedup_backend()
+
+
+# ---------------------------------------------------------------------------
+# Frontier-update differential: identical survivor sets
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fast_update_identical_survivor_sets(seed):
+    """frontier_update_fast through both backends: the compacted
+    frontier holds the SAME content set (the buffer prune makes both
+    exact antichains; only bloat may differ pre-prune), and the
+    overflow verdict-gate agrees."""
+    st, fo, fc, al = _candidates(seed)
+    cost = jnp.zeros(st.shape[0], jnp.int32)
+    out = {}
+    for b in ("sort", "bucket"):
+        kst, kfo, kfc, ka, ovf, _fp, _child = hx.frontier_update_fast(
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), cost, 64, dedup_backend=b,
+        )
+        out[b] = (_content(kst, kfo, kfc, ka), bool(ovf), int(np.asarray(ka).sum()))
+    assert out["sort"][0] == out["bucket"][0], "survivor content sets differ"
+    assert out["sort"][1] == out["bucket"][1], "overflow flags differ"
+    assert out["sort"][2] == out["bucket"][2]
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_exact_update_identical_survivor_sets(seed):
+    """frontier_update (the exact engine's content-decided update)
+    through both backends keeps the same survivor content set."""
+    st, fo, fc, al = _candidates(seed, capacity=48, P=3, G=2)
+    cost = jnp.asarray(
+        np.asarray(fc).sum(axis=1, dtype=np.int32)
+        + np.asarray([bin(int(x)).count("1") for x in np.asarray(fo)[:, 0]],
+                     dtype=np.int32)
+    )
+    out = {}
+    for b in ("sort", "bucket"):
+        kst, kfo, kfc, ka, ovf, _fp = hx.frontier_update(
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), cost, 48, dedup_backend=b,
+        )
+        out[b] = (_content(kst, kfo, kfc, ka), bool(ovf))
+    assert out["sort"][0] == out["bucket"][0]
+    assert out["sort"][1] == out["bucket"][1]
+
+
+def test_bucket_kills_only_true_duplicates():
+    """Soundness of the bucket keep mask: every killed row has an
+    identical EARLIER surviving row (kills are hash-verified duplicate
+    kills keeping the first copy in candidate order — never a distinct
+    config, never a later-copy survivor)."""
+    st, fo, fc, al = _candidates(7, capacity=32, P=4, G=2)
+    w, g = fo.shape[1], fc.shape[1]
+    cols = (
+        [jnp.asarray(st)] + [jnp.asarray(fo[:, k]) for k in range(w)]
+        + [jnp.asarray(fc[:, k]) for k in range(g)]
+    )
+    h1 = hx.hash_rows(cols, 0xB00B_135)
+    h2 = hx.hash_rows(cols, 0x1CEB_00DA)
+    keep, _ovf = hx._keep_bucket(h1, h2, jnp.asarray(al), 4)
+    keep = np.asarray(keep)
+    rows = [(int(st[i]), tuple(fo[i]), tuple(fc[i])) for i in range(len(st))]
+    first_copy = {}
+    for i in range(len(rows)):
+        if al[i]:
+            first_copy.setdefault(rows[i], i)
+    for i in np.flatnonzero(al & ~keep):
+        j = first_copy[rows[i]]
+        assert j < i, f"killed row {i} has no earlier copy"
+        assert keep[j], f"killed row {i}'s first copy {j} was killed too"
+    for i in np.flatnonzero(keep):
+        assert first_copy[rows[i]] == i, "bucket survivor is not the first copy"
+
+
+# ---------------------------------------------------------------------------
+# Overflow fallback soundness
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_overflow_retains_rows_never_drops():
+    """Regression for the overflow contract: >window DISTINCT rows in one
+    bucket raise the overflow flag and are ALL retained (bloat, sound);
+    >window true duplicates dedup fine and do NOT flag."""
+    n = 64
+    window = 4
+    ibits, bbits = hx._bucket_bits(n)
+    rng = np.random.default_rng(0)
+    h1 = rng.integers(0, 1 << 32, n).astype(np.uint32)
+    h2 = rng.integers(0, 1 << 32, n).astype(np.uint32)
+    alive = np.ones(n, bool)
+    # 10 distinct hashes sharing one bucket (same top bits, distinct low)
+    h1[:10] = (np.uint32(0xABC) << np.uint32(32 - bbits)) | np.arange(10, dtype=np.uint32)
+    keep, ovf = hx._keep_bucket(jnp.asarray(h1), jnp.asarray(h2), jnp.asarray(alive), window)
+    assert bool(ovf), "overflowed bucket did not flag"
+    assert np.asarray(keep)[:10].all(), "overflow DROPPED distinct rows"
+    # 10 copies of one hash: contiguous run, every copy past the first is
+    # within window of another copy — deduped, no overflow
+    h1[:10] = h1[0]
+    h2[:10] = h2[0]
+    keep, ovf = hx._keep_bucket(jnp.asarray(h1), jnp.asarray(h2), jnp.asarray(alive), window)
+    keep = np.asarray(keep)
+    assert keep[:10].sum() == 1, "duplicate run not deduped to one copy"
+    assert not bool(ovf)
+
+
+def test_bucket_long_dup_run_full_update_matches_sort():
+    """>window copies of whole ROWS through the full fast update: the
+    content-decided buffer prune kills what the window missed, so both
+    backends land on the same compacted frontier."""
+    st, fo, fc, al = _candidates(3, capacity=32, P=3, G=2)
+    n = st.shape[0]
+    for i in range(1, 12):  # 12 copies of row 0, spread out
+        j = (i * 17) % n
+        st[j], fo[j], fc[j], al[j] = st[0], fo[0], fc[0], True
+    al[0] = True
+    cost = jnp.zeros(n, jnp.int32)
+    outs = {}
+    for b in ("sort", "bucket"):
+        kst, kfo, kfc, ka, ovf, _fp, _c = hx.frontier_update_fast(
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), cost, 32, dedup_backend=b,
+        )
+        outs[b] = (_content(kst, kfo, kfc, ka), bool(ovf))
+    assert outs["sort"] == outs["bucket"]
+
+
+def test_bucket_infeasible_geometry_routes_to_sort(monkeypatch):
+    """When the packed-key geometry is infeasible the bucket backend
+    must route to the sort path at trace time — bit-identical output,
+    no dropped rows."""
+    monkeypatch.setattr(hx, "BUCKET_MIN_BITS", 40)  # nothing is feasible
+    assert not hx.bucket_feasible(640)
+    st, fo, fc, al = _candidates(11)
+    cost = jnp.zeros(st.shape[0], jnp.int32)
+    a = hx.frontier_update_fast(
+        jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc), jnp.asarray(al),
+        cost, 64, dedup_backend="bucket",
+    )
+    b = hx.frontier_update_fast(
+        jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc), jnp.asarray(al),
+        cost, 64, dedup_backend="sort",
+    )
+    for x, y in zip(a, b):
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
+# ---------------------------------------------------------------------------
+# Engine and ladder-level verdict agreement
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_engine_differential_vs_oracle():
+    """Single-history engines on the bucket backend vs the brute oracle:
+    wrong verdicts are soundness bugs; unknown is capacity."""
+    rng = random.Random(321)
+    for trial in range(40):
+        hist = random_history(rng)
+        truth = wgl_cpu.brute_analysis(m.CASRegister(None), hist)["valid?"]
+        got = wgl.analysis(
+            m.CASRegister(None), hist, capacity=256, dedup_backend="bucket"
+        )["valid?"]
+        assert got in (truth, "unknown"), (trial, got, truth)
+        got_a = wgl.analysis_async(
+            m.CASRegister(None), hist, capacity=256, dedup_backend="bucket"
+        )["valid?"]
+        assert got_a in (truth, "unknown"), (trial, got_a, truth)
+
+
+def test_ladder_verdict_agreement_across_backends():
+    """batch_analysis (the full ladder: greedy rung, async rungs, exact
+    escalation, confirmation) through both dedup backends on a
+    randomized batch: bit-identical verdicts, and both match the
+    oracle."""
+    rng = random.Random(45100)
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(12):
+        if i % 2:
+            hist = valid_register_history(
+                30, 4, seed=i, info_rate=rng.choice([0.0, 0.2]))
+            if i % 4 == 1:
+                hist = corrupt(hist, seed=i)
+        else:
+            hist = random_history(rng)
+        hists.append(h.index(hist))
+    kw = dict(capacity=(64, 256), cpu_fallback=False, exact_escalation=(64,))
+    verdicts = {}
+    for b in ("sort", "bucket"):
+        verdicts[b] = [
+            r["valid?"] for r in batch_analysis(model, hists, dedup_backend=b, **kw)
+        ]
+    assert verdicts["sort"] == verdicts["bucket"]
+    for i, hist in enumerate(hists):
+        got = verdicts["bucket"][i]
+        if got == "unknown":
+            continue
+        truth = wgl_cpu.sweep_analysis(model, hist, max_configs=500_000)["valid?"]
+        assert truth in (got, "unknown"), (i, got, truth)
+
+
+def test_chunked_analysis_bucket_backend():
+    """The chunked exact path (escalation/confirmation route) on the
+    bucket backend agrees with the sort backend's verdicts."""
+    model = m.CASRegister(None)
+    for seed in range(2):
+        hist = valid_register_history(60, 4, seed=seed, info_rate=0.2)
+        if seed == 1:
+            hist = corrupt(hist, seed=seed)
+        packed = wgl.pack(model, hist)
+        a = wgl.chunked_analysis(
+            model, hist, packed, [64, 256], chunk_barriers=32,
+            dedup_backend="bucket",
+        )
+        b = wgl.chunked_analysis(
+            model, hist, dict(packed), [64, 256], chunk_barriers=32,
+            dedup_backend="sort",
+        )
+        assert a["valid?"] == b["valid?"], (seed, a, b)
+
+
+# ---------------------------------------------------------------------------
+# _stays_pending (the shared ladder predicate)
+# ---------------------------------------------------------------------------
+
+
+def test_stays_pending_predicate():
+    from jepsen_tpu.parallel.batch import _stays_pending
+
+    assert not _stays_pending(True, -1, False)    # resolved True
+    assert not _stays_pending(True, -1, True)     # True survives loss too
+    assert not _stays_pending(False, 3, False)    # lossless refutation
+    assert _stays_pending(False, -1, False)       # unresolved (greedy rung)
+    assert _stays_pending(False, -1, True)        # budget loss, unresolved
+    assert _stays_pending(False, 3, True)         # lossy death: unknown
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: dedup.round spans
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_probe_emits_spans(tmp_path):
+    from jepsen_tpu import obs
+    from jepsen_tpu.obs.summary import format_summary
+
+    with obs.recording(tmp_path, enabled=True) as rec:
+        times = hx.dedup_round_probe(32, 4, 2, rounds=2)
+    assert set(times) == {"sort", "bucket"}
+    assert all(t > 0 for t in times.values())
+    rows = rec.summary["dedup"]
+    assert {r["backend"] for r in rows} == {"sort", "bucket"}
+    for r in rows:
+        assert r["candidates"] == 32 * (1 + 4 + 2)
+        assert r["per_round_us"] > 0
+    text = format_summary(rec.summary)
+    assert "dedup rounds" in text and "bucket" in text

@@ -481,6 +481,25 @@ class TestGenericCycleChecker:
         r = elle.cycle_checker(self._analyzer_from_edges(nodes, edges)).check({}, [], {})
         assert r["valid?"] is False
 
+    def test_instance_backend_threads_without_global_mutation(self):
+        """An explicit CycleChecker backend matches the per-call backend
+        on check_graph/check_graphs: per-instance routing, no
+        CYCLE_BACKEND module mutation needed."""
+        import jepsen_tpu.checker.elle as el
+
+        nodes = self._nodes(4)
+        analyzer = self._analyzer_from_edges(nodes, [(0, 1), (1, 2), (2, 0)])
+        default = elle.cycle_checker(analyzer).check({}, [], {})
+        for backend in ("host", "device"):
+            r = el.CycleChecker(analyzer, backend=backend).check({}, [], {})
+            assert r["valid?"] is False
+            [anom] = r["anomalies"]["cycle"]
+            assert sorted(o["index"] for o in anom["cycle"]) == [0, 1, 2]
+        assert el.CYCLE_BACKEND == "host"  # untouched
+        assert default["valid?"] is False
+        with pytest.raises(ValueError):
+            el.CycleChecker(analyzer, backend="quantum")
+
     def test_realtime_analyzer_end_to_end(self, tmp_path):
         """The built-in realtime analyzer over a real history: a normal
         history is acyclic; a hand-corrupted realtime order isn't — and
@@ -522,7 +541,8 @@ def test_cycle_checker_unwitnessed_flag_is_unknown(monkeypatch):
 
     # Force the flagged-but-unwitnessed shape via the seam itself.
     monkeypatch.setattr(
-        el.CycleChecker, "_find_cycle", staticmethod(lambda adj, n: (True, None))
+        el.CycleChecker, "_find_cycle",
+        staticmethod(lambda adj, n, backend=None: (True, None)),
     )
     r = chk.check({}, [], {})
     assert r["valid?"] == "unknown"

@@ -13,6 +13,30 @@ from jepsen_tpu import core, history as h
 NODES = ["n1", "n2", "n3", "n4", "n5"]
 
 
+def test_membership_composition_warns_on_node_downing_faults(caplog, tmp_path):
+    """Composing the membership nemesis with kill/pause logs the
+    stale-view caveat (a shrink decided on a view captured just before a
+    composed down can transiently exceed the minority bound); membership
+    alone, or kill alone, stays quiet.  Construction only — no run."""
+    import logging
+
+    def build(faults):
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="examples.quorum"):
+            quorum_test({
+                "nodes": NODES,
+                "faults": faults,
+                "ssh": {"local?": True},
+                "store-dir": str(tmp_path),
+            })
+        return [r for r in caplog.records if "stale view" in r.getMessage()]
+
+    assert build(["membership", "kill"]), "membership+kill did not warn"
+    assert build(["membership", "pause", "kill"])
+    assert not build(["membership"]), "membership alone must not warn"
+    assert not build(["kill", "pause"]), "no membership, no warning"
+
+
 def test_quorum_abd_linearizable_under_kills(tmp_path):
     """Full ABD (majority writes, read write-back) is provably
     linearizable while a majority survives; the kill nemesis crashes a

@@ -1,0 +1,76 @@
+"""Per-round dedup timing: sort vs bucket backend, over candidate shapes.
+
+Times JUST the dedup stage of the fast frontier update (row hash +
+partition + windowed kills + candidate-order keep mask — the part the
+two backends implement differently; see ops.hashing._dedup_stage), the
+per-round floor PERF.md's "Honest limits" names, at a grid of ladder
+shapes including the acceptance shape [256, 2176].
+
+  python tools/profile_dedup.py [--rounds N] [--telemetry DIR]
+
+``--telemetry DIR`` additionally records the probes as ``dedup.round``
+obs spans into DIR/telemetry.json{,l} (the artifact
+tools/trace_summarize.py renders).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from jepsen_tpu import obs  # noqa: E402
+from jepsen_tpu.ops import hashing  # noqa: E402
+
+#: (capacity, P, G) — candidates = capacity * (1 + P + G).  The first
+#: rows bracket the acceptance shape (2176-candidate dedup round, the
+#: [256, 1088x2] sort floor PERF.md's "Honest limits" names); the tail
+#: covers the ladder's wider rungs.
+SHAPES = [
+    (128, 12, 4),   # 2176 candidates exactly
+    (256, 4, 3),    # 2048 candidates, the cap-256 rung's table
+    (128, 8, 4),
+    (512, 8, 4),
+    (2048, 8, 4),
+]
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rounds = 20
+    tele_dir = None
+    if "--rounds" in argv:
+        rounds = int(argv[argv.index("--rounds") + 1])
+    if "--telemetry" in argv:
+        tele_dir = Path(argv[argv.index("--telemetry") + 1])
+
+    import contextlib
+
+    ctx = (
+        obs.recording(tele_dir, enabled=True)
+        if tele_dir is not None else contextlib.nullcontext()
+    )
+    rows = []
+    with ctx:
+        for cap, p, g in SHAPES:
+            n = cap * (1 + p + g)
+            times = hashing.dedup_round_probe(
+                cap, p, g, (p + 31) // 32, rounds=rounds,
+                emit=tele_dir is not None,
+            )
+            rows.append((cap, n, times["sort"], times["bucket"]))
+    print(f"{'capacity':>9} {'candidates':>11} {'sort_us':>9} "
+          f"{'bucket_us':>10} {'speedup':>8}")
+    for cap, n, ts, tb in rows:
+        print(f"{cap:>9} {n:>11} {ts*1e6:>9.1f} {tb*1e6:>10.1f} "
+              f"{ts/tb:>7.2f}x")
+    if tele_dir is not None:
+        print(f"\ntelemetry: {tele_dir}/telemetry.json "
+              f"(render: python tools/trace_summarize.py {tele_dir})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

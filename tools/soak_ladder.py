@@ -3,11 +3,17 @@
 Random small histories in adversarial shapes (info-heavy, crash groups,
 cas, corruptions), checked in batches through the COMPLETE round-5
 ladder (greedy rung, carried frontiers, saturating prune, both
-confirmation modes) and compared verdict-by-verdict against
-``wgl_cpu.sweep_analysis``.  Any non-unknown disagreement is a
+confirmation modes, both DEDUP BACKENDS — the ``dedup_backend`` axis
+randomizes sort vs bucket per batch) and compared verdict-by-verdict
+against ``wgl_cpu.sweep_analysis``.  Any non-unknown disagreement is a
 soundness bug — print it and exit 1.
 
-  python tools/soak_ladder.py [--minutes N] [--seed S]
+  python tools/soak_ladder.py [--minutes N] [--seed S] [--batches N]
+                              [--dedup-backend sort|bucket|both]
+
+``--batches`` runs a fixed batch count instead of a time budget (the
+differential-soak acceptance gate pins a count, not a duration);
+``--dedup-backend`` pins the dedup axis (default: both, randomized).
 """
 
 from __future__ import annotations
@@ -61,15 +67,23 @@ def random_history(rng, n_procs, n_ops, values, info_w):
 def main() -> int:
     minutes = 20.0
     seed = 45100
+    max_batches = None
+    dedup_axis = "both"
     if "--minutes" in sys.argv:
         minutes = float(sys.argv[sys.argv.index("--minutes") + 1])
     if "--seed" in sys.argv:
         seed = int(sys.argv[sys.argv.index("--seed") + 1])
+    if "--batches" in sys.argv:
+        max_batches = int(sys.argv[sys.argv.index("--batches") + 1])
+    if "--dedup-backend" in sys.argv:
+        dedup_axis = sys.argv[sys.argv.index("--dedup-backend") + 1]
+        assert dedup_axis in ("sort", "bucket", "both"), dedup_axis
     rng = random.Random(seed)
     model = m.CASRegister(None)
     deadline = time.monotonic() + minutes * 60
     batches = checked = disagreements = 0
-    while time.monotonic() < deadline:
+    while (time.monotonic() < deadline if max_batches is None
+           else batches < max_batches):
         hists = []
         for _ in range(16):
             kind = rng.random()
@@ -88,12 +102,14 @@ def main() -> int:
                     hist = corrupt(hist, seed=rng.randrange(1 << 30))
             hists.append(hist)
         confirm = rng.choice([True, "device"])
+        dedup = dedup_axis if dedup_axis != "both" else rng.choice(["sort", "bucket"])
         results = batch_analysis(
             model, hists, capacity=(rng.choice([16, 32, 64]), 256),
             cpu_fallback=False, exact_escalation=(),
             confirm_refutations=confirm,
             carry_frontier=rng.random() < 0.7,
             greedy_first=rng.random() < 0.8,
+            dedup_backend=dedup,
         )
         batches += 1
         for i, (hist, r) in enumerate(zip(hists, results)):
@@ -105,7 +121,7 @@ def main() -> int:
                 disagreements += 1
                 print("DISAGREEMENT", {"batch": batches, "i": i,
                                        "got": r, "want": truth["valid?"],
-                                       "confirm": confirm,
+                                       "confirm": confirm, "dedup": dedup,
                                        "hist": hist}, flush=True)
         if batches % 20 == 0:
             print(f"soak: {batches} batches, {checked} verdicts checked, "
