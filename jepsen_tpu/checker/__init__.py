@@ -19,7 +19,7 @@ import threading
 import traceback
 from typing import Any, Callable, Mapping, Sequence
 
-from jepsen_tpu import obs
+from jepsen_tpu import faults, obs
 from jepsen_tpu.utils import bounded_pmap
 
 UNKNOWN = "unknown"
@@ -81,6 +81,20 @@ def checker_name(chk: Checker) -> str:
     return type(chk).__name__
 
 
+def resolve_opts(opts: Mapping | None) -> dict:
+    """Normalize checker opts for the fault-tolerance keys: a raw
+    ``"check-deadline"`` seconds value is wrapped ONCE into a shared
+    ``faults.Deadline`` under ``"deadline"`` — Compose normalizes before
+    fanning out, so every composed checker polls the same wall-clock
+    budget instead of each starting its own."""
+    opts = dict(opts or {})
+    if opts.get("deadline") is None and opts.get("check-deadline") is not None:
+        opts["deadline"] = faults.Deadline(float(opts["check-deadline"]))
+    else:
+        opts["deadline"] = faults.Deadline.coerce(opts.get("deadline"))
+    return opts
+
+
 def check_safe(chk: Checker, test, history, opts=None, name: str | None = None) -> dict:
     """check, but exceptions become ``{"valid?": "unknown", "error": ...}``
     (checker.clj:74-85).
@@ -88,11 +102,14 @@ def check_safe(chk: Checker, test, history, opts=None, name: str | None = None) 
     The failure names WHICH checker raised (``"checker"`` key) so composed
     results stay attributable, and each check emits a telemetry span with
     the checker's name, duration, and verdict (``name`` lets Compose pass
-    the map key the caller knows the checker by)."""
+    the map key the caller knows the checker by).  Opts are normalized
+    through ``resolve_opts`` so a ``"check-deadline"`` budget reaches the
+    checker as a live ``"deadline"`` object."""
     name = name or checker_name(chk)
+    opts = resolve_opts(opts)
     with obs.span("checker.check", checker=name) as sp:
         try:
-            result = chk.check(test, history, opts or {})
+            result = chk.check(test, history, opts)
             if result is None:
                 result = {"valid?": True}
         except Exception:  # noqa: BLE001 - contract: never propagate
@@ -136,6 +153,9 @@ class Compose(Checker):
 
     def check(self, test, history, opts):
         items = list(self.checker_map.items())
+        # normalize ONCE so every composed checker shares one deadline
+        # budget (resolve_opts in each check_safe then passes it through)
+        opts = resolve_opts(opts)
         results = bounded_pmap(
             lambda kv: (kv[0], check_safe(kv[1], test, history, opts, name=kv[0])),
             items,

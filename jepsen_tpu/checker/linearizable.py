@@ -51,7 +51,7 @@ class Linearizable(Checker):
         self.algorithm = opts.get("algorithm", "competition")
         self.kernel_opts = dict(opts.get("kernel-opts", {}))
 
-    def _analyze(self, history):
+    def _analyze(self, history, deadline=None):
         if self.algorithm == "wgl":
             return wgl_cpu.dfs_analysis(self.model, history)
         if self.algorithm == "sweep":
@@ -59,12 +59,13 @@ class Linearizable(Checker):
         from jepsen_tpu.ops import wgl as wgl_tpu
 
         if self.algorithm == "tpu":
-            return wgl_tpu.analysis(self.model, history, **self.kernel_opts)
+            return wgl_tpu.analysis(self.model, history, deadline=deadline,
+                                    **self.kernel_opts)
         if self.algorithm == "competition":
-            return self._competition(history, wgl_tpu)
+            return self._competition(history, wgl_tpu, deadline)
         raise ValueError(f"unknown linearizability algorithm {self.algorithm!r}")
 
-    def _competition(self, history, wgl_tpu):
+    def _competition(self, history, wgl_tpu, deadline=None):
         """Fast engines first, exact ones on demand (see module doc).
 
         Tunables ride ``kernel-opts``: ``async-capacity`` sizes the beam
@@ -72,6 +73,13 @@ class Linearizable(Checker):
         is a separate knob, forwarded untouched), ``confirm-max-configs``
         bounds the refutation-confirmation sweep (same default as
         parallel.batch_analysis's confirm_max_configs)."""
+        if deadline is not None and deadline.expired():
+            # the budget was spent before this key's check began (e.g. by
+            # earlier keys of an independent checker): degrade attributably
+            return {
+                "valid?": UNKNOWN,
+                "cause": "deadline-exceeded: check budget exhausted",
+            }
         ladder = self.kernel_opts.get("async-capacity", (256, 1024))
         if isinstance(ladder, int):
             ladder = (ladder,)
@@ -111,6 +119,15 @@ class Linearizable(Checker):
                 # no tensor form: every device rung would fail the same
                 # way — the CPU oracle is the only engine
                 return wgl_cpu.analysis(self.model, history)
+        if deadline is not None and deadline.expired():
+            # the CPU DFS and the exact device ladder are the expensive
+            # oracles; past the budget they degrade to an attributable
+            # unknown instead of running unbounded
+            return {
+                "valid?": UNKNOWN,
+                "cause": "deadline-exceeded: check budget exhausted before "
+                         "the exact oracles",
+            }
         dfs = wgl_cpu.analysis(self.model, history)
         if dfs["valid?"] != UNKNOWN:
             return dfs
@@ -118,7 +135,7 @@ class Linearizable(Checker):
         # uses its own (chunked) capacity ladder from kernel_opts
         opts = {k: v for k, v in self.kernel_opts.items()
                 if k not in ("async-capacity", "confirm-max-configs")}
-        a = wgl_tpu.analysis(self.model, history, **opts)
+        a = wgl_tpu.analysis(self.model, history, deadline=deadline, **opts)
         if a["valid?"] == UNKNOWN and "not tensorizable" in str(a.get("cause", "")):
             return dfs  # keep the DFS's informative unknown (budget + op)
         return a
@@ -133,7 +150,9 @@ class Linearizable(Checker):
         return out
 
     def check(self, test, history, opts):
-        out = self._truncate(self._analyze(history))
+        out = self._truncate(
+            self._analyze(history, deadline=(opts or {}).get("deadline"))
+        )
         if out.get("valid?") is False:
             self._render_failure(test, history, out, opts)
         return out
@@ -177,10 +196,18 @@ class Linearizable(Checker):
             for k, v in self.kernel_opts.items()
             if k in ("capacity", "rounds", "mesh", "exact_escalation", "engine")
         }
+        # Fault-tolerance keys ride the CHECKER OPTS (core.analyze fills
+        # them from the test map / CLI): the ladder checkpoints into the
+        # run's store dir, honors the shared deadline, and resumes when
+        # asked (jepsen_tpu.parallel.batch_analysis docstring).
+        opts = opts or {}
         results = batch_analysis(
             self.model,
             histories,
             cpu_fallback=(self.algorithm == "competition"),
+            deadline=opts.get("deadline"),
+            checkpoint_dir=opts.get("checkpoint-dir"),
+            resume=bool(opts.get("resume?")),
             **batch_kw,
         )
         return [self._truncate(r) for r in results]

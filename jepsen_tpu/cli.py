@@ -80,6 +80,13 @@ def add_test_opts(p: argparse.ArgumentParser):
                         "ladder rungs: 'sort' (multi-key hash sort) or "
                         "'bucket' (packed radix buckets); default: env "
                         "JEPSEN_TPU_DEDUP_BACKEND, else 'sort'")
+    p.add_argument("--check-deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="wall-clock budget for the checker phase: on "
+                        "expiry the TPU ladder checkpoints, marks the "
+                        "remaining histories 'unknown' (cause "
+                        "deadline-exceeded + a checkpoint pointer), and "
+                        "results.json is still written complete")
 
 
 def options_to_test_opts(opts: argparse.Namespace) -> dict:
@@ -110,6 +117,8 @@ def options_to_test_opts(opts: argparse.Namespace) -> dict:
     }
     if opts.store_dir:
         out["store-dir"] = opts.store_dir
+    if getattr(opts, "check_deadline", None) is not None:
+        out["check-deadline"] = opts.check_deadline
     return out
 
 
@@ -148,8 +157,13 @@ def _cmd_test(test_fn: Callable, opts) -> int:
 
 def _cmd_analyze(test_fn: Callable, opts) -> int:
     """Re-check a stored history without touching a cluster
-    (cli.clj:402-431)."""
-    if opts.test_dir:
+    (cli.clj:402-431).  ``--resume <run-dir>`` re-enters an interrupted
+    checker run from that dir's checker-checkpoint.json (written per
+    ladder stage; see jepsen_tpu.store.checkpoint)."""
+    resume_dir = getattr(opts, "resume", None)
+    if resume_dir:
+        stored = store.load_dir(resume_dir)
+    elif opts.test_dir:
         stored = store.load_dir(opts.test_dir)
     else:
         stored = store.latest(store_dir=opts.store_dir)
@@ -167,6 +181,9 @@ def _cmd_analyze(test_fn: Callable, opts) -> int:
     merged = {**cli_test, **{k: v for k, v in stored.items() if k in
                              ("name", "start-time-str", "history")}}
     merged.setdefault("start-time-str", store.time_str())
+    if resume_dir:
+        merged["resume?"] = True
+        merged["checkpoint-dir"] = resume_dir
     merged = _apply_telemetry_opt(merged, opts)
     completed = core.analyze(merged)
     core.log_results(completed)
@@ -237,6 +254,10 @@ def run_cli(
         add_test_opts(p_an)
         p_an.add_argument("--test-dir", default=None,
                           help="stored test directory (default: latest)")
+        p_an.add_argument("--resume", default=None, metavar="RUN_DIR",
+                          help="resume an interrupted checker run from this "
+                               "stored run dir's checker checkpoint "
+                               "(implies --test-dir RUN_DIR)")
         if extra_opts:
             extra_opts(p_an)
 

@@ -9,6 +9,7 @@ All functions take a connected ``Session`` as their first argument.
 from __future__ import annotations
 
 import base64
+import random
 import shlex
 import time
 from typing import Mapping
@@ -28,15 +29,33 @@ def file_p(s: Session, path: str) -> bool:
     return s.exec_result("test", "-f", path).get("exit") == 0
 
 
-def await_tcp_port(s: Session, port: int, timeout: float = 60.0, interval: float = 0.5):
+def await_tcp_port(
+    s: Session,
+    port: int,
+    timeout: float = 60.0,
+    interval: float = 0.5,
+    max_interval: float = 2.0,
+):
     """Block until something listens on port (control/util.clj:14-30).
 
     A hung connect attempt (packets dropped — exactly the conditions this
     harness creates) counts as "not listening yet", not a transport error.
-    """
+
+    Polls at ``interval`` for the first few probes (a freshly exec'd
+    daemon usually listens within a second, the harness pays this wait
+    per node per db cycle, and backing off too early costs real seconds
+    across a suite), then doubles up to ``max_interval`` with jitter
+    (each sleep is 0.5–1.0x the nominal delay, so many nodes awaiting
+    the same slow daemon don't probe in lockstep).  On timeout the
+    ``TimeoutError`` names the LAST probe failure — "connection
+    refused" vs a dead control session vs a nonzero exec are very
+    different debugging starts."""
     from jepsen_tpu.control.core import RemoteError
 
     deadline = time.monotonic() + timeout
+    delay = interval
+    attempt = 0
+    last_err = "no probe completed"
     while True:
         try:
             r = s.exec_result(
@@ -44,11 +63,20 @@ def await_tcp_port(s: Session, port: int, timeout: float = 60.0, interval: float
             )
             if r.get("exit") == 0:
                 return
-        except RemoteError:
-            pass
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"nothing listening on {s.node}:{port} after {timeout}s")
-        time.sleep(interval)
+            err = (r.get("err") or r.get("out") or "").strip()
+            last_err = f"probe exit {r.get('exit')}" + (f": {err}" if err else "")
+        except RemoteError as e:
+            last_err = f"{type(e).__name__}: {e}"
+        now = time.monotonic()
+        if now > deadline:
+            raise TimeoutError(
+                f"nothing listening on {s.node}:{port} after {timeout}s "
+                f"(last probe: {last_err})"
+            )
+        time.sleep(min(delay, max(0.0, deadline - now)) * (0.5 + 0.5 * random.random()))
+        attempt += 1
+        if attempt >= 3:  # back off only once the fast path has clearly missed
+            delay = min(delay * 2, max_interval)
 
 
 def tmp_file(s: Session, suffix: str = "") -> str:

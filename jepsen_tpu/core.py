@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 from typing import Any, Mapping
 
 from jepsen_tpu import checker as chk
@@ -140,7 +141,17 @@ def analyze(test: Mapping, *, capture: bool = True) -> dict:
     (store.clj:436-464); run_test passes False because its own capture
     already spans the analysis.  A standalone analyze (CLI ``analyze``)
     opens its own telemetry recording into the store dir; under run_test
-    the spans nest into the run's already-open recording."""
+    the spans nest into the run's already-open recording.
+
+    Fault-tolerance keys flow from the test map into the checker opts:
+    ``"check-deadline"`` (seconds; CLI ``--check-deadline``) becomes the
+    shared wall-clock budget, ``"checkpoint-dir"`` (default: the run's
+    store dir; env ``JEPSEN_TPU_CHECKPOINT`` overrides) is where the
+    TPU ladder persists its per-stage checkpoint, and ``"resume?"``
+    (CLI ``analyze --resume <run-dir>``; implied by the env var) re-
+    enters an interrupted ladder at the saved rung.  A deadline expiry
+    degrades the remaining work to attributable unknowns — results.json
+    is ALWAYS written complete."""
     test = dict(test)
     cm = (
         store.capture_logging(test) if capture else contextlib.nullcontext()
@@ -150,7 +161,9 @@ def analyze(test: Mapping, *, capture: bool = True) -> dict:
             test["history"] = h.index(test.get("history") or [])
             checker = test.get("checker")
             if checker is not None:
-                results = chk.check_safe(checker, test, test["history"])
+                results = chk.check_safe(
+                    checker, test, test["history"], _checker_opts(test)
+                )
             else:
                 results = {"valid?": True}
             sp.set(valid=results.get("valid?"))
@@ -159,6 +172,24 @@ def analyze(test: Mapping, *, capture: bool = True) -> dict:
         with obs.span("phase.save-results"):
             store.save_2(test)
     return test
+
+
+def _checker_opts(test: Mapping) -> dict:
+    """The checker-opts fragment analyze derives from the test map (see
+    analyze's docstring for the key semantics)."""
+    opts: dict = {}
+    if test.get("check-deadline") is not None:
+        opts["check-deadline"] = test["check-deadline"]
+    ck_env = os.environ.get("JEPSEN_TPU_CHECKPOINT")
+    ck = test.get("checkpoint-dir") or ck_env
+    try:
+        opts["checkpoint-dir"] = str(ck) if ck else str(store.test_dir(test))
+    except KeyError:  # no name/start-time in the map: no store, no checkpoint
+        if ck:
+            opts["checkpoint-dir"] = str(ck)
+    if test.get("resume?") or ck_env:
+        opts["resume?"] = True
+    return opts
 
 
 def _write_checker_times(test: Mapping) -> None:

@@ -1,0 +1,209 @@
+"""Fault tolerance for the checker pipeline: retry, degrade, deadline.
+
+Long accelerator jobs die — preemption, OOM, transient XLA runtime
+errors, dead worker pools — and a multi-minute checker ladder must
+survive them the way a production training job does.  This module is the
+shared policy layer the launch sites thread through:
+
+  * ``error_kind(e)`` classifies an exception for the retry policy:
+    ``"oom"`` (RESOURCE_EXHAUSTED — halve the work and relaunch),
+    ``"transient"`` (backoff and retry the same launch), or ``None``
+    (not a recognized device fault — the caller re-raises, a code bug
+    must stay loud).
+  * ``call_with_retry(fn, ctx)`` runs one device launch under that
+    policy: transient faults retry with exponential backoff (env knobs
+    below); OOM and still-failing launches raise ``LaunchFailure`` for
+    the CALLER to handle — ``parallel.batch`` halves the sub-batch on
+    OOM and degrades only the failing lanes to ``"unknown"``;
+    ``ops.wgl.chunked_analysis`` degrades the single history.  Retries
+    and degradations all emit ``fault.*`` telemetry (the "faults" table
+    in telemetry.json).
+  * ``Deadline`` is the wall-clock check budget (CLI
+    ``--check-deadline``, opts key ``"deadline"`` threaded through
+    ``checker.check_safe``/``Compose``): stage boundaries poll
+    ``expired()``; on expiry the ladder checkpoints
+    (jepsen_tpu.store.checkpoint) and marks the remaining packs
+    ``unknown`` instead of running past the budget.
+
+Env knobs (read per call so tests and operators can adjust live):
+
+  JEPSEN_TPU_LAUNCH_RETRIES   transient retries per launch (default 3)
+  JEPSEN_TPU_RETRY_BASE_S     first backoff delay (default 0.25)
+  JEPSEN_TPU_RETRY_MAX_S      backoff cap (default 8.0)
+
+``INJECT`` is the fault-injection seam: when set to a callable it runs
+as ``INJECT(ctx, attempt)`` before every launch attempt and may raise a
+synthetic fault — tests and tools/chaos_check.py drive OOM/transient
+scenarios through it without monkeypatching kernel internals.
+
+Import-light by design (stdlib + obs only): the spawn-based confirmation
+workers and the control layer can import it without dragging in jax.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Mapping
+
+from jepsen_tpu import obs
+
+#: fault-injection hook: ``INJECT(ctx, attempt)`` runs before each launch
+#: attempt and may raise (classified exactly like a real launch error).
+INJECT: Callable[[dict, int], None] | None = None
+
+#: substrings that mark an exception as out-of-memory (halve, don't retry
+#: the same shape — the same launch would OOM again).
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Attempting to allocate",
+)
+
+#: substrings that mark an exception as transient (retry with backoff:
+#: tunnel drops, preempted/restarted workers, momentary runtime errors).
+TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "ABORTED",
+    "INTERNAL",
+    "DEADLINE_EXCEEDED",
+    "worker process crashed",
+    "restarted",
+    "Socket closed",
+    "connection reset",
+    "failed to connect",
+    "Unable to initialize backend",
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def describe(e: BaseException) -> str:
+    """One-line, bounded rendering of an exception for ``:cause`` strings
+    and telemetry attributes."""
+    s = f"{type(e).__name__}: {e}"
+    return s if len(s) <= 300 else s[:297] + "..."
+
+
+def error_kind(e: BaseException) -> str | None:
+    """Classify ``e`` for the launch retry policy (module doc).
+
+    Only RuntimeError/OSError lineages qualify — XlaRuntimeError (and
+    jax's JaxRuntimeError alias) subclass RuntimeError, and transport
+    errors ride OSError — so a ValueError from bad arguments is never
+    silently retried or degraded."""
+    if not isinstance(e, (RuntimeError, OSError)):
+        return None
+    msg = f"{type(e).__name__}: {e}"
+    if any(m in msg for m in OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return "transient"
+    return None
+
+
+class LaunchFailure(Exception):
+    """A device launch failed under the retry policy.
+
+    ``kind`` is ``"oom"`` (raised immediately — retrying the same shape
+    would OOM again; the caller halves the work) or ``"transient"`` (the
+    backoff retries are exhausted; the caller degrades the affected
+    lanes).  ``cause`` is the final underlying exception."""
+
+    def __init__(self, kind: str, cause: BaseException, what: str = "launch"):
+        self.kind = kind
+        self.cause = cause
+        self.what = what
+        super().__init__(f"{what} failed ({kind}): {describe(cause)}")
+
+
+def call_with_retry(
+    fn: Callable,
+    ctx: Mapping | None = None,
+    *,
+    retries: int | None = None,
+    base_s: float | None = None,
+    max_s: float | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run one device launch under the retry policy (module doc).
+
+    ``ctx`` annotates telemetry and the injection hook; recognized keys:
+    ``what`` (telemetry label), ``stage``/``engine``/``capacity``/
+    ``lanes`` (whatever the call site knows).  Returns ``fn()``'s value;
+    raises ``LaunchFailure`` on OOM or exhausted retries, and re-raises
+    unclassified exceptions untouched."""
+    ctx = dict(ctx or {})
+    what = str(ctx.get("what") or "launch")
+    retries = _env_int("JEPSEN_TPU_LAUNCH_RETRIES", 3) if retries is None else retries
+    base_s = _env_float("JEPSEN_TPU_RETRY_BASE_S", 0.25) if base_s is None else base_s
+    max_s = _env_float("JEPSEN_TPU_RETRY_MAX_S", 8.0) if max_s is None else max_s
+    attempt = 0
+    while True:
+        try:
+            hook = INJECT
+            if hook is not None:
+                hook(ctx, attempt)
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = error_kind(e)
+            if kind is None:
+                raise
+            if kind == "oom":
+                raise LaunchFailure("oom", e, what) from e
+            if attempt >= retries:
+                raise LaunchFailure("transient", e, what) from e
+            delay = min(max_s, base_s * (2 ** attempt))
+            attempt += 1
+            obs.counter(
+                "fault.launch.retry", what=what, attempt=attempt,
+                delay_s=round(delay, 3), error=describe(e),
+                **{k: ctx[k] for k in ("stage", "engine", "capacity", "lanes")
+                   if k in ctx},
+            )
+            sleep(delay)
+
+
+class Deadline:
+    """A wall-clock check budget, shared by every checker in a compose.
+
+    Constructed once (``checker.resolve_opts`` wraps the raw
+    ``"check-deadline"`` seconds value exactly once per check) so that
+    parallel checkers and nested engines all see ONE budget."""
+
+    __slots__ = ("seconds", "_t0")
+
+    def __init__(self, seconds: float, *, start: float | None = None):
+        self.seconds = float(seconds)
+        self._t0 = time.monotonic() if start is None else start
+
+    @classmethod
+    def coerce(cls, v) -> "Deadline | None":
+        """None passes through; a Deadline passes through; a number
+        becomes a fresh Deadline starting now."""
+        if v is None or isinstance(v, cls):
+            return v
+        return cls(float(v))
+
+    def remaining(self) -> float:
+        return self.seconds - (time.monotonic() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def __repr__(self):
+        return f"Deadline({self.seconds}s, {self.remaining():.3f}s left)"

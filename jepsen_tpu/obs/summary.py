@@ -14,6 +14,7 @@ later perf PRs report against.
                  "execute_s", "peak_frontier", "lossy", "dedup"}, ...]
    "dedup":    [{"backend", "candidates", "capacity", "probes",
                  "per_round_us"}, ...]                  # dedup.round spans
+   "faults":   [{"fault", "count", "seconds", "detail"}, ...]  # fault.* events
    "counters": {name: total}
    "gauges":   {name: last value}
    "spans":    {name: {"count", "total_s", "max_s"}}}
@@ -24,6 +25,13 @@ instruments (frontier occupancy, truncation/loss, per-stage utilization)
 plus the compile-vs-execute split ("compile_s" sums launches that hit a
 fresh (engine, shape) bucket — compile + first execute; "execute_s" sums
 warm launches).
+
+The faults table aggregates every ``fault.*`` event the fault-tolerance
+layer emits (jepsen_tpu.faults / parallel.batch): launch retries, OOM
+lane halvings, degraded launches, checkpoint saves/loads, confirmation
+resubmits, and deadline trips — one row per fault kind with its count,
+total seconds (for the span-shaped ones, e.g. checkpoint writes), and
+the last event's detail attributes.
 """
 
 from __future__ import annotations
@@ -34,12 +42,24 @@ from typing import Iterable, Mapping
 _STAGE_KEYS = (
     "resolved", "refuted", "unknowns_remaining", "launches",
     "compile_launches", "compile_s", "execute_s", "peak_frontier", "lossy",
-    "dedup",
+    "dedup", "degraded",
 )
 
 
 def _r(x: float) -> float:
     return round(float(x), 6)
+
+
+#: attrs copied (last write wins) into a fault row's "detail" string.
+_FAULT_DETAIL_KEYS = (
+    "what", "engine", "capacity", "stage", "lanes", "lanes_from", "lanes_to",
+    "at", "unresolved", "error", "reason", "history", "barrier",
+)
+
+
+def _fault_detail(attrs: Mapping) -> str:
+    parts = [f"{k}={attrs[k]}" for k in _FAULT_DETAIL_KEYS if k in attrs]
+    return " ".join(parts)
 
 
 def summarize(events: Iterable[Mapping]) -> dict:
@@ -49,9 +69,16 @@ def summarize(events: Iterable[Mapping]) -> dict:
     checkers: dict[str, dict] = {}
     ladder: list[dict] = []
     dedup: dict[tuple, dict] = {}
+    faults: dict[str, dict] = {}
     counters: dict[str, float] = {}
     gauges: dict[str, object] = {}
     wall = 0.0
+
+    def _fault_row(name: str) -> dict:
+        return faults.setdefault(
+            name, {"fault": name[len("fault."):], "count": 0, "seconds": 0.0,
+                   "detail": ""}
+        )
     for ev in events:
         et = ev.get("type")
         t = float(ev.get("t") or 0.0)
@@ -114,13 +141,32 @@ def summarize(events: Iterable[Mapping]) -> dict:
                 })
                 d["probes"] += 1
                 d["_total_us"] += float(attrs.get("per_round_us") or dur * 1e6)
+            if name.startswith("fault."):
+                f = _fault_row(name)
+                f["count"] += 1
+                f["seconds"] += dur
+                if attrs:
+                    f["detail"] = _fault_detail(attrs)
         elif et == "counter":
             wall = max(wall, t)
             name = str(ev.get("name"))
             counters[name] = counters.get(name, 0) + (ev.get("n") or 1)
+            if name.startswith("fault."):
+                f = _fault_row(name)
+                f["count"] += ev.get("n") or 1
+                if ev.get("attrs"):
+                    f["detail"] = _fault_detail(ev["attrs"])
         elif et == "gauge":
             wall = max(wall, t)
             gauges[str(ev.get("name"))] = ev.get("value")
+        elif et == "event":
+            wall = max(wall, t)
+            name = str(ev.get("name"))
+            if name.startswith("fault."):
+                f = _fault_row(name)
+                f["count"] += 1
+                if ev.get("attrs"):
+                    f["detail"] = _fault_detail(ev["attrs"])
     for p in phases:
         p["wall_s"] = _r(p["wall_s"])
     out_checkers = sorted(checkers.values(), key=lambda c: -c["seconds"])
@@ -135,6 +181,9 @@ def summarize(events: Iterable[Mapping]) -> dict:
     for name, s in spans.items():
         s["total_s"] = _r(s["total_s"])
         s["max_s"] = _r(s["max_s"])
+    out_faults = [faults[k] for k in sorted(faults)]
+    for f in out_faults:
+        f["seconds"] = _r(f["seconds"])
     return {
         "version": 1,
         "wall_s": _r(wall),
@@ -142,6 +191,7 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "checkers": out_checkers,
         "ladder": ladder,
         "dedup": out_dedup,
+        "faults": out_faults,
         "counters": counters,
         "gauges": gauges,
         "spans": spans,
@@ -206,6 +256,13 @@ def format_summary(summary: Mapping) -> str:
             [[d.get("backend"), d.get("candidates"), d.get("capacity"),
               d.get("probes"), d.get("per_round_us")]
              for d in summary["dedup"]],
+        ))
+    if summary.get("faults"):
+        parts.append("\nfaults (retries / degradations / checkpoints / deadline):")
+        parts.append(_table(
+            ["fault", "count", "seconds", "detail"],
+            [[f.get("fault"), f.get("count"), f.get("seconds", ""),
+              f.get("detail", "")] for f in summary["faults"]],
         ))
     if summary.get("counters"):
         parts.append("\ncounters:")

@@ -53,6 +53,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from jepsen_tpu import faults
 from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu import obs
@@ -798,6 +799,7 @@ def chunked_analysis(
     chunk_barriers: int = 512,
     fast: bool = False,
     dedup_backend: str | None = None,
+    deadline=None,
 ) -> dict:
     """Decide linearizability as a chain of chunk scans with a carried
     frontier (history decomposition — VERDICT round-2 item #2).
@@ -825,8 +827,17 @@ def chunked_analysis(
 
     ``dedup_backend`` selects the per-round dedup backend for every
     chunk scan (None → env/default via resolve_dedup_backend).
+
+    ``deadline`` (seconds or faults.Deadline) bounds wall clock at CHUNK
+    boundaries: on expiry the run degrades to an attributable
+    ``"unknown"`` instead of scanning past the budget.  Every chunk
+    launch runs under the transient-retry policy
+    (jepsen_tpu.faults.call_with_retry); a launch that still fails (or
+    OOMs — there is no sub-batch to halve on the single-history path)
+    degrades this history alone with the error named in ``cause``.
     """
     dedup = resolve_dedup_backend(dedup_backend)
+    deadline = faults.Deadline.coerce(deadline)
     B0 = packed["B"]
     quiet = packed["bar_quiet"]
     packed = pad_packed(packed, B=B0)  # bucket P/G; keep B for slicing
@@ -861,6 +872,23 @@ def chunked_analysis(
         )
 
     for lo, hi in bounds:
+        if deadline is not None and deadline.expired():
+            obs.counter("fault.deadline.trip")
+            obs.event("fault.deadline", at="wgl-chunk", barrier=lo)
+            stats = {
+                "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": True,
+                "chunks": len(bounds), "launches": launches,
+                "verified-barriers": verified,
+            }
+            _emit("unknown", stats)
+            return {
+                "valid?": "unknown",
+                "cause": (
+                    "deadline-exceeded: check budget exhausted at barrier "
+                    f"{lo}/{B0}"
+                ),
+                "kernel": stats,
+            }
         Bc = 1 << max(5, (hi - lo - 1).bit_length())
 
         def padc(a, fill=0):
@@ -897,12 +925,32 @@ def chunked_analysis(
             fo0[:k] = f_fok[:k]
             fc0[:k] = f_fcr[:k]
             al0[:k] = True
-            s, fo, fc, al, failed_at, lossy, peak = _scan_chunk(
-                packed["step"], F, int(rounds), P, G, W, fast,
-                jnp.asarray(st0), jnp.asarray(fo0), jnp.asarray(fc0),
-                jnp.asarray(al0), *c_args, *grp_args, c_grp_open,
-                slot_lane, slot_onehot, dedup=dedup,
-            )
+            try:
+                s, fo, fc, al, failed_at, lossy, peak = faults.call_with_retry(
+                    lambda: _scan_chunk(
+                        packed["step"], F, int(rounds), P, G, W, fast,
+                        jnp.asarray(st0), jnp.asarray(fo0), jnp.asarray(fc0),
+                        jnp.asarray(al0), *c_args, *grp_args, c_grp_open,
+                        slot_lane, slot_onehot, dedup=dedup,
+                    ),
+                    dict(what="wgl.chunk", engine="fast" if fast else "exact",
+                         capacity=F, lanes=1),
+                )
+            except faults.LaunchFailure as lf:
+                cause = faults.describe(lf.cause)
+                obs.counter("fault.launch.degraded", what="wgl.chunk",
+                            capacity=F, lanes=1, error=cause)
+                stats = {
+                    "frontier-peak": peak_g, "capacity": F, "lossy?": True,
+                    "chunks": len(bounds), "launches": launches,
+                    "verified-barriers": verified,
+                }
+                _emit("unknown", stats)
+                return {
+                    "valid?": "unknown",
+                    "cause": f"device launch failed: {cause}",
+                    "kernel": stats,
+                }
             launches += 1
             failed_at, lossy, peak = int(failed_at), bool(lossy), int(peak)
             peak_g = max(peak_g, peak)
@@ -970,6 +1018,7 @@ def analysis(
     chunk_barriers: int = 512,
     fast: bool = False,
     dedup_backend: str | None = None,
+    deadline=None,
 ) -> dict:
     """Decide linearizability on the accelerator.
 
@@ -998,7 +1047,7 @@ def analysis(
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     return chunked_analysis(
         model, history, packed, capacities, rounds, chunk_barriers, fast=fast,
-        dedup_backend=dedup_backend,
+        dedup_backend=dedup_backend, deadline=deadline,
     )
 
 

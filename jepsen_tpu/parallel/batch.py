@@ -12,11 +12,14 @@ its shard's frontiers in lockstep.
 
 from __future__ import annotations
 
+import logging
 import multiprocessing
 import os
 import time
 from concurrent.futures import ProcessPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from concurrent.futures.process import BrokenProcessPool
+from pathlib import Path
 from typing import Sequence
 
 import numpy as np
@@ -25,10 +28,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from jepsen_tpu import _confirm_worker, obs
+from jepsen_tpu import _confirm_worker, faults, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
 from jepsen_tpu.ops import hashing, wgl
+from jepsen_tpu.store import checkpoint as _ckpt
+
+logger = logging.getLogger(__name__)
 
 #: lazily created, reused across batch_analysis calls (spawn startup is
 #: ~seconds; the pool is harmless idle and dies with the process).
@@ -207,6 +213,9 @@ def batch_analysis(
     carry_frontier: bool = True,
     greedy_first: bool = True,
     dedup_backend: str | None = None,
+    checkpoint_dir: str | Path | None = None,
+    resume: bool = False,
+    deadline=None,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -274,6 +283,27 @@ def batch_analysis(
     content-decided either way.  (The greedy rung walks a single
     configuration — no frontier, nothing to dedup — so the backend
     choice is moot there by construction.)
+
+    Fault tolerance (jepsen_tpu.faults): every device launch runs under
+    a retry policy — transient ``XlaRuntimeError``s retry with
+    exponential backoff; ``RESOURCE_EXHAUSTED`` halves the sub-batch
+    (and the stage lane budget) and relaunches, floor one lane; a
+    launch that still fails degrades ONLY its lanes to ``"unknown"``
+    with a ``cause`` naming the error, never the whole batch.
+    ``checkpoint_dir`` persists the ladder's durable state after every
+    stage (jepsen_tpu.store.checkpoint: verdicts so far, the pending
+    set, resume frontiers, in-flight confirmation descriptors, the
+    RNG-free config); ``resume=True`` reloads it and re-enters the
+    ladder at the saved rung — a kill -9 mid-ladder then a resume
+    yields verdicts identical to an uninterrupted run.  On resume the
+    SAVED config wins over the caller's ladder arguments (verdict
+    identity requires the original ladder), and a checkpoint whose
+    history fingerprint doesn't match is ignored with a warning.
+    ``deadline`` (seconds or a faults.Deadline) bounds wall clock: it
+    is polled at stage boundaries; on expiry the ladder checkpoints,
+    marks the remaining packs ``unknown`` with cause
+    ``deadline-exceeded`` plus a pointer to the checkpoint, and still
+    returns a complete result list.
     """
     dedup = hashing.resolve_dedup_backend(dedup_backend)
     results: list[dict | None] = [None] * len(histories)
@@ -325,6 +355,70 @@ def batch_analysis(
                 stacklevel=2,
             )
     exact_caps = [int(c) for c in (exact_escalation or ())]
+
+    # ------------------------------------------------------------------
+    # Checkpoint / resume (jepsen_tpu.store.checkpoint).
+    # ------------------------------------------------------------------
+    checkpoint_dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
+    deadline = faults.Deadline.coerce(deadline)
+    deadline_tripped = False
+    trip_checkpointed = False  # a resumable trip checkpoint is on disk
+    no_fallback: set[int] = set()  # history idxs the CPU fallback must skip
+    start_stage = 0
+    restored = None
+    fp = None
+    if checkpoint_dir is not None or resume:
+        fp = _ckpt.fingerprint(histories)
+    if resume and checkpoint_dir is not None and _ckpt.exists(checkpoint_dir):
+        t_load = time.perf_counter()
+        try:
+            restored = _ckpt.load(checkpoint_dir)
+        except _ckpt.CheckpointError as e:
+            logger.warning("unreadable checkpoint in %s (%s); running fresh",
+                           checkpoint_dir, e)
+            obs.counter("fault.checkpoint.mismatch", reason="unreadable")
+        if restored is not None and restored["config"].get("fingerprint") != fp:
+            logger.warning(
+                "checkpoint in %s was written for different histories; "
+                "running fresh (resuming against changed inputs could "
+                "only produce wrong verdicts)", checkpoint_dir)
+            obs.counter("fault.checkpoint.mismatch", reason="fingerprint")
+            restored = None
+        if restored is not None:
+            # The saved config wins: verdict identity requires the
+            # original ladder, and the CLI resume path can't know the
+            # original kwargs.
+            cfg = restored["config"]
+            engine = cfg.get("engine", engine)
+            batch_caps = [int(c) for c in cfg.get("capacity", batch_caps)]
+            exact_caps = [int(c) for c in cfg.get("exact_escalation", exact_caps)]
+            rounds = int(cfg.get("rounds", rounds))
+            greedy_first = bool(cfg.get("greedy_first", greedy_first))
+            carry_frontier = bool(cfg.get("carry_frontier", carry_frontier))
+            dedup = cfg.get("dedup", dedup)
+            confirm_refutations = cfg.get(
+                "confirm_refutations", confirm_refutations)
+            start_stage = int(restored["stage"])
+            obs.span_event(
+                "fault.checkpoint.load", time.perf_counter() - t_load,
+                stage=start_stage, pending=len(restored["pending"]),
+                complete=restored["complete"],
+            )
+            if restored["complete"]:
+                # A finished run's checkpoint: hand back the saved
+                # verdicts (idempotent resume; no device work at all).
+                for i, r in restored["results"].items():
+                    if 0 <= i < len(results):
+                        results[i] = r
+                return [r if r is not None else {"valid?": "unknown"}
+                        for r in results]
+    config = {
+        "engine": engine, "capacity": list(batch_caps),
+        "exact_escalation": list(exact_caps), "rounds": int(rounds),
+        "greedy_first": bool(greedy_first),
+        "carry_frontier": bool(carry_frontier), "dedup": dedup,
+        "confirm_refutations": confirm_refutations, "fingerprint": fp,
+    }
 
     #: per-stage launch accounting for the telemetry stage table; "_key"
     #: is the launched (engine, shape) bucket, set at each runner site.
@@ -513,10 +607,114 @@ def batch_analysis(
         stages = [("greedy", 1)] + stages
     pending = list(range(len(packs)))
     resumes: dict[int, tuple] = {}  # pack idx -> saved resume frontier
-    confirm_futs: dict = {}  # history index -> (future, device result)
+    confirm_futs: dict = {}  # hist idx -> (pool, future, device result, t, op_pos)
     device_confirms: list[tuple] = []  # (pack idx, failed_at, cap, result)
+    confirm_degraded: set[int] = set()  # hist idxs whose confirmation hit the deadline
+    if restored is not None:
+        # Re-enter the ladder where the checkpoint left it: verdicts so
+        # far (including the pending lanes' unknown placeholders), the
+        # pending set, and each pending lane's carried-frontier resume
+        # snapshot.  In-flight worker confirmations are RESUBMITTED (the
+        # old futures died with the old process); queued device
+        # confirmations re-queue as they were.
+        pack_of = {i: k for k, i in enumerate(idxs)}
+        for i, r in restored["results"].items():
+            if 0 <= i < len(results):
+                results[i] = r
+        pending = [pack_of[i] for i in restored["pending"] if i in pack_of]
+        for i, fr in restored["resumes"].items():
+            if i in pack_of:
+                resumes[pack_of[i]] = fr
+        for i, info in restored["confirms"].items():
+            pool, fut = _submit_confirmation(
+                confirm_workers, model, list(histories[i]),
+                confirm_max_configs, int(info["op_pos"]),
+            )
+            obs.counter("confirm.submitted")
+            confirm_futs[i] = (
+                pool, fut, info["res"], time.perf_counter(), int(info["op_pos"])
+            )
+            results[i] = info["res"]
+        for e in restored["device_confirms"]:
+            if e["i"] in pack_of:
+                device_confirms.append(
+                    (pack_of[e["i"]], int(e["failed_at"]), int(e["cap"]), e["res"])
+                )
+                results[e["i"]] = e["res"]
+
+    def _save_checkpoint(next_stage: int, complete: bool = False):
+        """Persist the ladder's durable state at a stage boundary; a
+        save failure is logged, counted, and never fails the analysis
+        (the checkpoint is a recovery aid, not a verdict input)."""
+        if checkpoint_dir is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            path = _ckpt.save(
+                checkpoint_dir,
+                config=config,
+                stage=next_stage,
+                results={i: r for i, r in enumerate(results) if r is not None},
+                pending=[idxs[k] for k in pending],
+                confirms={
+                    i: {"res": res, "op_pos": op_pos}
+                    for i, (_p, _f, res, _t, op_pos) in confirm_futs.items()
+                },
+                device_confirms=[
+                    {"i": idxs[k], "failed_at": fat, "cap": cap, "res": res}
+                    for k, fat, cap, res in device_confirms
+                ],
+                resumes={idxs[k]: resumes[k] for k in pending if k in resumes},
+                complete=complete,
+            )
+        except Exception:  # noqa: BLE001 — see docstring
+            logger.warning("couldn't write checker checkpoint to %s",
+                           checkpoint_dir, exc_info=True)
+            obs.counter("fault.checkpoint.error")
+            return None
+        obs.span_event(
+            "fault.checkpoint.save", time.perf_counter() - t0,
+            stage=next_stage, pending=len(pending), complete=complete,
+        )
+        return path
+
+    #: OOM halvings shrink the stage lane budget for the REST of the run
+    #: (the device that OOM'd once at a shape will OOM again; re-probing
+    #: it every stage would pay the fault each time).
+    budget_scale = 1.0
     for si, (st_engine, batch_cap) in enumerate(stages):
         if not pending:
+            break
+        if si < start_stage:
+            continue  # resumed past this rung; its work is in `results`
+        if deadline is not None and deadline.expired():
+            # Deadline-bounded degradation: checkpoint FIRST (the saved
+            # placeholders keep their resumable causes), then mark every
+            # remaining pack unknown with an attributable cause plus a
+            # pointer to the checkpoint.  The CPU fallback is skipped
+            # for these — the budget is spent.
+            deadline_tripped = True
+            ck = _save_checkpoint(si)
+            trip_checkpointed = ck is not None
+            obs.event("fault.deadline", at="ladder-stage", stage=si,
+                      unresolved=len(pending))
+            obs.counter("fault.deadline.trip")
+            note = f"; resumable checkpoint: {ck}" if ck else ""
+            for k in pending:
+                i = idxs[k]
+                prev = results[i]
+                results[i] = {
+                    "valid?": "unknown",
+                    "cause": (
+                        "deadline-exceeded: check budget exhausted before "
+                        f"ladder stage {si}{note}"
+                    ),
+                }
+                if isinstance(prev, dict) and prev.get("kernel"):
+                    results[i]["kernel"] = prev["kernel"]
+                no_fallback.add(i)
+            obs.gauge("ladder.unknowns_remaining", len(pending), final=True)
+            pending = []
             break
         _reset_launch_acc()
         t_stage = time.perf_counter()
@@ -551,6 +749,7 @@ def batch_analysis(
                 results[i] = wgl.chunked_analysis(
                     model, histories[i], packs[k], exact_ladder,
                     rounds=int(rounds), fast=False, dedup_backend=dedup,
+                    deadline=deadline,
                 )
             pending = safe
             if not pending:
@@ -570,7 +769,6 @@ def batch_analysis(
             budget = _CARRY_LANE_BUDGET
         else:
             budget = _FAST_LANE_BUDGET
-        lanes_cap = max(1, budget // batch_cap)
         # Carried-frontier fetch (round 5): resume snapshots leave the
         # device only for lanes that STAY pending, and only when a later
         # async rung exists to resume them — each lane's pre-loss
@@ -586,19 +784,61 @@ def batch_analysis(
             st_engine == "async" and carry_frontier
             and any(e == "async" for e, _ in stages[si + 1:])
         )
-        outs = []
-        for s0 in range(0, len(pending), lanes_cap):
-            chunk = pending[s0 : s0 + lanes_cap]
+        lane_out: dict[int, tuple] = {}  # pack idx -> (valid, fat, lossy, peak)
+        degraded: list[tuple[int, str]] = []  # (pack idx, cause)
+
+        def _launch_ft(part: list[int]) -> None:
+            """Launch one sub-batch under the fault policy: transient
+            errors retry with backoff inside faults.call_with_retry; an
+            OOM halves the sub-batch recursively (floor one lane — and
+            the stage lane budget shrinks with it, so later chunks don't
+            re-probe the fault); a part that still fails degrades ONLY
+            its lanes, never the batch.  Successful parts land their
+            verdicts in lane_out and fetch their pending lanes' resume
+            snapshots immediately (at most one part's snapshot is ever
+            device-resident, preserving the lane budget's resident-row
+            bound)."""
+            nonlocal budget_scale
             sub_res = (
-                [resumes.get(k) for k in chunk]
+                [resumes.get(k) for k in part]
                 if (st_engine == "async" and carry_frontier) else None
             )
-            out = _launch(st_engine, batch_cap, [packs[k] for k in chunk], sub_res)
+            ctx = dict(
+                what=f"ladder.{st_engine}", stage=si, engine=st_engine,
+                capacity=batch_cap, lanes=len(part),
+            )
+            try:
+                out = faults.call_with_retry(
+                    lambda: _launch(
+                        st_engine, batch_cap, [packs[k] for k in part], sub_res
+                    ),
+                    ctx,
+                )
+            except faults.LaunchFailure as lf:
+                if lf.kind == "oom" and len(part) > 1:
+                    mid = (len(part) + 1) // 2
+                    budget_scale = max(budget_scale / 2, 1.0 / max(1, budget))
+                    obs.counter(
+                        "fault.launch.oom_halving", stage=si,
+                        engine=st_engine, capacity=batch_cap,
+                        lanes_from=len(part), lanes_to=mid,
+                    )
+                    _launch_ft(part[:mid])
+                    _launch_ft(part[mid:])
+                    return
+                cause = faults.describe(lf.cause)
+                obs.counter(
+                    "fault.launch.degraded", stage=si, engine=st_engine,
+                    capacity=batch_cap, lanes=len(part), error=cause,
+                )
+                degraded.extend((k, cause) for k in part)
+                return
             v, fat, lz, pk, snap = out
-            outs.append((v, fat, lz, pk))
+            for j, k in enumerate(part):
+                lane_out[k] = (v[j], fat[j], lz[j], pk[j])
             if fetch_snaps and snap is not None:
                 local = [
-                    jl for jl in range(len(chunk))
+                    jl for jl in range(len(part))
                     if _stays_pending(v[jl], fat[jl], lz[jl])
                 ]
                 if local:
@@ -607,27 +847,48 @@ def batch_analysis(
                         tuple(a[sel] for a in snap)
                     )
                     for t, jl in enumerate(local):
-                        resumes[chunk[jl]] = (
+                        resumes[part[jl]] = (
                             int(bs[t]), sst[t], sfo[t], sfc[t], sal[t]
                         )
             del snap, out  # free the device snapshot before the next launch
-        valid, failed_at, lossy, peak = (
-            np.concatenate([o[i] for o in outs]) for i in range(4)
-        )
+
+        # Re-read the (possibly OOM-halved) scale for EVERY chunk: when
+        # chunk 1 OOMs, chunks 2..n are sliced at the shrunken budget
+        # instead of re-probing the fault at the original width.
+        s0 = 0
+        while s0 < len(pending):
+            lanes_cap = max(1, int(budget * budget_scale) // batch_cap)
+            _launch_ft(pending[s0 : s0 + lanes_cap])
+            s0 += lanes_cap
+        for k, cause in degraded:
+            # a failed launch costs exactly its own lanes: each degrades
+            # to unknown with the error named, and (when enabled) the
+            # CPU fallback below still gets a chance to decide it
+            results[idxs[k]] = {
+                "valid?": "unknown",
+                "cause": f"device launch failed: {cause}",
+            }
         still = []
         n_true = n_refuted = 0
-        for j, k in enumerate(pending):
+        peak_max = 0
+        n_lossy = 0
+        for k in pending:
+            if k not in lane_out:
+                continue  # degraded this stage; its result is set above
+            valid_k, fat_k, lossy_k, peak_k = lane_out[k]
             i = idxs[k]
-            stats = {"frontier-peak": int(peak[j]), "capacity": batch_cap, "lossy?": bool(lossy[j])}
+            stats = {"frontier-peak": int(peak_k), "capacity": batch_cap, "lossy?": bool(lossy_k)}
+            peak_max = max(peak_max, int(peak_k))
+            n_lossy += bool(lossy_k)
             # the SAME predicate the snapshot fetch filtered on — a lane
             # fetched there is exactly a lane classified pending here
-            pending_lane = _stays_pending(valid[j], failed_at[j], lossy[j])
-            if not pending_lane and failed_at[j] < 0:
+            pending_lane = _stays_pending(valid_k, fat_k, lossy_k)
+            if not pending_lane and fat_k < 0:
                 n_true += 1
                 results[i] = {"valid?": True, "kernel": stats}
             elif not pending_lane:
                 n_refuted += 1
-                op_pos = int(packs[k]["bar_opid"][int(failed_at[j])])
+                op_pos = int(packs[k]["bar_opid"][int(fat_k)])
                 op = histories[i][op_pos]
                 res = {"valid?": False, "op": op, "kernel": stats}
                 if st_engine == "exact" or not confirm_refutations:
@@ -640,7 +901,7 @@ def batch_analysis(
                     # the ladder drains (no CPU sweeps at all on the
                     # happy path — the drain tail was the 1-core host's
                     # serial sweeps)
-                    device_confirms.append((k, int(failed_at[j]), batch_cap, res))
+                    device_confirms.append((k, int(fat_k), batch_cap, res))
                     results[i] = res  # placeholder; resolved below
                 else:
                     # fast-engine refutation: hash-dedup could in
@@ -654,7 +915,7 @@ def batch_analysis(
                         confirm_max_configs, op_pos,
                     )
                     obs.counter("confirm.submitted")
-                    confirm_futs[i] = (pool, fut, res, time.perf_counter())
+                    confirm_futs[i] = (pool, fut, res, time.perf_counter(), op_pos)
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
@@ -666,12 +927,13 @@ def batch_analysis(
         pending = still
         _emit_stage(
             t_stage, stage_attrs, resolved=n_true, refuted=n_refuted,
-            unknowns_remaining=len(still), peak_frontier=int(peak.max()),
-            lossy=int(lossy.sum()),
+            unknowns_remaining=len(still), peak_frontier=peak_max,
+            lossy=n_lossy, degraded=len(degraded),
         )
         obs.gauge(
             "ladder.unknowns_remaining", len(still), stage=si, capacity=batch_cap
         )
+        _save_checkpoint(si + 1)
 
     if pending:
         # The lanes the whole ladder failed to resolve: close the
@@ -703,12 +965,24 @@ def batch_analysis(
         """Resolve one device-mode confirmation: an exact lossless death
         makes the refutation final; otherwise (collision artifact or
         loss) the bounded CPU sweep decides (shared by the batched
-        launch and the unsafe-shape chunked fallback)."""
+        launch and the unsafe-shape chunked fallback).  A deadline that
+        expires mid-confirmation degrades to unknown instead of
+        starting a sweep the budget can no longer cover."""
+        nonlocal deadline_tripped
         i = idxs[k]
         device_resolved.add(i)
         if exact_died:
             res["confirmed?"] = True
             results[i] = res
+            return
+        if deadline is not None and deadline.expired():
+            deadline_tripped = True
+            results[i] = {
+                "valid?": "unknown",
+                "cause": ("device refutation; deadline-exceeded before "
+                          "exact confirmation"),
+                "kernel": res.get("kernel"),
+            }
             return
         op_pos = int(packs[k]["bar_opid"][fat])
         cpu_res = wgl_cpu.sweep_analysis(
@@ -717,6 +991,36 @@ def batch_analysis(
         )
         results[i] = _resolve_confirmation(res, cpu_res)
 
+    if device_confirms and deadline is not None and deadline.expired():
+        # The budget died before the exact confirmations ran: an
+        # unconfirmed fast-engine False must never be reported, so each
+        # one degrades to unknown.  The descriptors are in the
+        # checkpoint — a resume finishes the confirmations.  A stage
+        # trip already saved a resumable checkpoint (which INCLUDES
+        # these descriptors); overwriting it here would bake the
+        # pending lanes' deadline causes in as final results and
+        # destroy their resume frontiers.
+        deadline_tripped = True
+        if not trip_checkpointed:
+            ck = _save_checkpoint(len(stages))
+            trip_checkpointed = ck is not None
+        else:
+            ck = _ckpt.json_path(checkpoint_dir) if checkpoint_dir else None
+        obs.event("fault.deadline", at="device-confirm",
+                  unresolved=len(device_confirms))
+        obs.counter("fault.deadline.trip")
+        note = f"; resumable checkpoint: {ck}" if ck else ""
+        for k, _fat, _cap, res in device_confirms:
+            results[idxs[k]] = {
+                "valid?": "unknown",
+                "cause": (
+                    "device refutation; deadline-exceeded before exact "
+                    f"confirmation{note}"
+                ),
+                "kernel": res.get("kernel"),
+            }
+            no_fallback.add(idxs[k])
+        device_confirms = []
     if device_confirms:
         # One batched exact-engine launch per capacity bucket over the
         # failure PREFIXES: content-decided kills make a lossless exact
@@ -753,13 +1057,30 @@ def batch_analysis(
                 # launch below.
                 r = wgl.chunked_analysis(
                     model, histories[idxs[k]], p, [cap], rounds=int(rounds),
-                    fast=False, dedup_backend=dedup,
+                    fast=False, dedup_backend=dedup, deadline=deadline,
                 )
                 _finish_confirmation(k, fat, res, r["valid?"] is False)
             group = safe_group
             for s0 in range(0, len(group), lanes_cap):
                 sub = masked[s0 : s0 + lanes_cap]
-                gvalid, gfailed, glossy, _pk, _rs = _launch("exact", cap, sub)
+                ctx = dict(what="ladder.confirm", engine="exact",
+                           capacity=cap, lanes=len(sub))
+                try:
+                    gvalid, gfailed, glossy, _pk, _rs = faults.call_with_retry(
+                        lambda: _launch("exact", cap, sub), ctx
+                    )
+                except faults.LaunchFailure as lf:
+                    # no halving here: the bounded CPU sweep is the
+                    # natural degradation for a failed confirm launch —
+                    # it decides each refutation exactly, just slower
+                    obs.counter(
+                        "fault.launch.degraded", what="ladder.confirm",
+                        capacity=cap, lanes=len(sub),
+                        error=faults.describe(lf.cause),
+                    )
+                    for (k, fat, res) in group[s0 : s0 + lanes_cap]:
+                        _finish_confirmation(k, fat, res, False)
+                    continue
                 for (k, fat, res), v, f2, lz in zip(
                     group[s0 : s0 + lanes_cap], gvalid, gfailed, glossy
                 ):
@@ -768,13 +1089,24 @@ def batch_analysis(
             "ladder.confirm.device", time.perf_counter() - t_conf,
             refutations=len(device_confirms), launches=launch_acc["launches"],
         )
+        device_confirms = []  # resolved; keep them out of later checkpoints
 
     if cpu_fallback:
         t_fb = time.perf_counter()
         n_fb = 0
         for i, r in enumerate(results):
+            if deadline is not None and deadline.expired():
+                # The budget is spent: the remaining unknowns keep their
+                # attributable causes instead of starting CPU sweeps the
+                # deadline can no longer cover.
+                if not deadline_tripped:
+                    deadline_tripped = True
+                    obs.counter("fault.deadline.trip")
+                    obs.event("fault.deadline", at="cpu-fallback")
+                break
             if (r is not None and r["valid?"] == "unknown"
-                    and i not in confirm_futs and i not in device_resolved):
+                    and i not in confirm_futs and i not in device_resolved
+                    and i not in no_fallback):
                 # The config-set sweep, not the DFS: DFS backtracking goes
                 # exponential on exactly the histories that overflow the
                 # kernel (info-heavy invalid ones); the sweep is the same
@@ -786,46 +1118,95 @@ def batch_analysis(
                 "ladder.cpu-fallback", time.perf_counter() - t_fb, histories=n_fb
             )
 
-    t_drain = time.perf_counter()
-    for i, (pool, fut, dev_res, t_submit) in confirm_futs.items():
-        try:
-            if fut is None:
-                raise BrokenProcessPool("no confirmation worker available")
-            cpu_res = fut.result()
-        except Exception as e:  # noqa: BLE001 — a dead worker must not
-            # lose the other histories' verdicts; degrade this one only.
-            # Reset only the pool the failure came from, and only while
-            # it is still installed: a stale future's error must not
-            # shut down a healthy rebuilt pool that other histories'
-            # confirmations are running on.
-            if isinstance(e, BrokenProcessPool) and pool is not None and pool is _CONFIRM_POOL:
-                _reset_confirm_pool()
-            if cpu_fallback:
-                # the caller asked for CPU fallback on unknowns: confirm
-                # in-process instead (same sweep the worker would run).
-                # If the worker died because the sweep itself raises
-                # deterministically (model bug, malformed history), the
-                # re-run raises the SAME error — degrade this history
-                # alone, never the batch (advisor r4).
-                try:
-                    results[i] = wgl_cpu.sweep_analysis(
-                        model, histories[i], max_configs=confirm_max_configs
-                    )
-                except Exception as e2:  # noqa: BLE001
-                    results[i] = {
-                        "valid?": "unknown",
-                        "cause": (
-                            "device refutation; confirmation sweep raised: "
-                            f"{e2!r}"
-                        ),
-                        "kernel": dev_res.get("kernel"),
-                    }
-            else:
+    def _degrade_confirmation(i: int, dev_res: dict, e: BaseException) -> None:
+        """A confirmation worker died (twice, after the bounded
+        resubmit): degrade THIS history only, never the batch.  With
+        cpu_fallback (and budget left) the sweep re-runs in-process —
+        if the worker died because the sweep itself raises
+        deterministically (model bug, malformed history), the re-run
+        raises the SAME error and still degrades this history alone
+        (advisor r4)."""
+        if cpu_fallback and not (deadline is not None and deadline.expired()):
+            try:
+                results[i] = wgl_cpu.sweep_analysis(
+                    model, histories[i], max_configs=confirm_max_configs
+                )
+                return
+            except Exception as e2:  # noqa: BLE001
                 results[i] = {
                     "valid?": "unknown",
-                    "cause": f"device refutation; confirmation worker failed: {e!r}",
+                    "cause": (
+                        "device refutation; confirmation sweep raised: "
+                        f"{e2!r}"
+                    ),
                     "kernel": dev_res.get("kernel"),
                 }
+                return
+        results[i] = {
+            "valid?": "unknown",
+            "cause": f"device refutation; confirmation worker failed: {e!r}",
+            "kernel": dev_res.get("kernel"),
+        }
+
+    t_drain = time.perf_counter()
+    for i, (pool, fut, dev_res, t_submit, op_pos) in confirm_futs.items():
+        resubmitted = False
+        while True:
+            try:
+                if fut is None:
+                    raise BrokenProcessPool("no confirmation worker available")
+                timeout = None
+                if deadline is not None:
+                    # leave a small grace so nearly-done sweeps land; a
+                    # timeout degrades this history alone (the
+                    # checkpoint kept its descriptor for a resume)
+                    timeout = max(5.0, deadline.remaining())
+                cpu_res = fut.result(timeout=timeout)
+                break
+            except FutureTimeout:
+                deadline_tripped = True
+                confirm_degraded.add(i)
+                obs.counter("fault.deadline.trip")
+                obs.event("fault.deadline", at="confirm-drain", history=i)
+                results[i] = {
+                    "valid?": "unknown",
+                    "cause": (
+                        "device refutation; deadline-exceeded before the "
+                        "confirmation sweep finished"
+                    ),
+                    "kernel": dev_res.get("kernel"),
+                }
+                cpu_res = None
+                break
+            except BrokenProcessPool:
+                # Reset only the pool the failure came from, and only
+                # while it is still installed: a stale future's error
+                # must not shut down a healthy rebuilt pool that other
+                # histories' confirmations are running on.
+                if pool is not None and pool is _CONFIRM_POOL:
+                    _reset_confirm_pool()
+                if not resubmitted:
+                    # The in-flight task died WITH the pool: one bounded
+                    # resubmit against the rebuilt pool before degrading
+                    # (a broken pool is usually one bad worker, not a
+                    # deterministic task failure).
+                    resubmitted = True
+                    obs.counter("fault.confirm.resubmit", history=i)
+                    pool, fut = _submit_confirmation(
+                        confirm_workers, model, list(histories[i]),
+                        confirm_max_configs, op_pos,
+                    )
+                    continue
+                cpu_res = _degrade_confirmation(
+                    i, dev_res,
+                    BrokenProcessPool("confirmation worker failed twice"),
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — a dead worker must
+                # not lose the other histories' verdicts; this one only
+                cpu_res = _degrade_confirmation(i, dev_res, e)
+                break
+        if cpu_res is None:
             continue
         # Queue latency: submit-to-resolution — how much of the sweep ran
         # concurrently with the remaining ladder stages vs in the drain.
@@ -859,4 +1240,18 @@ def batch_analysis(
                 "ladder.dedup-probe", time.perf_counter() - t_probe,
                 capacity=batch_caps[0], active_backend=dedup,
             )
+    if checkpoint_dir is not None and not trip_checkpointed:
+        # Final checkpoint: "complete" unless a deadline trip left
+        # resumable work (degraded confirmations keep their descriptors
+        # so a resume can finish them; a complete checkpoint makes a
+        # later resume idempotent — saved verdicts, no device work).
+        # Skipped when a trip already wrote its resumable checkpoint —
+        # overwriting it would destroy exactly the state a resume needs.
+        confirm_futs = {
+            i: t for i, t in confirm_futs.items() if i in confirm_degraded
+        }
+        _save_checkpoint(
+            len(stages),
+            complete=not deadline_tripped and not confirm_degraded,
+        )
     return [r if r is not None else {"valid?": "unknown"} for r in results]

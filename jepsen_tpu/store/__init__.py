@@ -15,8 +15,10 @@ three phases, exactly like the reference's crash-safety story
 Formats: the test map and results are JSON (non-serializable values
 stringified, mirroring store.clj:92-104's nonserializable-key stripping);
 the history is JSON-lines (one op per line, like history.edn) plus the
-human-readable ``history.txt``.  All writes go through tmp+rename so a
-crash never leaves a torn file.
+human-readable ``history.txt``.  All writes go through tmp + fsync +
+rename (+ directory fsync) so a crash never leaves a torn file and a
+completed write survives a hard power cut — the same path the checker's
+``checker-checkpoint.json``/``.npz`` ride (store/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ import json
 import logging
 import os
 import shutil
+import tempfile
 from pathlib import Path
 from typing import Any, Mapping, Sequence
 
@@ -88,10 +91,50 @@ def serializable_test(test: Mapping) -> dict:
     return _jsonable({k: v for k, v in test.items() if k not in NONSERIALIZABLE_KEYS})
 
 
-def _atomic_write(path: Path, data: str):
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_text(data)
-    os.replace(tmp, path)
+def _fsync_dir(d: Path) -> None:
+    """fsync a directory so a just-renamed entry survives a power cut
+    (rename atomicity alone only orders the rename against the crash,
+    not against the disk).  Platforms without directory fds skip."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. Windows
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fs without dir fsync
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: Path, data: str | bytes):
+    """tmp + fsync + rename + dir fsync: a reader never sees a torn
+    file (rename atomicity), and a completed write survives a hard
+    power cut (the data AND the directory entry are durable before the
+    tmp name disappears).  Checkpoints and results both ride this.
+
+    The tmp name is UNIQUE per writer (mkstemp), not ``<path>.tmp``:
+    composed checkers write into one run dir concurrently, and two
+    writers sharing a fixed tmp name could publish a torn mix of both.
+    Concurrent same-path writers thus stay last-writer-wins, each write
+    atomic."""
+    path = Path(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=str(path.parent or "."), prefix=path.name + ".", suffix=".tmp"
+    )
+    binary = isinstance(data, (bytes, bytearray))
+    try:
+        with os.fdopen(fd, "wb" if binary else "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, 0o644)  # mkstemp's 0600 would hide artifacts from the web UI user
+        os.replace(tmp, path)
+    except BaseException:
+        with _contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+    _fsync_dir(path.parent)
 
 
 def _write_json(path: Path, obj):

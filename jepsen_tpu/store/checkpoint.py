@@ -1,0 +1,200 @@
+"""Checker checkpoint/resume: the ladder's durable state on disk.
+
+A multi-minute ``parallel.batch_analysis`` ladder run used to live only
+in process memory — a preemption lost everything.  This module persists
+the ladder's durable state after every stage so a killed run resumes at
+the saved rung with the saved frontiers and produces verdicts identical
+to an uninterrupted run:
+
+  ``checker-checkpoint.json``  the control state: the ladder config
+      (engine, capacity ladders, rounds, dedup backend, confirmation
+      mode — RNG-free by construction) plus a history fingerprint, the
+      stage cursor, per-history verdicts so far, the pending set,
+      in-flight confirmation descriptors, and queued device
+      confirmations.
+  ``checker-checkpoint.npz``   the pending lanes' carried-frontier
+      resume snapshots (the round-5 snapshot machinery's
+      (bsnap, state, fok, fcr, alive) tuples), keyed by history index.
+
+Both files ride ``store._atomic_write`` (tmp + fsync + rename + dir
+fsync), npz BEFORE json — the json names the stage the npz belongs to,
+so a crash between the two leaves a json that simply predates the npz's
+extra rows (never the reverse: a json pointing at missing frontiers).
+
+Resume semantics: ``load()`` hands the saved state back;
+``batch_analysis(resume=True)`` verifies the fingerprint against the
+histories it was given (a mismatch is IGNORED with a warning — resuming
+against different inputs can only produce wrong verdicts, running fresh
+never can) and re-enters the ladder at the saved rung.  The saved
+CONFIG wins over the caller's arguments on resume: the CLI resume path
+cannot know the original kwargs, and verdict identity requires the
+original ladder.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+from pathlib import Path
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from jepsen_tpu import store as _store
+
+CKPT_JSON = "checker-checkpoint.json"
+CKPT_NPZ = "checker-checkpoint.npz"
+
+VERSION = 1
+
+
+class CheckpointError(Exception):
+    """Missing, torn, or version-incompatible checkpoint."""
+
+
+def json_path(d) -> Path:
+    return Path(d) / CKPT_JSON
+
+
+def exists(d) -> bool:
+    return json_path(d).exists()
+
+
+def fingerprint(histories: Sequence[Sequence[Mapping]]) -> str:
+    """A stable identity for the checked inputs: sha256 over every op's
+    (type, process, f, value) in order, per history.
+
+    A stored ``ColumnHistory`` hashes its SoA columns DIRECTLY —
+    iterating it would materialize every op dict, defeating the store's
+    zero-copy path on 50k-op runs.  The two paths therefore fingerprint
+    the same content differently; that is fine — the fingerprint only
+    has to be stable for the same input source (a resume re-reads the
+    same stored run), and a spurious mismatch merely means a fresh run,
+    never a wrong resume."""
+    h = hashlib.sha256()
+    for hist in histories:
+        h.update(b"\x00")
+        cols = getattr(hist, "cols", None)
+        if cols is not None and hasattr(hist, "fs"):
+            for name in ("type", "process", "f", "value1", "value2"):
+                if name in cols:
+                    h.update(np.ascontiguousarray(np.asarray(cols[name])).tobytes())
+            h.update(json.dumps(list(hist.fs), default=str).encode())
+            extras = getattr(hist, "extras", None) or {}
+            if extras:
+                h.update(
+                    json.dumps(_store._jsonable(extras), sort_keys=True,
+                               default=str).encode()
+                )
+            continue
+        for o in hist:
+            h.update(
+                json.dumps(
+                    [
+                        _store._jsonable(o.get("type")),
+                        _store._jsonable(o.get("process")),
+                        _store._jsonable(o.get("f")),
+                        _store._jsonable(o.get("value")),
+                    ],
+                    separators=(",", ":"),
+                    default=str,
+                ).encode()
+            )
+    return h.hexdigest()
+
+
+def save(
+    d,
+    *,
+    config: Mapping,
+    stage: int,
+    results: Mapping[int, Mapping],
+    pending: Sequence[int],
+    confirms: Mapping[int, Mapping] | None = None,
+    device_confirms: Sequence[Mapping] | None = None,
+    resumes: Mapping[int, tuple] | None = None,
+    complete: bool = False,
+) -> Path:
+    """Atomically persist one stage boundary's state; returns the json
+    path.  ``resumes`` maps history index -> (bsnap, state, fok, fcr,
+    alive); ``confirms`` maps history index -> {"res", "op_pos"} for
+    in-flight worker confirmations (resubmitted on resume);
+    ``device_confirms`` is the queued device-confirmation descriptors
+    [{"i", "failed_at", "cap", "res"}].  ``complete`` marks a finished
+    run — resuming it returns the saved results without device work."""
+    d = Path(d)
+    d.mkdir(parents=True, exist_ok=True)
+    resumes = dict(resumes or {})
+    if resumes:
+        arrays = {}
+        for i, (bsnap, st, fo, fc, al) in resumes.items():
+            arrays[f"{i}_bsnap"] = np.asarray(bsnap, np.int32)
+            arrays[f"{i}_st"] = np.asarray(st)
+            arrays[f"{i}_fo"] = np.asarray(fo)
+            arrays[f"{i}_fc"] = np.asarray(fc)
+            arrays[f"{i}_al"] = np.asarray(al)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        _store._atomic_write(d / CKPT_NPZ, buf.getvalue())
+    doc = {
+        "version": VERSION,
+        "complete": bool(complete),
+        "config": config,
+        "stage": int(stage),
+        "results": {str(i): r for i, r in (results or {}).items()},
+        "pending": [int(i) for i in pending],
+        "confirms": {str(i): c for i, c in (confirms or {}).items()},
+        "device_confirms": list(device_confirms or ()),
+        "resumes": sorted(int(i) for i in resumes),
+    }
+    _store._atomic_write(
+        json_path(d), json.dumps(_store._jsonable(doc), indent=1)
+    )
+    return json_path(d)
+
+
+def load(d) -> dict:
+    """Load a checkpoint back into live shapes: int-keyed results/
+    confirms, resume tuples rebuilt from the npz.  Raises
+    CheckpointError on a missing/torn/unknown-version file."""
+    p = json_path(d)
+    if not p.exists():
+        raise CheckpointError(f"no {CKPT_JSON} in {d}")
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"unreadable {p}: {e}") from e
+    if doc.get("version") != VERSION:
+        raise CheckpointError(f"unknown checkpoint version {doc.get('version')!r}")
+    out = {
+        "complete": bool(doc.get("complete")),
+        "config": doc.get("config") or {},
+        "stage": int(doc.get("stage") or 0),
+        "results": {int(i): r for i, r in (doc.get("results") or {}).items()},
+        "pending": [int(i) for i in doc.get("pending") or ()],
+        "confirms": {int(i): c for i, c in (doc.get("confirms") or {}).items()},
+        "device_confirms": list(doc.get("device_confirms") or ()),
+        "resumes": {},
+        "path": str(p),
+    }
+    want = [int(i) for i in doc.get("resumes") or ()]
+    if want:
+        npz = Path(d) / CKPT_NPZ
+        if not npz.exists():
+            raise CheckpointError(f"{p} references missing {CKPT_NPZ}")
+        with np.load(npz) as a:
+            for i in want:
+                try:
+                    out["resumes"][i] = (
+                        int(a[f"{i}_bsnap"]),
+                        a[f"{i}_st"],
+                        a[f"{i}_fo"],
+                        a[f"{i}_fc"],
+                        a[f"{i}_al"],
+                    )
+                except KeyError as e:
+                    raise CheckpointError(
+                        f"{CKPT_NPZ} is missing frontier arrays for lane {i}"
+                    ) from e
+    return out

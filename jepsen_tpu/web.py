@@ -179,6 +179,13 @@ def telemetry_html(run_dir: Path) -> str:
             [[d.get("backend"), d.get("candidates"), d.get("capacity"),
               d.get("probes"), d.get("per_round_us")] for d in s["dedup"]],
         ))
+    if s.get("faults"):
+        parts.append("<h3>faults (retries / degradations / checkpoints / deadline)</h3>")
+        parts.append(_telemetry_table(
+            ["fault", "count", "seconds", "detail"],
+            [[f.get("fault"), f.get("count"), f.get("seconds", ""),
+              f.get("detail", "")] for f in s["faults"]],
+        ))
     if s.get("counters"):
         parts.append("<h3>counters</h3>")
         parts.append(_telemetry_table(

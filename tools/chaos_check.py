@@ -1,0 +1,231 @@
+"""Chaos harness for the checker's fault-tolerance layer.
+
+Runs a pinned workload (deterministic seeds) through
+``parallel.batch_analysis`` three ways and diffs verdicts:
+
+  1. a clean baseline — no faults;
+  2. ``--runs`` runs with RANDOMIZED injected launch faults (seeded —
+     reproducible): transient XlaRuntimeError-shaped errors on first
+     attempts and RESOURCE_EXHAUSTED on multi-lane launches, driven
+     through the ``jepsen_tpu.faults.INJECT`` seam;
+  3. one mid-run SIGKILL/resume cycle: a CHILD process runs the same
+     ladder with checkpointing and SIGKILLs itself after its
+     ``--kill-after``-th checkpoint write; the parent then resumes from
+     the checkpoint in-process.
+
+Exit 0 iff the robustness contract holds:
+
+  * every faulted run's verdict per history is either the clean-run
+    verdict or ``unknown`` with a non-empty ``cause`` (no crashes, no
+    silent verdict flips);
+  * the SIGKILL'd-then-resumed run's verdicts are IDENTICAL to the
+    clean run's.
+
+Usage:
+  python tools/chaos_check.py                  # full: 128x? no — pinned default below
+  python tools/chaos_check.py --smoke          # tiny variant (tier-1 tests)
+  python tools/chaos_check.py --runs 5 --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import faults  # noqa: E402
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.parallel import batch as pb  # noqa: E402
+
+#: the pinned ladder every phase runs (checkpoint config included) —
+#: small capacities so stage 0 leaves contested lanes for later rungs.
+LADDER = dict(capacity=(8, 64, 512), cpu_fallback=False, exact_escalation=(),
+              confirm_refutations=False)
+
+
+def build_histories(n: int, ops: int, procs: int, seed0: int = 4000):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(ops, procs, seed=seed0 + i, info_rate=0.35)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+def verdicts(results) -> list:
+    return [r["valid?"] for r in results]
+
+
+def diff_against_clean(clean, faulted) -> list[str]:
+    """The acceptance predicate: clean verdict, or attributable unknown."""
+    problems = []
+    for i, (c, f) in enumerate(zip(clean, faulted)):
+        if f["valid?"] == c["valid?"]:
+            continue
+        if f["valid?"] == "unknown" and str(f.get("cause") or "").strip():
+            continue
+        problems.append(
+            f"history {i}: clean={c['valid?']!r} faulted={f['valid?']!r} "
+            f"cause={f.get('cause')!r}"
+        )
+    return problems
+
+
+def chaos_injector(seed: int):
+    """A seeded randomized fault plan: ~25% of launch attempts fail
+    transiently (first attempts only, so retries succeed), ~15% of
+    multi-lane launches OOM (exercising the halving path)."""
+    rng = random.Random(seed)
+
+    class ChaosXlaRuntimeError(RuntimeError):
+        pass
+
+    def inject(ctx, attempt):
+        r = rng.random()
+        if attempt == 0 and r < 0.25:
+            raise ChaosXlaRuntimeError("INTERNAL: injected transient fault")
+        if attempt == 0 and r < 0.40 and ctx.get("lanes", 0) > 1:
+            raise ChaosXlaRuntimeError("RESOURCE_EXHAUSTED: injected OOM")
+
+    return inject
+
+
+def run_faulted(hists, seed: int):
+    faults.INJECT = chaos_injector(seed)
+    try:
+        return pb.batch_analysis(m.CASRegister(None), hists, **LADDER)
+    finally:
+        faults.INJECT = None
+
+
+#: the child half of the SIGKILL cycle: same pinned workload, checkpoint
+#: into CKPT_DIR, SIGKILL self after the KILL_AFTER-th checkpoint write.
+_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import chaos_check
+from jepsen_tpu.store import checkpoint as ckpt
+orig_save = ckpt.save
+state = {{"n": 0}}
+def killing_save(*a, **kw):
+    out = orig_save(*a, **kw)
+    state["n"] += 1
+    if state["n"] >= {kill_after}:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return out
+ckpt.save = killing_save
+hists = chaos_check.build_histories({n}, {ops}, {procs})
+from jepsen_tpu import models as m
+from jepsen_tpu.parallel import batch as pb
+pb.batch_analysis(m.CASRegister(None), hists,
+                  checkpoint_dir={ckpt_dir!r}, **chaos_check.LADDER)
+print("CHILD-FINISHED-WITHOUT-KILL")
+"""
+
+
+def sigkill_resume_cycle(hists, n, ops, procs, kill_after: int, ckpt_dir: str):
+    """Run the ladder in a child killed -9 mid-run, then resume here.
+    Returns (child_was_killed, resumed_results)."""
+    src = _CHILD_SRC.format(
+        repo=str(REPO), tools=str(REPO / "tools"), kill_after=kill_after,
+        n=n, ops=ops, procs=procs, ckpt_dir=ckpt_dir,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True,
+        env=env, cwd=str(REPO), timeout=600,
+    )
+    killed = p.returncode == -signal.SIGKILL
+    if not killed:
+        print(f"child exited {p.returncode} (expected SIGKILL); "
+              f"stdout tail: {p.stdout[-500:]} stderr tail: {p.stderr[-500:]}",
+              file=sys.stderr)
+    resumed = pb.batch_analysis(
+        m.CASRegister(None), hists, checkpoint_dir=ckpt_dir, resume=True,
+        **LADDER,
+    )
+    return killed, resumed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--histories", type=int, default=16)
+    ap.add_argument("--ops", type=int, default=60)
+    ap.add_argument("--procs", type=int, default=6)
+    ap.add_argument("--runs", type=int, default=3,
+                    help="randomized injected-fault runs")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--kill-after", type=int, default=2,
+                    help="SIGKILL the child after this many checkpoint writes")
+    ap.add_argument("--skip-sigkill", action="store_true",
+                    help="skip the subprocess SIGKILL/resume cycle")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny variant for the tier-1 test run")
+    opts = ap.parse_args(argv)
+    if opts.smoke:
+        opts.histories, opts.ops, opts.procs, opts.runs = 5, 30, 4, 1
+        opts.kill_after = 1  # kill right after the first checkpoint: the
+        # child pays one stage, the resume still has real ladder work
+
+    hists = build_histories(opts.histories, opts.ops, opts.procs)
+    clean = pb.batch_analysis(m.CASRegister(None), hists, **LADDER)
+    print(f"clean verdicts: {verdicts(clean)}")
+
+    failures = 0
+    for r in range(opts.runs):
+        seed = opts.seed + r
+        faulted = run_faulted(hists, seed)
+        problems = diff_against_clean(clean, faulted)
+        status = "ok" if not problems else "FAIL"
+        print(f"fault run seed={seed}: {status} verdicts={verdicts(faulted)}")
+        for pr in problems:
+            failures += 1
+            print(f"  {pr}", file=sys.stderr)
+
+    if not opts.skip_sigkill:
+        with tempfile.TemporaryDirectory(prefix="chaos-ckpt-") as d:
+            killed, resumed = sigkill_resume_cycle(
+                hists, opts.histories, opts.ops, opts.procs,
+                opts.kill_after, d,
+            )
+            if not killed:
+                failures += 1
+            same = verdicts(resumed) == verdicts(clean)
+            print(f"sigkill/resume: killed={killed} identical={same} "
+                  f"verdicts={verdicts(resumed)}")
+            if not same:
+                failures += 1
+                for i, (c, rr) in enumerate(zip(clean, resumed)):
+                    if c["valid?"] != rr["valid?"]:
+                        print(f"  history {i}: clean={c['valid?']!r} "
+                              f"resumed={rr['valid?']!r}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "chaos_check",
+        "histories": opts.histories,
+        "fault_runs": opts.runs,
+        "sigkill_cycle": not opts.skip_sigkill,
+        "failures": failures,
+    }))
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
