@@ -24,6 +24,24 @@ import os
 ENV_VAR = "JEPSEN_TPU_PLATFORM"
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """jax.shard_map across the jax API move: ``jax.shard_map`` (>=0.6,
+    ``check_vma``) vs ``jax.experimental.shard_map.shard_map`` (0.4/0.5,
+    ``check_rep`` — same meaning).  Every mesh kernel builds through this
+    one shim so an interpreter upgrade is a one-line fix."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def honor_env_platform() -> None:
     want = os.environ.get(ENV_VAR)
     if not want:

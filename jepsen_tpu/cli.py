@@ -216,9 +216,33 @@ def _cmd_test_all(suite_fn: Callable, opts) -> int:
 
 
 def _cmd_serve(opts) -> int:
+    """``serve``: the store browser, plus — with ``--check`` — the
+    persistent check service (jepsen_tpu.serve): POST /check admits
+    histories into the shared batching queue, bounded at --max-queue
+    (beyond it: 429 + Retry-After), and Ctrl-C drains gracefully,
+    checkpointing still-queued work into --drain-dir."""
     from jepsen_tpu import web
 
-    web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir)
+    svc = None
+    if getattr(opts, "check", False):
+        from jepsen_tpu.serve import CheckService
+
+        capacity = tuple(
+            int(c) for c in str(opts.check_capacity).split(",") if c
+        )
+        svc = CheckService(
+            capacity=capacity,
+            max_queue=opts.max_queue,
+            max_batch=opts.max_batch,
+            batch_window_s=opts.batch_window_ms / 1000.0,
+            drain_dir=opts.drain_dir,
+        ).start()
+        logger.info(
+            "check service up: max_queue=%d max_batch=%d capacity=%s",
+            opts.max_queue, opts.max_batch, capacity,
+        )
+    web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir,
+              check_service=svc)
     return EXIT_VALID
 
 
@@ -261,10 +285,32 @@ def run_cli(
         if extra_opts:
             extra_opts(p_an)
 
-    p_serve = sub.add_parser("serve", help="browse results over HTTP")
+    p_serve = sub.add_parser(
+        "serve", help="browse results over HTTP (+ check service)")
     p_serve.add_argument("--host", default="0.0.0.0")
     p_serve.add_argument("--port", type=int, default=8080)
     p_serve.add_argument("--store-dir", default=None)
+    p_serve.add_argument("--check", action="store_true",
+                         help="mount the check service (POST /check, "
+                              "GET /check/<id>, GET /queue): a persistent "
+                              "queue batching concurrent callers' histories "
+                              "into shared kernel launches")
+    p_serve.add_argument("--max-queue", type=int, default=256,
+                         help="admission bound; a full queue rejects with "
+                              "429 + Retry-After (default 256)")
+    p_serve.add_argument("--max-batch", type=int, default=64,
+                         help="max requests packed per shared launch "
+                              "(default 64)")
+    p_serve.add_argument("--batch-window-ms", type=float, default=2.0,
+                         help="pile-in pause before each batch so "
+                              "concurrent submitters coalesce (default 2)")
+    p_serve.add_argument("--check-capacity", default="64,512,4096",
+                         help="the service ladder's capacity stages "
+                              "(comma-separated; default 64,512,4096)")
+    p_serve.add_argument("--drain-dir", default=None,
+                         help="where shutdown checkpoints still-queued "
+                              "requests (resume with "
+                              "jepsen_tpu.serve.resume_drained)")
 
     try:
         opts = parser.parse_args(argv)

@@ -205,5 +205,11 @@ class Deadline:
     def expired(self) -> bool:
         return self.remaining() <= 0.0
 
+    def clamp(self, timeout: float) -> float:
+        """Bound a wait by the budget: min(timeout, remaining), floored
+        at 0 — the serving layer's blocking HTTP result waits must never
+        outlive the request's own deadline (web.py POST /check wait)."""
+        return max(0.0, min(timeout, self.remaining()))
+
     def __repr__(self):
         return f"Deadline({self.seconds}s, {self.remaining():.3f}s left)"

@@ -8,6 +8,12 @@ later perf PRs report against.
    "wall_s":   <last event end, seconds since recording start>,
    "phases":   [{"phase", "wall_s", "count"}, ...]      # phase.* spans
    "checkers": [{"checker", "seconds", "count", "valid"}, ...]
+   "serve":    {"batches", "requests", "batch_wall_s", "avg_batch_requests",
+                "avg_occupancy", "avg_padding_waste",
+                "admission": {"count", "mean_s", "max_s"},
+                "request":   {"count", "mean_s", "max_s"},
+                "submitted", "completed", "rejected", "expired", "drained"}
+                                                        # serve.* events
    "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
                  "resolved", "refuted", "unknowns_remaining",
                  "launches", "compile_launches", "compile_s",
@@ -32,6 +38,13 @@ lane halvings, degraded launches, checkpoint saves/loads, confirmation
 resubmits, and deadline trips — one row per fault kind with its count,
 total seconds (for the span-shaped ones, e.g. checkpoint writes), and
 the last event's detail attributes.
+
+The serve section aggregates the check-serving subsystem's ``serve.*``
+events (jepsen_tpu.serve): shared-batch count/occupancy/padding waste
+from ``serve.batch`` spans, admission-wait and end-to-end request
+latency from ``serve.admission``/``serve.request`` span events, and the
+admission counters (submitted/completed/rejected/expired/drained).
+Empty dict when a run never touched the service.
 """
 
 from __future__ import annotations
@@ -72,6 +85,12 @@ def summarize(events: Iterable[Mapping]) -> dict:
     faults: dict[str, dict] = {}
     counters: dict[str, float] = {}
     gauges: dict[str, object] = {}
+    serve_batch = {"count": 0, "requests": 0, "wall": 0.0, "occ": 0.0,
+                   "waste": 0.0}
+    serve_lat = {
+        "serve.admission": {"count": 0, "total": 0.0, "max": 0.0},
+        "serve.request": {"count": 0, "total": 0.0, "max": 0.0},
+    }
     wall = 0.0
 
     def _fault_row(name: str) -> dict:
@@ -141,6 +160,17 @@ def summarize(events: Iterable[Mapping]) -> dict:
                 })
                 d["probes"] += 1
                 d["_total_us"] += float(attrs.get("per_round_us") or dur * 1e6)
+            elif name == "serve.batch":
+                serve_batch["count"] += 1
+                serve_batch["requests"] += int(attrs.get("requests") or 0)
+                serve_batch["wall"] += dur
+                serve_batch["occ"] += float(attrs.get("occupancy") or 0.0)
+                serve_batch["waste"] += float(attrs.get("padding_waste") or 0.0)
+            elif name in serve_lat:
+                sl = serve_lat[name]
+                sl["count"] += 1
+                sl["total"] += dur
+                sl["max"] = max(sl["max"], dur)
             if name.startswith("fault."):
                 f = _fault_row(name)
                 f["count"] += 1
@@ -184,11 +214,35 @@ def summarize(events: Iterable[Mapping]) -> dict:
     out_faults = [faults[k] for k in sorted(faults)]
     for f in out_faults:
         f["seconds"] = _r(f["seconds"])
+    serve: dict = {}
+    if serve_batch["count"]:
+        nb = serve_batch["count"]
+        serve.update(
+            batches=nb,
+            requests=serve_batch["requests"],
+            batch_wall_s=_r(serve_batch["wall"]),
+            avg_batch_requests=round(serve_batch["requests"] / nb, 2),
+            avg_occupancy=round(serve_batch["occ"] / nb, 4),
+            avg_padding_waste=round(serve_batch["waste"] / nb, 4),
+        )
+    for span_name, out_key in (("serve.admission", "admission"),
+                               ("serve.request", "request")):
+        sl = serve_lat[span_name]
+        if sl["count"]:
+            serve[out_key] = {
+                "count": sl["count"],
+                "mean_s": _r(sl["total"] / sl["count"]),
+                "max_s": _r(sl["max"]),
+            }
+    for cname in ("submitted", "completed", "rejected", "expired", "drained"):
+        if f"serve.{cname}" in counters:
+            serve[cname] = counters[f"serve.{cname}"]
     return {
         "version": 1,
         "wall_s": _r(wall),
         "phases": phases,
         "checkers": out_checkers,
+        "serve": serve,
         "ladder": ladder,
         "dedup": out_dedup,
         "faults": out_faults,
@@ -233,6 +287,20 @@ def format_summary(summary: Mapping) -> str:
             [[c["checker"], c["seconds"], c["count"], c.get("valid")]
              for c in summary["checkers"]],
         ))
+    if summary.get("serve"):
+        s = summary["serve"]
+        parts.append("\ncheck service:")
+        rows = [[k, s[k]] for k in (
+            "batches", "requests", "batch_wall_s", "avg_batch_requests",
+            "avg_occupancy", "avg_padding_waste", "submitted", "completed",
+            "rejected", "expired", "drained") if k in s]
+        for key, label in (("admission", "admission wait"),
+                           ("request", "request latency")):
+            if key in s:
+                lat = s[key]
+                rows.append([f"{label} mean_s", lat["mean_s"]])
+                rows.append([f"{label} max_s", lat["max_s"]])
+        parts.append(_table(["serve", "value"], rows))
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",
                    "resolved", "refuted", "unknowns", "launches",

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu import _platform
 from jepsen_tpu._platform import honor_env_platform
 
 # This module is a backend-initializing entry point in its own right
@@ -295,7 +296,7 @@ def transitive_closure_sharded(adj: np.ndarray, mesh, steps: int | None = None):
         return lax.fori_loop(0, steps, step_fn, r_blk.astype(bool))
 
     fn = jax.jit(
-        jax.shard_map(
+        _platform.shard_map(
             body,
             mesh=mesh,
             in_specs=PartitionSpec(axis, None),

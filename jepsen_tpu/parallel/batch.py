@@ -72,6 +72,34 @@ _EXACT_LANE_BUDGET = 16 * 1024
 _FAST_LANE_BUDGET = 64 * 1024
 _CARRY_LANE_BUDGET = 32 * 1024
 
+#: the padded-geometry bucket tables every batched launch quantizes to
+#: (P = slots, G = groups); bucket_geometry is the single source the
+#: launch sites AND the serving layer's batch-compatibility key share —
+#: two histories with equal bucketed geometry reuse one compiled kernel.
+P_BUCKETS = (8, 16, 32, 64, 128)
+G_BUCKETS = (4, 8, 16, 32, 64)
+
+
+def bucket_geometry(B: int, P: int, G: int) -> tuple[int, int, int]:
+    """The padded (B, P, G) bucket a packed history launches at."""
+    return (
+        wgl.pad_B(B),
+        wgl._bucket(P, list(P_BUCKETS)),
+        wgl._bucket(G, list(G_BUCKETS)),
+    )
+
+
+def padded_batch(n: int, mesh: Mesh | None = None) -> int:
+    """The padded batch-axis size a launch of ``n`` lanes runs at: the
+    next power of two (floor 8), rounded up to a mesh multiple — the
+    same quantity _launch_impl pads to, exposed so the serving layer can
+    report true batch occupancy / padding waste."""
+    n_pad = 1 << max(3, (n - 1).bit_length())
+    if mesh is not None:
+        shard = mesh.devices.size
+        n_pad = ((n_pad + shard - 1) // shard) * shard
+    return n_pad
+
 
 def _stays_pending(valid, fat, lossy) -> bool:
     """Whether one lane's (valid, failed_at, lossy) launch outcome leaves
@@ -470,17 +498,16 @@ def batch_analysis(
         ``np.asarray`` here is a tunnel round-trip, and fetching every
         lane's full padded frontier after every rung was measured at
         ~0.8 s on the bench ladder (chip ablation, round 5)."""
-        B = wgl.pad_B(max(p["B"] for p in sub))
-        P = wgl._bucket(max(p["P"] for p in sub), [8, 16, 32, 64, 128])
-        G = wgl._bucket(max(p["G"] for p in sub), [4, 8, 16, 32, 64])
+        B, P, G = bucket_geometry(
+            max(p["B"] for p in sub),
+            max(p["P"] for p in sub),
+            max(p["G"] for p in sub),
+        )
         stacked = _stack(sub, B, P, G)
         n = len(sub)
         # Pad the batch axis to a power of two (and a mesh multiple) so the
         # vmapped kernel compiles once per bucket, not once per batch size.
-        n_pad = 1 << max(3, (n - 1).bit_length())
-        if mesh is not None:
-            shard = mesh.devices.size
-            n_pad = ((n_pad + shard - 1) // shard) * shard
+        n_pad = padded_batch(n, mesh)
         if n_pad != n:
             for k in stacked:
                 if k in ("slot_lane", "slot_onehot"):
@@ -1229,8 +1256,8 @@ def batch_analysis(
         # "dedup" table and tools/trace_summarize.py.  Telemetry-gated
         # AND once per shape per process: a couple ms, never a
         # recurring tax on long runs.
-        pP = wgl._bucket(max(p["P"] for p in packs), [8, 16, 32, 64, 128])
-        pG = wgl._bucket(max(p["G"] for p in packs), [4, 8, 16, 32, 64])
+        pP = wgl._bucket(max(p["P"] for p in packs), list(P_BUCKETS))
+        pG = wgl._bucket(max(p["G"] for p in packs), list(G_BUCKETS))
         shape = (batch_caps[0], pP, pG)
         if shape not in _PROBED_DEDUP_SHAPES:
             _PROBED_DEDUP_SHAPES.add(shape)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from jepsen_tpu import _platform
 from jepsen_tpu import models as m
 from jepsen_tpu.ops import wgl
 from jepsen_tpu.ops.hashing import frontier_update, hash_rows
@@ -215,7 +216,7 @@ def _sharded_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int):
     key = (mesh, step, Fl, R, P_, G, W)
     if key not in _SHARDED_RUNNERS:
         core = functools.partial(_run_core_sharded, axis, D, step, Fl, R, P_, G, W)
-        fn = jax.shard_map(
+        fn = _platform.shard_map(
             core,
             mesh=mesh,
             in_specs=(P(),) * 16,

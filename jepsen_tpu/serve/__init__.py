@@ -1,0 +1,50 @@
+"""Check-serving subsystem: a persistent, multi-tenant checking service.
+
+Everything before this package was one-shot: each ``core.analyze`` / CLI
+invocation paid its own compile + launch, small histories wasted ladder
+lanes, and nothing arbitrated concurrent callers for the device.  This
+package is the serving layer on top of the checker pipeline — the shape
+that made batched decoding practical (continuously pack independent
+small requests into one padded launch; cf. arXiv:2010.02164's streaming
+batched beam search) applied to the WGL ladder:
+
+  * ``CheckService`` — admission queue (per-request priority, deadline,
+    client id), a batching scheduler that packs compatible queued
+    histories into shared ``parallel.batch.batch_analysis`` launches
+    keyed by padded geometry (kernel compilations are reused across
+    requests, not per caller), per-request result demux via futures,
+    and explicit backpressure (bounded queue depth, ``QueueFull`` with
+    a retry-after estimate — HTTP 429 in ``jepsen_tpu.web``).
+  * Graceful drain: shutdown checkpoints still-queued work through
+    ``store.checkpoint`` so a restarted operator can finish it with
+    ``resume_drained``.
+  * ``serve.*`` telemetry (queue depth, admission latency, batch
+    occupancy, padding waste, per-request end-to-end latency) into the
+    existing obs tables (``telemetry.json``'s "serve" section).
+
+Exposure: this Python API (``submit(history, ...) -> Future[verdict]``),
+the HTTP API mounted into ``jepsen_tpu.web`` (``POST /check``,
+``GET /check/<id>``, ``GET /queue``), and ``jepsen-tpu serve --check``.
+"""
+
+from jepsen_tpu.serve.service import (
+    MODELS,
+    CheckFuture,
+    CheckRequest,
+    CheckService,
+    QueueFull,
+    ServiceClosed,
+    model_by_name,
+    resume_drained,
+)
+
+__all__ = [
+    "MODELS",
+    "CheckFuture",
+    "CheckRequest",
+    "CheckService",
+    "QueueFull",
+    "ServiceClosed",
+    "model_by_name",
+    "resume_drained",
+]

@@ -228,24 +228,35 @@ def update_symlinks(test: Mapping):
 # ---------------------------------------------------------------------------
 
 
-def tests(name: str | None = None, store_dir=None) -> dict:
-    """{name: {timestamp: path}} of stored runs (store.clj:121-160)."""
+def iter_runs(store_dir=None):
+    """Yield ``(name, timestamp, run_dir, mtime_ns)`` for every stored
+    run — the ONE store-directory enumeration (dir, non-symlink, two
+    levels) that ``tests()`` and the web dashboard's cached run index
+    both consume, so what counts as "a run" can never diverge between
+    the API and the UI."""
     base = base_dir({"store-dir": store_dir} if store_dir else None)
-    out: dict = {}
     if not base.exists():
-        return out
+        return
     for name_dir in sorted(base.iterdir()):
         if not name_dir.is_dir() or name_dir.is_symlink():
             continue
-        if name is not None and name_dir.name != name:
+        for run in sorted(name_dir.iterdir()):
+            if not run.is_dir() or run.is_symlink():
+                continue
+            try:
+                mt = run.stat().st_mtime_ns
+            except OSError:
+                continue
+            yield name_dir.name, run.name, run, mt
+
+
+def tests(name: str | None = None, store_dir=None) -> dict:
+    """{name: {timestamp: path}} of stored runs (store.clj:121-160)."""
+    out: dict = {}
+    for n, ts, run, _mt in iter_runs(store_dir=store_dir):
+        if name is not None and n != name:
             continue
-        runs = {
-            run.name: run
-            for run in sorted(name_dir.iterdir())
-            if run.is_dir() and not run.is_symlink()
-        }
-        if runs:
-            out[name_dir.name] = runs
+        out.setdefault(n, {})[ts] = run
     return out
 
 

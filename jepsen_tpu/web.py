@@ -1,10 +1,26 @@
-"""Web UI: browse stored test runs.
+"""Web UI: browse stored test runs + the check-serving HTTP API.
 
 Mirrors ``jepsen.web`` (reference: jepsen/src/jepsen/web.clj): a tiny HTTP
 app over the store directory — a home table of runs colored by validity
 (web.clj:25-41,128-158), directory listings and file serving with a
 path-traversal guard (web.clj:235-284, 328-333), and zip download of a
 whole test directory (web.clj:286-327).  stdlib http.server; no deps.
+
+When a ``jepsen_tpu.serve.CheckService`` is mounted (``make_server(...,
+check_service=svc)`` / ``jepsen-tpu serve --check``) the app also serves
+the check API:
+
+  POST /check        submit a history ({"history": [...], "model": ...,
+                     "priority", "deadline", "client", "wait"}); 202 +
+                     request id, 200 + result with "wait": true, 429 +
+                     Retry-After on backpressure
+  GET  /check/<id>   request status / result
+  GET  /queue        queue-status JSON (the home page shows a panel)
+
+The home/suite run index is cached keyed on store-directory mtimes so
+the dashboard stays cheap while the service is under load: validity is
+re-read for a run only when its directory's mtime changes (results.json
+and run.jepsen land via rename, which bumps it).
 """
 
 from __future__ import annotations
@@ -13,17 +29,29 @@ import html
 import io
 import json
 import logging
+import math
 import mimetypes
+import os
+import threading
+import time
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from urllib.parse import unquote
 
-from jepsen_tpu import store
+from jepsen_tpu import faults, store
 
 logger = logging.getLogger(__name__)
 
 VALID_COLORS = {True: "#6DB6FE", False: "#FFAA26", "unknown": "#FEB5DA"}
+
+#: run-index caches: full index keyed on the store dir's mtime signature,
+#: per-run validity keyed on that run dir's mtime (see run_index).  The
+#: lock serializes rebuilds — dashboard requests run on
+#: ThreadingHTTPServer threads.
+_INDEX_CACHE: dict[str, tuple[tuple, list]] = {}
+_VALID_CACHE: dict[str, tuple[int, object]] = {}
+_INDEX_LOCK = threading.Lock()
 
 
 def _valid_of(run_dir: Path):
@@ -49,11 +77,98 @@ def _valid_of(run_dir: Path):
         return "unknown"
 
 
-def home_html(store_dir=None) -> str:
+def run_index(store_dir=None) -> list[tuple[str, str, Path, object]]:
+    """(name, timestamp, run_dir, valid?) rows for every stored run,
+    cached on mtimes: the home/suite pages used to rescan the store dir
+    AND re-open every run's footer/results.json per request — under
+    serving load that made the dashboard the most expensive endpoint.
+    The full index is reused while the directory tree's mtime signature
+    is unchanged; a run's validity is re-read only when its own dir
+    mtime moves (artifacts land via rename, which bumps it)."""
+    base = store.base_dir({"store-dir": store_dir} if store_dir else None)
+    sig: list = []
+    entries: list[tuple[str, str, Path, int]] = []
+    if base.exists():
+        try:
+            sig.append(base.stat().st_mtime_ns)
+        except OSError:
+            pass
+        for name, ts, run, mt in store.iter_runs(store_dir=store_dir):
+            sig.append((name, ts, mt))
+            entries.append((name, ts, run, mt))
+    key = str(base)
+    with _INDEX_LOCK:
+        cached = _INDEX_CACHE.get(key)
+        if cached is not None and cached[0] == tuple(sig):
+            return cached[1]
+        rows = []
+        live = set()
+        now_ns = time.time_ns()
+        for name, ts, run, mt in entries:
+            ck = str(run)
+            live.add(ck)
+            vc = _VALID_CACHE.get(ck)
+            if vc is not None and vc[0] == mt:
+                v = vc[1]
+            else:
+                v = _valid_of(run)
+                # Don't cache a validity read off a just-modified run
+                # dir: a second artifact landing within the same mtime
+                # tick would be indistinguishable, baking a stale
+                # verdict in forever.  Quiet-for-2s runs cache normally.
+                if now_ns - mt > 2_000_000_000:
+                    _VALID_CACHE[ck] = (mt, v)
+            rows.append((name, ts, run, v))
+        # Evict deleted runs on each rebuild so a long-lived server
+        # watching a churning store doesn't leak cache entries.  The
+        # separator-suffixed prefix keeps a sibling store ("store2")
+        # from being evicted by "store"'s rebuilds.
+        prefix = key.rstrip(os.sep) + os.sep
+        for ck in [k for k in _VALID_CACHE
+                   if k.startswith(prefix) and k not in live]:
+            del _VALID_CACHE[ck]
+        if not entries or now_ns - max(mt for *_e, mt in entries) > 2_000_000_000:
+            _INDEX_CACHE[key] = (tuple(sig), rows)
+        else:
+            # An actively-written run shares the stale-tick hazard at
+            # the index level too: keep rebuilding (cheap — quiet runs'
+            # validity stays cached) until the store is 2s quiet, and
+            # drop any older cached index so its stale sig can't serve.
+            _INDEX_CACHE.pop(key, None)
+        return rows
+
+
+def queue_panel_html(service) -> str:
+    """The home page's check-service queue-status panel."""
+    if service is None:
+        return ""
+    s = service.stats()
+    cells = "".join(
+        f"<td><b>{html.escape(str(s.get(k)))}</b><br>"
+        f"<small>{html.escape(label)}</small></td>"
+        for k, label in (
+            ("queue_depth", "queued"), ("running", "running"),
+            ("submitted", "submitted"), ("completed", "completed"),
+            ("rejected", "rejected"), ("expired", "expired"),
+            ("batches", "batches"), ("batch_ewma_s", "batch ewma (s)"),
+        )
+    )
+    return (
+        "<h2>check service</h2>"
+        "<table style='border:1px solid #ddd'><tr>"
+        + cells
+        + "</tr></table>"
+        "<p><a href='/queue'>queue JSON</a></p>"
+    )
+
+
+def home_html(store_dir=None, check_service=None) -> str:
     rows = []
-    for name, runs in sorted(store.tests(store_dir=store_dir).items()):
-        for ts, d in sorted(runs.items(), reverse=True):
-            v = _valid_of(d)
+    by_name: dict[str, list] = {}
+    for name, ts, d, v in run_index(store_dir):
+        by_name.setdefault(name, []).append((ts, d, v))
+    for name in sorted(by_name):
+        for ts, d, v in sorted(by_name[name], reverse=True):
             color = VALID_COLORS.get(v, "#eee")
             rows.append(
                 f"<tr style='background:{color}'>"
@@ -69,7 +184,8 @@ def home_html(store_dir=None) -> str:
         "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
         "td,th{padding:4px 12px;text-align:left}</style></head><body>"
         "<h1>jepsen-tpu results</h1>"
-        "<p><a href='/suite'>suite overview</a></p>"
+        + queue_panel_html(check_service)
+        + "<p><a href='/suite'>suite overview</a></p>"
         "<table><tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
         + "".join(rows)
         + "</table></body></html>"
@@ -82,12 +198,15 @@ def suite_html(store_dir=None) -> str:
     at a glance, the role of the reference's test-all summary over the
     home table's run-by-run listing."""
     rows = []
-    for name, runs in sorted(store.tests(store_dir=store_dir).items()):
+    by_name: dict[str, dict] = {}
+    for name, ts, d, v in run_index(store_dir):
+        by_name.setdefault(name, {})[ts] = (d, v)
+    for name in sorted(by_name):
+        runs = by_name[name]
         cells = []
         ordered = sorted(runs.items(), reverse=True)
         n_valid = 0
-        for ts, d in ordered:
-            v = _valid_of(d)
+        for ts, (d, v) in ordered:
             n_valid += v is True
             color = VALID_COLORS.get(v, "#eee")
             cells.append(
@@ -111,6 +230,14 @@ def suite_html(store_dir=None) -> str:
         + "".join(rows)
         + "</table></body></html>"
     )
+
+
+def _serve_mod():
+    """Lazy jepsen_tpu.serve import: plain store browsing must not drag
+    in the checker stack (serve pulls parallel.batch pulls jax)."""
+    from jepsen_tpu import serve
+
+    return serve
 
 
 def _safe_resolve(base: Path, rel: str) -> Path | None:
@@ -159,6 +286,19 @@ def telemetry_html(run_dir: Path) -> str:
             [[c["checker"], c["seconds"], c["count"], c.get("valid")]
              for c in s["checkers"]],
         ))
+    if s.get("serve"):
+        sv = s["serve"]
+        parts.append("<h3>check service</h3>")
+        rows = [[k, sv[k]] for k in (
+            "batches", "requests", "batch_wall_s", "avg_batch_requests",
+            "avg_occupancy", "avg_padding_waste", "submitted", "completed",
+            "rejected", "expired", "drained") if k in sv]
+        for key, label in (("admission", "admission wait"),
+                           ("request", "request latency")):
+            if key in sv:
+                rows.append([f"{label} mean (s)", sv[key]["mean_s"]])
+                rows.append([f"{label} max (s)", sv[key]["max_s"]])
+        parts.append(_telemetry_table(["serve", "value"], rows))
     if s.get("ladder"):
         parts.append("<h3>ladder stages</h3>")
         parts.append(_telemetry_table(
@@ -196,25 +336,137 @@ def telemetry_html(run_dir: Path) -> str:
 
 class Handler(BaseHTTPRequestHandler):
     store_dir = None
+    check_service = None  # a jepsen_tpu.serve.CheckService, or None
 
     def log_message(self, fmt, *args):  # quiet
         logger.debug("web: " + fmt, *args)
 
-    def _send(self, code: int, body: bytes, ctype="text/html; charset=utf-8"):
+    def _send(self, code: int, body: bytes, ctype="text/html; charset=utf-8",
+              headers=None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_json(self, code: int, obj, headers=None):
+        self._send(
+            code, json.dumps(obj, default=str).encode(),
+            "application/json; charset=utf-8", headers,
+        )
+
+    # ------------------------------------------------------------------
+    # Check-serving API (jepsen_tpu.serve)
+    # ------------------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        try:
+            path = unquote(self.path.split("?")[0])
+            if path != "/check":
+                self._send(404, b"not found")
+                return
+            svc = self.check_service
+            if svc is None:
+                self._send_json(
+                    503, {"error": "no check service mounted "
+                                   "(start with serve --check)"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                history = body["history"]
+                if not isinstance(history, list):
+                    raise TypeError("history must be a list of op maps")
+                model = _serve_mod().model_by_name(
+                    body.get("model", "cas-register"))
+                priority = int(body.get("priority") or 0)
+                client = str(body.get("client") or "http")
+                deadline = body.get("deadline")
+                if deadline is not None:
+                    deadline = faults.Deadline.coerce(float(deadline))
+                wait_timeout = body.get("wait_timeout")
+                wait_timeout = (
+                    300.0 if wait_timeout is None
+                    else min(float(wait_timeout), 3600.0)
+                )
+            except (KeyError, TypeError, ValueError) as e:
+                self._send_json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                fut = svc.submit(
+                    history, model=model, priority=priority,
+                    deadline=deadline, client=client,
+                )
+            except (KeyError, TypeError, ValueError, IndexError) as e:
+                # malformed op dicts surface from pack() at admission —
+                # client input, not an internal error
+                self._send_json(400, {"error": f"bad history: {e!r}"})
+                return
+            except _serve_mod().QueueFull as e:
+                # The 429-style contract: bounded queue, explicit
+                # rejection with a retry hint — never unbounded buffering.
+                self._send_json(
+                    429,
+                    {"error": "queue full", "depth": e.depth,
+                     "limit": e.limit, "retry_after_s": e.retry_after},
+                    headers={"Retry-After": max(1, math.ceil(e.retry_after))},
+                )
+                return
+            except _serve_mod().ServiceClosed:
+                self._send_json(503, {"error": "service shutting down"})
+                return
+            if body.get("wait"):
+                import concurrent.futures
+
+                # A request deadline bounds the HTTP wait too (plus a
+                # short grace so the queue-expiry unknown lands).
+                timeout = wait_timeout
+                if deadline is not None:
+                    timeout = deadline.clamp(wait_timeout) + 1.0
+                try:
+                    result = fut.result(timeout=timeout)
+                except concurrent.futures.TimeoutError:
+                    self._send_json(
+                        202, {"id": fut.id, "status": "pending",
+                              "href": f"/check/{fut.id}"})
+                    return
+                self._send_json(200, {"id": fut.id, "result": result})
+            else:
+                self._send_json(
+                    202, {"id": fut.id, "status": "queued",
+                          "href": f"/check/{fut.id}"})
+        except BrokenPipeError:  # pragma: no cover
+            pass
+        except Exception:  # noqa: BLE001 - pragma: no cover
+            logger.exception("web POST handler error")
+            self._send_json(500, {"error": "internal error"})
 
     def do_GET(self):  # noqa: N802 - stdlib API
         try:
             path = unquote(self.path.split("?")[0])
             base = store.base_dir({"store-dir": self.store_dir} if self.store_dir else None)
             if path in ("/", "/index.html"):
-                self._send(200, home_html(self.store_dir).encode())
+                self._send(
+                    200, home_html(self.store_dir, self.check_service).encode()
+                )
             elif path == "/suite":
                 self._send(200, suite_html(self.store_dir).encode())
+            elif path == "/queue":
+                if self.check_service is None:
+                    self._send_json(503, {"error": "no check service mounted"})
+                else:
+                    self._send_json(200, self.check_service.stats())
+            elif path.startswith("/check/"):
+                if self.check_service is None:
+                    self._send_json(503, {"error": "no check service mounted"})
+                else:
+                    req = self.check_service.get(path[len("/check/"):])
+                    if req is None:
+                        self._send_json(404, {"error": "unknown request id"})
+                    else:
+                        self._send_json(200, req.describe())
             elif path.startswith("/files/"):
                 target = _safe_resolve(base, path[len("/files/"):])
                 if target is None or not target.exists():
@@ -268,19 +520,26 @@ class Handler(BaseHTTPRequestHandler):
             self._send(500, b"internal error")
 
 
-def make_server(host="0.0.0.0", port=8080, store_dir=None) -> ThreadingHTTPServer:
-    handler = type("BoundHandler", (Handler,), {"store_dir": store_dir})
+def make_server(host="0.0.0.0", port=8080, store_dir=None,
+                check_service=None) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundHandler", (Handler,),
+        {"store_dir": store_dir, "check_service": check_service},
+    )
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve(host="0.0.0.0", port=8080, store_dir=None):
-    """Blocking server (web.clj:385-390)."""
-    srv = make_server(host, port, store_dir)
+def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None):
+    """Blocking server (web.clj:385-390).  With a ``check_service`` the
+    check API mounts and shutdown drains it (checkpointing queued work)."""
+    srv = make_server(host, port, store_dir, check_service)
     logger.info("serving store on http://%s:%d", host, port)
     try:
         srv.serve_forever()
     finally:
         srv.server_close()
+        if check_service is not None:
+            check_service.shutdown(drain=True)
 
 
 if __name__ == "__main__":  # pragma: no cover — exercised via subprocess

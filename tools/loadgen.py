@@ -1,0 +1,268 @@
+"""Load generator for the check-serving subsystem (jepsen_tpu.serve).
+
+Replays generated register histories against a ``CheckService`` at
+configurable concurrency and reports throughput + p50/p95/p99 latency,
+verdict parity against the sequential one-shot ``batch_analysis``
+baseline (what each caller would pay without the service), and the
+backpressure contract (a full queue rejects with retry-after instead of
+buffering unboundedly).
+
+    # the PERF.md acceptance demo (8 concurrent tenants, 32 requests):
+    python tools/loadgen.py --cpu --requests 32 --concurrency 8
+
+Both modes are warmed (one untimed pass each) so the comparison is
+launch-vs-launch, not compile-vs-cache.  Exits 1 on a verdict parity
+mismatch or a missing backpressure rejection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _pct(xs: list[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, round(p / 100 * (len(xs) - 1))))
+    return xs[k]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--ops", type=int, default=30, help="ops per history")
+    ap.add_argument("--procs", type=int, default=3)
+    ap.add_argument("--info-rate", type=float, default=0.1)
+    ap.add_argument("--corrupt-every", type=int, default=4,
+                    help="every k-th history is corrupted (0: none)")
+    ap.add_argument("--capacity", default="64,256",
+                    help="service ladder capacities, comma-separated")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--batch-window-ms", type=float, default=5.0)
+    ap.add_argument("--mode", choices=("both", "service", "sequential"),
+                    default="both")
+    ap.add_argument("--arrival", choices=("open", "closed"), default="open",
+                    help="open: each tenant streams its requests then "
+                         "collects (in-flight up to --requests; the proxy-"
+                         "in-front-of-many-users shape). closed: each "
+                         "tenant blocks per request (in-flight capped at "
+                         "--concurrency)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the conftest dance) — "
+                         "use for demos on hosts without a chip")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="record obs telemetry (incl. the serve table) here")
+    a = ap.parse_args(argv)
+
+    if a.cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from genhist import corrupt, valid_register_history
+    from jepsen_tpu import obs
+    from jepsen_tpu import models as m
+    from jepsen_tpu.parallel import batch_analysis
+    from jepsen_tpu.serve import CheckService, QueueFull
+
+    capacity = tuple(int(c) for c in a.capacity.split(",") if c)
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(a.requests):
+        hh = valid_register_history(
+            a.ops, a.procs, seed=a.seed + i, info_rate=a.info_rate)
+        if a.corrupt_every and i % a.corrupt_every == a.corrupt_every - 1:
+            hh = corrupt(hh, seed=a.seed + i)
+        hists.append(hh)
+
+    out: dict = {
+        "requests": a.requests, "concurrency": a.concurrency,
+        "ops": a.ops, "capacity": list(capacity),
+    }
+    rc = 0
+    baseline_verdicts = None
+
+    import contextlib
+
+    rec_ctx = (
+        obs.recording(a.telemetry_dir, enabled=True)
+        if a.telemetry_dir else contextlib.nullcontext()
+    )
+    with rec_ctx:
+        if a.mode in ("both", "sequential"):
+            # One-shot baseline: each caller pays its own batch_analysis
+            # (the pre-serve world).  Warm untimed on one valid AND one
+            # refuting history so the measured pass is launch-vs-launch
+            # (refutations compile extra rungs + spawn the confirm pool).
+            batch_analysis(model, [hists[0]], capacity=capacity)
+            if a.corrupt_every and a.corrupt_every <= a.requests:
+                batch_analysis(
+                    model, [hists[a.corrupt_every - 1]], capacity=capacity)
+            lat = []
+            t0 = time.perf_counter()
+            baseline_verdicts = []
+            for hh in hists:
+                t1 = time.perf_counter()
+                r = batch_analysis(model, [hh], capacity=capacity)[0]
+                lat.append(time.perf_counter() - t1)
+                baseline_verdicts.append(r["valid?"])
+            wall = time.perf_counter() - t0
+            out["sequential"] = {
+                "wall_s": round(wall, 3),
+                "throughput_rps": round(a.requests / wall, 2),
+                "p50_s": round(_pct(lat, 50), 4),
+                "p95_s": round(_pct(lat, 95), 4),
+                "p99_s": round(_pct(lat, 99), 4),
+            }
+            print(f"sequential: {out['sequential']}")
+
+        if a.mode in ("both", "service"):
+            svc = CheckService(
+                capacity=capacity, max_batch=a.max_batch,
+                max_queue=a.max_queue,
+                batch_window_s=a.batch_window_ms / 1000.0,
+            ).start()
+            try:
+                # warm pass: same histories, untimed (compile the padded
+                # batch shapes the measured pass will launch)
+                warm = [svc.submit(hh, client="warm") for hh in hists]
+                for f in warm:
+                    f.result(timeout=600)
+                warm_batches = svc.stats()["batches"]
+
+                verdicts: list = [None] * a.requests
+                lat: list = [0.0] * a.requests
+                retries = [0]
+                idx_lock = threading.Lock()
+                next_idx = [0]
+
+                def submit_one(i: int, wid: int):
+                    t1 = time.perf_counter()
+                    while True:
+                        try:
+                            f = svc.submit(hists[i], client=f"tenant-{wid}")
+                            break
+                        except QueueFull as e:
+                            with idx_lock:
+                                retries[0] += 1
+                            time.sleep(e.retry_after)
+                    return t1, f
+
+                def worker(wid: int):
+                    if a.arrival == "closed":
+                        # closed loop: one in-flight request per tenant
+                        while True:
+                            with idx_lock:
+                                i = next_idx[0]
+                                if i >= a.requests:
+                                    return
+                                next_idx[0] += 1
+                            t1, f = submit_one(i, wid)
+                            r = f.result(timeout=600)
+                            lat[i] = time.perf_counter() - t1
+                            verdicts[i] = r["valid?"]
+                    else:
+                        # open arrivals: stream this tenant's share, then
+                        # collect — the queue depth is where cross-request
+                        # batching engages
+                        mine = list(range(wid, a.requests, a.concurrency))
+                        futs = [submit_one(i, wid) for i in mine]
+                        for i, (t1, f) in zip(mine, futs):
+                            r = f.result(timeout=600)
+                            lat[i] = time.perf_counter() - t1
+                            verdicts[i] = r["valid?"]
+
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=worker, args=(w,))
+                    for w in range(a.concurrency)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                st = svc.stats()
+                out["service"] = {
+                    "wall_s": round(wall, 3),
+                    "throughput_rps": round(a.requests / wall, 2),
+                    "p50_s": round(_pct(lat, 50), 4),
+                    "p95_s": round(_pct(lat, 95), 4),
+                    "p99_s": round(_pct(lat, 99), 4),
+                    "batches": st["batches"] - warm_batches,
+                    "avg_occupancy": st["avg_occupancy"],
+                    "queue_full_retries": retries[0],
+                }
+                print(f"service:    {out['service']}")
+            finally:
+                svc.shutdown(drain=False)
+
+            if baseline_verdicts is not None:
+                parity = verdicts == baseline_verdicts
+                out["verdict_parity"] = parity
+                if not parity:
+                    print("PARITY MISMATCH:", list(zip(baseline_verdicts, verdicts)),
+                          file=sys.stderr)
+                    rc = 1
+                out["speedup"] = round(
+                    out["service"]["throughput_rps"]
+                    / out["sequential"]["throughput_rps"], 2)
+                print(f"speedup:    {out['speedup']}x "
+                      f"(parity: {out['verdict_parity']})")
+
+        # Backpressure contract: a full queue REJECTS (retry-after), it
+        # never buffers unboundedly.  Unstarted service = no drain race.
+        # The probe generates its own max_queue+1 histories so a small
+        # --requests can't make it a false failure.
+        bp = CheckService(capacity=capacity, max_queue=4)
+        probe = [
+            valid_register_history(a.ops, a.procs, seed=10_000 + i,
+                                   info_rate=a.info_rate)
+            for i in range(4 + 1)
+        ]
+        accepted = 0
+        rejected = None
+        try:
+            for hh in probe:
+                try:
+                    bp.submit(hh, client="flood")
+                    accepted += 1
+                except QueueFull as e:
+                    rejected = round(e.retry_after, 3)
+                    break
+        finally:
+            bp.shutdown(drain=False)
+        out["backpressure"] = {
+            "max_queue": 4, "accepted": accepted,
+            "rejected_with_retry_after_s": rejected,
+        }
+        if rejected is None:
+            print("BACKPRESSURE MISSING: full queue did not reject",
+                  file=sys.stderr)
+            rc = 1
+        print(f"backpressure: {out['backpressure']}")
+
+    print(json.dumps({"loadgen": out}))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
