@@ -241,8 +241,20 @@ def _cmd_serve(opts) -> int:
             "check service up: max_queue=%d max_batch=%d capacity=%s",
             opts.max_queue, opts.max_batch, capacity,
         )
+    profiler = None
+    if getattr(opts, "profile_dir", None):
+        from jepsen_tpu.obs.profiler import ProfilerHook
+
+        profiler = ProfilerHook(
+            opts.profile_dir, max_seconds=opts.profile_max_seconds
+        )
+        logger.info(
+            "profiler hook armed: POST /profile/start (captures land in "
+            "%s, bounded at %.0fs)", opts.profile_dir,
+            opts.profile_max_seconds,
+        )
     web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir,
-              check_service=svc)
+              check_service=svc, profiler=profiler)
     return EXIT_VALID
 
 
@@ -311,6 +323,15 @@ def run_cli(
                          help="where shutdown checkpoints still-queued "
                               "requests (resume with "
                               "jepsen_tpu.serve.resume_drained)")
+    p_serve.add_argument("--profile-dir", default=None,
+                         help="arm the bounded jax.profiler capture hook: "
+                              "POST /profile/start (optional {\"seconds\": "
+                              "n} body) / POST /profile/stop drive device "
+                              "captures into this directory")
+    p_serve.add_argument("--profile-max-seconds", type=float, default=120.0,
+                         help="hard bound per profiler capture; every "
+                              "start auto-stops after at most this long "
+                              "(default 120)")
 
     try:
         opts = parser.parse_args(argv)

@@ -47,6 +47,7 @@ import time
 from typing import Callable, Mapping
 
 from jepsen_tpu import obs
+from jepsen_tpu.obs import metrics as _metrics
 
 #: fault-injection hook: ``INJECT(ctx, attempt)`` runs before each launch
 #: attempt and may raise (classified exactly like a real launch error).
@@ -163,9 +164,19 @@ def call_with_retry(
             kind = error_kind(e)
             if kind is None:
                 raise
+            # Live fault metrics (obs.metrics, the /metrics endpoint):
+            # exhausted launches get a series labeled by kind + launch
+            # site, so an operator watching a serving process sees WHERE
+            # faults cluster without opening any run's telemetry.
+            # (Retries need no explicit series — the obs.counter below
+            # already mirrors as fault_launch_retry_total; a second
+            # explicit one would double-count the same event.)
             if kind == "oom":
+                _metrics.inc("fault.launch_failures", kind="oom", what=what)
                 raise LaunchFailure("oom", e, what) from e
             if attempt >= retries:
+                _metrics.inc("fault.launch_failures", kind="transient",
+                             what=what)
                 raise LaunchFailure("transient", e, what) from e
             delay = min(max_s, base_s * (2 ** attempt))
             attempt += 1

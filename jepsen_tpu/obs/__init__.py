@@ -24,6 +24,24 @@ return immediately after one global read — the interpreter and kernel
 hot loops pay ~nothing when telemetry is off, so call sites never need
 their own guards.
 
+Two service-grade extensions (the check-serving pipeline's regime):
+
+  * **Trace context** — ``new_trace_id()`` mints a request trace id;
+    ``capture()`` snapshots the current thread's span context (parent
+    span name + trace) into a picklable ``Ctx`` and ``attach(ctx)``
+    installs it on ANOTHER thread (or later on the same one), so
+    parent links and trace ids survive the admission → scheduler →
+    demux thread hops and the confirm-pool submit/drain boundary.
+    While a trace is attached, every emitted event carries a top-level
+    ``"trace"`` field (a single id, or the list of member ids on
+    shared-batch work).
+  * **Live metrics mirror** — when ``obs.metrics.MIRROR`` is enabled
+    (a serving process), ``counter``/``gauge`` also land in the
+    process-global Prometheus registry (``jepsen_tpu.obs.metrics``),
+    independent of any per-run recording.  ``observing()`` reports
+    whether EITHER sink is live, for call sites whose sampling itself
+    costs something (device-memory reads).
+
 Toggles: the test-map key ``"telemetry?"`` (set by the CLI's
 ``--telemetry/--no-telemetry``) wins; otherwise the env var
 ``JEPSEN_TPU_TELEMETRY`` (``0``/``false``/``off`` disable); default ON
@@ -36,17 +54,20 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import threading
 import time
+import uuid
 from pathlib import Path
 from typing import Any, Mapping
 
+from jepsen_tpu.obs import metrics as _metrics
 from jepsen_tpu.obs.summary import summarize
 
 __all__ = [
-    "ENV_VAR", "Recorder", "active", "counter", "enabled_for",
-    "env_enabled", "event", "gauge", "recording", "span", "span_event",
-    "summarize",
+    "ENV_VAR", "Ctx", "Recorder", "active", "attach", "capture", "counter",
+    "enabled_for", "env_enabled", "event", "gauge", "new_trace_id",
+    "observing", "recording", "span", "span_event", "summarize",
 ]
 
 ENV_VAR = "JEPSEN_TPU_TELEMETRY"
@@ -82,6 +103,85 @@ def active() -> "Recorder | None":
     return _RECORDER
 
 
+def observing() -> bool:
+    """Whether ANY sink is live — a recording or the live metrics
+    mirror.  The gate for call sites whose sampling itself costs
+    something (e.g. device-memory reads at stage boundaries)."""
+    return _RECORDER is not None or _metrics.MIRROR
+
+
+# ---------------------------------------------------------------------------
+# Trace context: ids + the cross-thread/process handoff
+# ---------------------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh request trace id (16 hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+class Ctx:
+    """A picklable span-context snapshot: the parent span name and the
+    active trace (one id, or a list of member ids for shared-batch
+    work).  Produced by ``capture()``, installed by ``attach()``."""
+
+    __slots__ = ("parent", "trace")
+
+    def __init__(self, parent: str | None = None, trace=None):
+        self.parent = parent
+        self.trace = trace
+
+    def __repr__(self):
+        return f"Ctx(parent={self.parent!r}, trace={self.trace!r})"
+
+
+def capture(*, trace=None, parent: str | None = None) -> Ctx:
+    """Snapshot the current thread's span context for a later
+    ``attach()`` on another thread (or after a queue/process hop).
+    ``trace``/``parent`` override the captured values — the serving
+    layer captures at admission with ``trace=<the request's id>``."""
+    if parent is None:
+        stack = getattr(_STACK, "spans", None)
+        parent = stack[-1].name if stack else getattr(_STACK, "parent", None)
+    if trace is None:
+        trace = getattr(_STACK, "trace", None)
+    return Ctx(parent, trace)
+
+
+@contextlib.contextmanager
+def attach(ctx: Ctx | None = None, *, trace=None, parent: str | None = None):
+    """Install a captured context on THIS thread: spans opened inside
+    parent to ``ctx.parent`` (when they have no enclosing local span)
+    and every event emitted inside carries ``ctx.trace``.  Nests —
+    the previous context is restored on exit.  Works with no recorder
+    installed (the thread-local write is ~free), so call sites don't
+    need their own telemetry guards."""
+    if ctx is None:
+        ctx = Ctx(parent, trace)
+    else:
+        ctx = Ctx(
+            ctx.parent if parent is None else parent,
+            ctx.trace if trace is None else trace,
+        )
+    prev_parent = getattr(_STACK, "parent", None)
+    prev_trace = getattr(_STACK, "trace", None)
+    _STACK.parent = ctx.parent
+    _STACK.trace = ctx.trace
+    try:
+        yield ctx
+    finally:
+        _STACK.parent = prev_parent
+        _STACK.trace = prev_trace
+
+
+def _stamp(ev: dict) -> dict:
+    """Attach the thread's active trace (if any) to an outgoing event."""
+    tr = getattr(_STACK, "trace", None)
+    if tr is not None:
+        ev["trace"] = tr
+    return ev
+
+
 class Recorder:
     """Appends events to ``<dir>/telemetry.jsonl``; ``close()`` rolls them
     up into ``<dir>/telemetry.json``.  Thread-safe (checkers run composed
@@ -101,8 +201,17 @@ class Recorder:
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
         self._fh = open(self.path, "w", encoding="utf-8")
-        self.emit({"type": "meta", "version": 1, "wall-clock": time.time(),
-                   "pid": os.getpid()})
+        # t0 is the wall-clock epoch the monotonic event offsets hang off
+        # (every event's "t" is seconds after it): epoch = t0 + t.  With
+        # pid + host in the header, traces from different processes,
+        # machines, and runs can be time-aligned (trace_export uses it).
+        t0 = time.time()
+        try:
+            host = socket.gethostname()
+        except OSError:  # pragma: no cover — hostname lookup failed
+            host = "?"
+        self.emit({"type": "meta", "version": 1, "wall-clock": t0,
+                   "t0": t0, "pid": os.getpid(), "host": host})
 
     def now(self) -> float:
         """Seconds since the recording opened (monotonic)."""
@@ -196,11 +305,13 @@ class _Span:
         stack = getattr(_STACK, "spans", None)
         if stack and stack[-1] is self:
             stack.pop()
-        parent = stack[-1].name if stack else None
-        ev: dict[str, Any] = {
+        # parent: the enclosing local span, else an attach()ed handoff
+        # context's parent (the cross-thread link)
+        parent = stack[-1].name if stack else getattr(_STACK, "parent", None)
+        ev: dict[str, Any] = _stamp({
             "type": "span", "name": self.name, "t": round(self._start, 6),
             "dur": round(dur, 6),
-        }
+        })
         if parent is not None:
             ev["parent"] = parent
         if exc_type is not None:
@@ -228,22 +339,26 @@ def span_event(name: str, seconds: float, **attrs) -> None:
     if r is None:
         return
     now = r.now()
-    ev: dict[str, Any] = {
+    ev: dict[str, Any] = _stamp({
         "type": "span", "name": name,
         "t": round(max(0.0, now - seconds), 6), "dur": round(seconds, 6),
-    }
+    })
     if attrs:
         ev["attrs"] = attrs
     r.emit(ev)
 
 
 def counter(name: str, n: int = 1, **attrs) -> None:
-    """Accumulate a count (summed per name in the summary)."""
+    """Accumulate a count (summed per name in the summary).  Also feeds
+    the live Prometheus registry when its mirror is on — by NAME only
+    (attrs would be unbounded label cardinality)."""
     r = _RECORDER
+    if _metrics.MIRROR:
+        _metrics.REGISTRY.inc(name, n)
     if r is None:
         return
-    ev: dict[str, Any] = {"type": "counter", "name": name,
-                          "t": round(r.now(), 6), "n": n}
+    ev: dict[str, Any] = _stamp({"type": "counter", "name": name,
+                                 "t": round(r.now(), 6), "n": n})
     if attrs:
         ev["attrs"] = attrs
     r.emit(ev)
@@ -251,12 +366,15 @@ def counter(name: str, n: int = 1, **attrs) -> None:
 
 def gauge(name: str, value, **attrs) -> None:
     """Record a point-in-time value (last write per name wins in the
-    summary; every sample stays in the JSONL)."""
+    summary; every sample stays in the JSONL).  Numeric values also
+    feed the live Prometheus registry when its mirror is on."""
     r = _RECORDER
+    if _metrics.MIRROR:
+        _metrics.REGISTRY.set(name, value)
     if r is None:
         return
-    ev: dict[str, Any] = {"type": "gauge", "name": name,
-                          "t": round(r.now(), 6), "value": value}
+    ev: dict[str, Any] = _stamp({"type": "gauge", "name": name,
+                                 "t": round(r.now(), 6), "value": value})
     if attrs:
         ev["attrs"] = attrs
     r.emit(ev)
@@ -267,8 +385,8 @@ def event(name: str, **attrs) -> None:
     r = _RECORDER
     if r is None:
         return
-    ev: dict[str, Any] = {"type": "event", "name": name,
-                          "t": round(r.now(), 6)}
+    ev: dict[str, Any] = _stamp({"type": "event", "name": name,
+                                 "t": round(r.now(), 6)})
     if attrs:
         ev["attrs"] = attrs
     r.emit(ev)

@@ -1,0 +1,138 @@
+"""A bounded ``jax.profiler`` capture hook for long-lived processes.
+
+A serving process can't be restarted under a profiler every time an
+operator wants a device timeline, and an unattended ``start_trace``
+left running fills a disk.  This hook wraps the profiler in a
+start/stop pair that is:
+
+  * **bounded** — every capture auto-stops after ``max_seconds`` (a
+    watchdog timer), so a forgotten start can cost at most one window;
+  * **exclusive** — one capture at a time; a second start reports the
+    running one instead of corrupting it;
+  * **lazy** — jax is imported only when a capture actually starts, so
+    mounting the hook costs nothing (web.py serves plain stores without
+    dragging in the accelerator stack).
+
+Wired up by ``jepsen-tpu serve --profile-dir DIR`` and driven over HTTP
+(``POST /profile/start`` with an optional ``{"seconds": n}`` body,
+``POST /profile/stop``, ``GET /profile`` for status).  Captures land in
+timestamped subdirectories of ``DIR``; view them with TensorBoard's
+profile plugin or ``xprof``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+__all__ = ["ProfilerHook"]
+
+
+def _trace_api():
+    """(start_trace, stop_trace) — a seam so tests can drive the hook
+    without paying a real profiler capture."""
+    import jax.profiler
+
+    return jax.profiler.start_trace, jax.profiler.stop_trace
+
+
+class ProfilerHook:
+    """One process's profiler control surface (module doc)."""
+
+    def __init__(self, directory: str | Path, max_seconds: float = 120.0):
+        self.dir = Path(directory)
+        self.max_seconds = float(max_seconds)
+        self._lock = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._active_dir: str | None = None
+        self._gen = 0  # capture generation; stale watchdogs no-op on it
+        self._t_start = 0.0
+        self._deadline = 0.0
+
+    def status(self) -> dict:
+        with self._lock:
+            return self._status_locked()
+
+    def _status_locked(self) -> dict:
+        out = {
+            "profiling": self._active_dir is not None,
+            "dir": str(self.dir),
+            "max_seconds": self.max_seconds,
+        }
+        if self._active_dir is not None:
+            out["capture_dir"] = self._active_dir
+            out["elapsed_s"] = round(time.monotonic() - self._t_start, 3)
+            out["auto_stop_in_s"] = round(
+                max(0.0, self._deadline - time.monotonic()), 3)
+        return out
+
+    def start(self, seconds: float | None = None) -> dict:
+        """Start a capture bounded at ``min(seconds, max_seconds)``;
+        idempotent-ish: a second start while one is running returns the
+        running capture's status with ``"error"`` set."""
+        with self._lock:
+            if self._active_dir is not None:
+                return {**self._status_locked(),
+                        "error": "capture already running"}
+            bound = self.max_seconds
+            if seconds is not None:
+                try:
+                    bound = min(float(seconds), self.max_seconds)
+                except (TypeError, ValueError):
+                    return {**self._status_locked(),
+                            "error": f"bad seconds value {seconds!r}"}
+            bound = max(0.1, bound)
+            capture_dir = self.dir / time.strftime("profile-%Y%m%dT%H%M%S")
+            try:
+                capture_dir.mkdir(parents=True, exist_ok=True)
+                start_trace, _stop = _trace_api()
+                start_trace(str(capture_dir))
+            except Exception as e:  # noqa: BLE001 — surface, don't crash
+                return {**self._status_locked(),
+                        "error": f"profiler start failed: {e!r}"}
+            self._active_dir = str(capture_dir)
+            self._gen += 1
+            self._t_start = time.monotonic()
+            self._deadline = self._t_start + bound
+            # The watchdog is pinned to THIS capture's generation: a
+            # timer that fires concurrently with a manual stop (cancel()
+            # can't recall a callback already blocked on the lock) must
+            # not kill the NEXT capture an operator starts meanwhile.
+            self._timer = threading.Timer(bound, self.stop,
+                                          kwargs={"gen": self._gen})
+            self._timer.daemon = True
+            self._timer.start()
+            out = self._status_locked()
+            out["seconds"] = bound
+            return out
+
+    def stop(self, gen: int | None = None) -> dict:
+        """Stop the running capture; a stop with nothing running is a
+        no-op status report.  ``gen`` is the watchdog's capture
+        generation — a stale watchdog (its capture already stopped
+        manually) no-ops instead of truncating a newer capture."""
+        with self._lock:
+            if self._active_dir is None:
+                return self._status_locked()
+            if gen is not None and gen != self._gen:
+                return self._status_locked()  # stale watchdog
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            capture_dir = self._active_dir
+            elapsed = round(time.monotonic() - self._t_start, 3)
+            try:
+                _start, stop_trace = _trace_api()
+                stop_trace()
+            except Exception as e:  # noqa: BLE001 — a failed stop must
+                # still clear the state or the hook wedges shut
+                self._active_dir = None
+                return {**self._status_locked(),
+                        "error": f"profiler stop failed: {e!r}",
+                        "capture_dir": capture_dir}
+            self._active_dir = None
+            out = self._status_locked()
+            out["stopped"] = {"capture_dir": capture_dir,
+                              "elapsed_s": elapsed}
+            return out

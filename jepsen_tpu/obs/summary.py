@@ -55,7 +55,7 @@ from typing import Iterable, Mapping
 _STAGE_KEYS = (
     "resolved", "refuted", "unknowns_remaining", "launches",
     "compile_launches", "compile_s", "execute_s", "peak_frontier", "lossy",
-    "dedup", "degraded",
+    "dedup", "degraded", "device_bytes_peak",
 )
 
 
@@ -257,6 +257,16 @@ def summarize(events: Iterable[Mapping]) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _mb(b) -> str:
+    """Bytes as a compact MB cell ('' when the stage never sampled;
+    sub-0.1MB footprints keep three decimals so CPU-backend samples
+    don't render as an ambiguous 0.0)."""
+    if not b:
+        return ""
+    mb = float(b) / 1e6
+    return str(round(mb, 1 if mb >= 0.1 else 3))
+
+
 def _fmt_row(cells, widths) -> str:
     return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
 
@@ -304,7 +314,8 @@ def format_summary(summary: Mapping) -> str:
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",
                    "resolved", "refuted", "unknowns", "launches",
-                   "compile_s", "execute_s", "peak", "lossy", "dedup"]
+                   "compile_s", "execute_s", "peak", "lossy", "dedup",
+                   "dev_MB"]
         rows = []
         for r in summary["ladder"]:
             rows.append([
@@ -314,6 +325,7 @@ def format_summary(summary: Mapping) -> str:
                 r.get("launches", ""), r.get("compile_s", ""),
                 r.get("execute_s", ""), r.get("peak_frontier", ""),
                 r.get("lossy", ""), r.get("dedup", ""),
+                _mb(r.get("device_bytes_peak")),
             ])
         parts.append("\nladder stages:")
         parts.append(_table(headers, rows))

@@ -1,0 +1,158 @@
+"""Convert a telemetry event stream into Chrome/Perfetto trace-event JSON.
+
+The ``telemetry.jsonl`` stream is already span-shaped (monotonic start +
+duration, parent links, trace ids); this module maps it onto the Chrome
+trace-event format (the JSON Perfetto and ``chrome://tracing`` load):
+
+  * one LANE (tid) per request trace id — every event stamped with that
+    single ``"trace"`` carries the request's journey (HTTP admission →
+    queued wait → demux) on its own row, named after the id;
+  * a shared **device/ladder** lane (tid 0) for spans that belong to
+    the whole process or a shared batch (``ladder.stage``,
+    ``serve.batch``, confirmation drains) — their member trace ids ride
+    along in ``args`` so a lane's request can be found from the shared
+    span and vice versa;
+  * counter tracks (``ph: "C"``) for the live gauges (queue depth,
+    unknowns remaining, device buffer bytes), so occupancy and memory
+    are plotted against the spans that caused them.
+
+Timestamps are microseconds since the recording opened; the header
+``meta`` event's ``t0`` epoch (obs.Recorder) is preserved in
+``otherData`` so traces from different processes can be aligned.
+
+Stdlib-only: the web UI (``GET /trace/<run>``) and
+``tools/trace_export.py`` both import this.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Mapping
+
+__all__ = ["read_jsonl_events", "to_trace_events"]
+
+#: gauges worth a Perfetto counter track (point samples over time).
+_COUNTER_GAUGES = {
+    "serve.queue_depth",
+    "ladder.unknowns_remaining",
+    "device.buffer_bytes",
+    "confirm.queue_latency_s",
+}
+
+_DEVICE_TID = 0
+
+
+def read_jsonl_events(path: Path | str) -> list[dict]:
+    """Tolerant ``telemetry.jsonl`` reader: a crashed process may leave
+    the LAST line truncated mid-write — skip unparseable lines instead
+    of failing the whole stream.  Raises ``FileNotFoundError`` for a
+    missing file and ``ValueError`` when not a single line parses (a
+    clearly-not-telemetry input deserves a loud error, not an empty
+    trace)."""
+    path = Path(path)
+    text = path.read_text()
+    events: list[dict] = []
+    skipped = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(ev, dict):
+            events.append(ev)
+        else:
+            skipped += 1
+    if not events and skipped:
+        raise ValueError(
+            f"{path}: no parseable telemetry events "
+            f"({skipped} malformed line(s))"
+        )
+    if skipped:
+        events.append({"type": "meta", "skipped-lines": skipped})
+    return events
+
+
+def _us(t) -> float:
+    return round(float(t or 0.0) * 1e6, 1)
+
+
+def to_trace_events(events: Iterable[Mapping]) -> dict:
+    """Map a telemetry event stream to ``{"traceEvents": [...]}``
+    (Chrome trace-event JSON; Perfetto-loadable)."""
+    events = list(events)
+    meta = next((e for e in events if e.get("type") == "meta"), {})
+    pid = int(meta.get("pid") or 1)
+    out: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": pid,
+         "args": {"name": f"jepsen-tpu ({meta.get('host', '?')})"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": _DEVICE_TID,
+         "args": {"name": "device/ladder"}},
+        # keep the device lane on top, requests below in arrival order
+        {"ph": "M", "name": "thread_sort_index", "pid": pid,
+         "tid": _DEVICE_TID, "args": {"sort_index": -1}},
+    ]
+    lanes: dict[str, int] = {}
+
+    def lane_of(trace) -> int:
+        """tid for one request's lane; shared (list) traces and
+        untraced events ride the device lane."""
+        if not isinstance(trace, str):
+            return _DEVICE_TID
+        tid = lanes.get(trace)
+        if tid is None:
+            tid = lanes[trace] = len(lanes) + 1
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": f"request {trace}"},
+            })
+        return tid
+
+    for ev in events:
+        et = ev.get("type")
+        tr = ev.get("trace")
+        if et == "span":
+            args = dict(ev.get("attrs") or {})
+            if tr is not None:
+                args["trace"] = tr
+            if ev.get("parent"):
+                args["parent"] = ev["parent"]
+            if ev.get("err"):
+                args["err"] = ev["err"]
+            out.append({
+                "ph": "X", "name": str(ev.get("name")), "pid": pid,
+                "tid": lane_of(tr), "ts": _us(ev.get("t")),
+                "dur": max(1.0, _us(ev.get("dur"))), "args": args,
+            })
+        elif et == "gauge":
+            name = str(ev.get("name"))
+            v = ev.get("value")
+            if name in _COUNTER_GAUGES and isinstance(v, (int, float)):
+                out.append({
+                    "ph": "C", "name": name, "pid": pid, "tid": _DEVICE_TID,
+                    "ts": _us(ev.get("t")), "args": {"value": v},
+                })
+        elif et == "event":
+            args = dict(ev.get("attrs") or {})
+            if tr is not None:
+                args["trace"] = tr
+            out.append({
+                "ph": "i", "name": str(ev.get("name")), "pid": pid,
+                "tid": lane_of(tr), "ts": _us(ev.get("t")), "s": "t",
+                "args": args,
+            })
+        # counters are cumulative noise at trace zoom; the summary has them
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "t0": meta.get("t0", meta.get("wall-clock")),
+            "host": meta.get("host"),
+            "pid": meta.get("pid"),
+            "requests": len(lanes),
+        },
+    }

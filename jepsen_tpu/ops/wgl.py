@@ -692,6 +692,7 @@ def batched_runner(step, F: int, R: int, P: int, G: int, W: int,
     (jepsen_tpu.parallel.batch).  ``dedup`` selects the per-round dedup
     backend (jepsen_tpu.ops.hashing, "sort"|"bucket")."""
     key = (step, F, R, P, G, W, True, dedup)
+    _cache_counter(_BATCH_RUNNERS, key, "sync")
     if key not in _BATCH_RUNNERS:
         core = functools.partial(_run_core, step, F, R, P, G, W, True, dedup=dedup)
         axes = (0,) * 14 + (None, None)
@@ -710,6 +711,7 @@ def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int,
     escalation stage costs one launch instead of ~60% of bench wall clock
     (round-2 profile)."""
     key = (step, F, R, P, G, W, False, dedup)
+    _cache_counter(_BATCH_RUNNERS, key, "exact")
     if key not in _BATCH_RUNNERS:
         core = functools.partial(_run_core, step, F, R, P, G, W, False, dedup=dedup)
         axes = (0,) * 14 + (None, None)
@@ -720,6 +722,39 @@ def exact_batched_runner(step, F: int, R: int, P: int, G: int, W: int,
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
+
+
+def device_buffer_bytes() -> int | None:
+    """Live device-buffer bytes on the primary device — the quantity the
+    ladder's per-stage memory high-water marks sample (telemetry stage
+    table ``device_bytes_peak``, live gauge ``device.buffer_bytes``).
+
+    Prefers the backend allocator's ``bytes_in_use`` (TPU/GPU); falls
+    back to summing live jax array footprints (the CPU backend exposes
+    no allocator stats).  Returns None when neither is available —
+    callers (all telemetry-gated) just skip the sample."""
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return int(stats["bytes_in_use"])
+    except Exception:  # noqa: BLE001 — stats are backend-optional
+        pass
+    try:
+        return int(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:  # noqa: BLE001 — never fail a launch for a gauge
+        return None
+
+
+def _cache_counter(cache: dict, key, kind: str) -> None:
+    """One compile-cache hit/miss counter per runner lookup: a fresh key
+    means jit trace+compile is about to be paid (the compile_s column's
+    event-level sibling; surfaced live via /metrics as
+    ``wgl_runner_cache_hit/miss``)."""
+    obs.counter(
+        "wgl.runner_cache.hit" if key in cache else "wgl.runner_cache.miss",
+        kind=kind,
+    )
 
 
 def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
@@ -962,6 +997,15 @@ def chunked_analysis(
         lossy_any |= trunc  # input truncation of the ACCEPTED attempt
         if trunc:
             obs.counter("wgl.frontier.truncations")
+        if obs.observing():
+            # Chunk-boundary device-memory sample: the chunked path is
+            # the long-history workhorse, and its carried frontier is
+            # exactly where resident bytes creep (telemetry-gated — the
+            # allocator/live-array walk isn't free).
+            db = device_buffer_bytes()
+            if db is not None:
+                obs.gauge("device.buffer_bytes", db, at="wgl-chunk",
+                          barrier=lo)
         stats = {
             "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": lossy or lossy_any,
             "chunks": len(bounds), "launches": launches,
@@ -1240,6 +1284,7 @@ def async_runner(step, F: int, T: int, B: int, P: int, G: int, W: int,
     n_active, then the 12 barrier/mover/group tables; slot tables
     broadcast.  ``dedup`` selects the per-round dedup backend."""
     key = (step, F, T, B, P, G, W, dedup)
+    _cache_counter(_ASYNC_RUNNERS, key, "async")
     if key not in _ASYNC_RUNNERS:
         core = functools.partial(
             _run_core_async, step, F, T, B, P, G, W, dedup=dedup
@@ -1417,6 +1462,7 @@ _GREEDY_RUNNERS: dict = {}
 def greedy_runner(step, B: int, P: int, G: int, W: int):
     """jit(vmap(_greedy_core)) — the batched greedy witness walk."""
     key = (step, B, P, G, W)
+    _cache_counter(_GREEDY_RUNNERS, key, "greedy")
     if key not in _GREEDY_RUNNERS:
         core = functools.partial(_greedy_core, step, B, P, G, W)
         axes = (0,) * 14 + (None, None)

@@ -454,7 +454,8 @@ def batch_analysis(
 
     def _reset_launch_acc() -> None:
         launch_acc.update(
-            launches=0, compile_launches=0, compile_s=0.0, execute_s=0.0
+            launches=0, compile_launches=0, compile_s=0.0, execute_s=0.0,
+            device_bytes_peak=0,
         )
 
     _reset_launch_acc()
@@ -462,8 +463,9 @@ def batch_analysis(
     def _launch(st_engine: str, batch_cap: int, sub: list[dict],
                 sub_resumes: list[tuple | None] | None = None):
         """Instrumented wrapper over the kernel launch: times the launch,
-        classifies it compile (fresh shape bucket) vs execute, and emits a
-        ladder.launch telemetry span."""
+        classifies it compile (fresh shape bucket) vs execute, samples
+        the post-launch device-buffer footprint (the stage's memory
+        high-water mark), and emits a ladder.launch telemetry span."""
         with obs.span(
             "ladder.launch", engine=st_engine, capacity=batch_cap, lanes=len(sub)
         ) as sp:
@@ -480,7 +482,20 @@ def batch_analysis(
                 launch_acc["compile_s"] += dt
             else:
                 launch_acc["execute_s"] += dt
+            obs.counter(
+                "ladder.compile_cache.miss" if compiled
+                else "ladder.compile_cache.hit",
+                engine=st_engine,
+            )
             sp.set(compiled=compiled)
+            if obs.observing():
+                # Post-launch device footprint: right after a launch is
+                # where the stage's buffers (frontier, snapshot, sort
+                # scratch) peak host-visibly — the per-stage high-water
+                # mark in the telemetry stage table.
+                db = wgl.device_buffer_bytes()
+                if db is not None and db > launch_acc["device_bytes_peak"]:
+                    launch_acc["device_bytes_peak"] = db
         return out
 
     def _launch_impl(st_engine: str, batch_cap: int, sub: list[dict],
@@ -619,14 +634,20 @@ def batch_analysis(
 
     def _emit_stage(t_stage: float, stage_attrs: dict, **extra) -> None:
         """One ladder.stage telemetry span per rung: wall time, lanes in,
-        verdict counts, and the stage's compile/execute launch split."""
+        verdict counts, the stage's compile/execute launch split, and
+        its device-memory high-water mark."""
+        mem = {}
+        if launch_acc.get("device_bytes_peak"):
+            mem["device_bytes_peak"] = launch_acc["device_bytes_peak"]
+            obs.gauge("device.buffer_bytes", launch_acc["device_bytes_peak"],
+                      at="ladder-stage", stage=stage_attrs.get("stage"))
         obs.span_event(
             "ladder.stage", time.perf_counter() - t_stage,
             launches=launch_acc["launches"],
             compile_launches=launch_acc["compile_launches"],
             compile_s=round(launch_acc["compile_s"], 6),
             execute_s=round(launch_acc["execute_s"], 6),
-            **stage_attrs, **extra,
+            **mem, **stage_attrs, **extra,
         )
 
     stages = [(engine, c) for c in batch_caps] + [("exact", c) for c in exact_caps]
@@ -634,7 +655,12 @@ def batch_analysis(
         stages = [("greedy", 1)] + stages
     pending = list(range(len(packs)))
     resumes: dict[int, tuple] = {}  # pack idx -> saved resume frontier
-    confirm_futs: dict = {}  # hist idx -> (pool, future, device result, t, op_pos)
+    # hist idx -> (pool, future, device result, t, op_pos, obs.Ctx): the
+    # Ctx is the span context captured at SUBMIT time, re-attached when
+    # the drain resolves the confirmation — trace ids survive the
+    # worker-pool process boundary (the worker itself records nothing;
+    # its submit/resolve bracket in this process carries the trace).
+    confirm_futs: dict = {}
     device_confirms: list[tuple] = []  # (pack idx, failed_at, cap, result)
     confirm_degraded: set[int] = set()  # hist idxs whose confirmation hit the deadline
     if restored is not None:
@@ -659,7 +685,8 @@ def batch_analysis(
             )
             obs.counter("confirm.submitted")
             confirm_futs[i] = (
-                pool, fut, info["res"], time.perf_counter(), int(info["op_pos"])
+                pool, fut, info["res"], time.perf_counter(),
+                int(info["op_pos"]), obs.capture(),
             )
             results[i] = info["res"]
         for e in restored["device_confirms"]:
@@ -685,7 +712,7 @@ def batch_analysis(
                 pending=[idxs[k] for k in pending],
                 confirms={
                     i: {"res": res, "op_pos": op_pos}
-                    for i, (_p, _f, res, _t, op_pos) in confirm_futs.items()
+                    for i, (_p, _f, res, _t, op_pos, _c) in confirm_futs.items()
                 },
                 device_confirms=[
                     {"i": idxs[k], "failed_at": fat, "cap": cap, "res": res}
@@ -942,7 +969,10 @@ def batch_analysis(
                         confirm_max_configs, op_pos,
                     )
                     obs.counter("confirm.submitted")
-                    confirm_futs[i] = (pool, fut, res, time.perf_counter(), op_pos)
+                    confirm_futs[i] = (
+                        pool, fut, res, time.perf_counter(), op_pos,
+                        obs.capture(),
+                    )
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
@@ -1176,72 +1206,78 @@ def batch_analysis(
         }
 
     t_drain = time.perf_counter()
-    for i, (pool, fut, dev_res, t_submit, op_pos) in confirm_futs.items():
-        resubmitted = False
-        while True:
-            try:
-                if fut is None:
-                    raise BrokenProcessPool("no confirmation worker available")
-                timeout = None
-                if deadline is not None:
-                    # leave a small grace so nearly-done sweeps land; a
-                    # timeout degrades this history alone (the
-                    # checkpoint kept its descriptor for a resume)
-                    timeout = max(5.0, deadline.remaining())
-                cpu_res = fut.result(timeout=timeout)
-                break
-            except FutureTimeout:
-                deadline_tripped = True
-                confirm_degraded.add(i)
-                obs.counter("fault.deadline.trip")
-                obs.event("fault.deadline", at="confirm-drain", history=i)
-                results[i] = {
-                    "valid?": "unknown",
-                    "cause": (
-                        "device refutation; deadline-exceeded before the "
-                        "confirmation sweep finished"
-                    ),
-                    "kernel": dev_res.get("kernel"),
-                }
-                cpu_res = None
-                break
-            except BrokenProcessPool:
-                # Reset only the pool the failure came from, and only
-                # while it is still installed: a stale future's error
-                # must not shut down a healthy rebuilt pool that other
-                # histories' confirmations are running on.
-                if pool is not None and pool is _CONFIRM_POOL:
-                    _reset_confirm_pool()
-                if not resubmitted:
-                    # The in-flight task died WITH the pool: one bounded
-                    # resubmit against the rebuilt pool before degrading
-                    # (a broken pool is usually one bad worker, not a
-                    # deterministic task failure).
-                    resubmitted = True
-                    obs.counter("fault.confirm.resubmit", history=i)
-                    pool, fut = _submit_confirmation(
-                        confirm_workers, model, list(histories[i]),
-                        confirm_max_configs, op_pos,
+    for i, (pool, fut, dev_res, t_submit, op_pos, ctx) in confirm_futs.items():
+        with obs.attach(ctx):
+            # The re-attached submit-time context: every event this
+            # resolution emits carries the originating trace, even
+            # though the sweep itself ran in a worker process.
+            resubmitted = False
+            while True:
+                try:
+                    if fut is None:
+                        raise BrokenProcessPool(
+                            "no confirmation worker available")
+                    timeout = None
+                    if deadline is not None:
+                        # leave a small grace so nearly-done sweeps land;
+                        # a timeout degrades this history alone (the
+                        # checkpoint kept its descriptor for a resume)
+                        timeout = max(5.0, deadline.remaining())
+                    cpu_res = fut.result(timeout=timeout)
+                    break
+                except FutureTimeout:
+                    deadline_tripped = True
+                    confirm_degraded.add(i)
+                    obs.counter("fault.deadline.trip")
+                    obs.event("fault.deadline", at="confirm-drain", history=i)
+                    results[i] = {
+                        "valid?": "unknown",
+                        "cause": (
+                            "device refutation; deadline-exceeded before the "
+                            "confirmation sweep finished"
+                        ),
+                        "kernel": dev_res.get("kernel"),
+                    }
+                    cpu_res = None
+                    break
+                except BrokenProcessPool:
+                    # Reset only the pool the failure came from, and only
+                    # while it is still installed: a stale future's error
+                    # must not shut down a healthy rebuilt pool that other
+                    # histories' confirmations are running on.
+                    if pool is not None and pool is _CONFIRM_POOL:
+                        _reset_confirm_pool()
+                    if not resubmitted:
+                        # The in-flight task died WITH the pool: one bounded
+                        # resubmit against the rebuilt pool before degrading
+                        # (a broken pool is usually one bad worker, not a
+                        # deterministic task failure).
+                        resubmitted = True
+                        obs.counter("fault.confirm.resubmit", history=i)
+                        pool, fut = _submit_confirmation(
+                            confirm_workers, model, list(histories[i]),
+                            confirm_max_configs, op_pos,
+                        )
+                        continue
+                    cpu_res = _degrade_confirmation(
+                        i, dev_res,
+                        BrokenProcessPool("confirmation worker failed twice"),
                     )
-                    continue
-                cpu_res = _degrade_confirmation(
-                    i, dev_res,
-                    BrokenProcessPool("confirmation worker failed twice"),
-                )
-                break
-            except Exception as e:  # noqa: BLE001 — a dead worker must
-                # not lose the other histories' verdicts; this one only
-                cpu_res = _degrade_confirmation(i, dev_res, e)
-                break
-        if cpu_res is None:
-            continue
-        # Queue latency: submit-to-resolution — how much of the sweep ran
-        # concurrently with the remaining ladder stages vs in the drain.
-        obs.gauge(
-            "confirm.queue_latency_s",
-            round(time.perf_counter() - t_submit, 6), history=i,
-        )
-        results[i] = _resolve_confirmation(dev_res, cpu_res)
+                    break
+                except Exception as e:  # noqa: BLE001 — a dead worker must
+                    # not lose the other histories' verdicts; this one only
+                    cpu_res = _degrade_confirmation(i, dev_res, e)
+                    break
+            if cpu_res is None:
+                continue
+            # Queue latency: submit-to-resolution — how much of the sweep
+            # ran concurrently with the remaining ladder stages vs in the
+            # drain.
+            obs.gauge(
+                "confirm.queue_latency_s",
+                round(time.perf_counter() - t_submit, 6), history=i,
+            )
+            results[i] = _resolve_confirmation(dev_res, cpu_res)
     if confirm_futs:
         obs.span_event(
             "ladder.confirm.drain", time.perf_counter() - t_drain,

@@ -43,6 +43,7 @@ from typing import Mapping, Sequence
 
 from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
+from jepsen_tpu.obs import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -110,10 +111,11 @@ class CheckRequest:
     __slots__ = (
         "id", "seq", "model", "history", "priority", "deadline", "client",
         "group", "future", "status", "result", "t_submit", "t_done",
+        "trace_id", "ctx",
     )
 
     def __init__(self, *, seq, model, history, priority, deadline, client,
-                 group):
+                 group, trace_id=None):
         self.id = uuid.uuid4().hex[:12]
         self.seq = seq
         self.model = model
@@ -128,6 +130,12 @@ class CheckRequest:
         self.result: dict | None = None
         self.t_submit = time.monotonic()
         self.t_done: float | None = None
+        # The request's trace identity + the admission thread's span
+        # context, captured HERE so the scheduler thread's demux events
+        # re-attach to it (obs.attach) — parent links and the trace id
+        # survive the admission -> scheduler -> demux thread hops.
+        self.trace_id = trace_id or obs.new_trace_id()
+        self.ctx = obs.capture(trace=self.trace_id)
 
     def describe(self) -> dict:
         """The JSONable status document (GET /check/<id>)."""
@@ -137,6 +145,7 @@ class CheckRequest:
             "client": self.client,
             "priority": self.priority,
             "model": self.model.name,
+            "trace_id": self.trace_id,
         }
         if self.result is not None:
             out["result"] = self.result
@@ -236,13 +245,18 @@ class CheckService:
         priority: int = 0,
         deadline=None,
         client: str = "anon",
+        trace_id: str | None = None,
     ) -> CheckFuture:
         """Admit one history; returns a future resolving to its verdict.
 
         ``model`` defaults to ``CASRegister()``.  ``priority``: higher
         runs first (FIFO within a priority).  ``deadline``: seconds (or
-        a ``faults.Deadline``) bounding the queue wait.  Raises
-        ``QueueFull`` (backpressure) or ``ServiceClosed``."""
+        a ``faults.Deadline``) bounding the queue wait.  ``trace_id``
+        joins this request to a caller's existing trace (HTTP clients
+        pass it in the POST body); None mints a fresh id — read it back
+        from the returned future's request record or the status
+        document.  Raises ``QueueFull`` (backpressure) or
+        ``ServiceClosed``."""
         # Coerce every argument BEFORE reserving a slot: a reservation
         # leaked past a bad-argument raise would shrink admission
         # capacity forever.
@@ -251,6 +265,7 @@ class CheckService:
         history = list(history)
         priority = int(priority)
         client = str(client)
+        trace_id = str(trace_id) if trace_id is not None else None
         with self._lock:
             if self._closed:
                 raise ServiceClosed("check service is shutting down")
@@ -267,7 +282,7 @@ class CheckService:
             req = CheckRequest(
                 seq=next(self._seq), model=model, history=history,
                 priority=priority, deadline=deadline, client=client,
-                group=group,
+                group=group, trace_id=trace_id,
             )
         except BaseException:
             with self._lock:
@@ -289,15 +304,20 @@ class CheckService:
             else:
                 self._queue.append(req)
                 self._cond.notify_all()
-            obs.counter("serve.submitted", client=client)
-            obs.gauge("serve.queue_depth", len(self._queue))
+            with obs.attach(req.ctx):
+                obs.counter("serve.submitted", client=client)
+                obs.gauge("serve.queue_depth", len(self._queue))
         if group is None:
             # Trivial fast path: no barriers -> valid, no lanes spent.
             # Resolved OUTSIDE the lock: set_result runs done-callbacks
             # synchronously, and a callback re-entering the service
             # (submit/stats) must not deadlock on a held lock.
             req.resolve({"valid?": True})
-            obs.counter("serve.completed")
+            with obs.attach(req.ctx):
+                obs.counter("serve.completed")
+            metrics.inc("serve.verdicts", verdict="true")
+            metrics.observe("serve.request_latency_seconds",
+                            time.monotonic() - req.t_submit)
         return req.future
 
     def _group_of(self, model: m.Model, history) -> tuple | None:
@@ -343,9 +363,12 @@ class CheckService:
     # ------------------------------------------------------------------
 
     def start(self) -> "CheckService":
-        """Spawn the scheduler thread; idempotent."""
+        """Spawn the scheduler thread; idempotent.  Also turns on the
+        live metrics registry (obs.metrics) — a started service is a
+        serving process, and /metrics should reflect it."""
         if self._thread is not None:
             return self
+        metrics.enable_mirror()
         if self.warm_pool and self._check_opts.get(
                 "confirm_refutations", True) is True:
             # Satellite contract: pre-fork the confirmation workers at
@@ -401,7 +424,9 @@ class CheckService:
         # Expired futures resolve outside the lock (done-callbacks may
         # re-enter the service); the shared batch is untouched.
         for r in expired:
-            obs.counter("serve.expired", client=r.client)
+            with obs.attach(r.ctx):
+                obs.counter("serve.expired", client=r.client)
+            metrics.inc("serve.verdicts", verdict="unknown")
             r.resolve(
                 {
                     "valid?": "unknown",
@@ -417,9 +442,15 @@ class CheckService:
             return handled
         t_start = time.monotonic()
         for r in batch_reqs:
-            obs.span_event(
-                "serve.admission", t_start - r.t_submit, client=r.client
-            )
+            # Re-attach each request's admission-thread context: the
+            # scheduler thread's per-request events carry the request's
+            # trace id, not the scheduler's.
+            with obs.attach(r.ctx):
+                obs.span_event(
+                    "serve.admission", t_start - r.t_submit, client=r.client
+                )
+            metrics.observe("serve.admission_latency_seconds",
+                            t_start - r.t_submit)
         try:
             self._run_batch(batch_reqs)
         finally:
@@ -448,34 +479,49 @@ class CheckService:
         n = len(batch_reqs)
         n_pad = batch.padded_batch(n, self.mesh)
         geom = batch_reqs[0].group[1:]
+        trace_ids = [r.trace_id for r in batch_reqs]
+        metrics.set_gauge("serve.batch_occupancy", round(n / n_pad, 4))
+        metrics.set_gauge("serve.batch_padding_waste",
+                          round(1.0 - n / n_pad, 4))
+        metrics.set_gauge("serve.batch_requests", n)
         with obs.span(
             "serve.batch", requests=n, padded=n_pad,
             occupancy=round(n / n_pad, 4),
             padding_waste=round(1.0 - n / n_pad, 4),
             model=model.name, geometry=str(geom),
+            trace_ids=trace_ids,
         ):
             t0 = time.monotonic()
             try:
-                results = batch.batch_analysis(
-                    model, [r.history for r in batch_reqs],
-                    capacity=self.capacity, mesh=self.mesh,
-                    **self._check_opts,
-                )
+                # The shared-batch trace scope: everything the launch
+                # emits below here (ladder stages, confirmations,
+                # fault retries) carries the member trace ids, so one
+                # request's journey is findable inside the shared work.
+                with obs.attach(trace=trace_ids, parent="serve.batch"):
+                    results = batch.batch_analysis(
+                        model, [r.history for r in batch_reqs],
+                        capacity=self.capacity, mesh=self.mesh,
+                        **self._check_opts,
+                    )
                 err = None
             except Exception as e:  # noqa: BLE001 — degrade the batch's
                 # requests, never the service (the scheduler lives on)
                 logger.exception("check-service batch failed")
                 results, err = None, e
             dt = time.monotonic() - t0
+        metrics.observe("serve.batch_seconds", dt)
         with self._lock:
             self._batch_ewma_s = 0.7 * self._batch_ewma_s + 0.3 * dt
             self._totals["batches"] += 1
             self._occ_sum += n / n_pad
             if err is not None:
                 self._totals["batch_errors"] += 1
+        metrics.inc("serve.batches")
         if err is not None:
+            metrics.inc("serve.batch_errors")
             obs.counter("serve.batch_error", error=faults.describe(err))
             for r in batch_reqs:
+                metrics.inc("serve.verdicts", verdict="unknown")
                 r.resolve(
                     {
                         "valid?": "unknown",
@@ -492,10 +538,15 @@ class CheckService:
                 # SLA-bound caller can still discount it.
                 res = {**res, "deadline-overrun": True}
             r.resolve(res)
-            obs.span_event(
-                "serve.request", t_done - r.t_submit, client=r.client,
-                verdict=str(res.get("valid?")),
-            )
+            with obs.attach(r.ctx):
+                obs.span_event(
+                    "serve.request", t_done - r.t_submit, client=r.client,
+                    verdict=str(res.get("valid?")),
+                )
+            metrics.observe("serve.request_latency_seconds",
+                            t_done - r.t_submit)
+            metrics.inc("serve.verdicts",
+                        verdict=str(res.get("valid?")).lower())
         with self._lock:
             self._totals["completed"] += len(batch_reqs)
         obs.counter("serve.completed", len(batch_reqs))
@@ -652,7 +703,9 @@ class CheckService:
             if sub is not None:
                 cause += f"; resumable drain checkpoint: {sub}"
             for r in rs:
-                obs.counter("serve.drained", client=r.client)
+                with obs.attach(r.ctx):
+                    obs.counter("serve.drained", client=r.client)
+                metrics.inc("serve.verdicts", verdict="unknown")
                 r.resolve({"valid?": "unknown", "cause": cause},
                           status="drained")
         return out

@@ -11,11 +11,28 @@ check_service=svc)`` / ``jepsen-tpu serve --check``) the app also serves
 the check API:
 
   POST /check        submit a history ({"history": [...], "model": ...,
-                     "priority", "deadline", "client", "wait"}); 202 +
-                     request id, 200 + result with "wait": true, 429 +
-                     Retry-After on backpressure
-  GET  /check/<id>   request status / result
+                     "priority", "deadline", "client", "trace_id",
+                     "wait"}); 202 + request id + trace id, 200 +
+                     result with "wait": true, 429 + Retry-After on
+                     backpressure
+  GET  /check/<id>   request status / result (includes the trace_id)
   GET  /queue        queue-status JSON (the home page shows a panel)
+
+Observability endpoints (always mounted):
+
+  GET  /metrics          live Prometheus text (jepsen_tpu.obs.metrics):
+                         queue depth, batch occupancy/padding waste,
+                         admission + end-to-end latency histograms,
+                         fault/retry counters, verdicts by outcome,
+                         device-buffer bytes — the home page shows a
+                         self-refreshing panel
+  GET  /trace/<t>/<ts>   a run's telemetry.jsonl as Chrome/Perfetto
+                         trace-event JSON (one lane per request trace
+                         id; linked from the run page)
+  GET  /profile          jax.profiler capture-hook status; POST
+  POST /profile/start    /profile/start {"seconds": n} and POST
+  POST /profile/stop     /profile/stop drive a bounded device-profile
+                         capture (serve --profile-dir)
 
 The home/suite run index is cached keyed on store-directory mtimes so
 the dashboard stays cheap while the service is under load: validity is
@@ -40,6 +57,9 @@ from pathlib import Path
 from urllib.parse import unquote
 
 from jepsen_tpu import faults, store
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.obs.summary import _mb
 
 logger = logging.getLogger(__name__)
 
@@ -162,6 +182,26 @@ def queue_panel_html(service) -> str:
     )
 
 
+def metrics_panel_html() -> str:
+    """The home page's live-metrics panel: the current Prometheus text,
+    self-refreshing via a tiny fetch loop (the server-rendered snapshot
+    stands in when JS is off).  Rendered only when the live registry is
+    enabled (a serving process)."""
+    if not obs_metrics.MIRROR:
+        return ""
+    snap = html.escape(obs_metrics.render() or "(no samples yet)")
+    return (
+        "<h2>live metrics</h2>"
+        "<details open><summary><a href='/metrics'>/metrics</a> "
+        "(refreshes every 2s)</summary>"
+        "<pre id='live-metrics' style='background:#f6f6f6;padding:8px;"
+        f"max-height:340px;overflow:auto'>{snap}</pre></details>"
+        "<script>async function _lm(){try{const r=await fetch('/metrics');"
+        "document.getElementById('live-metrics').textContent="
+        "await r.text();}catch(e){}}setInterval(_lm,2000);</script>"
+    )
+
+
 def home_html(store_dir=None, check_service=None) -> str:
     rows = []
     by_name: dict[str, list] = {}
@@ -185,6 +225,7 @@ def home_html(store_dir=None, check_service=None) -> str:
         "td,th{padding:4px 12px;text-align:left}</style></head><body>"
         "<h1>jepsen-tpu results</h1>"
         + queue_panel_html(check_service)
+        + metrics_panel_html()
         + "<p><a href='/suite'>suite overview</a></p>"
         "<table><tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
         + "".join(rows)
@@ -261,10 +302,12 @@ def _telemetry_table(headers: list, rows: list[list]) -> str:
     )
 
 
-def telemetry_html(run_dir: Path) -> str:
+def telemetry_html(run_dir: Path, rel: str | None = None) -> str:
     """The run page's phase / checker / ladder-stage timing tables,
     rendered from the run's ``telemetry.json`` (the obs.summary rollup).
-    Empty string when the run carries no telemetry."""
+    ``rel`` (the run's path under the store root) adds the Perfetto
+    trace-export download link.  Empty string when the run carries no
+    telemetry."""
     p = Path(run_dir) / "telemetry.json"
     if not p.exists():
         return ""
@@ -273,6 +316,13 @@ def telemetry_html(run_dir: Path) -> str:
     except (OSError, ValueError):
         return ""
     parts = [f"<h2>telemetry</h2><p>total wall: {s.get('wall_s', 0)} s</p>"]
+    if rel and (Path(run_dir) / "telemetry.jsonl").exists():
+        href = "/trace/" + html.escape(rel.strip("/"))
+        parts.append(
+            f"<p><a href='{href}'>trace.json</a> — Perfetto/Chrome "
+            "trace-event export (one lane per request; load at "
+            "ui.perfetto.dev)</p>"
+        )
     if s.get("phases"):
         parts.append("<h3>phases</h3>")
         parts.append(_telemetry_table(
@@ -304,13 +354,15 @@ def telemetry_html(run_dir: Path) -> str:
         parts.append(_telemetry_table(
             ["stage", "engine", "capacity", "lanes", "seconds", "resolved",
              "refuted", "unknowns left", "launches", "compile (s)",
-             "execute (s)", "peak frontier", "lossy", "dedup"],
+             "execute (s)", "peak frontier", "lossy", "dedup",
+             "device MB (peak)"],
             [[r.get("stage"), r.get("engine"), r.get("capacity"),
               r.get("lanes"), r.get("seconds"), r.get("resolved", ""),
               r.get("refuted", ""), r.get("unknowns_remaining", ""),
               r.get("launches", ""), r.get("compile_s", ""),
               r.get("execute_s", ""), r.get("peak_frontier", ""),
-              r.get("lossy", ""), r.get("dedup", "")] for r in s["ladder"]],
+              r.get("lossy", ""), r.get("dedup", ""),
+              _mb(r.get("device_bytes_peak"))] for r in s["ladder"]],
         ))
     if s.get("dedup"):
         parts.append("<h3>dedup rounds (sort vs bucket probe)</h3>")
@@ -337,6 +389,7 @@ def telemetry_html(run_dir: Path) -> str:
 class Handler(BaseHTTPRequestHandler):
     store_dir = None
     check_service = None  # a jepsen_tpu.serve.CheckService, or None
+    profiler = None  # a jepsen_tpu.obs.profiler.ProfilerHook, or None
 
     def log_message(self, fmt, *args):  # quiet
         logger.debug("web: " + fmt, *args)
@@ -364,6 +417,9 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 - stdlib API
         try:
             path = unquote(self.path.split("?")[0])
+            if path in ("/profile/start", "/profile/stop"):
+                self._handle_profile(path)
+                return
             if path != "/check":
                 self._send(404, b"not found")
                 return
@@ -383,6 +439,9 @@ class Handler(BaseHTTPRequestHandler):
                     body.get("model", "cas-register"))
                 priority = int(body.get("priority") or 0)
                 client = str(body.get("client") or "http")
+                trace_id = body.get("trace_id")
+                if trace_id is not None:
+                    trace_id = str(trace_id)
                 deadline = body.get("deadline")
                 if deadline is not None:
                     deadline = faults.Deadline.coerce(float(deadline))
@@ -397,7 +456,7 @@ class Handler(BaseHTTPRequestHandler):
             try:
                 fut = svc.submit(
                     history, model=model, priority=priority,
-                    deadline=deadline, client=client,
+                    deadline=deadline, client=client, trace_id=trace_id,
                 )
             except (KeyError, TypeError, ValueError, IndexError) as e:
                 # malformed op dicts surface from pack() at admission —
@@ -417,6 +476,8 @@ class Handler(BaseHTTPRequestHandler):
             except _serve_mod().ServiceClosed:
                 self._send_json(503, {"error": "service shutting down"})
                 return
+            req = svc.get(fut.id)
+            tid = req.trace_id if req is not None else None
             if body.get("wait"):
                 import concurrent.futures
 
@@ -430,24 +491,78 @@ class Handler(BaseHTTPRequestHandler):
                 except concurrent.futures.TimeoutError:
                     self._send_json(
                         202, {"id": fut.id, "status": "pending",
-                              "href": f"/check/{fut.id}"})
+                              "trace_id": tid, "href": f"/check/{fut.id}"})
                     return
-                self._send_json(200, {"id": fut.id, "result": result})
+                self._send_json(
+                    200, {"id": fut.id, "trace_id": tid, "result": result})
             else:
                 self._send_json(
                     202, {"id": fut.id, "status": "queued",
-                          "href": f"/check/{fut.id}"})
+                          "trace_id": tid, "href": f"/check/{fut.id}"})
         except BrokenPipeError:  # pragma: no cover
             pass
         except Exception:  # noqa: BLE001 - pragma: no cover
             logger.exception("web POST handler error")
             self._send_json(500, {"error": "internal error"})
 
+    def _handle_profile(self, path: str) -> None:
+        """POST /profile/start|stop — the bounded jax.profiler capture
+        hook (obs.profiler, mounted via serve --profile-dir)."""
+        if self.profiler is None:
+            self._send_json(
+                503, {"error": "no profiler mounted "
+                               "(start with serve --profile-dir)"})
+            return
+        if path.endswith("/start"):
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                self._send_json(400, {"error": "bad JSON body"})
+                return
+            doc = self.profiler.start(body.get("seconds"))
+        else:
+            doc = self.profiler.stop()
+        self._send_json(409 if doc.get("error") else 200, doc)
+
     def do_GET(self):  # noqa: N802 - stdlib API
         try:
             path = unquote(self.path.split("?")[0])
             base = store.base_dir({"store-dir": self.store_dir} if self.store_dir else None)
-            if path in ("/", "/index.html"):
+            if path == "/metrics":
+                # Prometheus text exposition: the live registry, fed by
+                # the obs mirror + the serving layer's explicit series.
+                self._send(
+                    200, obs_metrics.render().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/profile":
+                if self.profiler is None:
+                    self._send_json(503, {"error": "no profiler mounted"})
+                else:
+                    self._send_json(200, self.profiler.status())
+            elif path.startswith("/trace/"):
+                target = _safe_resolve(base, path[len("/trace/"):])
+                jsonl = target / "telemetry.jsonl" if target else None
+                if jsonl is None or not jsonl.is_file():
+                    self._send(404, b"not found")
+                else:
+                    try:
+                        events = obs_trace.read_jsonl_events(jsonl)
+                    except (OSError, ValueError) as e:
+                        self._send_json(500, {"error": f"unreadable "
+                                                       f"telemetry: {e}"})
+                        return
+                    body = json.dumps(
+                        obs_trace.to_trace_events(events),
+                        separators=(",", ":"), default=str,
+                    ).encode()
+                    self._send(
+                        200, body, "application/json; charset=utf-8",
+                        headers={"Content-Disposition":
+                                 'attachment; filename="trace.json"'},
+                    )
+            elif path in ("/", "/index.html"):
                 self._send(
                     200, home_html(self.store_dir, self.check_service).encode()
                 )
@@ -479,8 +594,9 @@ class Handler(BaseHTTPRequestHandler):
                         for e in entries
                     )
                     # The run page: a run dir with telemetry renders its
-                    # phase/stage timing tables above the file listing.
-                    tele = telemetry_html(target)
+                    # phase/stage timing tables above the file listing
+                    # (+ the Perfetto trace-export link).
+                    tele = telemetry_html(target, rel=path[len("/files/"):])
                     self._send(
                         200,
                         (
@@ -521,23 +637,32 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def make_server(host="0.0.0.0", port=8080, store_dir=None,
-                check_service=None) -> ThreadingHTTPServer:
+                check_service=None, profiler=None) -> ThreadingHTTPServer:
+    # A mounted web server IS a serving process: turn the live metrics
+    # registry on so /metrics (and the home panel) have data to show.
+    obs_metrics.enable_mirror()
     handler = type(
         "BoundHandler", (Handler,),
-        {"store_dir": store_dir, "check_service": check_service},
+        {"store_dir": store_dir, "check_service": check_service,
+         "profiler": profiler},
     )
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None):
+def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None,
+          profiler=None):
     """Blocking server (web.clj:385-390).  With a ``check_service`` the
-    check API mounts and shutdown drains it (checkpointing queued work)."""
-    srv = make_server(host, port, store_dir, check_service)
+    check API mounts and shutdown drains it (checkpointing queued work);
+    with a ``profiler`` (obs.profiler.ProfilerHook) the /profile
+    endpoints drive bounded device captures."""
+    srv = make_server(host, port, store_dir, check_service, profiler)
     logger.info("serving store on http://%s:%d", host, port)
     try:
         srv.serve_forever()
     finally:
         srv.server_close()
+        if profiler is not None:
+            profiler.stop()
         if check_service is not None:
             check_service.shutdown(drain=True)
 

@@ -17,3 +17,20 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_metrics_mirror():
+    """The live metrics mirror is process-global and DELIBERATELY never
+    auto-disabled in production (a serving process stays scrape-able for
+    its lifetime) — but in the suite, a test that starts a service or
+    web server must not leave the mirror's per-event tax (registry
+    writes, device-memory samples at launch boundaries) running for
+    every test after it; the tier-1 budget is near its cap."""
+    from jepsen_tpu.obs import metrics
+
+    saved = metrics.MIRROR
+    yield
+    metrics.enable_mirror(saved)

@@ -30,6 +30,63 @@ def read_jsonl(d):
 # ---------------------------------------------------------------------------
 
 
+def test_recorder_header_epoch_pid_host(tmp_path):
+    """The meta header carries the wall-clock epoch t0 (plus pid/host):
+    event "t" offsets are monotonic-only, so without t0 two processes'
+    traces could never be time-aligned."""
+    import os
+    import time
+
+    before = time.time()
+    with obs.recording(tmp_path):
+        obs.event("x")
+    after = time.time()
+    meta = read_jsonl(tmp_path)[0]
+    assert meta["type"] == "meta"
+    assert before <= meta["t0"] <= after
+    assert meta["wall-clock"] == meta["t0"]  # legacy key stays aligned
+    assert meta["pid"] == os.getpid()
+    assert isinstance(meta["host"], str) and meta["host"]
+
+
+def test_capture_attach_crosses_threads(tmp_path):
+    """The context-handoff API: a Ctx captured on one thread re-parents
+    and trace-stamps spans emitted on another (the serve admission ->
+    scheduler -> demux hops)."""
+    import threading
+
+    with obs.recording(tmp_path):
+        with obs.span("root"):
+            ctx = obs.capture(trace="tr-1")
+        assert ctx.parent == "root" and ctx.trace == "tr-1"
+
+        def other():
+            with obs.attach(ctx):
+                with obs.span("hop"):
+                    with obs.span("nested"):
+                        pass
+                obs.counter("hits")
+                obs.gauge("depth", 1)
+            obs.counter("outside")  # after detach: unstamped
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        # attach also works trace-only (the shared-batch scope)
+        with obs.attach(trace=["tr-1", "tr-2"]):
+            obs.event("shared")
+    by_name = {e.get("name"): e for e in read_jsonl(tmp_path)[1:]}
+    assert by_name["hop"]["parent"] == "root"  # the cross-thread link
+    assert by_name["hop"]["trace"] == "tr-1"
+    assert by_name["nested"]["parent"] == "hop"  # local nesting wins
+    assert by_name["nested"]["trace"] == "tr-1"
+    assert by_name["hits"]["trace"] == "tr-1"
+    assert by_name["depth"]["trace"] == "tr-1"
+    assert "trace" not in by_name["outside"]
+    assert "trace" not in by_name["root"]
+    assert by_name["shared"]["trace"] == ["tr-1", "tr-2"]
+
+
 def test_span_nesting_attrs_and_jsonl_roundtrip(tmp_path):
     with obs.recording(tmp_path) as rec:
         with obs.span("outer", a=1) as sp:
@@ -127,6 +184,224 @@ def test_env_toggle(monkeypatch):
     monkeypatch.setenv(obs.ENV_VAR, "0")
     assert obs.enabled_for({"telemetry?": True})
     assert not obs.enabled_for({})
+
+
+def test_noop_fast_path_overhead_guard():
+    """With telemetry off (no recorder, mirror off), the per-call cost of
+    span/counter/gauge must stay negligible — the kernels' host loops
+    call these unguarded.  The bound is deliberately generous (CI noise,
+    cold caches); a regression that installs real per-call work (dict
+    allocation, lock acquisition, registry writes) blows through it."""
+    import time
+
+    from jepsen_tpu.obs import metrics
+
+    assert obs.active() is None
+    saved = metrics.MIRROR
+    metrics.enable_mirror(False)
+    try:
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("s", a=1):
+                pass
+            obs.counter("c")
+            obs.gauge("g", 1)
+            obs.span_event("e", 0.1)
+        per_call = (time.perf_counter() - t0) / (4 * n)
+    finally:
+        metrics.enable_mirror(saved)
+    assert per_call < 10e-6, f"no-op telemetry path costs {per_call*1e6:.2f}µs/call"
+
+
+def test_summarize_edge_cases_empty_sections():
+    """summarize() on empty/partial streams: every section present with
+    its empty shape (consumers index unconditionally), no serve/faults
+    rows invented, and the text renderer skips absent blocks."""
+    s = summarize([])
+    assert s["wall_s"] == 0
+    assert s["phases"] == [] and s["checkers"] == [] and s["ladder"] == []
+    assert s["serve"] == {} and s["faults"] == [] and s["dedup"] == []
+    assert s["counters"] == {} and s["gauges"] == {} and s["spans"] == {}
+    txt = format_summary(s)
+    assert "check service" not in txt and "faults" not in txt
+    assert "ladder stages" not in txt
+    # meta-only (a recording that opened and crashed before any event)
+    s2 = summarize([{"type": "meta", "version": 1}])
+    assert s2["serve"] == {} and s2["faults"] == []
+    # events with no serve/fault activity leave those sections empty
+    s3 = summarize([
+        {"type": "span", "name": "phase.analyze", "t": 0.0, "dur": 1.5},
+        {"type": "counter", "name": "hits", "t": 0.1, "n": 2},
+        {"type": "gauge", "name": "depth", "t": 0.2, "value": 7},
+        {"type": "span", "name": "x", "t": 0.0},  # dur absent -> 0
+        {"type": "counter", "name": "k", "t": None},  # t absent -> 0
+    ])
+    assert s3["serve"] == {} and s3["faults"] == []
+    assert s3["phases"] == [{"phase": "analyze", "wall_s": 1.5, "count": 1}]
+    assert s3["counters"] == {"hits": 2, "k": 1}
+    assert "phases" in format_summary(s3)
+
+
+def test_metrics_registry_and_obs_mirror():
+    """The live registry: labeled counters/gauges/histograms render as
+    valid Prometheus text, and the obs mirror feeds it (by name) even
+    with NO recording active — the serving process's regime."""
+    from jepsen_tpu.obs import metrics
+
+    r = metrics.Registry()
+    r.inc("serve.verdicts", verdict="true")
+    r.inc("serve.verdicts", 2, verdict="false")
+    r.set("serve.queue_depth", 4)
+    r.set("weird.gauge", "not-a-number")  # non-numeric: never rendered
+    r.set("bool.gauge", True)
+    r.observe("lat", 0.004, buckets=(0.01, 1.0))
+    r.observe("lat", 5.0, buckets=(0.01, 1.0))
+    text = r.render()
+    assert "# TYPE jepsen_tpu_serve_verdicts_total counter" in text
+    assert 'jepsen_tpu_serve_verdicts_total{verdict="false"} 2' in text
+    assert "# TYPE jepsen_tpu_serve_queue_depth gauge" in text
+    assert "jepsen_tpu_serve_queue_depth 4" in text
+    assert "weird_gauge" not in text
+    assert "jepsen_tpu_bool_gauge 1" in text
+    assert 'jepsen_tpu_lat_bucket{le="0.01"} 1' in text
+    assert 'jepsen_tpu_lat_bucket{le="+Inf"} 2' in text
+    assert "jepsen_tpu_lat_sum 5.004" in text
+    assert "jepsen_tpu_lat_count 2" in text
+    assert r.get("serve.queue_depth") == 4
+    assert r.get("serve.verdicts", verdict="true") == 1
+    assert r.get("nope") is None
+    snap = r.snapshot()
+    assert snap["histograms"]["jepsen_tpu_lat"]["count"] == 2
+    r.reset()
+    assert r.render() == ""
+    # --- the obs mirror: counters/gauges land with no recorder ---
+    saved = metrics.MIRROR
+    before = metrics.REGISTRY.get("mirror.test.hits") or 0
+    try:
+        metrics.enable_mirror(False)
+        obs.counter("mirror.test.hits", 5)
+        assert (metrics.REGISTRY.get("mirror.test.hits") or 0) == before
+        metrics.enable_mirror(True)
+        assert obs.observing()
+        obs.counter("mirror.test.hits", 5)
+        obs.gauge("mirror.test.depth", 9)
+        assert metrics.REGISTRY.get("mirror.test.hits") == before + 5
+        assert metrics.REGISTRY.get("mirror.test.depth") == 9
+    finally:
+        metrics.enable_mirror(saved)
+
+
+def test_profiler_hook_bounded_exclusive_generation_safe(tmp_path, monkeypatch):
+    """The jax.profiler capture hook: bounded (seconds clamp to
+    max_seconds), exclusive (second start reports, never corrupts), and
+    a stale watchdog (its capture already stopped manually) must no-op
+    instead of truncating the NEXT capture."""
+    from jepsen_tpu.obs import profiler
+
+    calls = []
+    monkeypatch.setattr(
+        profiler, "_trace_api",
+        lambda: (lambda d: calls.append(("start", d)),
+                 lambda: calls.append(("stop",))),
+    )
+    h = profiler.ProfilerHook(tmp_path, max_seconds=60)
+    doc = h.start(5)
+    assert doc["profiling"] is True and doc["seconds"] == 5
+    assert doc["capture_dir"].startswith(str(tmp_path))
+    assert h.start()["error"] == "capture already running"
+    stale_gen = h._gen
+    st = h.stop()
+    assert st["profiling"] is False and "stopped" in st
+    assert h.stop()["profiling"] is False  # idempotent
+    # stale watchdog vs a new capture: the gen mismatch no-ops
+    h.start(5)
+    assert h.stop(gen=stale_gen)["profiling"] is True  # still running
+    assert h.stop()["profiling"] is False
+    # the bound clamps over-asks
+    assert h.start(999)["seconds"] == 60
+    h.stop()
+    assert [c[0] for c in calls] == ["start", "stop"] * 3
+
+
+def test_trace_export_lanes_and_counters(tmp_path):
+    """The Perfetto export: one lane per request trace id, shared-batch
+    spans on the device lane with their member ids in args, counter
+    tracks for the live gauges — and the CLI wrapper round-trips."""
+    import trace_export
+
+    from jepsen_tpu.obs.trace import read_jsonl_events, to_trace_events
+
+    with obs.recording(tmp_path):
+        with obs.attach(trace="req-1"):
+            obs.span_event("serve.admission", 0.01, client="a")
+        with obs.attach(trace="req-2"):
+            obs.span_event("serve.admission", 0.02, client="b")
+        with obs.span("serve.batch", trace_ids=["req-1", "req-2"]):
+            with obs.attach(trace=["req-1", "req-2"]):
+                obs.span_event("ladder.stage", 0.1, stage=0)
+                obs.gauge("device.buffer_bytes", 1234)
+        obs.gauge("serve.queue_depth", 2)
+    trace = to_trace_events(read_jsonl_events(tmp_path / "telemetry.jsonl"))
+    evs = trace["traceEvents"]
+    lane_names = {
+        e["args"]["name"]: e["tid"] for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert lane_names["request req-1"] != lane_names["request req-2"]
+    assert lane_names["device/ladder"] == 0
+    assert trace["otherData"]["requests"] == 2
+    adm = [e for e in evs if e["ph"] == "X" and e["name"] == "serve.admission"]
+    assert {e["tid"] for e in adm} == {
+        lane_names["request req-1"], lane_names["request req-2"]}
+    [stage] = [e for e in evs if e["ph"] == "X" and e["name"] == "ladder.stage"]
+    assert stage["tid"] == 0 and stage["args"]["trace"] == ["req-1", "req-2"]
+    counters = {e["name"] for e in evs if e["ph"] == "C"}
+    assert {"serve.queue_depth", "device.buffer_bytes"} <= counters
+    assert trace["otherData"]["t0"] is not None
+    # the CLI writes a loadable trace.json next to the jsonl
+    assert trace_export.main([str(tmp_path)]) == 0
+    out = json.loads((tmp_path / "trace.json").read_text())
+    assert out["traceEvents"]
+    assert trace_export.main([str(tmp_path / "missing")]) == 1
+
+
+def test_trace_summarize_partial_stream(tmp_path, capsys):
+    """A partially-written telemetry.jsonl (crash mid-line) summarizes
+    what parsed; unreadable inputs exit 1 with a message, never a
+    traceback (the satellite contract)."""
+    import trace_summarize
+
+    p = tmp_path / "telemetry.jsonl"
+    p.write_text(
+        '{"type":"meta","version":1,"t0":1.0,"pid":1}\n'
+        '{"type":"counter","name":"hits","t":0.1,"n":3}\n'
+        '{"type":"span","name":"phase.run","t":0.0,"dur"'  # truncated
+    )
+    assert trace_summarize.main([str(tmp_path)]) == 0
+    captured = capsys.readouterr()
+    assert "counters" in captured.out
+    assert "skipped 1 malformed line" in captured.err
+    # --json still works on the tolerant load
+    assert trace_summarize.main([str(p), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["counters"] == {"hits": 3}
+    # nothing parseable -> clear error, exit 1
+    bad = tmp_path / "bad" / "telemetry.jsonl"
+    bad.parent.mkdir()
+    bad.write_text("not json at all\n{{{\n")
+    assert trace_summarize.main([str(bad)]) == 1
+    assert "no parseable telemetry" in capsys.readouterr().err
+    # empty file -> clear error, exit 1
+    empty = tmp_path / "empty" / "telemetry.jsonl"
+    empty.parent.mkdir()
+    empty.write_text("")
+    assert trace_summarize.main([str(empty)]) == 1
+    # corrupt rolled-up .json -> clear error, exit 1
+    rolled = tmp_path / "rolled"
+    rolled.mkdir()
+    (rolled / "telemetry.json").write_text('{"version": 1, "wall_s"')
+    assert trace_summarize.main([str(rolled)]) == 1
+    assert "not valid JSON" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------------------------

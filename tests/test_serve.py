@@ -329,6 +329,105 @@ def test_http_check_api(tmp_path):
         svc.shutdown(drain=False)
 
 
+def test_metrics_endpoint_and_trace_propagation(tmp_path):
+    """The service-grade observability contract, on suite-shared kernel
+    shapes (no new compiles): GET /metrics serves Prometheus text whose
+    queue/verdict/latency series match the service's own accounting,
+    and one request's trace_id rides every hop — HTTP-visible admission
+    record, the serve.admission/serve.request span events, the shared
+    serve.batch span's trace_ids link, the ladder stage spans inside
+    the launch, and the confirmation demux."""
+    from jepsen_tpu import web
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    hists = mixed_histories(3)  # index 2 corrupt -> exercises confirm demux
+    obs_metrics.REGISTRY.reset()
+    with obs.recording(tmp_path) as rec:
+        svc = sv.CheckService(**KW)
+        srv = web.make_server("127.0.0.1", 0, str(tmp_path / "store"),
+                              check_service=svc)  # enables the live mirror
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            futs = [
+                svc.submit(hh, client="t", trace_id=f"trace-{i:04d}")
+                for i, hh in enumerate(hists)
+            ]
+            # the admission record carries the caller's trace id
+            assert svc.get(futs[0].id).trace_id == "trace-0000"
+            assert svc.get(futs[0].id).describe()["trace_id"] == "trace-0000"
+
+            def scrape():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                    assert r.headers["Content-Type"].startswith("text/plain")
+                    return r.read().decode()
+
+            text = scrape()
+            assert "# TYPE jepsen_tpu_serve_queue_depth gauge" in text
+            assert "jepsen_tpu_serve_queue_depth 3" in text
+            assert "jepsen_tpu_serve_submitted_total 3" in text
+            svc.step()
+            [f.result(timeout=10) for f in futs]
+            text = scrape()
+            st = svc.stats()
+            assert f"jepsen_tpu_serve_submitted_total {st['submitted']}" in text
+            assert f"jepsen_tpu_serve_completed_total {st['completed']}" in text
+            assert "jepsen_tpu_serve_queue_depth 0" in text
+            # verdicts by outcome: 2 valid + 1 refuted (mixed_histories)
+            assert 'jepsen_tpu_serve_verdicts_total{verdict="true"} 2' in text
+            assert 'jepsen_tpu_serve_verdicts_total{verdict="false"} 1' in text
+            # end-to-end latency histogram saw every request
+            assert ("jepsen_tpu_serve_request_latency_seconds_count "
+                    f"{st['completed']}") in text
+            # batch occupancy: 3 lanes in a pad-8 launch
+            assert "jepsen_tpu_serve_batch_occupancy 0.375" in text
+            # POST /check surfaces the trace id over HTTP (trivial
+            # history: resolved inline, no extra kernel launch)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/check",
+                data=json.dumps({"history": [], "wait": True,
+                                 "trace_id": "trace-http"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert doc["trace_id"] == "trace-http"
+            assert doc["result"]["valid?"] is True
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    # --- trace propagation through the recorded event stream ---
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "telemetry.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    tid = "trace-0000"
+
+    def with_trace(name):
+        return [
+            e for e in events if e.get("name") == name
+            and (e.get("trace") == tid or tid in (e.get("trace") or ()))
+        ]
+
+    assert with_trace("serve.submitted"), "admission counter lost the trace"
+    assert with_trace("serve.admission"), "admission span lost the trace"
+    [batch_ev] = [e for e in events if e.get("name") == "serve.batch"]
+    assert set(batch_ev["attrs"]["trace_ids"]) == {
+        "trace-0000", "trace-0001", "trace-0002"}
+    # the shared launch's ladder stages carry the member trace ids
+    stage_evs = with_trace("ladder.stage")
+    assert stage_evs, "ladder stages lost the batch trace link"
+    assert all(e.get("parent") == "serve.batch" or "trace" in e
+               for e in stage_evs)
+    # confirmation demux (the corrupt history's refutation was confirmed
+    # through the worker pool) kept the trace across the process hop
+    assert with_trace("confirm.submitted")
+    assert with_trace("confirm.queue_latency_s")
+    # per-request end-to-end span resolves back to the single trace id
+    assert all(e.get("trace") == tid for e in with_trace("serve.request"))
+
+
 def test_web_run_index_mtime_cache(tmp_path):
     """The home/suite pages' run index is cached on store-dir mtimes and
     refreshes when a run's artifacts change.  Run-dir mtimes are

@@ -12,7 +12,10 @@ buffering unboundedly).
 
 Both modes are warmed (one untimed pass each) so the comparison is
 launch-vs-launch, not compile-vs-cache.  Exits 1 on a verdict parity
-mismatch or a missing backpressure rejection.
+mismatch, a missing backpressure rejection, or (service mode) a live
+``/metrics`` scrape whose queue/occupancy/counter series disagree with
+the generator's own request accounting — the observability layer is
+load-tested alongside the thing it observes.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ import json
 import sys
 import threading
 import time
+import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
@@ -34,6 +38,67 @@ def _pct(xs: list[float], p: float) -> float:
     xs = sorted(xs)
     k = min(len(xs) - 1, max(0, round(p / 100 * (len(xs) - 1))))
     return xs[k]
+
+
+def _parse_prom(text: str) -> dict[str, float]:
+    """Prometheus text -> {name{labels}: value} (enough of the format
+    for the consistency assertions; histogram buckets included)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsScraper:
+    """Polls GET /metrics during the load phase (its own thread),
+    recording queue-depth samples and the last full parse."""
+
+    def __init__(self, port: int, period_s: float = 0.1):
+        self.url = f"http://127.0.0.1:{port}/metrics"
+        self.period_s = period_s
+        self.samples: list[float] = []  # queue_depth over time
+        self.scrapes = 0
+        self.last: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def scrape(self) -> dict[str, float]:
+        with urllib.request.urlopen(self.url, timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain"), (
+                "metrics endpoint must serve Prometheus text, got "
+                f"{r.headers['Content-Type']}"
+            )
+            parsed = _parse_prom(r.read().decode())
+        self.scrapes += 1
+        self.last = parsed
+        return parsed
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                parsed = self.scrape()
+                d = parsed.get("jepsen_tpu_serve_queue_depth")
+                if d is not None:
+                    self.samples.append(d)
+            except Exception:  # noqa: BLE001 — scrape gaps are fine
+                pass
+            self._stop.wait(self.period_s)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
 
 def main(argv=None) -> int:
@@ -80,8 +145,15 @@ def main(argv=None) -> int:
     from genhist import corrupt, valid_register_history
     from jepsen_tpu import obs
     from jepsen_tpu import models as m
+    from jepsen_tpu.obs import metrics as obs_metrics
     from jepsen_tpu.parallel import batch_analysis
     from jepsen_tpu.serve import CheckService, QueueFull
+
+    # Enable the live metrics mirror BEFORE either arm runs: the service
+    # arm would flip it on anyway (make_server/start), and the sequential
+    # baseline must pay the same per-launch observation cost or the
+    # printed speedup stops being launch-vs-launch.
+    obs_metrics.enable_mirror()
 
     capacity = tuple(int(c) for c in a.capacity.split(",") if c)
     model = m.CASRegister(None)
@@ -135,11 +207,21 @@ def main(argv=None) -> int:
             print(f"sequential: {out['sequential']}")
 
         if a.mode in ("both", "service"):
+            from jepsen_tpu import web
+
             svc = CheckService(
                 capacity=capacity, max_batch=a.max_batch,
                 max_queue=a.max_queue,
                 batch_window_s=a.batch_window_ms / 1000.0,
             ).start()
+            # Mount the real HTTP app over the service so the load runs
+            # with /metrics live — the scrape-vs-accounting consistency
+            # check below exercises the whole observability path, not a
+            # registry read.
+            srv = web.make_server("127.0.0.1", 0, check_service=svc)
+            srv_thread = threading.Thread(target=srv.serve_forever, daemon=True)
+            srv_thread.start()
+            scraper = MetricsScraper(srv.server_address[1])
             try:
                 # warm pass: same histories, untimed (compile the padded
                 # batch shapes the measured pass will launch)
@@ -147,6 +229,7 @@ def main(argv=None) -> int:
                 for f in warm:
                     f.result(timeout=600)
                 warm_batches = svc.stats()["batches"]
+                scraper.start()  # mid-load /metrics sampling starts here
 
                 verdicts: list = [None] * a.requests
                 lat: list = [0.0] * a.requests
@@ -212,7 +295,59 @@ def main(argv=None) -> int:
                     "queue_full_retries": retries[0],
                 }
                 print(f"service:    {out['service']}")
+
+                # ------------------------------------------------------
+                # /metrics consistency: the scraped series must agree
+                # with the generator's own accounting and the service's
+                # totals — a live dashboard that disagrees with the
+                # system it watches is worse than none.
+                # ------------------------------------------------------
+                scraper.stop()
+                m = scraper.scrape()  # final settle scrape
+                checks = {
+                    # warm + measured, each a.requests submissions
+                    "submitted": (
+                        m.get("jepsen_tpu_serve_submitted_total"),
+                        float(2 * a.requests),
+                    ),
+                    "completed": (
+                        m.get("jepsen_tpu_serve_completed_total"),
+                        float(2 * a.requests),
+                    ),
+                    "rejected": (
+                        m.get("jepsen_tpu_serve_rejected_total", 0.0),
+                        float(st["rejected"]),
+                    ),
+                    "request_latency_count": (
+                        m.get("jepsen_tpu_serve_request_latency_seconds_count"),
+                        float(st["completed"]),
+                    ),
+                    "queue_depth_settled": (
+                        m.get("jepsen_tpu_serve_queue_depth"), 0.0
+                    ),
+                }
+                bad = {k: v for k, v in checks.items() if v[0] != v[1]}
+                occ = m.get("jepsen_tpu_serve_batch_occupancy")
+                if occ is None or not (0.0 < occ <= 1.0):
+                    bad["batch_occupancy"] = (occ, "(0, 1]")
+                depth_max = max(scraper.samples, default=0.0)
+                if depth_max > a.max_queue:
+                    bad["queue_depth_bound"] = (depth_max, a.max_queue)
+                out["metrics"] = {
+                    "scrapes": scraper.scrapes,
+                    "queue_depth_max": depth_max,
+                    "queue_depth_samples": len(scraper.samples),
+                    "batch_occupancy_last": occ,
+                    "consistent": not bad,
+                }
+                if bad:
+                    print(f"METRICS INCONSISTENT: {bad}", file=sys.stderr)
+                    rc = 1
+                print(f"metrics:    {out['metrics']}")
             finally:
+                scraper.stop()
+                srv.shutdown()
+                srv.server_close()
                 svc.shutdown(drain=False)
 
             if baseline_verdicts is not None:

@@ -1,0 +1,50 @@
+"""Export a run's telemetry.jsonl as Chrome/Perfetto trace-event JSON.
+
+Load the output at https://ui.perfetto.dev (or chrome://tracing): one
+lane per request trace id, a shared device/ladder lane for batch and
+stage spans, and counter tracks for queue depth / unknowns remaining /
+device buffer bytes.  The same converter backs the web UI's
+``GET /trace/<test>/<time>`` download link.
+
+  python tools/trace_export.py store/my-test/latest
+  python tools/trace_export.py <run-dir>/telemetry.jsonl -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jepsen_tpu.obs.trace import read_jsonl_events, to_trace_events  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run directory or telemetry.jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output file (default: <run-dir>/trace.json)")
+    opts = ap.parse_args(argv)
+    path = Path(opts.path)
+    if path.is_dir():
+        path = path / "telemetry.jsonl"
+    try:
+        events = read_jsonl_events(path)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    trace = to_trace_events(events)
+    out = Path(opts.out) if opts.out else path.parent / "trace.json"
+    out.write_text(json.dumps(trace, separators=(",", ":"), default=str))
+    n = len(trace["traceEvents"])
+    print(f"{out}: {n} trace events, "
+          f"{trace['otherData']['requests']} request lane(s) "
+          "(load at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

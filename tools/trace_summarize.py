@@ -19,12 +19,18 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from jepsen_tpu.obs.summary import format_summary, summarize  # noqa: E402
+from jepsen_tpu.obs.trace import read_jsonl_events  # noqa: E402
 
 
 def load_summary(path: Path) -> dict:
     """Resolve a run dir / telemetry.jsonl / telemetry.json into a summary
     dict.  JSONL is always re-rolled (it is the source of truth; the .json
-    rollup may be stale after a crash)."""
+    rollup may be stale after a crash).  A partially-written JSONL (a
+    crashed writer truncates the LAST line mid-write) is read tolerantly
+    — parseable lines summarize, the skip is reported on stderr; a file
+    with nothing parseable, or a corrupt .json rollup, raises ValueError
+    with the path named (main turns that into a clear message + exit 1,
+    never a traceback)."""
     path = Path(path)
     if path.is_dir():
         jsonl = path / "telemetry.jsonl"
@@ -34,26 +40,47 @@ def load_summary(path: Path) -> dict:
         elif rolled.exists():
             path = rolled
         else:
-            raise FileNotFoundError(f"no telemetry.jsonl/.json in {path}")
+            raise FileNotFoundError(
+                f"no telemetry.jsonl/.json in {path} (was the run recorded "
+                "with --no-telemetry?)"
+            )
     if path.suffix == ".jsonl":
-        events = [
-            json.loads(line)
-            for line in path.read_text().splitlines()
-            if line.strip()
-        ]
+        events = read_jsonl_events(path)
+        skipped = next(
+            (e["skipped-lines"] for e in events if "skipped-lines" in e), 0
+        )
+        if skipped:
+            print(
+                f"warning: skipped {skipped} malformed line(s) in {path} "
+                "(partially-written stream?)",
+                file=sys.stderr,
+            )
+        if not events:
+            raise ValueError(f"{path}: empty telemetry stream (the "
+                             "recording never wrote its header)")
         return summarize(events)
-    return json.loads(path.read_text())
+    try:
+        summary = json.loads(path.read_text())
+    except ValueError as e:
+        raise ValueError(
+            f"{path}: not valid JSON ({e}) — if the run crashed "
+            "mid-write, point at its telemetry.jsonl instead"
+        ) from None
+    if not isinstance(summary, dict):
+        raise ValueError(f"{path}: expected a telemetry summary object")
+    return summary
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="run directory, telemetry.jsonl, or telemetry.json")
     ap.add_argument("--json", action="store_true",
-                    help="print the rolled-up summary as JSON instead of tables")
+                    help="print the rolled-up summary as JSON instead of tables"
+                         " (scripting: jq '.serve', '.ladder[0]', ...)")
     opts = ap.parse_args(argv)
     try:
         summary = load_summary(Path(opts.path))
-    except (FileNotFoundError, ValueError) as e:
+    except (FileNotFoundError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     if opts.json:
