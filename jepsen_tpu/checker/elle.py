@@ -476,6 +476,13 @@ DEFAULT_ANOMALIES = ["G2", "G1a", "G1b", "internal"]  # tests/cycle/wr.clj:46
 class _ElleChecker(Checker):
     """Shared artifact plumbing for the elle-style checkers."""
 
+    #: Graph workloads have no padded-kernel geometry to share, so the
+    #: check service must never pack them into a geometry bucket —
+    #: admission routes them to the host side lane instead of letting
+    #: them stall packable ladder work (ROADMAP item 4: elle got no
+    #: cross-request batching by accident; this makes it explicit).
+    geometry_batchable = False
+
     def write_artifacts(self, test, result, opts=None):
         """Render the elle/ anomaly-explanation directory for a stored
         run (called per key by independent.checker on the batch path)."""

@@ -254,6 +254,76 @@ def _dfs_analysis(model, history, max_visited, stats: dict) -> dict:
     }
 
 
+def greedy_walk(model: m.Model, history: Sequence[dict],
+                max_steps: int | None = None) -> bool | None:
+    """Speculative single-config greedy walk — the host-side counterpart
+    of the ladder's rung-0 greedy kernel (one beam lane, returning-op
+    first, no backtracking).  Returns ``True`` when the walk completes:
+    that is a full linearization, i.e. a constructive witness, so the
+    verdict is EXACT.  Returns ``None`` when the walk sticks (no
+    greedy-consistent move, or ``max_steps`` fired) — the caller must
+    escalate; a stuck walk never refutes, because only search proves
+    absence of witnesses.
+
+    This is the serving layer's interactive fast path: ~microseconds per
+    small history, no kernel launch, so it cannot contend with a ladder
+    mid-rung for the device (or, on the CPU backend, for host cores).
+    """
+    events, eff_ops, crashed = prepare(model, history)
+    barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
+    n_barriers = len(barriers)
+    if n_barriers == 0:
+        return True
+    groups, gidx, group_op_list, empty = _group_vocab(group_ops)
+    # Every fired op strictly grows fok or a crashed count, both bounded,
+    # so the walk terminates without the cap; the cap bounds worst-case
+    # latency anyway (this path sits under an interactive SLO).
+    cap = max_steps if max_steps is not None else 4 * len(history) + 64
+    state, fok, fcr = model, frozenset(), empty
+    b = steps = 0
+    with obs.span("wgl_cpu.greedy_walk") as sp:
+        while b < n_barriers:
+            _pos, i, open_ok, open_crashed = barriers[b]
+            if i in fok:
+                fok = fok - {i}
+                b += 1
+                continue
+            steps += 1
+            if steps > cap:
+                sp.set(completed=False, steps=steps)
+                return None
+            # Greedy: fire the returning op itself first.
+            s2 = state.step(eff_ops[i])
+            if not m.is_inconsistent(s2):
+                state, fok = s2, fok | {i}
+                continue
+            # Enabling move: the first consistent open ok op, else the
+            # first available crashed group (same legality and order the
+            # DFS branches over — we just never come back).
+            for j in open_ok:
+                if j in fok or j == i:
+                    continue
+                s2 = state.step(eff_ops[j])
+                if not m.is_inconsistent(s2):
+                    state, fok = s2, fok | {j}
+                    break
+            else:
+                for g, open_count in open_crashed:
+                    k = gidx[g]
+                    if fcr[k] >= open_count:
+                        continue
+                    s2 = state.step(group_op_list[k])
+                    if not m.is_inconsistent(s2):
+                        state = s2
+                        fcr = fcr[:k] + (fcr[k] + 1,) + fcr[k + 1:]
+                        break
+                else:
+                    sp.set(completed=False, steps=steps)
+                    return None  # stuck: every greedy move is inconsistent
+        sp.set(completed=True, steps=steps)
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Configuration-set sweep (the TPU kernel's semantics oracle)
 # ---------------------------------------------------------------------------

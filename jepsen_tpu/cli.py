@@ -233,13 +233,21 @@ def _cmd_serve(opts) -> int:
         svc = CheckService(
             capacity=capacity,
             max_queue=opts.max_queue,
+            max_interactive_queue=opts.max_interactive_queue,
             max_batch=opts.max_batch,
             batch_window_s=opts.batch_window_ms / 1000.0,
+            interactive_max_b=opts.interactive_max_b,
+            continuous=not opts.no_continuous,
+            devices=opts.check_devices,
+            verify_placement=opts.verify_placement,
             drain_dir=opts.drain_dir,
         ).start()
         logger.info(
-            "check service up: max_queue=%d max_batch=%d capacity=%s",
+            "check service up: max_queue=%d max_batch=%d capacity=%s "
+            "continuous=%s devices=%s interactive_max_b=%d",
             opts.max_queue, opts.max_batch, capacity,
+            not opts.no_continuous, opts.check_devices or 1,
+            opts.interactive_max_b,
         )
     profiler = None
     if getattr(opts, "profile_dir", None):
@@ -319,6 +327,28 @@ def run_cli(
     p_serve.add_argument("--check-capacity", default="64,512,4096",
                          help="the service ladder's capacity stages "
                               "(comma-separated; default 64,512,4096)")
+    p_serve.add_argument("--check-devices", type=int, default=None,
+                         help="lane-shard every launch across the first "
+                              "N jax devices (mesh placement; default: "
+                              "single device)")
+    p_serve.add_argument("--verify-placement", action="store_true",
+                         help="re-run the first mesh-sharded batch on a "
+                              "single device and report any verdict "
+                              "disagreement (placement parity probe)")
+    p_serve.add_argument("--interactive-max-b", type=int, default=12,
+                         help="histories with at most this many barriers "
+                              "auto-route to the interactive tier (the "
+                              "speculative greedy fast path; 0 disables "
+                              "auto-routing — requests still opt in via "
+                              "the POST /check \"class\" key; default 12)")
+    p_serve.add_argument("--max-interactive-queue", type=int, default=None,
+                         help="dedicated interactive-tier admission "
+                              "allowance on top of --max-queue, so batch "
+                              "backlog can't starve the fast lane")
+    p_serve.add_argument("--no-continuous", action="store_true",
+                         help="disable rung-boundary admission into "
+                              "running ladders (restores window-then-"
+                              "launch batching, for A/B comparison)")
     p_serve.add_argument("--drain-dir", default=None,
                          help="where shutdown checkpoints still-queued "
                               "requests (resume with "

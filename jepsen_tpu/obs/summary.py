@@ -10,8 +10,13 @@ later perf PRs report against.
    "checkers": [{"checker", "seconds", "count", "valid"}, ...]
    "serve":    {"batches", "requests", "batch_wall_s", "avg_batch_requests",
                 "avg_occupancy", "avg_padding_waste",
+                "continuous_occupancy", "rungs", "rung_joined",  # rung-
+                                       # boundary admission (continuous
+                                       # batching; PR 6)
                 "admission": {"count", "mean_s", "max_s"},
                 "request":   {"count", "mean_s", "max_s"},
+                "request_by_class": {tier: {"count", "mean_s", "max_s"}},
+                "fastpath_resolved", "fastpath_escalated",
                 "submitted", "completed", "rejected", "expired", "drained"}
                                                         # serve.* events
    "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
@@ -91,6 +96,12 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "serve.admission": {"count": 0, "total": 0.0, "max": 0.0},
         "serve.request": {"count": 0, "total": 0.0, "max": 0.0},
     }
+    #: per-latency-class end-to-end latency (serve.request "tier" attr).
+    serve_class: dict[str, dict] = {}
+    #: continuous-batching accumulators: per-rung occupancy is averaged
+    #: weighted by rung count (serve.batch spans carry the per-ladder
+    #: mean + rung count; joiners admitted at rung boundaries).
+    serve_cont = {"rungs": 0, "occ": 0.0, "joined": 0}
     wall = 0.0
 
     def _fault_row(name: str) -> dict:
@@ -166,11 +177,26 @@ def summarize(events: Iterable[Mapping]) -> dict:
                 serve_batch["wall"] += dur
                 serve_batch["occ"] += float(attrs.get("occupancy") or 0.0)
                 serve_batch["waste"] += float(attrs.get("padding_waste") or 0.0)
+                rungs = int(attrs.get("rungs") or 0)
+                if rungs and attrs.get("continuous_occupancy") is not None:
+                    serve_cont["rungs"] += rungs
+                    serve_cont["occ"] += (
+                        float(attrs["continuous_occupancy"]) * rungs
+                    )
+                serve_cont["joined"] += int(attrs.get("joined") or 0)
             elif name in serve_lat:
                 sl = serve_lat[name]
                 sl["count"] += 1
                 sl["total"] += dur
                 sl["max"] = max(sl["max"], dur)
+                if name == "serve.request" and attrs.get("tier"):
+                    sc = serve_class.setdefault(
+                        str(attrs["tier"]),
+                        {"count": 0, "total": 0.0, "max": 0.0},
+                    )
+                    sc["count"] += 1
+                    sc["total"] += dur
+                    sc["max"] = max(sc["max"], dur)
             if name.startswith("fault."):
                 f = _fault_row(name)
                 f["count"] += 1
@@ -225,6 +251,13 @@ def summarize(events: Iterable[Mapping]) -> dict:
             avg_occupancy=round(serve_batch["occ"] / nb, 4),
             avg_padding_waste=round(serve_batch["waste"] / nb, 4),
         )
+    if serve_cont["rungs"]:
+        serve["continuous_occupancy"] = round(
+            serve_cont["occ"] / serve_cont["rungs"], 4
+        )
+        serve["rungs"] = serve_cont["rungs"]
+    if serve_cont["joined"]:
+        serve["rung_joined"] = serve_cont["joined"]
     for span_name, out_key in (("serve.admission", "admission"),
                                ("serve.request", "request")):
         sl = serve_lat[span_name]
@@ -234,7 +267,17 @@ def summarize(events: Iterable[Mapping]) -> dict:
                 "mean_s": _r(sl["total"] / sl["count"]),
                 "max_s": _r(sl["max"]),
             }
-    for cname in ("submitted", "completed", "rejected", "expired", "drained"):
+    if serve_class:
+        serve["request_by_class"] = {
+            tier: {
+                "count": sc["count"],
+                "mean_s": _r(sc["total"] / sc["count"]),
+                "max_s": _r(sc["max"]),
+            }
+            for tier, sc in sorted(serve_class.items())
+        }
+    for cname in ("submitted", "completed", "rejected", "expired", "drained",
+                  "fastpath_resolved", "fastpath_escalated"):
         if f"serve.{cname}" in counters:
             serve[cname] = counters[f"serve.{cname}"]
     return {
@@ -302,7 +345,9 @@ def format_summary(summary: Mapping) -> str:
         parts.append("\ncheck service:")
         rows = [[k, s[k]] for k in (
             "batches", "requests", "batch_wall_s", "avg_batch_requests",
-            "avg_occupancy", "avg_padding_waste", "submitted", "completed",
+            "avg_occupancy", "avg_padding_waste", "continuous_occupancy",
+            "rungs", "rung_joined", "fastpath_resolved",
+            "fastpath_escalated", "submitted", "completed",
             "rejected", "expired", "drained") if k in s]
         for key, label in (("admission", "admission wait"),
                            ("request", "request latency")):
@@ -310,6 +355,9 @@ def format_summary(summary: Mapping) -> str:
                 lat = s[key]
                 rows.append([f"{label} mean_s", lat["mean_s"]])
                 rows.append([f"{label} max_s", lat["max_s"]])
+        for tier, lat in (s.get("request_by_class") or {}).items():
+            rows.append([f"request[{tier}] mean_s", lat["mean_s"]])
+            rows.append([f"request[{tier}] max_s", lat["max_s"]])
         parts.append(_table(["serve", "value"], rows))
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",

@@ -79,6 +79,17 @@ _CARRY_LANE_BUDGET = 32 * 1024
 P_BUCKETS = (8, 16, 32, 64, 128)
 G_BUCKETS = (4, 8, 16, 32, 64)
 
+#: Continuous ladders: how long a pending member may sit skipped at
+#: its rung before that rung preempts lowest-rung-first selection (see
+#: the ladder loop).  A TIME bound, and generous on purpose: eager
+#: preemption serves NARROW high-rung launches and costs real occupancy
+#: (a skipped-launch-count bound of 8 measured 0.69-0.75 against ~0.91
+#: on the round-8 acceptance demo, and even 64 still fired — rung-0
+#: launches are milliseconds).  Healthy arrival streams pause well
+#: inside this bound; a pathological steady stream can no longer defer
+#: an escalated member's launch indefinitely.
+_STARVE_SECONDS = 5.0
+
 
 def bucket_geometry(B: int, P: int, G: int) -> tuple[int, int, int]:
     """The padded (B, P, G) bucket a packed history launches at."""
@@ -99,6 +110,68 @@ def padded_batch(n: int, mesh: Mesh | None = None) -> int:
         shard = mesh.devices.size
         n_pad = ((n_pad + shard - 1) // shard) * shard
     return n_pad
+
+
+def greedy_fastpath(model: m.Model, packed: Sequence[dict],
+                    mesh: Mesh | None = None,
+                    pad_to: int | None = None) -> list[bool]:
+    """One batched greedy witness-walk launch over pre-packed histories
+    — the device-batched variant of the interactive fast path (the
+    CheckService serves waves with per-request host walks,
+    ``wgl_cpu.greedy_walk``; this launch form is for hosts where the
+    walk is kernel-bound, and pins the mesh-placement parity contract
+    for greedy work).  ``packed`` entries are
+    ``wgl.pack`` outputs sharing a geometry bucket; returns one flag per
+    entry — True is EXACT (the walk completed: a constructive witness),
+    False only means the walk stuck and the caller must escalate that
+    history into the beam ladder.  Never refutes.
+
+    The launch stacks to the same ``bucket_geometry``/``padded_batch``
+    shapes the ladder's greedy rung uses, so a warm serving process
+    re-hits the compiled greedy kernel instead of paying a fast-path
+    compile per geometry.  With a ``mesh`` the padded batch axis is
+    lane-sharded across its devices (``parallel.sharded.lane_shard``,
+    the ``_platform.shard_map`` shim) — placement only; flags are
+    device-count independent."""
+    B, P, G = bucket_geometry(
+        max(p["B"] for p in packed),
+        max(p["P"] for p in packed),
+        max(p["G"] for p in packed),
+    )
+    stacked = _stack(packed, B, P, G)
+    n = len(packed)
+    # ``pad_to`` pins the batch axis to the caller's fixed serving
+    # width: every wave size then re-hits ONE compiled greedy kernel.
+    n_pad = padded_batch(n, mesh)
+    if pad_to is not None and pad_to > n_pad:
+        n_pad = int(pad_to)
+    n_actives = np.array([p["bar_active"].sum() for p in packed], np.int32)
+    if n_pad != n:
+        for k in stacked:
+            if k in ("slot_lane", "slot_onehot"):
+                continue
+            stacked[k] = np.concatenate(
+                [stacked[k]] + [stacked[k][-1:]] * (n_pad - n), axis=0
+            )
+        n_actives = np.concatenate(
+            [n_actives, np.repeat(n_actives[-1:], n_pad - n)]
+        )
+    W = (P + 31) // 32
+    g_args = [stacked["init_state"], jnp.asarray(n_actives)] + [
+        stacked[k] for k in ASYNC_ARG_ORDER[1:]
+    ]
+    runner = wgl.greedy_runner(packed[0]["step"], B, P, G, W)
+    if mesh is not None:
+        from jepsen_tpu.parallel import sharded
+
+        # the greedy runner's vmap batches every arg except the shared
+        # slot tables (its in_axes: (0,)*14 + (None, None))
+        runner = sharded.lane_shard(
+            runner, mesh, n_args=len(g_args),
+            replicated=(len(g_args) - 2, len(g_args) - 1), n_out=3,
+        )
+    finished, _stuck_at, _fired = runner(*g_args)
+    return [bool(x) for x in np.asarray(finished)[:n]]
 
 
 def _stays_pending(valid, fat, lossy) -> bool:
@@ -244,6 +317,7 @@ def batch_analysis(
     checkpoint_dir: str | Path | None = None,
     resume: bool = False,
     deadline=None,
+    admission=None,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -332,8 +406,36 @@ def batch_analysis(
     marks the remaining packs ``unknown`` with cause
     ``deadline-exceeded`` plus a pointer to the checkpoint, and still
     returns a complete result list.
+
+    Continuous batching (``admission``): an object with a
+    ``poll(stage=, lanes=)`` method is consulted at every rung boundary
+    and may return new histories that JOIN the running ladder — they
+    are packed, enter at rung 0 (the greedy walk), and run the same
+    rung sequence a one-shot call would, so verdict semantics are
+    identical; their results are appended to the returned list in
+    admission order (index = ``len(histories)`` at the moment of the
+    poll — the caller mirrors that counter to demux).  Lane slots
+    recycle naturally: resolved members leave the pending set at the
+    same boundaries joiners enter, which is what keeps device occupancy
+    high under open arrival (streaming batched beam search,
+    arXiv:2010.02164).  Optional hook methods: ``on_result(i, result)``
+    is called the moment history ``i``'s verdict is DECIDED (True, or a
+    final/confirmed False) so a serving layer can resolve that caller
+    mid-ladder; ``on_rung(stage=, engine=, capacity=, lanes=, padded=,
+    seconds=)`` reports, after each rung's launches complete, the live
+    lanes, the padded lane-slots actually launched, and the rung's
+    launch seconds — compile + execute device time, not the stage wall
+    — for device-time-weighted occupancy.  A hook may also advertise
+    ``pad_lanes``: every rung launch is then padded UP to that fixed
+    batch axis (clamped to the stage lane budget), so membership churn
+    never changes the compiled kernel shape mid-service.  With an
+    admission hook, finished worker confirmations are also drained at
+    rung boundaries (refuted requests resolve while the ladder keeps
+    running).  The ladder returns when the pending set is empty and a
+    poll returned no joiners.
     """
     dedup = hashing.resolve_dedup_backend(dedup_backend)
+    histories = list(histories)
     results: list[dict | None] = [None] * len(histories)
     packs: list[dict] = []
     idxs: list[int] = []
@@ -391,6 +493,7 @@ def batch_analysis(
     deadline = faults.Deadline.coerce(deadline)
     deadline_tripped = False
     trip_checkpointed = False  # a resumable trip checkpoint is on disk
+    fp_dirty = False  # rung admission grew `histories` since fp was taken
     no_fallback: set[int] = set()  # history idxs the CPU fallback must skip
     start_stage = 0
     restored = None
@@ -448,6 +551,24 @@ def batch_analysis(
         "confirm_refutations": confirm_refutations, "fingerprint": fp,
     }
 
+    def _notify(i: int) -> None:
+        """Early per-history demux for the rung-admission caller: hand a
+        DECIDED verdict (True, or a final False) to the hook the moment
+        it is final, instead of at return.  Unknowns are left for the
+        return path — some are provisional (the CPU fallback may still
+        decide them), and the caller settles every leftover from the
+        returned list anyway."""
+        if admission is None:
+            return
+        r = results[i]
+        if r is None or r.get("valid?") == "unknown":
+            return
+        try:
+            admission.on_result(i, r)
+        except Exception:  # noqa: BLE001 — a broken feeder must not
+            # lose the ladder; the verdict still lands in the return list
+            logger.exception("rung-admission on_result failed (history %d)", i)
+
     #: per-stage launch accounting for the telemetry stage table; "_key"
     #: is the launched (engine, shape) bucket, set at each runner site.
     launch_acc: dict = {}
@@ -461,7 +582,8 @@ def batch_analysis(
     _reset_launch_acc()
 
     def _launch(st_engine: str, batch_cap: int, sub: list[dict],
-                sub_resumes: list[tuple | None] | None = None):
+                sub_resumes: list[tuple | None] | None = None,
+                pad_to: int | None = None):
         """Instrumented wrapper over the kernel launch: times the launch,
         classifies it compile (fresh shape bucket) vs execute, samples
         the post-launch device-buffer footprint (the stage's memory
@@ -470,7 +592,7 @@ def batch_analysis(
             "ladder.launch", engine=st_engine, capacity=batch_cap, lanes=len(sub)
         ) as sp:
             t0 = time.perf_counter()
-            out = _launch_impl(st_engine, batch_cap, sub, sub_resumes)
+            out = _launch_impl(st_engine, batch_cap, sub, sub_resumes, pad_to)
             dt = time.perf_counter() - t0
             key = launch_acc.pop("_key", None)
             compiled = key is not None and key not in _SEEN_SHAPES
@@ -499,7 +621,8 @@ def batch_analysis(
         return out
 
     def _launch_impl(st_engine: str, batch_cap: int, sub: list[dict],
-                     sub_resumes: list[tuple | None] | None = None):
+                     sub_resumes: list[tuple | None] | None = None,
+                     pad_to: int | None = None):
         """Stack ``sub`` to common bucket shapes and run one vmapped
         kernel launch; returns (valid, failed_at, lossy, peak, snap)
         with host arrays of len(sub).  ``sub_resumes[j]`` optionally
@@ -522,7 +645,14 @@ def batch_analysis(
         n = len(sub)
         # Pad the batch axis to a power of two (and a mesh multiple) so the
         # vmapped kernel compiles once per bucket, not once per batch size.
+        # ``pad_to`` (continuous batching) pins the width HIGHER — every
+        # rung of a served ladder launches at one fixed batch axis, so
+        # membership churn (joiners, resolved lanes) never changes the
+        # compiled shape mid-service: an underfull rung costs padded
+        # lanes (~replicated rows), never an XLA compile.
         n_pad = padded_batch(n, mesh)
+        if pad_to is not None and pad_to > n_pad:
+            n_pad = int(pad_to)
         if n_pad != n:
             for k in stacked:
                 if k in ("slot_lane", "slot_onehot"):
@@ -696,12 +826,34 @@ def batch_analysis(
                 )
                 results[e["i"]] = e["res"]
 
+    #: per-pack rung cursor: stages[rungs[k]] is pack k's NEXT rung.
+    #: Every initial pack starts (or resumes) at the same rung, so
+    #: without rung-boundary admission the loop below walks the ladder
+    #: exactly like a uniform per-stage loop; packs admitted mid-ladder
+    #: enter at rung 0 and catch up, running the SAME rung sequence a
+    #: one-shot call would (continuous batching changes who shares a
+    #: launch, never how a history is decided).
+    rungs: dict[int, int] = {k: start_stage for k in pending}
+    if restored is not None and restored.get("rungs"):
+        pack_of = {i: k for k, i in enumerate(idxs)}
+        for i, r in restored["rungs"].items():
+            if i in pack_of:
+                rungs[pack_of[i]] = int(r)
+
     def _save_checkpoint(next_stage: int, complete: bool = False):
         """Persist the ladder's durable state at a stage boundary; a
         save failure is logged, counted, and never fails the analysis
         (the checkpoint is a recovery aid, not a verdict input)."""
         if checkpoint_dir is None:
             return None
+        nonlocal fp_dirty
+        if fp_dirty:
+            # Rung admission grew the membership since the fingerprint
+            # was taken: re-fingerprint the CURRENT histories so a
+            # resume over the drained member list (original + joined)
+            # matches instead of spuriously running fresh.
+            config["fingerprint"] = _ckpt.fingerprint(histories)
+            fp_dirty = False
         t0 = time.perf_counter()
         try:
             path = _ckpt.save(
@@ -719,6 +871,7 @@ def batch_analysis(
                     for k, fat, cap, res in device_confirms
                 ],
                 resumes={idxs[k]: resumes[k] for k in pending if k in resumes},
+                rungs={idxs[k]: rungs.get(k, next_stage) for k in pending},
                 complete=complete,
             )
         except Exception:  # noqa: BLE001 — see docstring
@@ -732,15 +885,140 @@ def batch_analysis(
         )
         return path
 
+    early_confirmed: set[int] = set()  # resolved at a rung boundary
+
+    def _poll_confirmations() -> None:
+        """Rung-boundary confirmation demux (continuous batching): a
+        worker sweep that already finished resolves NOW — its caller's
+        future settles while the ladder keeps running — instead of at
+        the final drain.  Only the clean success path resolves here;
+        failed/timed-out futures keep their descriptor so the final
+        drain's full retry machinery (pool rebuild, bounded resubmit,
+        deadline grace) handles them unchanged."""
+        done = [
+            i for i, e in confirm_futs.items()
+            if e[1] is not None and e[1].done()
+            and not e[1].cancelled() and e[1].exception() is None
+        ]
+        for i in done:
+            _pool, fut, dev_res, t_submit, _op_pos, ctx = confirm_futs.pop(i)
+            early_confirmed.add(i)
+            with obs.attach(ctx):
+                obs.gauge(
+                    "confirm.queue_latency_s",
+                    round(time.perf_counter() - t_submit, 6), history=i,
+                )
+                results[i] = _resolve_confirmation(dev_res, fut.result())
+            _notify(i)
+
+    def _poll_admission() -> None:
+        """The rung-boundary admission hook (continuous batching): ask
+        the caller for new histories to JOIN the running ladder.  Each
+        joiner packs here, enters the pending set at rung 0, and is
+        assigned result index len(histories) — sequential, so the
+        caller can mirror the counter to demux.  A broken hook degrades
+        to no joiners, never a lost ladder."""
+        nonlocal fp_dirty
+        if admission is None:
+            return
+        min_rung = min((rungs[k] for k in pending), default=0)
+        try:
+            new_hists = admission.poll(stage=min_rung, lanes=len(pending))
+        except Exception:  # noqa: BLE001 — see docstring
+            logger.exception(
+                "rung-admission poll failed; continuing without joiners")
+            new_hists = None
+        for hist in new_hists or ():
+            i = len(histories)
+            histories.append(list(hist))
+            results.append(None)
+            fp_dirty = True
+            try:
+                p = wgl.pack(model, histories[i])
+            except wgl.NotTensorizable as e:
+                results[i] = {
+                    "valid?": "unknown", "cause": f"not tensorizable: {e}"}
+                continue
+            if p["B"] == 0:
+                results[i] = {"valid?": True}
+                _notify(i)
+                continue
+            k = len(packs)
+            packs.append(p)
+            idxs.append(i)
+            pending.append(k)
+            rungs[k] = 0
+            obs.counter("ladder.rung_admission", stage=min_rung)
+
+    #: Continuous batching pins every rung launch to one fixed batch
+    #: axis (the hook advertises its width): joiners and resolved lanes
+    #: then recycle slots inside a single compiled shape instead of
+    #: walking the ladder through a fresh XLA compile per membership
+    #: size (a mid-service async compile measured ~2.5 s on CPU — worse
+    #: than the batch it served).
+    pad_lanes = getattr(admission, "pad_lanes", None)
+    pad_lanes = int(pad_lanes) if pad_lanes else None
+
     #: OOM halvings shrink the stage lane budget for the REST of the run
     #: (the device that OOM'd once at a shape will OOM again; re-probing
     #: it every stage would pay the fault each time).
     budget_scale = 1.0
-    for si, (st_engine, batch_cap) in enumerate(stages):
+    exhausted: list[int] = []  # packs that ran out of rungs unresolved
+    #: Lowest-rung-first selection + rung-0 joiner admission could defer
+    #: an escalated member forever under a steady arrival stream; a
+    #: member skipped for more than _STARVE_SECONDS gets its rung served
+    #: next (bounded wait, not strict priority).  Only continuous
+    #: ladders need it — without admission the lowest rung drains
+    #: monotonically.  k -> perf_counter() of the first skipped launch.
+    starve: dict[int, float] = {}
+    while pending or admission is not None:
+        _poll_admission()
+        if admission is not None:
+            _poll_confirmations()
+        # Members past the last rung leave the ladder (post-ladder
+        # unknowns: the exact-confirm/CPU-fallback tail decides them).
+        past = [k for k in pending if rungs[k] >= len(stages)]
+        if past:
+            exhausted.extend(past)
+            pending = [k for k in pending if rungs[k] < len(stages)]
         if not pending:
-            break
-        if si < start_stage:
-            continue  # resumed past this rung; its work is in `results`
+            if admission is not None and confirm_futs:
+                # Linger while worker confirmations are in flight: keep
+                # demuxing finished confirms early and keep ADMITTING —
+                # a joiner arriving during the confirm tail enters rung
+                # 0 of THIS ladder instead of seeding a narrow
+                # follow-up batch (the tail was measured as a whole
+                # second service cycle at ~0.4 occupancy).  Only LIVE
+                # futures are worth lingering for: _poll_confirmations
+                # demuxes clean successes only, so a dead entry (failed
+                # submit left fut=None, or a future holding an
+                # exception) would spin this loop forever — those
+                # belong to the final drain's retry machinery below, as
+                # does everything once the deadline expires.
+                live = any(
+                    e[1] is not None and not e[1].done()
+                    for e in confirm_futs.values()
+                )
+                if live and (deadline is None or not deadline.expired()):
+                    time.sleep(0.001)
+                    continue
+            break  # ladder drained and the hook (if any) had nothing
+        si = min(rungs[k] for k in pending)
+        if admission is not None and starve:
+            waiting = [k for k in pending if k in starve]
+            if waiting:
+                k_worst = min(waiting, key=lambda k: starve[k])
+                if time.perf_counter() - starve[k_worst] > _STARVE_SECONDS:
+                    si = rungs[k_worst]
+        group = [k for k in pending if rungs[k] == si]
+        rest = [k for k in pending if rungs[k] != si]
+        if admission is not None:
+            for k in group:
+                starve.pop(k, None)
+            t_skip = time.perf_counter()
+            for k in rest:
+                starve.setdefault(k, t_skip)
+        st_engine, batch_cap = stages[si]
         if deadline is not None and deadline.expired():
             # Deadline-bounded degradation: checkpoint FIRST (the saved
             # placeholders keep their resumable causes), then mark every
@@ -774,7 +1052,7 @@ def batch_analysis(
         t_stage = time.perf_counter()
         stage_attrs = dict(
             stage=si, engine=st_engine, capacity=batch_cap,
-            lanes=len(pending), dedup=dedup,
+            lanes=len(group), dedup=dedup,
         )
         # Measured-shape guard (round 5): the batched exact runner
         # faults the TPU worker on long-scan x wide-frontier shapes
@@ -792,9 +1070,9 @@ def batch_analysis(
             # the guard sees the PADDED lane count the kernel actually
             # holds resident (the fault grid is single-lane; vmap
             # multiplies the live buffers by the lane count)
-            n_lanes = min(max(1, _EXACT_LANE_BUDGET // batch_cap), len(pending))
+            n_lanes = min(max(1, _EXACT_LANE_BUDGET // batch_cap), len(group))
             n_lanes = 1 << max(3, (n_lanes - 1).bit_length())
-            for k in pending:
+            for k in group:
                 if wgl.exact_scan_safe(
                         wgl.pad_B(packs[k]["B"]), batch_cap, n_lanes):
                     safe.append(k)
@@ -805,9 +1083,11 @@ def batch_analysis(
                     rounds=int(rounds), fast=False, dedup_backend=dedup,
                     deadline=deadline,
                 )
-            pending = safe
-            if not pending:
+                _notify(i)
+            group = safe
+            if not group:
                 _emit_stage(t_stage, stage_attrs, unknowns_remaining=0)
+                pending = rest
                 continue
         # Bound total frontier rows per launch so wide-capacity stages
         # sub-batch instead of faulting the TPU worker (observed at
@@ -841,7 +1121,7 @@ def batch_analysis(
         lane_out: dict[int, tuple] = {}  # pack idx -> (valid, fat, lossy, peak)
         degraded: list[tuple[int, str]] = []  # (pack idx, cause)
 
-        def _launch_ft(part: list[int]) -> None:
+        def _launch_ft(part: list[int], pad_to: int | None = None) -> None:
             """Launch one sub-batch under the fault policy: transient
             errors retry with backoff inside faults.call_with_retry; an
             OOM halves the sub-batch recursively (floor one lane — and
@@ -864,7 +1144,8 @@ def batch_analysis(
             try:
                 out = faults.call_with_retry(
                     lambda: _launch(
-                        st_engine, batch_cap, [packs[k] for k in part], sub_res
+                        st_engine, batch_cap, [packs[k] for k in part],
+                        sub_res, pad_to,
                     ),
                     ctx,
                 )
@@ -877,6 +1158,9 @@ def batch_analysis(
                         engine=st_engine, capacity=batch_cap,
                         lanes_from=len(part), lanes_to=mid,
                     )
+                    # Fault path: drop the fixed continuous-batching pad
+                    # — replaying the halved part back up to the width
+                    # that just OOM'd would re-probe the fault.
                     _launch_ft(part[:mid])
                     _launch_ft(part[mid:])
                     return
@@ -908,12 +1192,41 @@ def batch_analysis(
 
         # Re-read the (possibly OOM-halved) scale for EVERY chunk: when
         # chunk 1 OOMs, chunks 2..n are sliced at the shrunken budget
-        # instead of re-probing the fault at the original width.
+        # instead of re-probing the fault at the original width.  The
+        # continuous fixed pad is clamped to the chunk lane budget so
+        # pinning the shape never exceeds the resident-row bound.
         s0 = 0
-        while s0 < len(pending):
+        launched_pad = 0
+        while s0 < len(group):
             lanes_cap = max(1, int(budget * budget_scale) // batch_cap)
-            _launch_ft(pending[s0 : s0 + lanes_cap])
+            part = group[s0 : s0 + lanes_cap]
+            pad_to = (
+                min(pad_lanes, padded_batch(lanes_cap, mesh))
+                if pad_lanes is not None else None
+            )
+            # the launch pads to MAX(natural pad, pinned pad) — mirror
+            # that here so reported slots never undercount live lanes
+            launched_pad += max(pad_to or 0, padded_batch(len(part), mesh))
+            _launch_ft(part, pad_to)
             s0 += lanes_cap
+        if admission is not None and hasattr(admission, "on_rung"):
+            # Post-stage occupancy report: the lanes that were live, the
+            # padded lane-slots the kernel actually launched (the fixed
+            # continuous width when pinned), and the rung's LAUNCH
+            # seconds (compile + execute, from the launch accounting —
+            # not the stage wall, which also counts host-side packing
+            # and demux the device never saw) — so the caller can
+            # weight occupancy by device time instead of counting a
+            # 2 ms underfull greedy launch the same as a 300 ms
+            # full-width beam rung.
+            try:
+                admission.on_rung(
+                    stage=si, engine=st_engine, capacity=batch_cap,
+                    lanes=len(group), padded=launched_pad,
+                    seconds=launch_acc["compile_s"] + launch_acc["execute_s"],
+                )
+            except Exception:  # noqa: BLE001 — telemetry-only hook
+                logger.exception("rung-admission on_rung failed")
         for k, cause in degraded:
             # a failed launch costs exactly its own lanes: each degrades
             # to unknown with the error named, and (when enabled) the
@@ -926,7 +1239,7 @@ def batch_analysis(
         n_true = n_refuted = 0
         peak_max = 0
         n_lossy = 0
-        for k in pending:
+        for k in group:
             if k not in lane_out:
                 continue  # degraded this stage; its result is set above
             valid_k, fat_k, lossy_k, peak_k = lane_out[k]
@@ -940,6 +1253,7 @@ def batch_analysis(
             if not pending_lane and fat_k < 0:
                 n_true += 1
                 results[i] = {"valid?": True, "kernel": stats}
+                _notify(i)
             elif not pending_lane:
                 n_refuted += 1
                 op_pos = int(packs[k]["bar_opid"][int(fat_k)])
@@ -949,6 +1263,7 @@ def batch_analysis(
                     # content-decided kills (or the caller opted out):
                     # the refutation is final
                     results[i] = res
+                    _notify(i)
                 elif confirm_refutations == "device":
                     # confirm on the accelerator: queue for one batched
                     # exact-kernel launch over the failure prefix after
@@ -981,7 +1296,9 @@ def batch_analysis(
                     "cause": "frontier capacity or closure rounds exhausted",
                     "kernel": stats,
                 }
-        pending = still
+        for k in still:
+            rungs[k] = si + 1
+        pending = sorted(rest + still)
         _emit_stage(
             t_stage, stage_attrs, resolved=n_true, refuted=n_refuted,
             unknowns_remaining=len(still), peak_frontier=peak_max,
@@ -990,15 +1307,17 @@ def batch_analysis(
         obs.gauge(
             "ladder.unknowns_remaining", len(still), stage=si, capacity=batch_cap
         )
-        _save_checkpoint(si + 1)
+        _save_checkpoint(
+            min(rungs[k] for k in pending) if pending else si + 1
+        )
 
-    if pending:
+    if exhausted:
         # The lanes the whole ladder failed to resolve: close the
         # documented "extra unknowns with no runtime signal" gap — a final
         # gauge plus an attributable cause in each unknown result (these
         # are exactly the lanes a pre-round-3 implicit exact stage might
         # have resolved when cpu_fallback is off).
-        obs.gauge("ladder.unknowns_remaining", len(pending), final=True)
+        obs.gauge("ladder.unknowns_remaining", len(exhausted), final=True)
         if exact_caps:
             note = (
                 f"capacity ladder {tuple(batch_caps)} and exact escalation "
@@ -1010,7 +1329,7 @@ def batch_analysis(
                 "exact-escalation stages (exact_escalation=None means none "
                 "since round 3)"
             )
-        for k in pending:
+        for k in exhausted:
             i = idxs[k]
             r = results[i]
             if r is not None and r.get("valid?") == "unknown" and r.get("cause"):
@@ -1031,6 +1350,7 @@ def batch_analysis(
         if exact_died:
             res["confirmed?"] = True
             results[i] = res
+            _notify(i)
             return
         if deadline is not None and deadline.expired():
             deadline_tripped = True
@@ -1047,6 +1367,7 @@ def batch_analysis(
             stop_at_index=op_pos,
         )
         results[i] = _resolve_confirmation(res, cpu_res)
+        _notify(i)
 
     if device_confirms and deadline is not None and deadline.expired():
         # The budget died before the exact confirmations ran: an
@@ -1163,13 +1484,14 @@ def batch_analysis(
                 break
             if (r is not None and r["valid?"] == "unknown"
                     and i not in confirm_futs and i not in device_resolved
-                    and i not in no_fallback):
+                    and i not in early_confirmed and i not in no_fallback):
                 # The config-set sweep, not the DFS: DFS backtracking goes
                 # exponential on exactly the histories that overflow the
                 # kernel (info-heavy invalid ones); the sweep is the same
                 # frontier algorithm the kernel runs and degrades linearly.
                 n_fb += 1
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
+                _notify(i)
         if n_fb:
             obs.span_event(
                 "ladder.cpu-fallback", time.perf_counter() - t_fb, histories=n_fb
@@ -1278,6 +1600,7 @@ def batch_analysis(
                 round(time.perf_counter() - t_submit, 6), history=i,
             )
             results[i] = _resolve_confirmation(dev_res, cpu_res)
+        _notify(i)
     if confirm_futs:
         obs.span_event(
             "ladder.confirm.drain", time.perf_counter() - t_drain,

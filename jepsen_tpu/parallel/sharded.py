@@ -209,6 +209,38 @@ def _run_core_sharded(
 #: (mesh id, step, Fl, R, P, G, W) -> compiled sharded runner.
 _SHARDED_RUNNERS: dict = {}
 
+#: (runner, mesh, replicated, n_out) -> lane-sharded compiled wrapper.
+_LANE_SHARDED: dict = {}
+
+
+def lane_shard(fn, mesh: Mesh, *, n_args: int, replicated: Sequence[int] = (),
+               n_out: int = 1):
+    """Lane-parallel placement for a batched (vmapped) kernel runner:
+    shard every argument's LEADING batch axis across ``mesh``'s one
+    axis (arguments listed in ``replicated`` broadcast whole), run
+    ``fn`` on each device's lane shard, and concatenate the ``n_out``
+    outputs back on that axis.  Built on the ``_platform.shard_map``
+    shim — the same seam every frontier-sharded kernel in this module
+    compiles through — so the serving layer's launch placement and the
+    single-history sharded checker ride one jax-API compatibility
+    point.  The caller pads the batch axis to a mesh multiple
+    (``parallel.batch.padded_batch`` with a mesh does)."""
+    key = (fn, mesh, tuple(replicated), int(n_args), int(n_out))
+    if key not in _LANE_SHARDED:
+        axis = mesh.axis_names[0]
+        rep = set(replicated)
+        in_specs = tuple(
+            P() if i in rep else P(axis) for i in range(n_args)
+        )
+        out_specs = (
+            tuple(P(axis) for _ in range(n_out)) if n_out > 1 else P(axis)
+        )
+        _LANE_SHARDED[key] = jax.jit(_platform.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        ))
+    return _LANE_SHARDED[key]
+
 
 def _sharded_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int):
     axis = mesh.axis_names[0]

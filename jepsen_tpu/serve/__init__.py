@@ -22,11 +22,19 @@ batched beam search) applied to the WGL ladder:
     occupancy, padding waste, per-request end-to-end latency) into the
     existing obs tables (``telemetry.json``'s "serve" section).
 
+Scheduling is delegated to ``jepsen_tpu.serve.sched`` (PR 6): admission
+into latency-class queues (``interactive`` fast path vs ``batch`` tier,
+per-class backpressure/retry-after), CONTINUOUS packing (rung-boundary
+admission into running ladders via ``batch_analysis(admission=...)``),
+and mesh-sharded launch placement (``devices=N`` /
+``verify_placement``).
+
 Exposure: this Python API (``submit(history, ...) -> Future[verdict]``),
 the HTTP API mounted into ``jepsen_tpu.web`` (``POST /check``,
 ``GET /check/<id>``, ``GET /queue``), and ``jepsen-tpu serve --check``.
 """
 
+from jepsen_tpu.serve import sched
 from jepsen_tpu.serve.service import (
     MODELS,
     CheckFuture,
@@ -47,4 +55,5 @@ __all__ = [
     "ServiceClosed",
     "model_by_name",
     "resume_drained",
+    "sched",
 ]

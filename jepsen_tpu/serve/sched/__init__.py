@@ -1,0 +1,54 @@
+"""The CheckService scheduler: admission, packing, placement — separated.
+
+PR 4's service was a monolithic window-then-launch loop: one queue, one
+batch at a time, launch on whatever device jax defaulted to.  Its own
+telemetry showed the cost — ``serve.batch`` occupancy 0.50–0.57 (the
+device idles between batches and pads dead lanes inside them) and p50
+~0.2 s for a ~3 ms request riding a worst-lane batch (PERF.md round 7).
+This package is the scheduler refactor ROADMAP item 2 calls for, split
+along the three decisions a serving scheduler actually makes:
+
+  * **admission** (``sched.admission``) — WHO gets in, and into which
+    latency class: an ``interactive`` tier (small likely-valid
+    histories; served by a speculative greedy single-rung fast path)
+    and a ``batch`` tier (everything else), each with its own bounded
+    queue and its own retry-after EWMA, so a queue-full interactive
+    request is told to come back in fast-path units, not batch-ladder
+    units.  Graph-shaped work (elle ``CycleChecker`` & co.) is tagged
+    non-geometry-batchable here and runs on a host side lane — it never
+    occupies a geometry bucket or stalls packable ladder work.
+  * **packing** (``sched.packing``) — WHAT shares a launch, over TIME:
+    continuous batching.  A ``RungFeeder`` is handed to
+    ``parallel.batch.batch_analysis(admission=...)`` and consulted at
+    every rung boundary: geometry-compatible queued requests JOIN the
+    running ladder as members resolve and free lane slots (streaming
+    batched beam search, arXiv:2010.02164), verdicts demux the moment
+    they are decided, and true per-rung occupancy is recorded.
+  * **placement** (``sched.placement``) — WHERE a packed batch runs:
+    lane-parallel across an N-device mesh (the ``_platform.shard_map``
+    shim ``parallel/sharded.py`` builds on), with a verdict-parity
+    assertion against single-device execution.
+
+``serve.service.CheckService`` composes the three; nothing here decides
+a verdict — soundness stays in the ladder.
+"""
+
+from jepsen_tpu.serve.sched.admission import (
+    CLASSES,
+    AdmissionQueues,
+    classify,
+    geometry_batchable,
+)
+from jepsen_tpu.serve.sched.packing import RungFeeder
+from jepsen_tpu.serve.sched.placement import Placement, PlacementMismatch, assert_parity
+
+__all__ = [
+    "CLASSES",
+    "AdmissionQueues",
+    "Placement",
+    "PlacementMismatch",
+    "RungFeeder",
+    "assert_parity",
+    "classify",
+    "geometry_batchable",
+]

@@ -1,21 +1,37 @@
-"""The CheckService: queue, batching scheduler, demux, backpressure.
+"""The CheckService: scheduled checking over batch_analysis.
 
 Request lifecycle::
 
-    submit() ──admission──▶ queued ──scheduler──▶ running ──demux──▶ done
-        │ (queue full)         │ (deadline up)                        ▲
-        ▼                      ▼                                      │
-    QueueFull(retry_after)   expired (unknown)        drained (checkpoint)
+    submit() ──admission──▶ queued(class) ──scheduler──▶ running ──demux──▶ done
+        │ (queue full)         │ (deadline up)             ▲ (rung joiners)
+        ▼                      ▼                           │
+    QueueFull(retry_after   expired (unknown)   drained (checkpoint)
+      per class)
 
-The scheduler thread owns the device: it pops the highest-priority
-queued request, gathers up to ``max_batch`` queued requests from the
-SAME compatibility group — ``(model, padded B, bucketed P, bucketed G)``
-via ``parallel.batch.bucket_geometry``, so every batch re-launches an
-already-compiled kernel shape — and runs ONE ``batch_analysis`` over
-them.  Requests from other groups stay queued for the next cycle;
-submissions arriving mid-batch queue up behind it (continuous
-cross-request batching: the device never waits for a "full" batch, and
-a batch never waits on a straggler caller).
+The scheduler is split along the three decisions it makes
+(``jepsen_tpu.serve.sched``):
+
+  * **admission** — requests land in a latency-class queue
+    (``interactive`` or ``batch``; ``sched.admission``), each with its
+    own backpressure and retry-after EWMA.  Graph-shaped work (elle
+    checkers: ``geometry_batchable = False``) is tagged
+    non-geometry-batchable and runs on a host side lane, never
+    occupying a geometry bucket.
+  * **packing** — the interactive tier is served by a speculative
+    greedy single-rung fast path (one batched witness-walk launch;
+    walk-complete histories resolve there, the rest escalate to the
+    batch tier).  The batch tier runs CONTINUOUS batching: one
+    ``batch_analysis`` ladder per compatibility group —
+    ``(model, padded B, bucketed P, bucketed G)`` via
+    ``parallel.batch.bucket_geometry`` — with a ``sched.RungFeeder``
+    admitting geometry-compatible queued requests into the RUNNING
+    ladder at rung boundaries as resolved members free lane slots
+    (streaming batched beam search, arXiv:2010.02164).  Verdicts demux
+    the moment the ladder decides them.
+  * **placement** — packed batches launch lane-parallel across an
+    N-device mesh when configured (``devices=`` / ``mesh=``;
+    ``sched.Placement``), with a verdict-parity check against
+    single-device execution available at ``verify_placement=True``.
 
 Per-request deadlines bound the QUEUE wait: a request whose
 ``faults.Deadline`` expires while queued resolves ``unknown``
@@ -25,8 +41,8 @@ launch when its budget runs out still gets its verdict (it costs the
 batch nothing extra); the result carries ``"deadline-overrun": True``.
 
 Soundness is inherited unchanged from ``batch_analysis``: the service
-only arbitrates WHICH histories share a launch, never how they are
-decided.
+only arbitrates WHICH histories share a launch (and where it runs),
+never how they are decided.
 """
 
 from __future__ import annotations
@@ -37,13 +53,16 @@ import logging
 import threading
 import time
 import uuid
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from pathlib import Path
 from typing import Mapping, Sequence
 
 from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
 from jepsen_tpu.obs import metrics
+from jepsen_tpu.serve.sched import admission as _sched_adm
+from jepsen_tpu.serve.sched import packing as _sched_pack
+from jepsen_tpu.serve.sched import placement as _sched_place
 
 logger = logging.getLogger(__name__)
 
@@ -76,20 +95,33 @@ def model_by_name(name: str) -> m.Model:
         ) from None
 
 
+#: Continuous ladders: once ANOTHER geometry group's queued batch-tier
+#: request has waited this long, the running ladder stops admitting
+#: joiners and drains — the cross-group face of the bounded-wait
+#: contract ``parallel.batch._STARVE_SECONDS`` gives members inside a
+#: ladder.  Same magnitude on purpose: both bound "how long a steady
+#: stream may defer someone else's launch".
+_GROUP_STARVE_S = 5.0
+
+
 class QueueFull(Exception):
-    """Admission rejected: the queue is at ``max_queue`` depth.
+    """Admission rejected: ``tier``'s queue is at its depth bound.
 
     ``retry_after`` estimates (seconds) when a slot should free up —
-    queue depth over batch width times the recent batch wall-clock EWMA.
+    THAT CLASS's queue depth over batch width times ITS recent cycle
+    wall-clock EWMA (an interactive rejection is quoted in fast-path
+    waves, a batch rejection in ladder batches — never each other's).
     The HTTP layer maps this to 429 + a Retry-After header."""
 
-    def __init__(self, depth: int, limit: int, retry_after: float):
+    def __init__(self, depth: int, limit: int, retry_after: float,
+                 tier: str = "batch"):
         self.depth = depth
         self.limit = limit
         self.retry_after = retry_after
+        self.tier = tier
         super().__init__(
-            f"check queue full ({depth}/{limit}); retry after "
-            f"~{retry_after:.1f}s"
+            f"check queue full ({depth}/{limit}, {tier} tier); retry "
+            f"after ~{retry_after:.2f}s"
         )
 
 
@@ -111,11 +143,12 @@ class CheckRequest:
     __slots__ = (
         "id", "seq", "model", "history", "priority", "deadline", "client",
         "group", "future", "status", "result", "t_submit", "t_done",
-        "trace_id", "ctx",
+        "trace_id", "ctx", "tier", "kind", "checker", "escalated",
     )
 
     def __init__(self, *, seq, model, history, priority, deadline, client,
-                 group, trace_id=None):
+                 group, trace_id=None, tier="batch", kind="ladder",
+                 checker=None):
         self.id = uuid.uuid4().hex[:12]
         self.seq = seq
         self.model = model
@@ -124,6 +157,10 @@ class CheckRequest:
         self.deadline = deadline
         self.client = client
         self.group = group
+        self.tier = tier          # latency class (fixed once queued)
+        self.kind = kind          # "ladder" | "graph"
+        self.checker = checker    # graph requests: the Checker instance
+        self.escalated = False    # fast path couldn't finish; rode the ladder
         self.future = CheckFuture()
         self.future.id = self.id
         self.status = "queued"
@@ -144,9 +181,15 @@ class CheckRequest:
             "status": self.status,
             "client": self.client,
             "priority": self.priority,
-            "model": self.model.name,
+            "class": self.tier,
+            "model": self.model.name if self.model is not None else None,
             "trace_id": self.trace_id,
         }
+        if self.kind == "graph":
+            out["checker"] = type(self.checker).__name__
+            out["geometry_batchable"] = False
+        if self.escalated:
+            out["escalated"] = True
         if self.result is not None:
             out["result"] = self.result
         if self.t_done is not None:
@@ -174,15 +217,24 @@ class CheckRequest:
 class CheckService:
     """A persistent multi-tenant check service over ``batch_analysis``.
 
-    ``capacity``/``mesh``/``**check_opts`` configure the ONE ladder every
-    batch runs (requests carry no per-request ladder knobs — a shared
-    launch needs a shared config; per-request opts are priority,
-    deadline, and client id).  ``max_queue`` bounds admission
-    (``QueueFull`` beyond it), ``max_batch`` bounds lanes per launch,
-    ``batch_window_s`` is the brief pile-in pause before each batch so
-    concurrent submitters coalesce.  ``drain_dir`` is where shutdown
-    checkpoints still-queued work (None: drained requests resolve
-    unknown without a checkpoint).
+    ``capacity``/``devices``/``mesh``/``**check_opts`` configure the ONE
+    ladder every batch runs (requests carry no per-request ladder knobs
+    — a shared launch needs a shared config; per-request opts are
+    priority, deadline, latency class, and client id).  ``max_queue``
+    bounds admission (``QueueFull`` beyond it) with an optional
+    dedicated ``max_interactive_queue`` allowance so batch backlog
+    can't starve the fast lane.  ``max_batch`` bounds lanes per launch.
+    ``interactive_max_b`` auto-routes histories with at most that many
+    barriers to the interactive tier (0, the library default, keeps
+    auto-routing off — callers opt in per request with
+    ``class_="interactive"``).  ``continuous`` enables rung-boundary
+    admission into running ladders (the default; False restores PR 4's
+    window-then-launch batching for A/B).  ``devices=N`` lane-shards
+    every launch across the first N jax devices; ``verify_placement``
+    re-runs the first sharded batch single-device and reports any
+    verdict disagreement.  ``drain_dir`` is where shutdown checkpoints
+    still-queued work (None: drained requests resolve unknown without a
+    checkpoint).
 
     ``start()`` spawns the scheduler thread (and pre-forks the
     confirmation worker pool, so the first confirmed-unknown request
@@ -194,44 +246,75 @@ class CheckService:
         *,
         capacity: int | Sequence[int] = (64, 512, 4096),
         mesh=None,
+        devices: int | None = None,
         max_queue: int = 256,
+        max_interactive_queue: int | None = None,
         max_batch: int = 64,
         batch_window_s: float = 0.002,
+        interactive_max_b: int = 0,
+        continuous: bool = True,
+        verify_placement: bool = False,
         warm_pool: bool = True,
         drain_dir: str | Path | None = None,
         **check_opts,
     ):
-        for k in ("capacity", "mesh", "deadline", "checkpoint_dir", "resume"):
+        for k in ("capacity", "mesh", "deadline", "checkpoint_dir", "resume",
+                  "admission"):
             if k in check_opts:
                 raise TypeError(
                     f"{k!r} is service-level configuration, not a check opt"
                 )
         self.capacity = capacity
-        self.mesh = mesh
+        self._placement = _sched_place.Placement(devices=devices, mesh=mesh)
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
         self.batch_window_s = float(batch_window_s)
+        self.interactive_max_b = int(interactive_max_b)
+        self.continuous = bool(continuous)
+        self.verify_placement = bool(verify_placement)
         self.warm_pool = warm_pool
         self.drain_dir = Path(drain_dir) if drain_dir is not None else None
         self._check_opts = dict(check_opts)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue: list[CheckRequest] = []
+        self._adm = _sched_adm.AdmissionQueues(
+            self.max_queue, max_interactive=max_interactive_queue
+        )
         self._reserved = 0  # admission slots held while packing off-lock
         self._requests: dict[str, CheckRequest] = {}
         self._seq = itertools.count()
         self._closed = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._running = 0
-        self._inflight: list[CheckRequest] = []  # the batch on the device
+        self._fp_thread: threading.Thread | None = None
+        self._graph_pool: ThreadPoolExecutor | None = None
+        self._inflight: list[CheckRequest] = []  # requests on the device
         self._t_start = time.monotonic()
-        self._batch_ewma_s = 1.0
+        self._parity_checked = False
         self._totals = {
             "submitted": 0, "completed": 0, "rejected": 0, "expired": 0,
             "drained": 0, "batches": 0, "batch_errors": 0,
+            "fastpath_resolved": 0, "escalated": 0, "graphs": 0,
         }
-        self._occ_sum = 0.0  # occupancy accumulator for stats()
+        self._occ_sum = 0.0     # per-batch occupancy accumulator
+        #: continuous-occupancy accumulators: live lane-seconds over
+        #: launched lane-slot-seconds across every rung — the
+        #: device-TIME-utilization aggregate the ≥ 0.80 gate reads
+        #: (each rung weighted by its wall clock; see RungFeeder).
+        self._rung_lane_sum = 0.0
+        self._rung_slot_sum = 0.0
+        self._rungs = 0
+
+    @property
+    def mesh(self):
+        """The placement mesh (None: single-device)."""
+        return self._placement.mesh
+
+    @property
+    def _batch_ewma_s(self) -> float:
+        # Back-compat alias (stats key batch_ewma_s): the batch tier's
+        # cycle EWMA now lives in the admission queues, per class.
+        return self._adm.ewma_s["batch"]
 
     # ------------------------------------------------------------------
     # Admission
@@ -246,43 +329,111 @@ class CheckService:
         deadline=None,
         client: str = "anon",
         trace_id: str | None = None,
+        class_: str | None = None,
+        checker=None,
     ) -> CheckFuture:
         """Admit one history; returns a future resolving to its verdict.
 
         ``model`` defaults to ``CASRegister()``.  ``priority``: higher
         runs first (FIFO within a priority).  ``deadline``: seconds (or
-        a ``faults.Deadline``) bounding the queue wait.  ``trace_id``
-        joins this request to a caller's existing trace (HTTP clients
-        pass it in the POST body); None mints a fresh id — read it back
-        from the returned future's request record or the status
-        document.  Raises ``QueueFull`` (backpressure) or
-        ``ServiceClosed``."""
+        a ``faults.Deadline``) bounding the queue wait.  ``class_``:
+        the latency class — ``"interactive"`` (greedy fast path, p50 in
+        single-launch units) or ``"batch"``; None auto-routes small
+        histories when ``interactive_max_b`` is configured, else batch.
+        ``checker``: a graph checker instance (elle ``CycleChecker`` &
+        co.) instead of a ladder model — tagged non-geometry-batchable
+        at admission and run on the host side lane, never occupying a
+        geometry bucket.  ``trace_id`` joins this request to a caller's
+        existing trace (HTTP clients pass it in the POST body); None
+        mints a fresh id.  Raises ``QueueFull`` (backpressure, with a
+        per-class retry-after) or ``ServiceClosed``."""
         # Coerce every argument BEFORE reserving a slot: a reservation
         # leaked past a bad-argument raise would shrink admission
         # capacity forever.
-        model = model if model is not None else m.CASRegister()
+        if checker is None:
+            model = model if model is not None else m.CASRegister()
         deadline = faults.Deadline.coerce(deadline)
         history = list(history)
         priority = int(priority)
         client = str(client)
         trace_id = str(trace_id) if trace_id is not None else None
+        if class_ is not None and class_ not in _sched_adm.CLASSES:
+            raise ValueError(
+                f"unknown latency class {class_!r}; expected one of "
+                f"{_sched_adm.CLASSES}"
+            )
+        #: the tier used for the pre-pack depth check; auto-routing can
+        #: only move a request INTO the interactive tier after packing,
+        #: and only when that tier has room (checked again below).
+        pre_tier = class_ or "batch"
         with self._lock:
             if self._closed:
                 raise ServiceClosed("check service is shutting down")
-            depth = len(self._queue) + self._reserved
-            if depth >= self.max_queue:
+            if self._adm.over_limit(pre_tier, self._reserved):
                 self._totals["rejected"] += 1
-                obs.counter("serve.rejected", client=client)
-                raise QueueFull(depth, self.max_queue, self._retry_after())
+                obs.counter("serve.rejected", client=client, tier=pre_tier)
+                metrics.inc("serve.rejections", tier=pre_tier)
+                if (pre_tier == "interactive"
+                        and self._adm.max_interactive is not None
+                        and (self._adm.depth("interactive")
+                             >= self._adm.max_interactive)):
+                    # The dedicated interactive bound is what tripped:
+                    # quote ITS depth/limit, not the shared queue's
+                    # (a "full at 10/256" rejection reads as a bug).
+                    depth, limit = (self._adm.depth("interactive"),
+                                    self._adm.max_interactive)
+                else:
+                    depth, limit = (self._adm.depth() + self._reserved,
+                                    self.max_queue)
+                raise QueueFull(
+                    depth, limit,
+                    self._adm.retry_after(pre_tier, self.max_batch),
+                    tier=pre_tier,
+                )
             # Hold the slot while packing off-lock: two racing submitters
             # must not both pass the depth check into a full queue.
             self._reserved += 1
         try:
-            group = self._group_of(model, history)
+            if checker is not None:
+                if _sched_adm.geometry_batchable(checker):
+                    # The admission tag is the routing contract: the
+                    # side lane exists for work that CANNOT share
+                    # padded-kernel geometry (elle's CycleChecker
+                    # family sets geometry_batchable = False).  A
+                    # checker that doesn't opt out is asking for
+                    # geometry batching the service can only do from
+                    # model= + history — reject loudly instead of
+                    # silently serving it unbatched.
+                    raise ValueError(
+                        f"{type(checker).__name__} does not set "
+                        "geometry_batchable = False; checker-based "
+                        "submissions ride the host side lane, so "
+                        "geometry-batchable work must be submitted as "
+                        "model= + history for the service to pack it"
+                    )
+                # Graph work: no kernel geometry, no geometry bucket.
+                group: tuple | None = ("graph", type(checker).__name__)
+                pack = None
+                kind = "graph"
+                tier = class_ or "batch"
+            else:
+                group, pack = self._group_of(model, history)
+                kind = "ladder"
+                if pack is None:
+                    # Untensorizable: no geometry, no fast path — the
+                    # ladder's CPU fallback decides it on the batch tier
+                    # regardless of the requested class.
+                    tier = "batch"
+                else:
+                    tier = _sched_adm.classify(
+                        class_, B=int(pack["B"]),
+                        interactive_max_b=self.interactive_max_b,
+                    )
             req = CheckRequest(
                 seq=next(self._seq), model=model, history=history,
                 priority=priority, deadline=deadline, client=client,
-                group=group, trace_id=trace_id,
+                group=group, trace_id=trace_id, tier=tier, kind=kind,
+                checker=checker,
             )
         except BaseException:
             with self._lock:
@@ -295,18 +446,28 @@ class CheckService:
                 # drain already snapshotted the queue, so appending now
                 # would strand this request unresolved forever.
                 self._totals["rejected"] += 1
-                obs.counter("serve.rejected", client=client)
+                obs.counter("serve.rejected", client=client, tier=tier)
                 raise ServiceClosed("check service is shutting down")
             self._totals["submitted"] += 1
             self._remember(req)
             if group is None:
                 self._totals["completed"] += 1
             else:
-                self._queue.append(req)
+                if (class_ is None and req.tier == "interactive"
+                        and self._adm.max_interactive is not None
+                        and (self._adm.depth("interactive")
+                             >= self._adm.max_interactive)):
+                    # Auto-routing must not bypass the dedicated
+                    # interactive bound: a full fast lane demotes
+                    # opportunistic traffic to the batch tier instead of
+                    # overfilling it (explicit class_="interactive" was
+                    # depth-checked at admission and rejected there).
+                    req.tier = "batch"
+                self._adm.push(req)
                 self._cond.notify_all()
             with obs.attach(req.ctx):
-                obs.counter("serve.submitted", client=client)
-                obs.gauge("serve.queue_depth", len(self._queue))
+                obs.counter("serve.submitted", client=client, tier=tier)
+                obs.gauge("serve.queue_depth", self._adm.depth())
         if group is None:
             # Trivial fast path: no barriers -> valid, no lanes spent.
             # Resolved OUTSIDE the lock: set_result runs done-callbacks
@@ -316,37 +477,38 @@ class CheckService:
             with obs.attach(req.ctx):
                 obs.counter("serve.completed")
             metrics.inc("serve.verdicts", verdict="true")
-            metrics.observe("serve.request_latency_seconds",
-                            time.monotonic() - req.t_submit)
+            dt = time.monotonic() - req.t_submit
+            metrics.observe("serve.request_latency_seconds", dt)
+            metrics.observe("serve.class_request_latency_seconds", dt,
+                            tier=tier)
         return req.future
 
-    def _group_of(self, model: m.Model, history) -> tuple | None:
-        """The batch-compatibility key: (model, padded geometry).  None
-        means trivially valid (no device work); untensorizable histories
-        get their own group so ``batch_analysis`` decides them the same
-        way it would for a direct caller (CPU fallback or unknown).
+    def _group_of(self, model: m.Model, history) -> tuple[tuple | None, dict | None]:
+        """The batch-compatibility key ``(model, padded geometry)`` plus
+        the pack it was computed from.  A None group means trivially
+        valid (no device work); untensorizable histories get their own
+        group so ``batch_analysis`` decides them the same way it would
+        for a direct caller (CPU fallback or unknown).
 
-        Known cost: the admission pack is thrown away and
-        ``batch_analysis`` re-packs at launch — removing the double pack
-        needs batch_analysis to accept pre-packed inputs (its
+        The pack is returned so admission can classify by barrier
+        count; it is then dropped — the interactive greedy walk runs on
+        the raw history, and ``batch_analysis`` re-packs at launch (its
         checkpoint fingerprint and confirmation paths key on the raw
-        histories today)."""
+        histories)."""
         from jepsen_tpu.ops import wgl
         from jepsen_tpu.parallel import batch
 
         try:
             p = wgl.pack(model, list(history))
         except wgl.NotTensorizable:
-            return (model, "untensorizable")
+            return (model, "untensorizable"), None
         if p["B"] == 0:
-            return None
-        return (model, *batch.bucket_geometry(p["B"], p["P"], p["G"]))
+            return None, p
+        return (model, *batch.bucket_geometry(p["B"], p["P"], p["G"])), p
 
     def _retry_after(self) -> float:
-        """Backpressure hint: queue depth over batch width, in units of
-        the recent batch wall-clock EWMA."""
-        waves = max(1.0, len(self._queue) / max(1, self.max_batch))
-        return round(max(0.05, waves * self._batch_ewma_s), 3)
+        """Back-compat backpressure hint (batch tier)."""
+        return self._adm.retry_after("batch", self.max_batch)
 
     def _remember(self, req: CheckRequest) -> None:
         self._requests[req.id] = req
@@ -382,50 +544,81 @@ class CheckService:
             target=self._loop, name="check-service", daemon=True
         )
         self._thread.start()
+        # The interactive tier gets its OWN service thread: a greedy
+        # fast-path wave is a ~ms launch, and riding the scheduler loop
+        # would bound its latency by the batch tier's rung wall clock.
+        # jax dispatch is thread-safe; the wave's tiny launch interleaves
+        # with the ladder's on the device (one device serves both tiers).
+        self._fp_thread = threading.Thread(
+            target=self._fastpath_loop, name="check-service-fastpath",
+            daemon=True,
+        )
+        self._fp_thread.start()
         return self
 
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stop.is_set():
+                while self._adm.depth() == 0 and not self._stop.is_set():
                     self._cond.wait(timeout=0.2)
                 if self._stop.is_set():
                     return
             if self.batch_window_s > 0:
                 # The pile-in window: let concurrent submitters coalesce
                 # into this batch instead of each paying its own launch.
+                # Rung-boundary admission makes this window nearly moot
+                # (latecomers join the running ladder), so it stays tiny.
                 time.sleep(self.batch_window_s)
             try:
                 self.step()
             except Exception:  # noqa: BLE001 — the scheduler must survive
                 logger.exception("check-service batch step failed")
 
+    def _fastpath_loop(self) -> None:
+        while True:
+            with self._cond:
+                # Wait for LADDER-kind interactive work specifically: a
+                # graph request parked in the interactive queue belongs
+                # to the side lane (step()/rung boundaries), and a bare
+                # depth check would busy-spin on it — the wave below
+                # takes only ladder requests and would never drain it.
+                while (not self._stop.is_set() and not any(
+                        r.kind == "ladder"
+                        for r in self._adm.queues["interactive"])):
+                    self._cond.wait(timeout=0.2)
+                if self._stop.is_set():
+                    return
+            # No coalesce window and no yield-to-the-ladder: the host
+            # greedy walk batches nothing (per-request host work) and
+            # contends with nothing (no kernel launch), so the lowest-
+            # latency move is always to serve the queue immediately.
+            try:
+                self._interactive_wave()
+            except Exception:  # noqa: BLE001 — the fast lane must survive
+                logger.exception("check-service fast-path wave failed")
+
     def step(self) -> int:
-        """Process one batch synchronously: expire overdue queued
-        requests, select the highest-priority compatibility group, run
-        one shared launch, demux.  Returns requests resolved (expired +
-        batched).  The scheduler loop calls this; tests call it directly
-        for deterministic control."""
-        batch_reqs: list[CheckRequest] = []
+        """Process one scheduler cycle synchronously: expire overdue
+        queued requests, dispatch graph side-lane work, serve one
+        interactive fast-path wave, then run one (continuous) batch-tier
+        ladder.  Returns requests handled.  The scheduler loop calls
+        this; tests call it directly for deterministic control."""
         with self._cond:
-            expired = self._take_expired_locked()
-            if self._queue:
-                self._queue.sort(key=lambda r: (-r.priority, r.seq))
-                lead = self._queue[0]
-                batch_reqs = [r for r in self._queue if r.group == lead.group]
-                batch_reqs = batch_reqs[: self.max_batch]
-                taken = set(id(r) for r in batch_reqs)
-                self._queue = [r for r in self._queue if id(r) not in taken]
-                for r in batch_reqs:
-                    r.status = "running"
-                self._running = len(batch_reqs)
-                self._inflight = list(batch_reqs)
-                obs.gauge("serve.queue_depth", len(self._queue))
+            expired = self._adm.take_expired()
+            self._totals["expired"] += len(expired)
+        self._resolve_expired(expired)
+        handled = len(expired)
+        handled += self._step_graphs()
+        handled += self._interactive_wave()
+        handled += self._step_batch()
+        return handled
+
+    def _resolve_expired(self, expired: list[CheckRequest]) -> None:
         # Expired futures resolve outside the lock (done-callbacks may
         # re-enter the service); the shared batch is untouched.
         for r in expired:
             with obs.attach(r.ctx):
-                obs.counter("serve.expired", client=r.client)
+                obs.counter("serve.expired", client=r.client, tier=r.tier)
             metrics.inc("serve.verdicts", verdict="unknown")
             r.resolve(
                 {
@@ -437,9 +630,156 @@ class CheckService:
                 },
                 status="expired",
             )
-        handled = len(expired)
-        if not batch_reqs:
-            return handled
+
+    # -- graph side lane ---------------------------------------------------
+
+    def _step_graphs(self) -> int:
+        """Dispatch queued non-geometry-batchable (graph) requests to
+        the host side lane: a small thread pool when the scheduler
+        thread runs (graph checks must not stall ladder work), inline
+        when tests drive ``step()`` directly (determinism)."""
+        with self._cond:
+            gq = [
+                r for q in self._adm.queues.values() for r in q
+                if r.kind == "graph"
+            ]
+            self._adm.remove(gq)
+            for r in gq:
+                r.status = "running"
+        for r in gq:
+            if self._thread is not None:
+                if self._graph_pool is None:
+                    self._graph_pool = ThreadPoolExecutor(
+                        max_workers=2, thread_name_prefix="check-graph"
+                    )
+                self._graph_pool.submit(self._run_graph, r)
+            else:
+                self._run_graph(r)
+        return len(gq)
+
+    def _run_graph(self, r: CheckRequest) -> None:
+        from jepsen_tpu import checker as _checker
+
+        with obs.attach(r.ctx):
+            with obs.span(
+                "serve.graph", checker=type(r.checker).__name__,
+                client=r.client,
+            ):
+                # check_safe owns the Checker.check contract: a None
+                # result means valid, exceptions become an attributable
+                # unknown — one bad graph request degrades alone, never
+                # the side lane.
+                res = _checker.check_safe(
+                    r.checker, {"name": "serve"}, list(r.history)
+                )
+        with self._lock:
+            self._totals["graphs"] += 1
+        self._settle_member(r, res)
+
+    # -- interactive fast path ---------------------------------------------
+
+    def _interactive_wave(self) -> int:
+        """One speculative greedy wave over the interactive queue: a
+        host-side witness walk per request (``wgl_cpu.greedy_walk`` —
+        one beam lane, returning-op first, no backtracking; the host
+        counterpart of the ladder's rung-0 greedy kernel).  Walks that
+        complete resolve True (a full linearization IS a constructive
+        witness — the same verdict rung 0 of a one-shot ladder would
+        return); walks that stick escalate into the batch tier, where
+        the full ladder decides them.  The walk never touches the
+        device, so an interactive request's latency is bounded by
+        microseconds of host work — not by a beam rung mid-flight on
+        the device (the device wave this replaced measured 10–30 ms
+        when racing a rung for host cores, on top of a bounded yield).
+        Returns requests RESOLVED here (escalations are in flight)."""
+        from jepsen_tpu.checker import wgl_cpu
+
+        with self._cond:
+            wave = [
+                r for r in self._adm.queues["interactive"]
+                if r.kind == "ladder"
+            ]
+            if not wave:
+                return 0
+            wave.sort(key=lambda r: (-r.priority, r.seq))
+            wave = wave[: self.max_batch]
+            self._adm.remove(wave)
+            for r in wave:
+                r.status = "running"
+            self._inflight.extend(wave)
+            obs.gauge("serve.queue_depth", self._adm.depth())
+        t0 = time.monotonic()
+        for r in wave:
+            with obs.attach(r.ctx):
+                obs.span_event(
+                    "serve.admission", t0 - r.t_submit, client=r.client,
+                    tier="interactive",
+                )
+            metrics.observe("serve.admission_latency_seconds",
+                            t0 - r.t_submit)
+            metrics.observe("serve.class_admission_latency_seconds",
+                            t0 - r.t_submit, tier="interactive")
+        with _sched_adm.WaveTimer(self._adm, "interactive"):
+            with obs.span(
+                "serve.fastpath", requests=len(wave), engine="host-greedy",
+            ) as sp:
+                flags = []
+                for r in wave:
+                    try:
+                        flags.append(
+                            wgl_cpu.greedy_walk(r.model, r.history) is True
+                        )
+                    except Exception:  # noqa: BLE001 — a failed walk
+                        # escalates its member; the ladder decides it
+                        logger.exception("interactive greedy walk failed")
+                        flags.append(False)
+                sp.set(resolved=sum(flags),
+                       escalated=len(wave) - sum(flags))
+        resolved = 0
+        for r, ok in zip(wave, flags):
+            if ok:
+                resolved += 1
+                with self._cond:
+                    if r in self._inflight:
+                        self._inflight.remove(r)
+                self._settle_member(r, {"valid?": True, "fastpath": "greedy"})
+            else:
+                r.escalated = True
+                with self._cond:
+                    self._inflight.remove(r)
+                    r.status = "queued"
+                    self._adm.requeue(r, "batch")
+                    self._cond.notify_all()
+        with self._lock:
+            self._totals["fastpath_resolved"] += resolved
+            self._totals["escalated"] += len(wave) - resolved
+        if resolved:
+            obs.counter("serve.fastpath_resolved", resolved)
+        if len(wave) - resolved:
+            obs.counter("serve.fastpath_escalated", len(wave) - resolved)
+        return resolved
+
+    # -- batch tier (continuous ladder) -------------------------------------
+
+    def _step_batch(self) -> int:
+        """Run one batch-tier ladder over the lead compatibility group
+        (continuous: a RungFeeder admits compatible latecomers at rung
+        boundaries).  Returns requests settled."""
+        with self._cond:
+            q = [
+                r for r in self._adm.queues["batch"] if r.kind == "ladder"
+            ]
+            if not q:
+                return 0
+            q.sort(key=lambda r: (-r.priority, r.seq))
+            lead = q[0]
+            batch_reqs = [r for r in q if r.group == lead.group]
+            batch_reqs = batch_reqs[: self.max_batch]
+            self._adm.remove(batch_reqs)
+            for r in batch_reqs:
+                r.status = "running"
+            self._inflight.extend(batch_reqs)
+            obs.gauge("serve.queue_depth", self._adm.depth())
         t_start = time.monotonic()
         for r in batch_reqs:
             # Re-attach each request's admission-thread context: the
@@ -447,109 +787,263 @@ class CheckService:
             # trace id, not the scheduler's.
             with obs.attach(r.ctx):
                 obs.span_event(
-                    "serve.admission", t_start - r.t_submit, client=r.client
+                    "serve.admission", t_start - r.t_submit,
+                    client=r.client, tier=r.tier,
                 )
             metrics.observe("serve.admission_latency_seconds",
                             t_start - r.t_submit)
+            metrics.observe("serve.class_admission_latency_seconds",
+                            t_start - r.t_submit, tier=r.tier)
+        feeder = (
+            _sched_pack.RungFeeder(self, lead.group, batch_reqs)
+            if self.continuous else None
+        )
         try:
-            self._run_batch(batch_reqs)
+            self._run_batch(batch_reqs, feeder)
         finally:
+            members = feeder.members if feeder is not None else batch_reqs
             with self._lock:
-                self._running = 0
-                self._inflight = []
-        return handled + len(batch_reqs)
+                for r in members:
+                    if r in self._inflight:
+                        self._inflight.remove(r)
+        return len(members)
 
-    def _take_expired_locked(self) -> list[CheckRequest]:
-        """Pull queued requests whose deadline has passed off the queue
-        (caller resolves them OUTSIDE the lock)."""
-        live, expired = [], []
-        for r in self._queue:
-            if r.deadline is not None and r.deadline.expired():
-                expired.append(r)
-            else:
-                live.append(r)
-        self._queue = live
-        self._totals["expired"] += len(expired)
-        return expired
+    def _admit_joiners(self, feeder, *, stage: int, lanes: int) -> list:
+        """The RungFeeder's poll body: a bounded mid-ladder service
+        opportunity.  Expire overdue queued requests, serve one
+        interactive wave (this is what bounds interactive latency by a
+        RUNG, not a batch), then hand geometry-compatible batch-tier
+        requests to the running ladder — at most ``max_batch - lanes``,
+        so recycled lane slots are what joiners consume."""
+        with self._cond:
+            expired = self._adm.take_expired()
+            self._totals["expired"] += len(expired)
+        self._resolve_expired(expired)
+        if self._adm.depth("interactive"):
+            # The rung boundary is an interactive service opportunity
+            # whether or not the dedicated fast-path thread runs: the
+            # ladder pausing here means the wave launches uncontended,
+            # and an interactive request is never stuck behind more than
+            # ONE rung even if the fast-path thread is mid-wave.  (The
+            # two pickers take disjoint requests under the lock.)
+            self._interactive_wave()
+        if self._thread is not None:
+            # Graph work dispatches to its thread pool, so the rung
+            # boundary is its service opportunity too — a continuous
+            # ladder with a steady joiner stream would otherwise pin
+            # queued graph requests behind the whole ladder lifetime
+            # (inline/step() callers keep their deterministic ordering:
+            # graphs there run in step() itself).
+            self._step_graphs()
+        if not self.continuous or self._closed:
+            return []
+        with self._cond:
+            now = time.monotonic()
+            other_wait = max(
+                (now - r.t_submit
+                 for r in self._adm.queues["batch"]
+                 if r.kind == "ladder" and r.group != feeder.group),
+                default=0.0,
+            )
+            if other_wait > _GROUP_STARVE_S:
+                # Another geometry group has waited a full starvation
+                # bound: stop feeding this ladder so it drains and the
+                # next scheduler cycle serves that group — the
+                # cross-GROUP face of the bounded-wait contract
+                # parallel.batch._STARVE_SECONDS gives members inside a
+                # ladder (a steady same-group stream must not hold the
+                # device forever).
+                return []
+            # Joiners may grow the ladder past the feeder's initial
+            # pad_lanes: pad widths are power-of-2 bucketed, so growth
+            # changes the compiled shape at most log2(max_batch /
+            # pad_lanes) times per ladder and every width re-warms for
+            # the process lifetime — clamping the budget to the initial
+            # width instead was measured at 0.70-0.73 occupancy against
+            # ~0.90 (overflow seeded extra narrow ladders all day to
+            # dodge a once-per-shape compile).
+            budget = self.max_batch - int(lanes)
+            if budget <= 0:
+                return []
+            q = [
+                r for r in self._adm.queues["batch"]
+                if r.kind == "ladder" and r.group == feeder.group
+            ]
+            q.sort(key=lambda r: (-r.priority, r.seq))
+            joiners = q[:budget]
+            self._adm.remove(joiners)
+            for r in joiners:
+                r.status = "running"
+            self._inflight.extend(joiners)
+            if joiners:
+                obs.gauge("serve.queue_depth", self._adm.depth())
+        t = time.monotonic()
+        for r in joiners:
+            with obs.attach(r.ctx):
+                obs.span_event(
+                    "serve.admission", t - r.t_submit, client=r.client,
+                    tier=r.tier, joined_at_rung=stage,
+                )
+            metrics.observe("serve.admission_latency_seconds",
+                            t - r.t_submit)
+            metrics.observe("serve.class_admission_latency_seconds",
+                            t - r.t_submit, tier=r.tier)
+        return joiners
 
-    def _run_batch(self, batch_reqs: list[CheckRequest]) -> None:
+    def _settle_member(self, r: CheckRequest, res: dict,
+                       status: str = "done") -> bool:
+        """Resolve one request's future with its verdict (idempotent —
+        the ladder's early demux and the final settle loop may both
+        reach a member).  Annotates mid-flight deadline overrun and
+        emits the per-request telemetry."""
+        if r.deadline is not None and r.deadline.expired():
+            # Launched before the budget ran out: the verdict is
+            # already paid for, so hand it over — annotated, so an
+            # SLA-bound caller can still discount it.
+            res = {**res, "deadline-overrun": True}
+        if not r.resolve(res, status=status):
+            return False
+        with obs.attach(r.ctx):
+            obs.span_event(
+                "serve.request", r.t_done - r.t_submit, client=r.client,
+                verdict=str(res.get("valid?")), tier=r.tier,
+            )
+        metrics.observe("serve.request_latency_seconds",
+                        r.t_done - r.t_submit)
+        metrics.observe("serve.class_request_latency_seconds",
+                        r.t_done - r.t_submit, tier=r.tier)
+        metrics.inc("serve.verdicts", verdict=str(res.get("valid?")).lower())
+        with self._lock:
+            self._totals["completed"] += 1
+        obs.counter("serve.completed")
+        return True
+
+    def _run_batch(self, batch_reqs: list[CheckRequest], feeder) -> None:
         from jepsen_tpu.parallel import batch
 
         model = batch_reqs[0].model
         n = len(batch_reqs)
-        n_pad = batch.padded_batch(n, self.mesh)
+        mesh = self._placement.mesh
+        n_pad = batch.padded_batch(n, mesh)
         geom = batch_reqs[0].group[1:]
         trace_ids = [r.trace_id for r in batch_reqs]
         metrics.set_gauge("serve.batch_occupancy", round(n / n_pad, 4))
         metrics.set_gauge("serve.batch_padding_waste",
                           round(1.0 - n / n_pad, 4))
         metrics.set_gauge("serve.batch_requests", n)
-        with obs.span(
-            "serve.batch", requests=n, padded=n_pad,
-            occupancy=round(n / n_pad, 4),
-            padding_waste=round(1.0 - n / n_pad, 4),
-            model=model.name, geometry=str(geom),
-            trace_ids=trace_ids,
-        ):
-            t0 = time.monotonic()
-            try:
-                # The shared-batch trace scope: everything the launch
-                # emits below here (ladder stages, confirmations,
-                # fault retries) carries the member trace ids, so one
-                # request's journey is findable inside the shared work.
-                with obs.attach(trace=trace_ids, parent="serve.batch"):
-                    results = batch.batch_analysis(
-                        model, [r.history for r in batch_reqs],
-                        capacity=self.capacity, mesh=self.mesh,
-                        **self._check_opts,
+        with self._placement.span(requests=n, tier="batch"):
+            with obs.span(
+                "serve.batch", requests=n, padded=n_pad,
+                occupancy=round(n / n_pad, 4),
+                padding_waste=round(1.0 - n / n_pad, 4),
+                model=model.name, geometry=str(geom),
+                trace_ids=trace_ids, continuous=feeder is not None,
+            ) as sp:
+                t0 = time.monotonic()
+                try:
+                    # The shared-batch trace scope: everything the launch
+                    # emits below here (ladder stages, confirmations,
+                    # fault retries) carries the member trace ids, so one
+                    # request's journey is findable inside the shared work.
+                    with obs.attach(trace=trace_ids, parent="serve.batch"):
+                        results = batch.batch_analysis(
+                            model, [r.history for r in batch_reqs],
+                            capacity=self.capacity, mesh=mesh,
+                            admission=feeder,
+                            **self._check_opts,
+                        )
+                    err = None
+                except Exception as e:  # noqa: BLE001 — degrade the batch's
+                    # requests, never the service (the scheduler lives on)
+                    logger.exception("check-service batch failed")
+                    results, err = None, e
+                dt = time.monotonic() - t0
+                if feeder is not None:
+                    sp.set(
+                        joined=feeder.joined, members=len(feeder.members),
+                        rungs=feeder.rungs,
+                        continuous_occupancy=feeder.mean_occupancy,
                     )
-                err = None
-            except Exception as e:  # noqa: BLE001 — degrade the batch's
-                # requests, never the service (the scheduler lives on)
-                logger.exception("check-service batch failed")
-                results, err = None, e
-            dt = time.monotonic() - t0
+        members = feeder.members if feeder is not None else batch_reqs
         metrics.observe("serve.batch_seconds", dt)
         with self._lock:
-            self._batch_ewma_s = 0.7 * self._batch_ewma_s + 0.3 * dt
+            # The batch-tier retry-after quotes SLOT-RECYCLE cadence: a
+            # continuous ladder lives as long as joiners keep coming
+            # (minutes, under steady arrival), but lanes free at every
+            # rung — feeding the whole-ladder wall into the EWMA would
+            # tell a rejected client to come back a ladder-lifetime
+            # later for a slot that frees in milliseconds.
+            cycles = feeder.rungs if (feeder is not None
+                                      and feeder.rungs) else 1
+            self._adm.record_wall("batch", dt / cycles)
             self._totals["batches"] += 1
             self._occ_sum += n / n_pad
+            if feeder is not None:
+                self._rung_lane_sum += feeder.lane_sum
+                self._rung_slot_sum += feeder.slot_sum
+                self._rungs += feeder.rungs
             if err is not None:
                 self._totals["batch_errors"] += 1
         metrics.inc("serve.batches")
         if err is not None:
             metrics.inc("serve.batch_errors")
             obs.counter("serve.batch_error", error=faults.describe(err))
-            for r in batch_reqs:
-                metrics.inc("serve.verdicts", verdict="unknown")
-                r.resolve(
-                    {
-                        "valid?": "unknown",
-                        "cause": f"service batch failed: {faults.describe(err)}",
-                    },
-                    status="error",
-                )
+            for r in members:
+                if not r.future.done():
+                    metrics.inc("serve.verdicts", verdict="unknown")
+                    r.resolve(
+                        {
+                            "valid?": "unknown",
+                            "cause": (
+                                "service batch failed: "
+                                f"{faults.describe(err)}"
+                            ),
+                        },
+                        status="error",
+                    )
             return
-        t_done = time.monotonic()
-        for r, res in zip(batch_reqs, results):
-            if r.deadline is not None and r.deadline.expired():
-                # Launched before the budget ran out: the verdict is
-                # already paid for, so hand it over — annotated, so an
-                # SLA-bound caller can still discount it.
-                res = {**res, "deadline-overrun": True}
-            r.resolve(res)
-            with obs.attach(r.ctx):
-                obs.span_event(
-                    "serve.request", t_done - r.t_submit, client=r.client,
-                    verdict=str(res.get("valid?")),
-                )
-            metrics.observe("serve.request_latency_seconds",
-                            t_done - r.t_submit)
-            metrics.inc("serve.verdicts",
-                        verdict=str(res.get("valid?")).lower())
-        with self._lock:
-            self._totals["completed"] += len(batch_reqs)
-        obs.counter("serve.completed", len(batch_reqs))
+        # Settle every member the ladder's early demux didn't (unknowns
+        # and confirmation leftovers); _settle_member is idempotent so
+        # already-resolved members are skipped.
+        for r, res in zip(members, results):
+            self._settle_member(r, res)
+        if (self.verify_placement and mesh is not None
+                and not self._parity_checked):
+            self._parity_checked = True
+            self._verify_placement(model, [r.history for r in members],
+                                   results)
+
+    def _verify_placement(self, model, histories, sharded_results) -> None:
+        """The placement parity check (first sharded batch only): the
+        SAME histories one-shot on a single device must produce the
+        same verdicts.  A mismatch is reported loudly (counter + log)
+        but never degrades the already-delivered verdicts — placement
+        bugs are for operators, crashes are not a remedy."""
+        from jepsen_tpu.parallel import batch
+
+        try:
+            single = batch.batch_analysis(
+                model, histories, capacity=self.capacity, mesh=None,
+                **self._check_opts,
+            )
+        except Exception:  # noqa: BLE001 — the probe is best-effort
+            logger.exception("placement parity probe failed")
+            return
+        got = [r["valid?"] for r in sharded_results]
+        want = [r["valid?"] for r in single]
+        if got == want:
+            obs.counter("serve.placement_parity_ok",
+                        histories=len(histories))
+            logger.info("placement parity verified over %d histories "
+                        "(%d devices)", len(histories),
+                        self._placement.n_devices)
+        else:
+            obs.counter("serve.placement_parity_mismatch")
+            metrics.inc("serve.placement_parity_mismatch")
+            logger.error(
+                "PLACEMENT PARITY MISMATCH: mesh verdicts %s != "
+                "single-device %s", got, want,
+            )
 
     # ------------------------------------------------------------------
     # Introspection (GET /queue, GET /check/<id>)
@@ -562,23 +1056,38 @@ class CheckService:
     def stats(self) -> dict:
         """The queue-status document (GET /queue, web panel)."""
         with self._lock:
+            queued = [r for q in self._adm.queues.values() for r in q]
             by_client: dict[str, int] = {}
-            for r in self._queue:
+            for r in queued:
                 by_client[r.client] = by_client.get(r.client, 0) + 1
-            groups = len({r.group for r in self._queue})
+            groups = len({r.group for r in queued})
             t = dict(self._totals)
             return {
-                "queue_depth": len(self._queue),
+                "queue_depth": self._adm.depth(),
                 "queue_groups": groups,
-                "running": self._running,
+                "running": len(self._inflight),
                 "max_queue": self.max_queue,
                 "max_batch": self.max_batch,
                 "closed": self._closed,
                 "by_client": by_client,
-                "batch_ewma_s": round(self._batch_ewma_s, 4),
+                "classes": self._adm.describe(self.max_batch),
+                "placement": self._placement.describe(),
+                "continuous": self.continuous,
+                "batch_ewma_s": round(self._adm.ewma_s["batch"], 4),
                 "avg_occupancy": round(
                     self._occ_sum / t["batches"], 4) if t["batches"] else None,
-                "retry_after_hint_s": self._retry_after(),
+                "continuous_occupancy": round(
+                    self._rung_lane_sum / self._rung_slot_sum, 4
+                ) if self._rung_slot_sum else None,
+                # raw device-time accumulators behind continuous_occupancy
+                # (live lane-seconds / launched lane-slot-seconds): a
+                # load harness snapshots these around a measured window
+                # to get steady-state occupancy with warmup (compile
+                # rungs) excluded — see tools/loadgen.py.
+                "rung_lane_s": round(self._rung_lane_sum, 6),
+                "rung_slot_s": round(self._rung_slot_sum, 6),
+                "retry_after_hint_s": self._adm.retry_after(
+                    "batch", self.max_batch),
                 "uptime_s": round(time.monotonic() - self._t_start, 3),
                 **t,
             }
@@ -601,8 +1110,10 @@ class CheckService:
         resolve unknown with the checkpoint path in ``cause``.  A batch
         still on the device after ``join_timeout`` has its requests
         drained too (resolve() is first-write-wins, so the zombie
-        batch's late verdicts are discarded harmlessly).  Returns a
-        summary dict."""
+        batch's late verdicts are discarded harmlessly).  Closing also
+        stops rung-boundary admission — a running continuous ladder
+        finishes its current members but takes no new joiners.  Returns
+        a summary dict."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -611,7 +1122,7 @@ class CheckService:
             # scheduler thread isn't running, step() here.
             while True:
                 with self._lock:
-                    empty = not self._queue and self._running == 0
+                    empty = self._adm.depth() == 0 and not self._inflight
                 if empty:
                     break
                 if self._thread is None:
@@ -630,11 +1141,18 @@ class CheckService:
                     join_timeout,
                 )
             self._thread = None
+        if self._fp_thread is not None:
+            self._fp_thread.join(timeout=30.0)
+            self._fp_thread = None
+        if self._graph_pool is not None:
+            self._graph_pool.shutdown(wait=True)
+            self._graph_pool = None
         with self._lock:
             # _inflight is non-empty only when the join timed out: those
             # requests were admitted and must still settle (drain below).
-            remaining = list(self._inflight) + list(self._queue)
-            self._queue = []
+            remaining = list(self._inflight) + self._adm.drain_all()
+            self._inflight = []
+        remaining = [r for r in remaining if not r.future.done()]
         summary = {"drained": 0, "checkpoints": []}
         if remaining:
             if drain:
@@ -658,7 +1176,8 @@ class CheckService:
         ``store.checkpoint`` written by the real ladder machinery (a
         zero-budget ``batch_analysis`` trips its deadline at stage 0 and
         persists config + fingerprint + pending set — exactly the state
-        ``resume=True`` re-enters)."""
+        ``resume=True`` re-enters).  Graph requests have no ladder state
+        to checkpoint; they resolve unknown without one."""
         from jepsen_tpu.parallel import batch
 
         groups: dict[tuple | None, list[CheckRequest]] = {}
@@ -672,7 +1191,8 @@ class CheckService:
         for gi, (group, rs) in enumerate(sorted(
                 groups.items(), key=lambda kv: kv[1][0].seq)):
             sub = None
-            if self.drain_dir is not None:
+            checkpointable = not (group and group[0] == "graph")
+            if self.drain_dir is not None and checkpointable:
                 sub = self.drain_dir / f"{stamp}-g{gi:02d}"
                 try:
                     sub.mkdir(parents=True, exist_ok=True)
@@ -690,7 +1210,7 @@ class CheckService:
                     )
                     batch.batch_analysis(
                         rs[0].model, [r.history for r in rs],
-                        capacity=self.capacity, mesh=self.mesh,
+                        capacity=self.capacity, mesh=self._placement.mesh,
                         checkpoint_dir=sub, deadline=faults.Deadline(0.0),
                         **self._check_opts,
                     )

@@ -114,6 +114,7 @@ def save(
     confirms: Mapping[int, Mapping] | None = None,
     device_confirms: Sequence[Mapping] | None = None,
     resumes: Mapping[int, tuple] | None = None,
+    rungs: Mapping[int, int] | None = None,
     complete: bool = False,
 ) -> Path:
     """Atomically persist one stage boundary's state; returns the json
@@ -121,8 +122,13 @@ def save(
     alive); ``confirms`` maps history index -> {"res", "op_pos"} for
     in-flight worker confirmations (resubmitted on resume);
     ``device_confirms`` is the queued device-confirmation descriptors
-    [{"i", "failed_at", "cap", "res"}].  ``complete`` marks a finished
-    run — resuming it returns the saved results without device work."""
+    [{"i", "failed_at", "cap", "res"}].  ``rungs`` optionally maps a
+    pending history index -> its NEXT ladder-stage index — continuous
+    batching admits members at rung boundaries, so pending members may
+    sit at different rungs; a member absent from the map resumes at
+    ``stage`` (the pre-continuous behavior, and what old checkpoints
+    decode to).  ``complete`` marks a finished run — resuming it
+    returns the saved results without device work."""
     d = Path(d)
     d.mkdir(parents=True, exist_ok=True)
     resumes = dict(resumes or {})
@@ -147,6 +153,7 @@ def save(
         "confirms": {str(i): c for i, c in (confirms or {}).items()},
         "device_confirms": list(device_confirms or ()),
         "resumes": sorted(int(i) for i in resumes),
+        "rungs": {str(i): int(r) for i, r in (rungs or {}).items()},
     }
     _store._atomic_write(
         json_path(d), json.dumps(_store._jsonable(doc), indent=1)
@@ -176,6 +183,7 @@ def load(d) -> dict:
         "confirms": {int(i): c for i, c in (doc.get("confirms") or {}).items()},
         "device_confirms": list(doc.get("device_confirms") or ()),
         "resumes": {},
+        "rungs": {int(i): int(r) for i, r in (doc.get("rungs") or {}).items()},
         "path": str(p),
     }
     want = [int(i) for i in doc.get("resumes") or ()]

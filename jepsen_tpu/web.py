@@ -12,11 +12,15 @@ the check API:
 
   POST /check        submit a history ({"history": [...], "model": ...,
                      "priority", "deadline", "client", "trace_id",
-                     "wait"}); 202 + request id + trace id, 200 +
-                     result with "wait": true, 429 + Retry-After on
-                     backpressure
+                     "class", "wait"}); "class" picks the latency tier
+                     ("interactive": the speculative greedy fast path;
+                     "batch": the continuous ladder — the default);
+                     202 + request id + trace id, 200 + result with
+                     "wait": true, 429 + Retry-After on backpressure
+                     (the estimate is computed per latency class)
   GET  /check/<id>   request status / result (includes the trace_id)
-  GET  /queue        queue-status JSON (the home page shows a panel)
+  GET  /queue        queue-status JSON incl. per-class queue depths and
+                     retry-after EWMAs (the home page shows a panel)
 
 Observability endpoints (always mounted):
 
@@ -159,7 +163,10 @@ def run_index(store_dir=None) -> list[tuple[str, str, Path, object]]:
 
 
 def queue_panel_html(service) -> str:
-    """The home page's check-service queue-status panel."""
+    """The home page's check-service queue-status panel: the process
+    totals plus one row per latency class (queue depth and retry-after
+    EWMA are PER CLASS — an interactive rejection is quoted in
+    fast-path waves, a batch one in ladder batches)."""
     if service is None:
         return ""
     s = service.stats()
@@ -171,14 +178,32 @@ def queue_panel_html(service) -> str:
             ("submitted", "submitted"), ("completed", "completed"),
             ("rejected", "rejected"), ("expired", "expired"),
             ("batches", "batches"), ("batch_ewma_s", "batch ewma (s)"),
+            ("continuous_occupancy", "rung occupancy"),
+            ("fastpath_resolved", "fastpath"),
         )
     )
+    class_rows = ""
+    for tier, c in sorted((s.get("classes") or {}).items()):
+        class_rows += (
+            f"<tr><td>{html.escape(tier)}</td>"
+            f"<td>{html.escape(str(c.get('queued')))}</td>"
+            f"<td>{html.escape(str(c.get('ewma_s')))}</td>"
+            f"<td>{html.escape(str(c.get('retry_after_hint_s')))}</td></tr>"
+        )
+    placement = s.get("placement") or {}
     return (
         "<h2>check service</h2>"
         "<table style='border:1px solid #ddd'><tr>"
         + cells
         + "</tr></table>"
-        "<p><a href='/queue'>queue JSON</a></p>"
+        "<table style='border:1px solid #ddd;margin-top:6px'>"
+        "<tr><th>class</th><th>queued</th><th>cycle ewma (s)</th>"
+        "<th>retry-after (s)</th></tr>"
+        + class_rows
+        + "</table>"
+        f"<p>placement: {html.escape(str(placement.get('devices', 1)))} "
+        f"device(s){' (lane-sharded)' if placement.get('sharded') else ''}"
+        " — <a href='/queue'>queue JSON</a></p>"
     )
 
 
@@ -439,6 +464,9 @@ class Handler(BaseHTTPRequestHandler):
                     body.get("model", "cas-register"))
                 priority = int(body.get("priority") or 0)
                 client = str(body.get("client") or "http")
+                latency_class = body.get("class")
+                if latency_class is not None:
+                    latency_class = str(latency_class)
                 trace_id = body.get("trace_id")
                 if trace_id is not None:
                     trace_id = str(trace_id)
@@ -457,6 +485,7 @@ class Handler(BaseHTTPRequestHandler):
                 fut = svc.submit(
                     history, model=model, priority=priority,
                     deadline=deadline, client=client, trace_id=trace_id,
+                    class_=latency_class,
                 )
             except (KeyError, TypeError, ValueError, IndexError) as e:
                 # malformed op dicts surface from pack() at admission —

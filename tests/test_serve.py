@@ -71,10 +71,18 @@ def test_trivial_and_untensorizable_fast_paths():
 
 
 def test_priority_orders_batches():
-    """Higher priority runs in the earlier batch; FIFO within a level."""
+    """Higher priority runs in the earlier batch; FIFO within a level.
+    The low-priority pair uses a DIFFERENT padded geometry (the wide
+    shape test_geometry_groups_batch_separately also compiles) so it
+    can't ride the high-priority ladder as rung-boundary joiners —
+    continuous batching deliberately lets geometry-compatible
+    latecomers join mid-ladder (tests/test_serve_sched.py covers
+    that)."""
+    wide = [valid_register_history(30, 12, seed=s, info_rate=0.1)
+            for s in (2, 3)]
     hists = mixed_histories(4)
     svc = sv.CheckService(max_batch=2, **KW)
-    f_low = [svc.submit(hh, priority=0, client="batch") for hh in hists[:2]]
+    f_low = [svc.submit(hh, priority=0, client="batch") for hh in wide]
     f_high = [svc.submit(hh, priority=5, client="interactive") for hh in hists[2:]]
     svc.step()  # batch 1: the two priority-5 requests
     assert all(f.done() for f in f_high)

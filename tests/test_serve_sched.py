@@ -1,0 +1,307 @@
+"""Scheduler-subsystem tests (jepsen_tpu.serve.sched): rung-boundary
+admission into running ladders, latency-class fast path / batch-tier
+isolation, per-class retry-after, mid-ladder drain-with-checkpoint under
+membership churn, and mesh-sharded launch placement.
+
+Kernel shapes are shared with tests/test_parallel.py / test_serve.py —
+(30, 3) register histories at capacity (64, 256), and the suite's
+8-virtual-device mesh — so every launch re-hits runner caches the suite
+already paid to compile (tier-1 is ~780–850 s of the 870 s cap; no new
+compile geometries)."""
+
+import pathlib
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import faults
+from jepsen_tpu import models as m
+from jepsen_tpu import serve as sv
+from jepsen_tpu.checker import elle
+from jepsen_tpu.parallel import batch_analysis, make_mesh
+from jepsen_tpu.serve import sched
+
+#: the suite-shared ladder (same shapes as test_parallel.py).
+KW = dict(capacity=(64, 256), warm_pool=False)
+
+
+def mixed_histories(n=6):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(30, 3, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+class ScriptedFeeder:
+    """A deterministic rung-admission hook: ``waves[k]`` joins at the
+    k-th poll; records every early-demuxed verdict."""
+
+    def __init__(self, waves: dict):
+        self.waves = dict(waves)
+        self.polls = []
+        self.rungs = []
+        self.early: dict = {}
+
+    def poll(self, *, stage, lanes):
+        k = len(self.polls)
+        self.polls.append((stage, lanes))
+        return self.waves.pop(k, [])
+
+    def on_result(self, i, result):
+        self.early[i] = result
+
+    def on_rung(self, **kw):
+        self.rungs.append(kw)
+
+
+def test_rung_admission_verdict_parity():
+    """Histories that JOIN a running ladder at a rung boundary get
+    verdicts identical to a one-shot batch_analysis over the full set
+    (continuous batching changes who shares a launch, never how a
+    history is decided), in admission order, with decided verdicts
+    demuxed early."""
+    hists = mixed_histories(6)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    feeder = ScriptedFeeder({1: hists[4:]})  # join at the second poll
+    got = batch_analysis(
+        m.CASRegister(None), hists[:4], capacity=(64, 256), admission=feeder,
+    )
+    assert len(got) == 6
+    assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
+    # the hook was consulted at every rung boundary, with live lane counts
+    assert len(feeder.polls) >= 2
+    assert all(lanes >= 0 for _s, lanes in feeder.polls)
+    # per-rung occupancy reporting fired for every launched rung
+    assert feeder.rungs and all(
+        0 < r["lanes"] <= r["padded"] for r in feeder.rungs
+    )
+    # early demux handed over decided verdicts that match the return list
+    for i, res in feeder.early.items():
+        assert res["valid?"] == got[i]["valid?"]
+    assert any(r["valid?"] is True for r in got)
+    assert any(r["valid?"] is False for r in got)
+
+
+def test_fastpath_and_batch_tier_isolation():
+    """Interactive requests resolve via the speculative greedy wave
+    (exact True verdicts, no ladder ride); walks that stick escalate to
+    the batch tier and still get the full-ladder verdict.  Per-class
+    accounting keeps the tiers visible separately."""
+    hists = mixed_histories(6)  # indices 2, 5 corrupt
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    svc = sv.CheckService(**KW)
+    futs = [
+        svc.submit(hh, class_="interactive" if i < 4 else "batch")
+        for i, hh in enumerate(hists)
+    ]
+    st = svc.stats()
+    assert st["classes"]["interactive"]["queued"] == 4
+    assert st["classes"]["batch"]["queued"] == 2
+    svc.step()
+    got = [f.result(timeout=30) for f in futs]
+    assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
+    # the three valid interactive histories resolved on the fast path
+    assert [r.get("fastpath") for r in got[:4]] == [
+        "greedy", "greedy", None, "greedy"
+    ]
+    st = svc.stats()
+    assert st["fastpath_resolved"] == 3
+    assert st["escalated"] == 1  # the corrupt interactive one rode the ladder
+    assert svc.get(futs[2].id).describe()["escalated"] is True
+    assert svc.get(futs[0].id).describe()["class"] == "interactive"
+
+
+def test_retry_after_is_computed_per_class():
+    """A queue-full interactive request is quoted in fast-path wave
+    units, a batch one in ladder units — never each other's (the PR 4
+    single-EWMA bug this PR's satellite fixes)."""
+    q = sched.AdmissionQueues(8)
+    q.record_wall("batch", 4.0)        # ladders are slow today
+    q.record_wall("interactive", 0.004)  # waves are not
+    assert q.retry_after("batch", 4) > 0.5
+    assert q.retry_after("interactive", 4) < 0.1
+    # service level: the rejection carries its class and ITS estimate
+    svc = sv.CheckService(max_queue=1, **KW)
+    svc._adm.record_wall("batch", 4.0)
+    svc._adm.record_wall("interactive", 0.004)
+    svc.submit(mixed_histories(1)[0])  # fills the shared queue
+    with pytest.raises(sv.QueueFull) as ei:
+        svc.submit(mixed_histories(2)[1], class_="interactive")
+    assert ei.value.tier == "interactive"
+    assert ei.value.retry_after < 0.1
+    with pytest.raises(sv.QueueFull) as eb:
+        svc.submit(mixed_histories(2)[1], class_="batch")
+    assert eb.value.tier == "batch"
+    assert eb.value.retry_after > 0.5
+    # a dedicated interactive allowance keeps the fast lane admitting
+    # over a batch-full shared queue
+    svc2 = sv.CheckService(max_queue=1, max_interactive_queue=2, **KW)
+    svc2.submit(mixed_histories(1)[0])
+    f = svc2.submit(mixed_histories(2)[1], class_="interactive")
+    assert not f.done()
+    assert svc2.stats()["classes"]["interactive"]["queued"] == 1
+
+
+class _TrippingDeadline(faults.Deadline):
+    """A deadline tripped by the test script, not the clock."""
+
+    def __init__(self):
+        super().__init__(1e9)
+        self.tripped = False
+
+    def expired(self):
+        return self.tripped
+
+
+def test_mid_ladder_drain_with_membership_churn(tmp_path):
+    """Checkpoint/drain a CONTINUOUS ladder mid-flight, after rung
+    admission has grown the member set: the checkpoint covers original
+    members AND joiners (re-fingerprinted over the grown history list,
+    per-member rung cursors saved), and a resume over the full list
+    reproduces the uninterrupted verdicts."""
+    hists = mixed_histories(6)  # 2 and 5 corrupt
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    deadline = _TrippingDeadline()
+    ck = tmp_path / "ck"
+
+    class ChurnThenTrip(ScriptedFeeder):
+        def poll(self, *, stage, lanes):
+            k = len(self.polls)
+            out = super().poll(stage=stage, lanes=lanes)
+            if k >= 1:
+                # joiners are in (poll 1): trip the budget so the NEXT
+                # stage boundary checkpoints a mixed-rung member set
+                deadline.tripped = True
+            return out
+
+    feeder = ChurnThenTrip({1: hists[4:]})
+    got = batch_analysis(
+        m.CASRegister(None), hists[:4], capacity=(64, 256),
+        admission=feeder, checkpoint_dir=ck, deadline=deadline,
+    )
+    assert len(got) == 6
+    unknowns = [i for i, r in enumerate(got) if r["valid?"] == "unknown"]
+    assert unknowns, "the trip should have left unresolved members"
+    assert any(
+        "deadline-exceeded" in got[i].get("cause", "") for i in unknowns
+    )
+    # resume over the GROWN member list (original + joined) finishes the
+    # drained work with verdicts identical to an uninterrupted run
+    resumed = batch_analysis(
+        m.CASRegister(None), hists, capacity=(64, 256),
+        checkpoint_dir=ck, resume=True,
+    )
+    assert [r["valid?"] for r in resumed] == [r["valid?"] for r in direct]
+
+
+def test_mesh_placement_verdict_agreement():
+    """Lane-sharding a packed batch across the suite's 8-virtual-device
+    mesh must not change one verdict (placement is arbitration, not
+    decision) — the sched.assert_parity gate, plus the greedy fast-path
+    wave through parallel.sharded.lane_shard."""
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.parallel import batch
+
+    hists = mixed_histories(6)
+    mesh = make_mesh()  # all 8 virtual devices (same as test_parallel)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    sharded_res = sched.assert_parity(
+        m.CASRegister(None), hists, mesh=mesh, capacity=(64, 256),
+    )
+    assert [r["valid?"] for r in sharded_res] == [
+        r["valid?"] for r in direct
+    ]
+    packs = [wgl.pack(m.CASRegister(None), hh) for hh in hists]
+    flags_single = batch.greedy_fastpath(m.CASRegister(None), packs)
+    flags_mesh = batch.greedy_fastpath(m.CASRegister(None), packs, mesh=mesh)
+    assert flags_single == flags_mesh
+
+
+def test_service_mesh_placement_end_to_end():
+    """A devices=N service serves identical verdicts to a single-device
+    one, reports its placement, and the parity probe passes."""
+    hists = mixed_histories(4)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    svc = sv.CheckService(devices=8, verify_placement=True, **KW)
+    futs = [svc.submit(hh) for hh in hists]
+    svc.step()
+    got = [f.result(timeout=60) for f in futs]
+    assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
+    st = svc.stats()
+    assert st["placement"] == {"devices": 8, "sharded": True}
+    assert svc._parity_checked
+
+
+def test_graph_requests_skip_geometry_buckets():
+    """elle-family checkers are tagged non-geometry-batchable and run on
+    the host side lane: they never occupy a geometry bucket, and ladder
+    work proceeds unaffected in the same cycle."""
+
+    def analyzer(history):
+        n = len(history)
+        rel = np.zeros((n, n), bool)
+        for i in range(n - 1):
+            rel[i, i + 1] = True
+        if n >= 2:
+            rel[n - 1, 0] = True  # a cycle
+        return list(history), {"order": rel}, None
+
+    ck = elle.CycleChecker(analyzer)
+    assert sched.geometry_batchable(ck) is False
+    assert sched.geometry_batchable(object()) is True
+    hists = mixed_histories(2)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    svc = sv.CheckService(**KW)
+    fg = svc.submit(
+        [{"type": "ok", "process": i, "f": "w", "value": i} for i in range(3)],
+        checker=ck,
+    )
+    fl = [svc.submit(hh) for hh in hists]
+    # the graph request shares no geometry bucket with the ladder queue
+    groups = {r.group for q in svc._adm.queues.values() for r in q}
+    assert ("graph", "CycleChecker") in groups
+    svc.step()
+    assert fg.result(timeout=30)["valid?"] is False  # the cycle is found
+    assert [f.result(timeout=30)["valid?"] for f in fl] == [
+        r["valid?"] for r in direct
+    ]
+    st = svc.stats()
+    assert st["graphs"] == 1
+    assert st["batches"] == 1
+    doc = svc.get(fg.id).describe()
+    assert doc["geometry_batchable"] is False
+    assert doc["checker"] == "CycleChecker"
+
+
+def test_continuous_service_coalesces_latecomers():
+    """Requests submitted while a ladder is running join it at rung
+    boundaries (or at worst the next batch): verdict parity holds and
+    the launch count stays far below one-per-caller."""
+    hists = mixed_histories(6)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    svc = sv.CheckService(batch_window_s=0, **KW)
+    futs = [svc.submit(hh) for hh in hists[:3]]
+    stepped = threading.Event()
+
+    def run():
+        stepped.set()
+        while svc.stats()["queue_depth"] or svc.stats()["running"]:
+            svc.step()
+
+    th = threading.Thread(target=run)
+    th.start()
+    stepped.wait(5)
+    futs += [svc.submit(hh) for hh in hists[3:]]
+    th.join(timeout=120)
+    got = [f.result(timeout=30) for f in futs]
+    assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
+    assert svc.stats()["batches"] <= 2  # coalesced, never one-per-caller

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Guard the tier-1 suite's wall-clock budget.
+
+The tier-1 suite (``python -m pytest tests/ -q``) runs under a hard
+870 s cap; the suite already sits at ~780-850 s, so a handful of
+carelessly-added compile geometries can silently blow it.  This script
+turns "recorded suite time" into an exit code so CI fails LOUDLY and
+names the slowest tests instead:
+
+    python -m pytest tests/ | tee tier1.log     # --durations=25 is in
+                                                # pyproject addopts
+    python tools/check_tier1_budget.py tier1.log
+
+It parses pytest's final summary line ("... in 812.34s (0:13:32)")
+and, when the log carries a ``slowest durations`` block, echoes the
+top entries in the failure message so the offender is named in the CI
+output.  ``--seconds`` bypasses log parsing for drivers that timed the
+suite themselves.  Budget: ``--budget`` > ``JEPSEN_TPU_TIER1_BUDGET_S``
+env > 850 (headroom under the 870 s cap).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+DEFAULT_BUDGET_S = 850.0
+
+#: pytest's terminal summary: "= 123 passed, 2 skipped in 812.34s (0:13:32) ="
+_SUMMARY_RE = re.compile(r"\bin (\d+(?:\.\d+)?)s(?: \(\d+:\d+(?::\d+)?\))?\s*=*\s*$")
+#: a "slowest durations" table row: "12.34s call     tests/test_x.py::test_y"
+_DURATION_RE = re.compile(r"^\s*(\d+\.\d+)s\s+(?:call|setup|teardown)\s+(\S+)")
+
+
+def parse_log(text: str) -> tuple[float | None, list[tuple[float, str]]]:
+    """(recorded suite seconds, [(seconds, test id), ...] slowest-first).
+
+    The summary is searched from the end so an embedded sub-pytest run
+    (some tier-1 tests shell out to pytest) can't shadow the real one.
+    """
+    seconds = None
+    for line in reversed(text.splitlines()):
+        m = _SUMMARY_RE.search(line)
+        if m:
+            seconds = float(m.group(1))
+            break
+    durations = [
+        (float(m.group(1)), m.group(2))
+        for line in text.splitlines()
+        if (m := _DURATION_RE.match(line))
+    ]
+    durations.sort(reverse=True)
+    return seconds, durations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", nargs="?", default="-",
+                    help="pytest output to parse ('-'/omitted: stdin)")
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="recorded suite seconds (skips log parsing)")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="budget in seconds (default: "
+                         "$JEPSEN_TPU_TIER1_BUDGET_S or 850)")
+    a = ap.parse_args(argv)
+
+    budget = a.budget
+    if budget is None:
+        budget = float(os.environ.get("JEPSEN_TPU_TIER1_BUDGET_S",
+                                      DEFAULT_BUDGET_S))
+
+    durations: list[tuple[float, str]] = []
+    if a.seconds is not None:
+        seconds = a.seconds
+    else:
+        text = (sys.stdin.read() if a.log == "-"
+                else open(a.log, encoding="utf-8", errors="replace").read())
+        seconds, durations = parse_log(text)
+        if seconds is None:
+            print("check_tier1_budget: no pytest summary line found "
+                  f"in {a.log!r} (did the suite crash?)", file=sys.stderr)
+            return 2
+
+    if seconds <= budget:
+        print(f"tier-1 budget OK: {seconds:.1f}s <= {budget:.0f}s "
+              f"({budget - seconds:.1f}s headroom)")
+        return 0
+
+    print(f"tier-1 BUDGET EXCEEDED: {seconds:.1f}s > {budget:.0f}s",
+          file=sys.stderr)
+    if durations:
+        print("slowest recorded tests:", file=sys.stderr)
+        for secs, test in durations[:10]:
+            print(f"  {secs:8.2f}s  {test}", file=sys.stderr)
+    else:
+        print("(re-run with --durations=25 — tier-1's pyproject addopts "
+              "include it — to see the slowest tests here)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,27 +1,52 @@
 """Load generator for the check-serving subsystem (jepsen_tpu.serve).
 
 Replays generated register histories against a ``CheckService`` at
-configurable concurrency and reports throughput + p50/p95/p99 latency,
-verdict parity against the sequential one-shot ``batch_analysis``
-baseline (what each caller would pay without the service), and the
-backpressure contract (a full queue rejects with retry-after instead of
-buffering unboundedly).
+configurable concurrency and reports throughput + p50/p95/p99 latency
+(PER LATENCY CLASS — interactive-tier SLO stats are reported separately
+from the batch tier), verdict parity against the sequential one-shot
+``batch_analysis`` baseline (what each caller would pay without the
+service), and the backpressure contract (a full queue rejects with
+retry-after instead of buffering unboundedly).
 
-    # the PERF.md acceptance demo (8 concurrent tenants, 32 requests):
-    python tools/loadgen.py --cpu --requests 32 --concurrency 8
+Arrival patterns (the adversarial-load slice of ROADMAP item 5b):
+
+  open     each tenant streams its share then collects (the proxy-in-
+           front-of-many-users shape)
+  closed   one in-flight request per tenant
+  poisson  open arrival on an exponential inter-arrival clock (--rate)
+  burst    alternating full-concurrency bursts and idle gaps
+           (--burst-idle-ms) — the worst case for window-then-launch
+           batching, the motivating case for rung-boundary admission
+  diurnal  poisson with the rate swept sinusoidally between 20% and
+           100% of --rate over the run (a compressed day)
+
+``--size-mix "30:0.8,8:0.2"`` draws each request's history size from a
+weighted ops-count mix; ``--interactive-max-ops N`` submits requests of
+at most N ops with ``class_="interactive"`` (the greedy fast-path
+tier).  ``--min-occupancy`` / ``--slo-interactive-p50-ms`` turn the
+ISSUE's acceptance gates into exit-code assertions:
+
+    # the PR 6 acceptance demo (8 open-arrival tenants; >=96 requests
+    # keeps the queue populated so rung occupancy is measured, not noise):
+    python tools/loadgen.py --cpu --requests 96 --concurrency 8 \\
+        --max-batch 16 --size-mix 30:0.75,8:0.25 --interactive-max-ops 10 \\
+        --min-occupancy 0.8 --slo-interactive-p50-ms 20
 
 Both modes are warmed (one untimed pass each) so the comparison is
 launch-vs-launch, not compile-vs-cache.  Exits 1 on a verdict parity
-mismatch, a missing backpressure rejection, or (service mode) a live
-``/metrics`` scrape whose queue/occupancy/counter series disagree with
-the generator's own request accounting — the observability layer is
-load-tested alongside the thing it observes.
+mismatch, a missing backpressure rejection, a violated SLO/occupancy
+gate, or (service mode) a live ``/metrics`` scrape whose
+queue/occupancy/counter series disagree with the generator's own
+request accounting — the observability layer is load-tested alongside
+the thing it observes.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
+import random
 import sys
 import threading
 import time
@@ -38,6 +63,54 @@ def _pct(xs: list[float], p: float) -> float:
     xs = sorted(xs)
     k = min(len(xs) - 1, max(0, round(p / 100 * (len(xs) - 1))))
     return xs[k]
+
+
+def _parse_size_mix(spec: str) -> list[tuple[int, float]]:
+    """``"30:0.8,8:0.2"`` -> [(30, 0.8), (8, 0.2)] (weights normalized)."""
+    mix = []
+    for part in spec.split(","):
+        ops, _, w = part.partition(":")
+        mix.append((int(ops), float(w or 1.0)))
+    total = sum(w for _, w in mix) or 1.0
+    return [(o, w / total) for o, w in mix]
+
+
+def _draw_sizes(mix: list[tuple[int, float]], n: int, rng: random.Random) -> list[int]:
+    return [
+        rng.choices([o for o, _ in mix], weights=[w for _, w in mix])[0]
+        for _ in range(n)
+    ]
+
+
+def _arrival_schedule(mode: str, n: int, rate: float,
+                      rng: random.Random, *, concurrency: int,
+                      burst_idle_ms: float) -> list[float] | None:
+    """Per-request submit offsets (seconds from load start), or None for
+    the legacy as-fast-as-possible open/closed modes."""
+    if mode in ("open", "closed"):
+        return None
+    t, out = 0.0, []
+    if mode == "poisson":
+        for _ in range(n):
+            t += rng.expovariate(rate)
+            out.append(t)
+    elif mode == "burst":
+        # full-concurrency bursts separated by idle gaps: the pattern
+        # that leaves a window-then-launch scheduler either waiting or
+        # launching half-empty
+        i = 0
+        while i < n:
+            for _ in range(min(concurrency, n - i)):
+                out.append(t)
+                i += 1
+            t += burst_idle_ms / 1000.0
+    else:  # diurnal: sinusoidal rate sweep, 20%..100% of --rate
+        for k in range(n):
+            phase = 2 * math.pi * k / max(1, n)
+            r = rate * (0.6 - 0.4 * math.cos(phase))  # 0.2r .. 1.0r
+            t += rng.expovariate(max(1e-6, r))
+            out.append(t)
+    return out
 
 
 def _parse_prom(text: str) -> dict[str, float]:
@@ -117,12 +190,32 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-window-ms", type=float, default=5.0)
     ap.add_argument("--mode", choices=("both", "service", "sequential"),
                     default="both")
-    ap.add_argument("--arrival", choices=("open", "closed"), default="open",
-                    help="open: each tenant streams its requests then "
-                         "collects (in-flight up to --requests; the proxy-"
-                         "in-front-of-many-users shape). closed: each "
-                         "tenant blocks per request (in-flight capped at "
-                         "--concurrency)")
+    ap.add_argument("--arrival",
+                    choices=("open", "closed", "poisson", "burst", "diurnal"),
+                    default="open",
+                    help="arrival pattern (module docstring): open/closed "
+                         "as-fast-as-possible, or a timed schedule "
+                         "(poisson/burst/diurnal)")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="target arrival rate (req/s) for poisson/diurnal")
+    ap.add_argument("--burst-idle-ms", type=float, default=150.0,
+                    help="idle gap between full-concurrency bursts")
+    ap.add_argument("--size-mix", default=None,
+                    help='weighted ops-count mix, e.g. "30:0.8,8:0.2" '
+                         "(default: every history has --ops ops)")
+    ap.add_argument("--interactive-max-ops", type=int, default=0,
+                    help="requests with at most this many ops submit as "
+                         'class_="interactive" (greedy fast path); 0: all '
+                         "batch tier")
+    ap.add_argument("--min-occupancy", type=float, default=None,
+                    help="exit 1 if the service's continuous (per-rung) "
+                         "occupancy lands below this")
+    ap.add_argument("--slo-interactive-p50-ms", type=float, default=None,
+                    help="exit 1 if the interactive tier's p50 exceeds "
+                         "this many milliseconds")
+    ap.add_argument("--no-continuous", action="store_true",
+                    help="disable rung-boundary admission (A/B against "
+                         "window-then-launch batching)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the conftest dance) — "
@@ -157,17 +250,34 @@ def main(argv=None) -> int:
 
     capacity = tuple(int(c) for c in a.capacity.split(",") if c)
     model = m.CASRegister(None)
+    rng = random.Random(a.seed)
+    mix = _parse_size_mix(a.size_mix) if a.size_mix else [(a.ops, 1.0)]
+    sizes = _draw_sizes(mix, a.requests, rng)
+    classes: list[str | None] = [
+        "interactive"
+        if a.interactive_max_ops and s <= a.interactive_max_ops else None
+        for s in sizes
+    ]
     hists = []
     for i in range(a.requests):
         hh = valid_register_history(
-            a.ops, a.procs, seed=a.seed + i, info_rate=a.info_rate)
-        if a.corrupt_every and i % a.corrupt_every == a.corrupt_every - 1:
+            sizes[i], a.procs, seed=a.seed + i, info_rate=a.info_rate)
+        if (a.corrupt_every and i % a.corrupt_every == a.corrupt_every - 1
+                and classes[i] is None):
+            # corruption stays on the batch tier: the interactive tier's
+            # SLO is defined over small LIKELY-VALID histories
             hh = corrupt(hh, seed=a.seed + i)
         hists.append(hh)
+    schedule = _arrival_schedule(
+        a.arrival, a.requests, a.rate, rng,
+        concurrency=a.concurrency, burst_idle_ms=a.burst_idle_ms,
+    )
 
     out: dict = {
         "requests": a.requests, "concurrency": a.concurrency,
-        "ops": a.ops, "capacity": list(capacity),
+        "ops": sorted(set(sizes)) if a.size_mix else a.ops,
+        "capacity": list(capacity), "arrival": a.arrival,
+        "interactive": sum(c == "interactive" for c in classes),
     }
     rc = 0
     baseline_verdicts = None
@@ -213,6 +323,7 @@ def main(argv=None) -> int:
                 capacity=capacity, max_batch=a.max_batch,
                 max_queue=a.max_queue,
                 batch_window_s=a.batch_window_ms / 1000.0,
+                continuous=not a.no_continuous,
             ).start()
             # Mount the real HTTP app over the service so the load runs
             # with /metrics live — the scrape-vs-accounting consistency
@@ -223,16 +334,38 @@ def main(argv=None) -> int:
             srv_thread.start()
             scraper = MetricsScraper(srv.server_address[1])
             try:
-                # warm pass: same histories, untimed (compile the padded
-                # batch shapes the measured pass will launch)
-                warm = [svc.submit(hh, client="warm") for hh in hists]
+                # warm pass: same histories AND classes, untimed (compile
+                # the padded batch + greedy fast-path shapes the measured
+                # pass will launch)
+                warm = [svc.submit(hh, client="warm", class_=classes[i])
+                        for i, hh in enumerate(hists)]
                 for f in warm:
                     f.result(timeout=600)
-                warm_batches = svc.stats()["batches"]
+                # Quiesce: early demux resolves futures MID-ladder, so a
+                # warm batch can still be finishing (confirm drain, rung
+                # accounting) after every warm future is done — wait it
+                # out so the snapshots below cleanly separate warm from
+                # measured work.
+                t_q = time.perf_counter()
+                while time.perf_counter() - t_q < 60:
+                    st_w = svc.stats()
+                    if not st_w["running"] and not st_w["queue_depth"]:
+                        break
+                    time.sleep(0.005)
+                warm_batches = st_w["batches"]
+                # rung-occupancy accumulators at the warm/measured
+                # boundary: the gate reads the measured-pass DELTA, so
+                # one-off compile rungs (a 2+ s single-lane launch the
+                # first time a shape is seen) don't poison the steady-
+                # state number the SLO is about — same reason both modes
+                # warm untimed ("launch-vs-launch, not compile-vs-cache")
+                warm_lane_s = st_w["rung_lane_s"]
+                warm_slot_s = st_w["rung_slot_s"]
                 scraper.start()  # mid-load /metrics sampling starts here
 
                 verdicts: list = [None] * a.requests
                 lat: list = [0.0] * a.requests
+                done_at: list = [0.0] * a.requests
                 retries = [0]
                 idx_lock = threading.Lock()
                 next_idx = [0]
@@ -241,12 +374,18 @@ def main(argv=None) -> int:
                     t1 = time.perf_counter()
                     while True:
                         try:
-                            f = svc.submit(hists[i], client=f"tenant-{wid}")
+                            f = svc.submit(hists[i], client=f"tenant-{wid}",
+                                           class_=classes[i])
                             break
                         except QueueFull as e:
                             with idx_lock:
                                 retries[0] += 1
                             time.sleep(e.retry_after)
+
+                    def _stamp(fut, i=i):
+                        done_at[i] = time.perf_counter()
+
+                    f.add_done_callback(_stamp)
                     return t1, f
 
                 def worker(wid: int):
@@ -263,14 +402,27 @@ def main(argv=None) -> int:
                             lat[i] = time.perf_counter() - t1
                             verdicts[i] = r["valid?"]
                     else:
-                        # open arrivals: stream this tenant's share, then
-                        # collect — the queue depth is where cross-request
-                        # batching engages
+                        # open arrivals: stream this tenant's share
+                        # (optionally on the timed --arrival schedule),
+                        # then collect — the queue depth is where
+                        # cross-request batching engages, and completion
+                        # times come from the done-callback stamps so
+                        # late collection doesn't inflate latency
                         mine = list(range(wid, a.requests, a.concurrency))
-                        futs = [submit_one(i, wid) for i in mine]
+                        futs = []
+                        for i in mine:
+                            if schedule is not None:
+                                delay = t0 + schedule[i] - time.perf_counter()
+                                if delay > 0:
+                                    time.sleep(delay)
+                            futs.append(submit_one(i, wid))
                         for i, (t1, f) in zip(mine, futs):
                             r = f.result(timeout=600)
-                            lat[i] = time.perf_counter() - t1
+                            # set_result wakes waiters BEFORE running
+                            # done-callbacks, so the stamp can lag this
+                            # wake by a beat — an unstamped completion
+                            # is timed here, at wake (same instant).
+                            lat[i] = (done_at[i] or time.perf_counter()) - t1
                             verdicts[i] = r["valid?"]
 
                 t0 = time.perf_counter()
@@ -283,7 +435,20 @@ def main(argv=None) -> int:
                 for t in threads:
                     t.join()
                 wall = time.perf_counter() - t0
-                st = svc.stats()
+                # Quiesce again before reading stats: the last batch's
+                # rung accounting lands when the LADDER finishes, which
+                # can trail the last future (early demux).
+                t_q = time.perf_counter()
+                while time.perf_counter() - t_q < 60:
+                    st = svc.stats()
+                    if not st["running"] and not st["queue_depth"]:
+                        break
+                    time.sleep(0.005)
+                d_slot = st["rung_slot_s"] - warm_slot_s
+                occ_timed = (
+                    round((st["rung_lane_s"] - warm_lane_s) / d_slot, 4)
+                    if d_slot > 0 else None
+                )
                 out["service"] = {
                     "wall_s": round(wall, 3),
                     "throughput_rps": round(a.requests / wall, 2),
@@ -292,9 +457,46 @@ def main(argv=None) -> int:
                     "p99_s": round(_pct(lat, 99), 4),
                     "batches": st["batches"] - warm_batches,
                     "avg_occupancy": st["avg_occupancy"],
+                    "continuous_occupancy": occ_timed,
+                    "continuous_occupancy_cumulative":
+                        st["continuous_occupancy"],
+                    "fastpath_resolved": st["fastpath_resolved"],
+                    "escalated": st["escalated"],
                     "queue_full_retries": retries[0],
                 }
+                # Per-class SLO stats: the interactive tier's latency is
+                # reported SEPARATELY from the batch tier — one blended
+                # percentile would hide exactly the worst-lane-batch
+                # regression latency classes exist to fix.
+                by_class: dict = {}
+                for i in range(a.requests):
+                    tier = classes[i] or "batch"
+                    by_class.setdefault(tier, []).append(lat[i])
+                out["service"]["classes"] = {
+                    tier: {
+                        "requests": len(xs),
+                        "p50_s": round(_pct(xs, 50), 4),
+                        "p95_s": round(_pct(xs, 95), 4),
+                    }
+                    for tier, xs in sorted(by_class.items())
+                }
                 print(f"service:    {out['service']}")
+                # acceptance gates (ISSUE 6): continuous occupancy and
+                # the interactive tier's p50 SLO
+                if a.min_occupancy is not None:
+                    if occ_timed is None or occ_timed < a.min_occupancy:
+                        print(f"OCCUPANCY BELOW GATE: {occ_timed} < "
+                              f"{a.min_occupancy}", file=sys.stderr)
+                        rc = 1
+                if (a.slo_interactive_p50_ms is not None
+                        and "interactive" in by_class):
+                    p50_ms = _pct(by_class["interactive"], 50) * 1000.0
+                    out["service"]["interactive_p50_ms"] = round(p50_ms, 2)
+                    if p50_ms > a.slo_interactive_p50_ms:
+                        print(f"INTERACTIVE SLO MISS: p50 {p50_ms:.1f}ms > "
+                              f"{a.slo_interactive_p50_ms}ms",
+                              file=sys.stderr)
+                        rc = 1
 
                 # ------------------------------------------------------
                 # /metrics consistency: the scraped series must agree
