@@ -230,6 +230,12 @@ def _cmd_serve(opts) -> int:
         capacity = tuple(
             int(c) for c in str(opts.check_capacity).split(",") if c
         )
+        probe_s = opts.health_probe_s
+        if probe_s == 0:
+            # default: probe only when a mesh exists to probe
+            probe_s = 10.0 if opts.check_devices else None
+        elif probe_s is not None and probe_s < 0:
+            probe_s = None
         svc = CheckService(
             capacity=capacity,
             max_queue=opts.max_queue,
@@ -241,13 +247,22 @@ def _cmd_serve(opts) -> int:
             devices=opts.check_devices,
             verify_placement=opts.verify_placement,
             drain_dir=opts.drain_dir,
+            journal_dir=opts.journal_dir,
+            quarantine_ttl_s=opts.quarantine_ttl,
+            breaker_threshold=opts.breaker_threshold,
+            breaker_cooldown_s=opts.breaker_cooldown,
+            watchdog_factor=opts.launch_watchdog or None,
+            health_probe_every_s=probe_s,
         ).start()
         logger.info(
             "check service up: max_queue=%d max_batch=%d capacity=%s "
-            "continuous=%s devices=%s interactive_max_b=%d",
+            "continuous=%s devices=%s interactive_max_b=%d journal=%s "
+            "breaker=%d watchdog=%s",
             opts.max_queue, opts.max_batch, capacity,
             not opts.no_continuous, opts.check_devices or 1,
-            opts.interactive_max_b,
+            opts.interactive_max_b, opts.journal_dir or "off",
+            opts.breaker_threshold,
+            f"{opts.launch_watchdog}x" if opts.launch_watchdog else "off",
         )
     profiler = None
     if getattr(opts, "profile_dir", None):
@@ -262,7 +277,8 @@ def _cmd_serve(opts) -> int:
             opts.profile_max_seconds,
         )
     web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir,
-              check_service=svc, profiler=profiler)
+              check_service=svc, profiler=profiler,
+              max_request_mb=opts.max_request_mb)
     return EXIT_VALID
 
 
@@ -353,6 +369,41 @@ def run_cli(
                          help="where shutdown checkpoints still-queued "
                               "requests (resume with "
                               "jepsen_tpu.serve.resume_drained)")
+    p_serve.add_argument("--journal-dir", default=None,
+                         help="fsync'd admission journal: every admitted "
+                              "request lands here until it settles, and a "
+                              "restarted service replays the survivors "
+                              "(crash-safe restart; request ids are kept "
+                              "so GET /check/<id> works across the crash)")
+    p_serve.add_argument("--max-request-mb", type=float, default=32.0,
+                         help="POST /check body bound; larger payloads "
+                              "are rejected 413 before the JSON parse "
+                              "(default 32)")
+    p_serve.add_argument("--quarantine-ttl", type=float, default=900.0,
+                         help="seconds a poison history's fingerprint "
+                              "stays quarantined after bisection "
+                              "isolates it (default 900)")
+    p_serve.add_argument("--breaker-threshold", type=int, default=5,
+                         help="consecutive batch failures that open the "
+                              "circuit breaker (503 + Retry-After until "
+                              "the cooldown's half-open probe; default 5)")
+    p_serve.add_argument("--breaker-cooldown", type=float, default=30.0,
+                         help="seconds an open breaker waits before "
+                              "half-opening for a probe batch "
+                              "(default 30)")
+    p_serve.add_argument("--launch-watchdog", type=float, default=16.0,
+                         metavar="FACTOR",
+                         help="hung-launch watchdog: cap each batch's "
+                              "wall clock at FACTOR x the launch-time "
+                              "EWMA and retry a hung launch once on "
+                              "reduced placement (0 disables; default 16)")
+    p_serve.add_argument("--health-probe-s", type=float, default=0,
+                         metavar="SECONDS",
+                         help="mesh device-health probe interval: a "
+                              "failed device shrinks placement to the "
+                              "survivors and re-runs the parity probe "
+                              "(default: 10 when --check-devices is set, "
+                              "else off; negative disables)")
     p_serve.add_argument("--profile-dir", default=None,
                          help="arm the bounded jax.profiler capture hook: "
                               "POST /profile/start (optional {\"seconds\": "

@@ -34,7 +34,17 @@ Env knobs (read per call so tests and operators can adjust live):
 ``INJECT`` is the fault-injection seam: when set to a callable it runs
 as ``INJECT(ctx, attempt)`` before every launch attempt and may raise a
 synthetic fault — tests and tools/chaos_check.py drive OOM/transient
-scenarios through it without monkeypatching kernel internals.
+scenarios through it without monkeypatching kernel internals.  For
+LIVE-service chaos use ``inject_scope`` (thread-safe install/restore,
+composable nesting) with a ``seeded_injector`` (a deterministic
+per-seed fault schedule) instead of assigning ``INJECT`` directly —
+assignment is a process-global mutation two concurrent harnesses would
+clobber.
+
+The serving layer's hung-launch watchdog derives its per-launch
+wall-clock caps from ``launch_seconds_ewma()`` — an EWMA over every
+recorded device-launch wall time, fed by ``record_launch_seconds``
+at the ladder's instrumented launch sites (``parallel.batch._launch``).
 
 Import-light by design (stdlib + obs only): the spawn-based confirmation
 workers and the control layer can import it without dragging in jax.
@@ -42,7 +52,10 @@ workers and the control layer can import it without dragging in jax.
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import os
+import threading
 import time
 from typing import Callable, Mapping
 
@@ -52,6 +65,162 @@ from jepsen_tpu.obs import metrics as _metrics
 #: fault-injection hook: ``INJECT(ctx, attempt)`` runs before each launch
 #: attempt and may raise (classified exactly like a real launch error).
 INJECT: Callable[[dict, int], None] | None = None
+
+#: serializes INJECT install/restore (inject_scope); RLock so a scope
+#: may nest inside another on the same thread.
+_INJECT_LOCK = threading.RLock()
+
+#: the live inject_scope entries, oldest first.  The installed INJECT
+#: hook is REBUILT from this stack on every enter/exit, and an exiting
+#: scope removes only ITS OWN entry — so overlapping scopes on
+#: different threads (the concurrent-harness case) tear down in any
+#: order without disabling each other or resurrecting a dead injector
+#: (a naive save/restore pairing breaks exactly there).
+_INJECT_STACK: list = []
+#: whatever was assigned to INJECT directly before the first scope
+#: entered (legacy call sites); restored when the last scope exits.
+_INJECT_BASE: Callable[[dict, int], None] | None = None
+
+
+def _rebuild_inject() -> None:
+    entries = (
+        [(_INJECT_BASE, True)] if _INJECT_BASE is not None else []
+    ) + list(_INJECT_STACK)
+    start = 0
+    for i, (_fn, comp) in enumerate(entries):
+        if not comp:
+            start = i  # a shadowing scope hides everything before it
+    chain = [fn for fn, _comp in entries[start:]]
+    if not chain:
+        _set_inject(None)
+    elif len(chain) == 1:
+        _set_inject(chain[0])
+    else:
+        def chained(ctx, attempt, _fns=tuple(chain)):
+            for f in _fns:
+                f(ctx, attempt)
+        _set_inject(chained)
+
+
+@contextlib.contextmanager
+def inject_scope(injector: Callable[[dict, int], None], *,
+                 compose: bool = True):
+    """Install a fault injector for the duration of the scope —
+    thread-safe and re-entrant, unlike assigning ``INJECT`` directly.
+
+    With ``compose`` (the default) injectors from enclosing scopes keep
+    running FIRST, then this one: scopes stack, so a chaos harness can
+    layer a poison schedule over a transient/OOM schedule.
+    ``compose=False`` shadows the earlier injectors for the scope
+    instead.  Each exit removes only its own layer and the remaining
+    stack is re-composed — overlapping scopes on different threads may
+    therefore exit in any order, and a pre-scope direct ``INJECT``
+    assignment is restored once the last scope exits (even if a body
+    raises)."""
+    entry = [injector, bool(compose)]  # list: unique identity per enter
+    global _INJECT_BASE
+    with _INJECT_LOCK:
+        if not _INJECT_STACK:
+            _INJECT_BASE = INJECT
+        _INJECT_STACK.append(entry)
+        _rebuild_inject()
+    try:
+        yield injector
+    finally:
+        with _INJECT_LOCK:
+            for i in range(len(_INJECT_STACK) - 1, -1, -1):
+                if _INJECT_STACK[i] is entry:
+                    del _INJECT_STACK[i]
+                    break
+            if not _INJECT_STACK:
+                _set_inject(_INJECT_BASE)
+                _INJECT_BASE = None
+            else:
+                _rebuild_inject()
+
+
+def _set_inject(fn) -> None:
+    global INJECT
+    INJECT = fn
+
+
+def seeded_injector(
+    seed: int,
+    *,
+    transient_rate: float = 0.25,
+    oom_rate: float = 0.15,
+    what: str | None = None,
+) -> Callable[[dict, int], None]:
+    """A DETERMINISTIC randomized fault schedule for ``inject_scope``.
+
+    Decisions are a pure function of ``(seed, ctx identity, attempt)``
+    — a hash, not a shared RNG stream — so the same seed reproduces the
+    same fault plan even when launches interleave across service
+    threads (a shared ``random.Random`` would make the schedule depend
+    on thread timing).  First attempts fail transiently at
+    ``transient_rate`` (retries then succeed: the attempt number is in
+    the hash); multi-lane first attempts OOM at ``oom_rate`` on top
+    (exercising the halving path).  ``what`` restricts the schedule to
+    launch sites whose ctx ``what`` starts with it (e.g. ``"ladder."``
+    keeps service-level seams like ``serve.batch`` clean for a
+    composed poison injector)."""
+
+    def _roll(ctx: Mapping, attempt: int) -> float:
+        key = "|".join((
+            str(seed), str(ctx.get("what")), str(ctx.get("stage")),
+            str(ctx.get("engine")), str(ctx.get("capacity")),
+            str(ctx.get("lanes")), str(attempt),
+        ))
+        h = hashlib.sha256(key.encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def inject(ctx, attempt):
+        if what is not None and not str(ctx.get("what") or "").startswith(what):
+            return
+        if attempt != 0:
+            return  # retries always succeed: the plan tests recovery
+        r = _roll(ctx, attempt)
+        if r < transient_rate:
+            raise RuntimeError(
+                "INTERNAL: injected transient fault (seeded_injector "
+                f"seed={seed})"
+            )
+        if r < transient_rate + oom_rate and int(ctx.get("lanes") or 0) > 1:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected OOM (seeded_injector "
+                f"seed={seed})"
+            )
+
+    return inject
+
+
+#: launch-wall EWMA (record_launch_seconds / launch_seconds_ewma): the
+#: smoothed device-launch wall time the serving layer's hung-launch
+#: watchdog derives its per-launch caps from.  None until the first
+#: launch is recorded.
+_LAUNCH_EWMA_ALPHA = 0.2
+_launch_ewma_s: float | None = None
+_launch_ewma_lock = threading.Lock()
+
+
+def record_launch_seconds(seconds: float) -> None:
+    """Fold one device launch's wall clock into the process-wide launch
+    EWMA (called by the ladder's instrumented launch wrapper)."""
+    global _launch_ewma_s
+    with _launch_ewma_lock:
+        if _launch_ewma_s is None:
+            _launch_ewma_s = float(seconds)
+        else:
+            _launch_ewma_s = (
+                (1 - _LAUNCH_EWMA_ALPHA) * _launch_ewma_s
+                + _LAUNCH_EWMA_ALPHA * float(seconds)
+            )
+
+
+def launch_seconds_ewma() -> float | None:
+    """The smoothed per-launch wall clock (None before any launch)."""
+    with _launch_ewma_lock:
+        return _launch_ewma_s
 
 #: substrings that mark an exception as out-of-memory (halve, don't retry
 #: the same shape — the same launch would OOM again).

@@ -22,6 +22,20 @@ Two feeds populate it:
     (``inc``/``set_gauge``/``observe`` below, gated on the same MIRROR
     flag so a library user who never serves pays nothing).
 
+The self-healing layer (``jepsen_tpu.serve.health``) feeds its own
+``serve_*`` fault series through here (some via the obs mirror, some
+explicit): ``serve_quarantined_total`` /
+``serve_quarantine_hit_total`` / ``serve_poison_isolated_total`` /
+``serve_poison_bisect_launches_total`` (poison quarantine),
+``serve_breaker_rejected_total`` / ``serve_breaker_opened_total`` and the
+``serve_breaker_open`` gauge (circuit breaker),
+``serve_watchdog_trips_total`` (hung-launch watchdog),
+``serve_devices_lost_total`` + the ``serve_placement_devices`` gauge
+(device-loss re-placement), ``serve_journal_replayed_total`` (crash-safe
+restart), and ``serve_drain_errors_total`` /
+``serve_placement_probe_errors_total`` (previously-swallowed drain and
+parity-probe failures, now counted).
+
 Import-light by design (stdlib only — obs and faults import this
 module, and both must stay jax-free).  Everything is thread-safe; label
 sets are expected to be tiny (verdict, fault kind), never unbounded

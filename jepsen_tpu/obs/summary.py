@@ -277,7 +277,11 @@ def summarize(events: Iterable[Mapping]) -> dict:
             for tier, sc in sorted(serve_class.items())
         }
     for cname in ("submitted", "completed", "rejected", "expired", "drained",
-                  "fastpath_resolved", "fastpath_escalated"):
+                  "fastpath_resolved", "fastpath_escalated",
+                  # self-healing layer (serve.health)
+                  "quarantined", "quarantine_hit", "breaker_rejected",
+                  "breaker_opened", "watchdog_trip", "journal_replayed",
+                  "placement_replaced", "drain_error"):
         if f"serve.{cname}" in counters:
             serve[cname] = counters[f"serve.{cname}"]
     return {

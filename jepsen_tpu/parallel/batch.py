@@ -594,6 +594,9 @@ def batch_analysis(
             t0 = time.perf_counter()
             out = _launch_impl(st_engine, batch_cap, sub, sub_resumes, pad_to)
             dt = time.perf_counter() - t0
+            # Feed the process launch-time EWMA the serving layer's
+            # hung-launch watchdog derives its wall-clock caps from.
+            faults.record_launch_seconds(dt)
             key = launch_acc.pop("_key", None)
             compiled = key is not None and key not in _SEEN_SHAPES
             if key is not None:

@@ -242,6 +242,20 @@ def lane_shard(fn, mesh: Mesh, *, n_args: int, replicated: Sequence[int] = (),
     return _LANE_SHARDED[key]
 
 
+def forget_mesh(mesh: Mesh) -> int:
+    """Evict every cached runner compiled for ``mesh`` (device-loss
+    re-placement: a shrunk-away mesh's compiled wrappers pin references
+    to the lost devices and could never launch again anyway).  Returns
+    the number of cache entries dropped."""
+    dead = [k for k in _LANE_SHARDED if any(v is mesh for v in k)]
+    for k in dead:
+        del _LANE_SHARDED[k]
+    dead_r = [k for k in _SHARDED_RUNNERS if any(v is mesh for v in k)]
+    for k in dead_r:
+        del _SHARDED_RUNNERS[k]
+    return len(dead) + len(dead_r)
+
+
 def _sharded_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int):
     axis = mesh.axis_names[0]
     D = mesh.devices.size

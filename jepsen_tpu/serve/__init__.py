@@ -29,12 +29,22 @@ admission into running ladders via ``batch_analysis(admission=...)``),
 and mesh-sharded launch placement (``devices=N`` /
 ``verify_placement``).
 
+Self-healing is delegated to ``jepsen_tpu.serve.health`` (PR 7):
+poison-request quarantine (bisect a non-transiently failing shared
+launch, quarantine the poison member by history fingerprint), a
+circuit breaker (K consecutive batch failures → 503 + retry-after,
+half-open probe), a hung-launch watchdog (EWMA-derived wall-clock
+caps, cancel-and-retry on reduced placement), device-loss re-placement
+(mesh health probes, shrink to survivors + parity re-probe), and a
+crash-safe fsync'd admission journal replayed by ``start()``.
+
 Exposure: this Python API (``submit(history, ...) -> Future[verdict]``),
 the HTTP API mounted into ``jepsen_tpu.web`` (``POST /check``,
-``GET /check/<id>``, ``GET /queue``), and ``jepsen-tpu serve --check``.
+``GET /check/<id>``, ``GET /queue``, ``GET /healthz``, ``GET
+/readyz``), and ``jepsen-tpu serve --check``.
 """
 
-from jepsen_tpu.serve import sched
+from jepsen_tpu.serve import health, sched
 from jepsen_tpu.serve.service import (
     MODELS,
     CheckFuture,
@@ -42,6 +52,7 @@ from jepsen_tpu.serve.service import (
     CheckService,
     QueueFull,
     ServiceClosed,
+    ServiceUnavailable,
     model_by_name,
     resume_drained,
 )
@@ -53,6 +64,8 @@ __all__ = [
     "CheckService",
     "QueueFull",
     "ServiceClosed",
+    "ServiceUnavailable",
+    "health",
     "model_by_name",
     "resume_drained",
     "sched",

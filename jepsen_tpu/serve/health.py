@@ -1,0 +1,426 @@
+"""Self-healing for the check service: blast-radius isolation primitives.
+
+PRs 4–6 made checking a long-lived multi-tenant service; sharing a
+launch also shares its failures.  This module is the policy layer that
+keeps one bad input, one lost device, or one wedged launch from
+degrading everyone else — four pillars, composed by
+``serve.service.CheckService``:
+
+  * **Poison quarantine** (``bisect_poison`` + ``Quarantine``) — when a
+    shared ``batch_analysis`` launch fails NON-transiently (transient
+    and OOM faults are already retried/halved inside the ladder by
+    ``jepsen_tpu.faults``), the member set is bisected with bounded
+    relaunches: innocent members get their real verdicts from the
+    succeeding halves, and the member(s) whose presence makes launches
+    fail are quarantined — unknown verdict with the cause, plus a
+    TTL'd registry entry keyed by history fingerprint so a repeat
+    offender skips straight to rejection instead of poisoning another
+    shared launch.  Isolating a single poison member costs O(log n)
+    relaunches.
+  * **Circuit breaker** (``CircuitBreaker``) — K consecutive batch
+    failures open the breaker: admission returns 503 + retry-after
+    instead of queueing work the device can't serve; after a cooldown
+    the breaker half-opens and one probe batch decides whether to
+    close it again.
+  * **Hung-launch watchdog** (``LaunchWatchdog``) — per-launch
+    wall-clock caps derived from the EWMA of recorded launch times
+    (``faults.launch_seconds_ewma``, fed by ``parallel.batch._launch``);
+    a launch that exceeds its cap raises ``HungLaunch`` so the service
+    can cancel (abandon — first-write-wins result demux discards the
+    zombie's late verdicts) and retry on reduced placement.
+  * **Crash-safe restart** (``AdmissionJournal``) — an fsync'd journal
+    of admitted-but-unfinished requests (``store._atomic_write``, one
+    file per request in the drain-dir format) replayed by
+    ``CheckService.start()``: a service crash loses no admitted
+    request, and replayed requests keep their ids so ``GET
+    /check/<id>`` keeps working across the restart.
+
+Nothing here decides verdicts: quarantine and watchdog degradation
+resolve only to attributable ``unknown``s, never to a flipped verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Sequence
+
+from jepsen_tpu import store
+from jepsen_tpu.store import checkpoint as _ckpt
+
+logger = logging.getLogger(__name__)
+
+
+def history_fingerprint(history) -> str:
+    """The quarantine/journal identity of one history (the same sha256
+    the checkpoint layer uses, over a single-history list)."""
+    return _ckpt.fingerprint([history])
+
+
+# ---------------------------------------------------------------------------
+# Poison quarantine
+# ---------------------------------------------------------------------------
+
+class Quarantine:
+    """A TTL'd registry of poison-history fingerprints.
+
+    ``add`` records a fingerprint with its cause; ``check`` returns the
+    live entry (or None) so admission can reject a repeat offender
+    before it reaches a shared launch.  Entries expire after ``ttl_s``
+    — a poison verdict is evidence, not a life sentence (the failure
+    may have been environmental) — and expired entries are purged
+    lazily on access.  Thread-safe."""
+
+    def __init__(self, ttl_s: float = 900.0):
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        #: fp -> {"cause", "expires", "hits", "added"}
+        self._entries: dict[str, dict] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._purge_locked()
+            return len(self._entries)
+
+    def _purge_locked(self) -> None:
+        now = time.monotonic()
+        dead = [fp for fp, e in self._entries.items() if e["expires"] <= now]
+        for fp in dead:
+            del self._entries[fp]
+
+    def add(self, fp: str, cause: str) -> None:
+        with self._lock:
+            self._purge_locked()
+            self._entries[fp] = {
+                "cause": str(cause)[:300],
+                "expires": time.monotonic() + self.ttl_s,
+                "hits": 0,
+                "added": time.time(),
+            }
+
+    def check(self, fp: str) -> dict | None:
+        """The live entry for ``fp`` (hit-counted), or None.  A hit
+        refreshes the TTL — a fingerprint still being submitted is
+        still worth remembering."""
+        with self._lock:
+            self._purge_locked()
+            e = self._entries.get(fp)
+            if e is not None:
+                e["hits"] += 1
+                e["expires"] = time.monotonic() + self.ttl_s
+            return e
+
+    def describe(self) -> dict:
+        with self._lock:
+            self._purge_locked()
+            return {
+                "entries": len(self._entries),
+                "ttl_s": self.ttl_s,
+                "hits": sum(e["hits"] for e in self._entries.values()),
+            }
+
+
+def bisect_launch_budget(n: int) -> int:
+    """The relaunch budget ``bisect_poison`` defaults to: enough to
+    isolate one poison member among ``n`` — both bisection paths at
+    every level, ~2·(log2(n)+1) — with one extra level of slack for a
+    second offender before the remainder is quarantined as a group."""
+    levels = max(1, math.ceil(math.log2(max(2, n)))) + 1
+    return 3 * levels
+
+
+def bisect_poison(
+    launch: Callable[[list], list],
+    members: Sequence,
+    *,
+    max_launches: int | None = None,
+) -> tuple[list, dict, int]:
+    """Isolate the poison member(s) of a failed shared launch.
+
+    ``launch(subset)`` re-runs the shared work over ``subset`` and
+    returns one result per member (or raises — the failure signature
+    being bisected).  Returns ``(poison, results, launches)``: the
+    members whose presence makes launches fail, a ``{member: result}``
+    map for every innocent member (their REAL verdicts, recovered from
+    the succeeding halves), and the relaunch count.
+
+    Classic group testing: a failing group of one is poison; a failing
+    group of many splits in half and recurses.  A single poison member
+    among n costs O(log n) relaunches.  ``max_launches`` (default
+    ``bisect_launch_budget(n)``) bounds the degradation: when the
+    budget runs out, the still-unresolved group is quarantined TOGETHER
+    (conservative — innocents in it degrade to unknown, never to a
+    wrong verdict)."""
+    members = list(members)
+    budget = (
+        bisect_launch_budget(len(members))
+        if max_launches is None else int(max_launches)
+    )
+    poison: list = []
+    results: dict = {}
+    launches = 0
+    stack: list[list] = [members]
+    while stack:
+        group = stack.pop()
+        if not group:
+            continue
+        if launches >= budget:
+            # Budget exhausted: quarantine the rest as a group rather
+            # than launch forever against a pathological failure mix.
+            poison.extend(group)
+            continue
+        launches += 1
+        try:
+            out = launch(list(group))
+        except Exception:  # noqa: BLE001 — the signature being bisected
+            if len(group) == 1:
+                poison.append(group[0])
+            else:
+                mid = (len(group) + 1) // 2
+                # push the back half first so the front half (older
+                # members) is served next — deterministic order
+                stack.append(group[mid:])
+                stack.append(group[:mid])
+            continue
+        for mem, res in zip(group, out):
+            results[mem] = res
+    return poison, results, launches
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Closed → (K consecutive failures) → open → (cooldown) →
+    half-open → one probe success closes / failure re-opens.
+
+    ``allow()`` is the admission gate: False means reject now (the HTTP
+    layer returns 503 + Retry-After ``retry_after()``).  While OPEN the
+    gate stays shut until ``cooldown_s`` elapses; the first ``allow()``
+    after that transitions to HALF-OPEN and admits exactly ONE probe —
+    further ``allow()`` calls stay rejected until a batch outcome is
+    recorded, so a retry stampede at cooldown expiry can't refill the
+    queue with doomed work against a still-broken device.  Thread-safe;
+    the owning service calls ``record_failure``/``record_success`` per
+    batch outcome."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self.opened_at: float | None = None
+        self.opens = 0
+        self._probe_budget = 0  # half-open admissions left before outcome
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == "open":
+                if (time.monotonic() - self.opened_at) >= self.cooldown_s:
+                    self.state = "half-open"
+                    self._probe_budget = 1
+            if self.state == "half-open":
+                if self._probe_budget > 0:
+                    self._probe_budget -= 1
+                    return True
+                return False
+            return self.state == "closed"
+
+    def retry_after(self) -> float:
+        with self._lock:
+            if self.state == "half-open":
+                # a probe is in flight; its outcome decides shortly
+                return 0.5
+            if self.state != "open" or self.opened_at is None:
+                return 0.0
+            return max(
+                0.0, self.cooldown_s - (time.monotonic() - self.opened_at)
+            )
+
+    def record_failure(self) -> bool:
+        """One batch failed; returns True when THIS failure opened (or
+        re-opened) the breaker."""
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == "half-open" or (
+                self.state == "closed"
+                and self.consecutive_failures >= self.threshold
+            ):
+                self.state = "open"
+                self.opened_at = time.monotonic()
+                self.opens += 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state in ("half-open", "open"):
+                # an open breaker can see a success when a probe batch
+                # admitted just before the trip completes late — either
+                # way the device demonstrably serves again
+                self.state = "closed"
+                self.opened_at = None
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "opens": self.opens,
+                "retry_after_s": round(
+                    max(0.0, self.cooldown_s
+                        - (time.monotonic() - self.opened_at))
+                    if self.state == "open" and self.opened_at is not None
+                    else 0.0, 3),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Hung-launch watchdog
+# ---------------------------------------------------------------------------
+
+class HungLaunch(Exception):
+    """A watched launch exceeded its wall-clock cap.  The worker thread
+    may STILL be running (jax launches aren't interruptible from
+    Python) — the caller abandons it and retries on reduced placement;
+    first-write-wins result demux discards the zombie's late output."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        super().__init__(f"launch exceeded its {timeout_s:.1f}s watchdog cap")
+
+
+class LaunchWatchdog:
+    """Per-launch wall-clock caps derived from the launch-time EWMA.
+
+    ``timeout_s()`` is ``factor ×`` the process launch EWMA
+    (``faults.launch_seconds_ewma``), clamped to ``[floor_s, cap_s]`` —
+    a healthy ladder's launches are milliseconds-to-seconds, so a
+    multi-minute one is wedged, not slow.  ``run(fn)`` executes ``fn``
+    on a daemon worker thread and raises ``HungLaunch`` when the cap
+    passes first."""
+
+    def __init__(self, factor: float = 16.0, floor_s: float = 30.0,
+                 cap_s: float = 600.0):
+        self.factor = float(factor)
+        self.floor_s = float(floor_s)
+        self.cap_s = float(cap_s)
+        self.trips = 0
+
+    def timeout_s(self) -> float:
+        from jepsen_tpu import faults
+
+        ewma = faults.launch_seconds_ewma()
+        t = self.factor * ewma if ewma is not None else self.floor_s
+        return min(self.cap_s, max(self.floor_s, t))
+
+    def run(self, fn: Callable[[], object], timeout_s: float | None = None):
+        """``fn()``'s result, or ``HungLaunch`` after the cap.  ``fn``'s
+        own exception re-raises on this thread."""
+        timeout_s = self.timeout_s() if timeout_s is None else float(timeout_s)
+        box: dict = {}
+        done = threading.Event()
+
+        def _work():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_work, name="launch-watchdog-worker", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout_s):
+            self.trips += 1
+            raise HungLaunch(timeout_s)
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe admission journal
+# ---------------------------------------------------------------------------
+
+class AdmissionJournal:
+    """An fsync'd record of admitted-but-unfinished requests.
+
+    One JSON file per request (``store._atomic_write``: tmp + fsync +
+    rename + dir fsync — the same durability contract checkpoints
+    ride), in the drain-dir format (model name + history + request
+    identity) so ``replay()`` can rebuild the exact submission.
+    ``record`` on admission, ``resolve`` when the request settles (any
+    terminal status — done, expired, quarantined, drained); whatever
+    files remain after a crash ARE the lost queue, replayed by
+    ``CheckService.start()``.  Write failures are counted and logged,
+    never raised into admission — journaling is a recovery aid, not an
+    admission gate."""
+
+    def __init__(self, journal_dir: str | Path):
+        self.dir = Path(journal_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.errors = 0
+
+    def _path(self, req_id: str) -> Path:
+        return self.dir / f"req-{req_id}.json"
+
+    def record(self, *, req_id: str, seq: int, model_name: str, history,
+               priority: int, client: str, tier: str,
+               trace_id: str, deadline_s: float | None) -> bool:
+        entry = {
+            "id": req_id, "seq": int(seq), "model": model_name,
+            "history": store._jsonable(list(history)),
+            "priority": int(priority), "client": str(client),
+            "class": str(tier), "trace_id": str(trace_id),
+            "deadline_s": deadline_s,
+        }
+        try:
+            store._atomic_write(
+                self._path(req_id), json.dumps(entry, default=str)
+            )
+            return True
+        except Exception:  # noqa: BLE001 — see docstring
+            self.errors += 1
+            logger.warning("admission journal write failed for %s",
+                           req_id, exc_info=True)
+            return False
+
+    def resolve(self, req_id: str) -> None:
+        try:
+            self._path(req_id).unlink(missing_ok=True)
+        except OSError:
+            self.errors += 1
+            logger.warning("admission journal unlink failed for %s",
+                           req_id, exc_info=True)
+
+    def depth(self) -> int:
+        try:
+            return sum(1 for _ in self.dir.glob("req-*.json"))
+        except OSError:
+            return 0
+
+    def replay(self) -> list[dict]:
+        """Every surviving entry, in admission (seq) order.  Unreadable
+        files are counted and skipped — a torn write can't exist
+        (atomic rename), but an operator hand-editing the dir can."""
+        out = []
+        for p in sorted(self.dir.glob("req-*.json")):
+            try:
+                out.append(json.loads(p.read_text()))
+            except (OSError, ValueError):
+                self.errors += 1
+                logger.warning("unreadable journal entry %s; skipping", p)
+        out.sort(key=lambda e: e.get("seq", 0))
+        return out

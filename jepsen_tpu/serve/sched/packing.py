@@ -91,6 +91,19 @@ class RungFeeder:
         self.slot_sum = 0.0
         self.joined = 0
         self.t_start = time.monotonic()
+        #: a closed feeder admits no more joiners — flipped by the
+        #: service when the ladder must drain (hung-launch abandonment:
+        #: the zombie thread's polls must not pull queued requests into
+        #: a ladder nobody will settle; device-loss re-placement: the
+        #: mesh is changing under it).
+        self.closed = False
+        #: the placement generation this ladder launched under; a
+        #: mid-ladder device-loss shrink bumps the service's counter
+        #: and the mismatch closes the feeder at the next poll.
+        self.placement_gen = service._placement.generation
+
+    def close(self) -> None:
+        self.closed = True
 
     # -- the batch_analysis hook protocol ---------------------------------
 

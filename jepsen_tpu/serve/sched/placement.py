@@ -20,6 +20,7 @@ the test suite.
 from __future__ import annotations
 
 import logging
+from typing import Sequence
 
 from jepsen_tpu import obs
 
@@ -46,6 +47,11 @@ class Placement:
             raise TypeError("pass devices= or mesh=, not both")
         self.devices = int(devices) if devices is not None else None
         self._mesh = mesh
+        #: bumped by every shrink_to — running ladders compare it to
+        #: the generation they launched under and drain on mismatch.
+        self.generation = 0
+        #: devices removed by shrink_to (operator-visible in describe).
+        self.lost: list = []
 
     @property
     def mesh(self):
@@ -68,8 +74,69 @@ class Placement:
             tier=tier, sharded=self.mesh is not None,
         )
 
+    def probe(self) -> tuple[list, list]:
+        """Health-probe every mesh device with a tiny round-trip op;
+        returns ``(healthy, failed)`` device lists.  Device loss on a
+        real chip surfaces as the put/readback raising — and the
+        ``faults.INJECT`` seam runs first with
+        ``{"what": "placement.probe", "device": i}`` so chaos harnesses
+        can fail a virtual device deterministically."""
+        import numpy as np
+
+        import jax
+
+        from jepsen_tpu import faults
+
+        m = self.mesh
+        if m is None:
+            return [], []
+        healthy, failed = [], []
+        for i, dev in enumerate(m.devices.ravel().tolist()):
+            try:
+                hook = faults.INJECT
+                if hook is not None:
+                    hook({"what": "placement.probe", "device": i}, 0)
+                x = jax.device_put(np.int32(1), dev)
+                if int(jax.device_get(x)) != 1:
+                    raise RuntimeError("device readback mismatch")
+                healthy.append(dev)
+            except Exception:  # noqa: BLE001 — a failing device is the
+                # condition being probed for, whatever the exception
+                logger.warning("device %s failed its health probe",
+                               dev, exc_info=True)
+                failed.append(dev)
+        return healthy, failed
+
+    def shrink_to(self, devices: Sequence) -> None:
+        """Re-place onto the surviving devices (device-loss recovery):
+        rebuild the 1-D mesh over ``devices``, bump the generation so
+        running ladders drain, and evict the dead mesh's compiled
+        lane-shard kernels (they hold references to lost devices)."""
+        import numpy as np
+
+        from jepsen_tpu.parallel import sharded
+        from jax.sharding import Mesh
+
+        old = self._mesh
+        axis = old.axis_names[0] if old is not None else "histories"
+        self.lost.extend(
+            d for d in (old.devices.ravel().tolist() if old is not None
+                        else [])
+            if d not in devices
+        )
+        self._mesh = Mesh(np.array(list(devices)), (axis,))
+        self.devices = len(devices)
+        self.generation += 1
+        if old is not None:
+            sharded.forget_mesh(old)
+
     def describe(self) -> dict:
-        return {"devices": self.n_devices, "sharded": self.mesh is not None}
+        return {
+            "devices": self.n_devices,
+            "sharded": self.mesh is not None,
+            **({"lost_devices": len(self.lost),
+                "generation": self.generation} if self.generation else {}),
+        }
 
 
 def assert_parity(model, histories, *, mesh, capacity=(64, 256), **opts) -> list[dict]:

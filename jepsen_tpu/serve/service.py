@@ -60,6 +60,7 @@ from typing import Mapping, Sequence
 from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
 from jepsen_tpu.obs import metrics
+from jepsen_tpu.serve import health as _health
 from jepsen_tpu.serve.sched import admission as _sched_adm
 from jepsen_tpu.serve.sched import packing as _sched_pack
 from jepsen_tpu.serve.sched import placement as _sched_place
@@ -129,6 +130,20 @@ class ServiceClosed(Exception):
     """Submit after shutdown began: the service no longer admits work."""
 
 
+class ServiceUnavailable(Exception):
+    """Admission rejected: the circuit breaker is open (K consecutive
+    batch failures).  ``retry_after`` is the breaker cooldown remainder
+    — the HTTP layer maps this to 503 + Retry-After, distinct from the
+    backpressure 429 (the queue has room; the DEVICE is the problem)."""
+
+    def __init__(self, retry_after: float):
+        self.retry_after = retry_after
+        super().__init__(
+            "check service circuit breaker is open; retry after "
+            f"~{retry_after:.1f}s"
+        )
+
+
 class CheckFuture(Future):
     """The verdict future ``submit`` returns; resolves to the same
     knossos-shaped result dict ``batch_analysis`` produces.  ``id`` keys
@@ -143,13 +158,17 @@ class CheckRequest:
     __slots__ = (
         "id", "seq", "model", "history", "priority", "deadline", "client",
         "group", "future", "status", "result", "t_submit", "t_done",
-        "trace_id", "ctx", "tier", "kind", "checker", "escalated",
+        "trace_id", "ctx", "tier", "kind", "checker", "escalated", "fp",
     )
 
     def __init__(self, *, seq, model, history, priority, deadline, client,
                  group, trace_id=None, tier="batch", kind="ladder",
-                 checker=None):
-        self.id = uuid.uuid4().hex[:12]
+                 checker=None, request_id=None, fp=None):
+        # ``request_id`` preserves identity across a crash-safe restart
+        # (journal replay): GET /check/<id> keeps working after the
+        # process that minted the id died.
+        self.id = request_id or uuid.uuid4().hex[:12]
+        self.fp = fp  # history fingerprint (quarantine/journal identity)
         self.seq = seq
         self.model = model
         self.history = history
@@ -239,7 +258,23 @@ class CheckService:
     ``start()`` spawns the scheduler thread (and pre-forks the
     confirmation worker pool, so the first confirmed-unknown request
     doesn't eat pool fork latency); tests drive ``step()`` directly for
-    deterministic single-batch control."""
+    deterministic single-batch control.
+
+    Self-healing (``serve.health``): a non-transiently failing shared
+    launch is BISECTED (``poison_bisect``, default on) so only the
+    poison member(s) degrade — they land in a TTL'd quarantine registry
+    (``quarantine_ttl_s``) keyed by history fingerprint and repeat
+    offenders resolve unknown at admission without touching a launch;
+    ``breaker_threshold`` consecutive batch failures open a circuit
+    breaker (submit raises ``ServiceUnavailable`` → HTTP 503 +
+    Retry-After; after ``breaker_cooldown_s`` one probe batch half-opens
+    it); ``watchdog_factor`` (None: off) caps each batch's wall clock at
+    ``factor ×`` the launch-time EWMA (clamped to
+    ``[watchdog_floor_s, watchdog_cap_s]``) and retries a hung launch
+    once on reduced placement; ``journal_dir`` (None: off) keeps an
+    fsync'd admission journal replayed by ``start()`` after a crash;
+    ``health_probe_every_s`` (None: off) probes the mesh's devices and
+    shrinks placement to the survivors when one fails."""
 
     def __init__(
         self,
@@ -256,6 +291,15 @@ class CheckService:
         verify_placement: bool = False,
         warm_pool: bool = True,
         drain_dir: str | Path | None = None,
+        journal_dir: str | Path | None = None,
+        quarantine_ttl_s: float = 900.0,
+        poison_bisect: bool = True,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 30.0,
+        watchdog_factor: float | None = None,
+        watchdog_floor_s: float = 30.0,
+        watchdog_cap_s: float = 600.0,
+        health_probe_every_s: float | None = None,
         **check_opts,
     ):
         for k in ("capacity", "mesh", "deadline", "checkpoint_dir", "resume",
@@ -295,7 +339,30 @@ class CheckService:
             "submitted": 0, "completed": 0, "rejected": 0, "expired": 0,
             "drained": 0, "batches": 0, "batch_errors": 0,
             "fastpath_resolved": 0, "escalated": 0, "graphs": 0,
+            "quarantined": 0, "poison_isolated": 0, "bisect_launches": 0,
+            "watchdog_trips": 0, "journal_replayed": 0,
+            "devices_replaced": 0, "breaker_rejected": 0, "drain_errors": 0,
         }
+        # -- the self-healing layer (serve.health) ----------------------
+        self.quarantine = _health.Quarantine(ttl_s=quarantine_ttl_s)
+        self.poison_bisect = bool(poison_bisect)
+        self.breaker = _health.CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s
+        )
+        self._watchdog = (
+            _health.LaunchWatchdog(
+                factor=watchdog_factor, floor_s=watchdog_floor_s,
+                cap_s=watchdog_cap_s,
+            )
+            if watchdog_factor else None
+        )
+        self.journal = (
+            _health.AdmissionJournal(journal_dir)
+            if journal_dir is not None else None
+        )
+        self.health_probe_every_s = health_probe_every_s
+        self._t_probe = 0.0
+        self._recovered = False
         self._occ_sum = 0.0     # per-batch occupancy accumulator
         #: continuous-occupancy accumulators: live lane-seconds over
         #: launched lane-slot-seconds across every rung — the
@@ -362,6 +429,60 @@ class CheckService:
                 f"unknown latency class {class_!r}; expected one of "
                 f"{_sched_adm.CLASSES}"
             )
+        if not self.breaker.allow():
+            # The breaker gates ADMISSION, not the queue: K consecutive
+            # batch failures mean the device isn't serving — queueing
+            # more work would only grow the blast radius.  503-shaped,
+            # with the cooldown remainder as the retry hint.
+            with self._lock:
+                self._totals["breaker_rejected"] += 1
+            obs.counter("serve.breaker_rejected", client=client)
+            raise ServiceUnavailable(self.breaker.retry_after())
+        fp = None
+        if checker is None:
+            fp = _health.history_fingerprint(history)
+            q = self.quarantine.check(fp)
+            if q is not None:
+                # Repeat offender: skip straight to rejection — this
+                # fingerprint already poisoned a shared launch, and the
+                # registry entry is still live.  Resolved as an
+                # attributable unknown (never queued, never packed), so
+                # the caller learns WHY without costing anyone else a
+                # bisection.
+                req = CheckRequest(
+                    seq=next(self._seq), model=model, history=history,
+                    priority=priority, deadline=deadline, client=client,
+                    group=None, trace_id=trace_id,
+                    tier=class_ or "batch", fp=fp,
+                )
+                with self._lock:
+                    if self._closed:
+                        raise ServiceClosed(
+                            "check service is shutting down")
+                    self._totals["submitted"] += 1
+                    self._totals["completed"] += 1
+                    self._totals["quarantined"] += 1
+                    self._remember(req)
+                with obs.attach(req.ctx):
+                    obs.counter("serve.submitted", client=client,
+                                tier=req.tier)
+                    obs.counter("serve.quarantine_hit", client=client)
+                    obs.counter("serve.completed")
+                metrics.inc("serve.verdicts", verdict="unknown")
+                req.resolve(
+                    {
+                        "valid?": "unknown",
+                        "quarantined": True,
+                        "cause": (
+                            "quarantined history (repeat poison "
+                            f"offender): {q['cause']}"
+                        ),
+                    },
+                    status="quarantined",
+                )
+                dt = time.monotonic() - req.t_submit
+                metrics.observe("serve.request_latency_seconds", dt)
+                return req.future
         #: the tier used for the pre-pack depth check; auto-routing can
         #: only move a request INTO the interactive tier after packing,
         #: and only when that tier has room (checked again below).
@@ -433,8 +554,21 @@ class CheckService:
                 seq=next(self._seq), model=model, history=history,
                 priority=priority, deadline=deadline, client=client,
                 group=group, trace_id=trace_id, tier=tier, kind=kind,
-                checker=checker,
+                checker=checker, fp=fp,
             )
+            if (self.journal is not None and kind == "ladder"
+                    and group is not None):
+                # Journal BEFORE the queue push: a crash between the
+                # two replays a request nobody queued (harmless — it
+                # just runs) instead of losing one somebody admitted.
+                self.journal.record(
+                    req_id=req.id, seq=req.seq, model_name=model.name,
+                    history=req.history, priority=req.priority,
+                    client=req.client, tier=req.tier,
+                    trace_id=req.trace_id,
+                    deadline_s=(deadline.remaining()
+                                if deadline is not None else None),
+                )
         except BaseException:
             with self._lock:
                 self._reserved -= 1
@@ -444,9 +578,13 @@ class CheckService:
             if self._closed and group is not None:
                 # shutdown() began while we were packing off-lock: its
                 # drain already snapshotted the queue, so appending now
-                # would strand this request unresolved forever.
+                # would strand this request unresolved forever.  The
+                # just-written journal entry goes too — a restart must
+                # not replay a request this client was told was
+                # rejected.
                 self._totals["rejected"] += 1
                 obs.counter("serve.rejected", client=client, tier=tier)
+                self._journal_done(req)
                 raise ServiceClosed("check service is shutting down")
             self._totals["submitted"] += 1
             self._remember(req)
@@ -531,6 +669,7 @@ class CheckService:
         if self._thread is not None:
             return self
         metrics.enable_mirror()
+        self.recover()
         if self.warm_pool and self._check_opts.get(
                 "confirm_refutations", True) is True:
             # Satellite contract: pre-fork the confirmation workers at
@@ -555,6 +694,64 @@ class CheckService:
         )
         self._fp_thread.start()
         return self
+
+    def recover(self) -> int:
+        """Replay the admission journal (crash-safe restart): every
+        admitted-but-unfinished request a previous process journaled is
+        re-admitted here, KEEPING its request id — a client polling
+        ``GET /check/<id>`` across the crash still finds its request.
+        Called by ``start()``; step()-driven tests call it directly.
+        Idempotent per service instance.  Returns requests replayed."""
+        if self.journal is None or self._recovered:
+            return 0
+        self._recovered = True
+        n = 0
+        for e in self.journal.replay():
+            try:
+                model = model_by_name(str(e["model"]))
+                history = list(e["history"])
+                group, _pack = self._group_of(model, history)
+            except Exception:  # noqa: BLE001 — one bad entry must not
+                # block the rest of the queue from recovering
+                logger.exception("journal replay failed for entry %s",
+                                 e.get("id"))
+                continue
+            tier = e.get("class") or "batch"
+            if tier not in _sched_adm.CLASSES:
+                tier = "batch"
+            req = CheckRequest(
+                seq=next(self._seq), model=model, history=history,
+                priority=int(e.get("priority") or 0),
+                deadline=faults.Deadline.coerce(e.get("deadline_s")),
+                client=str(e.get("client") or "anon"), group=group,
+                trace_id=e.get("trace_id"), tier=tier,
+                request_id=str(e.get("id") or "") or None,
+                fp=_health.history_fingerprint(history),
+            )
+            with self._cond:
+                self._totals["submitted"] += 1
+                self._totals["journal_replayed"] += 1
+                self._remember(req)
+                if group is None:
+                    self._totals["completed"] += 1
+                else:
+                    self._adm.push(req)
+                    self._cond.notify_all()
+            with obs.attach(req.ctx):
+                obs.counter("serve.journal_replayed", client=req.client)
+            if group is None:
+                req.resolve({"valid?": True})
+                self.journal.resolve(req.id)
+            n += 1
+        if n:
+            logger.info("admission journal replayed %d request(s)", n)
+        return n
+
+    def _journal_done(self, r: CheckRequest) -> None:
+        """Drop a settled request's journal entry (terminal statuses
+        only reach here via resolve() call sites)."""
+        if self.journal is not None and r.kind == "ladder":
+            self.journal.resolve(r.id)
 
     def _loop(self) -> None:
         while True:
@@ -603,6 +800,7 @@ class CheckService:
         interactive fast-path wave, then run one (continuous) batch-tier
         ladder.  Returns requests handled.  The scheduler loop calls
         this; tests call it directly for deterministic control."""
+        self._probe_placement()
         with self._cond:
             expired = self._adm.take_expired()
             self._totals["expired"] += len(expired)
@@ -630,6 +828,7 @@ class CheckService:
                 },
                 status="expired",
             )
+            self._journal_done(r)
 
     # -- graph side lane ---------------------------------------------------
 
@@ -815,6 +1014,13 @@ class CheckService:
         RUNG, not a batch), then hand geometry-compatible batch-tier
         requests to the running ladder — at most ``max_batch - lanes``,
         so recycled lane slots are what joiners consume."""
+        # The rung boundary is where device-loss re-placement lands: a
+        # probe failure shrinks placement for the NEXT batch and closes
+        # this feeder so the running ladder drains instead of growing
+        # on a degraded mesh.
+        self._probe_placement()
+        if self._placement.generation != feeder.placement_gen:
+            feeder.close()
         with self._cond:
             expired = self._adm.take_expired()
             self._totals["expired"] += len(expired)
@@ -835,7 +1041,7 @@ class CheckService:
             # (inline/step() callers keep their deterministic ordering:
             # graphs there run in step() itself).
             self._step_graphs()
-        if not self.continuous or self._closed:
+        if not self.continuous or self._closed or feeder.closed:
             return []
         with self._cond:
             now = time.monotonic()
@@ -890,6 +1096,51 @@ class CheckService:
                             t - r.t_submit, tier=r.tier)
         return joiners
 
+    def _probe_placement(self) -> None:
+        """Mesh health probe (interval-gated by ``health_probe_every_s``):
+        a tiny per-device op through the ``faults.INJECT``-seamed
+        ``Placement.probe``.  On a failed device, shrink placement to
+        the survivors — the NEXT batch launches on the reduced mesh —
+        and re-arm the parity probe so the first reduced launch is
+        verified against single-device execution."""
+        if (self.health_probe_every_s is None
+                or self._placement.mesh is None):
+            return
+        now = time.monotonic()
+        if now - self._t_probe < self.health_probe_every_s:
+            return
+        self._t_probe = now
+        try:
+            healthy, failed = self._placement.probe()
+        except Exception:  # noqa: BLE001 — a broken probe must not
+            # take down the scheduler; it retries next interval
+            logger.exception("placement health probe itself failed")
+            return
+        if not failed:
+            return
+        if not healthy:
+            # Every device failed: nothing to shrink TO.  Leave
+            # placement alone — the launches will fail, the breaker
+            # will open, and the operator sees both.
+            logger.error("ALL %d devices failed the placement health "
+                         "probe; placement unchanged", len(failed))
+            obs.counter("serve.placement_probe_all_failed",
+                        devices=len(failed))
+            return
+        self._placement.shrink_to(healthy)
+        with self._lock:
+            self._totals["devices_replaced"] += len(failed)
+        self._parity_checked = False
+        metrics.inc("serve.devices_lost", len(failed))
+        metrics.set_gauge("serve.placement_devices", len(healthy))
+        obs.counter("serve.placement_replaced", lost=len(failed),
+                    devices=len(healthy))
+        logger.warning(
+            "device loss: placement shrunk to %d device(s) after %d "
+            "failed health probe(s); parity probe re-armed",
+            len(healthy), len(failed),
+        )
+
     def _settle_member(self, r: CheckRequest, res: dict,
                        status: str = "done") -> bool:
         """Resolve one request's future with its verdict (idempotent —
@@ -916,6 +1167,7 @@ class CheckService:
         with self._lock:
             self._totals["completed"] += 1
         obs.counter("serve.completed")
+        self._journal_done(r)
         return True
 
     def _run_batch(self, batch_reqs: list[CheckRequest], feeder) -> None:
@@ -931,6 +1183,7 @@ class CheckService:
         metrics.set_gauge("serve.batch_padding_waste",
                           round(1.0 - n / n_pad, 4))
         metrics.set_gauge("serve.batch_requests", n)
+        hung = False
         with self._placement.span(requests=n, tier="batch"):
             with obs.span(
                 "serve.batch", requests=n, padded=n_pad,
@@ -940,19 +1193,52 @@ class CheckService:
                 trace_ids=trace_ids, continuous=feeder is not None,
             ) as sp:
                 t0 = time.monotonic()
-                try:
-                    # The shared-batch trace scope: everything the launch
-                    # emits below here (ladder stages, confirmations,
-                    # fault retries) carries the member trace ids, so one
-                    # request's journey is findable inside the shared work.
+
+                def _launch():
+                    # The serve-level fault-injection seam: unlike the
+                    # per-kernel INJECT calls inside the ladder, this
+                    # one names WHICH members share the launch (history
+                    # fingerprints), so poison-request chaos scenarios
+                    # compose through faults.inject_scope without
+                    # monkeypatching the ladder.
+                    hook = faults.INJECT
+                    if hook is not None:
+                        hook({"what": "serve.batch",
+                              "members": [r.fp for r in batch_reqs],
+                              "lanes": n}, 0)
+                    # The shared-batch trace scope: everything the
+                    # launch emits (ladder stages, confirmations, fault
+                    # retries) carries the member trace ids, so one
+                    # request's journey is findable inside the shared
+                    # work.  Attached HERE (inside the callable) so it
+                    # holds on the watchdog worker thread too.
                     with obs.attach(trace=trace_ids, parent="serve.batch"):
-                        results = batch.batch_analysis(
+                        return batch.batch_analysis(
                             model, [r.history for r in batch_reqs],
                             capacity=self.capacity, mesh=mesh,
                             admission=feeder,
                             **self._check_opts,
                         )
+
+                try:
+                    if self._watchdog is not None:
+                        results = self._watchdog.run(_launch)
+                    else:
+                        results = _launch()
                     err = None
+                except _health.HungLaunch as e:
+                    # The launch blew its wall-clock cap: the worker
+                    # thread may still be running — abandon it (its
+                    # late verdicts lose the first-write-wins race) and
+                    # close the feeder so it can't pull new joiners
+                    # into a zombie ladder.
+                    logger.warning(
+                        "check-service batch hung (%s); abandoning and "
+                        "retrying on reduced placement", e,
+                    )
+                    results, err, hung = None, e, True
+                    if feeder is not None:
+                        feeder.close()
                 except Exception as e:  # noqa: BLE001 — degrade the batch's
                     # requests, never the service (the scheduler lives on)
                     logger.exception("check-service batch failed")
@@ -964,7 +1250,7 @@ class CheckService:
                         rungs=feeder.rungs,
                         continuous_occupancy=feeder.mean_occupancy,
                     )
-        members = feeder.members if feeder is not None else batch_reqs
+        members = list(feeder.members) if feeder is not None else batch_reqs
         metrics.observe("serve.batch_seconds", dt)
         with self._lock:
             # The batch-tier retry-after quotes SLOT-RECYCLE cadence: a
@@ -988,20 +1274,47 @@ class CheckService:
         if err is not None:
             metrics.inc("serve.batch_errors")
             obs.counter("serve.batch_error", error=faults.describe(err))
-            for r in members:
-                if not r.future.done():
-                    metrics.inc("serve.verdicts", verdict="unknown")
-                    r.resolve(
-                        {
-                            "valid?": "unknown",
-                            "cause": (
-                                "service batch failed: "
-                                f"{faults.describe(err)}"
-                            ),
-                        },
-                        status="error",
-                    )
+            if hung:
+                self._retry_hung(model, members, err)
+                return
+            unresolved = [r for r in members if not r.future.done()]
+            if (self.poison_bisect and len(unresolved) > 0
+                    and faults.error_kind(err) is None):
+                # A NON-transient shared-launch failure (transients and
+                # OOM already retried/halved inside the ladder): bisect
+                # the member set so only the poison member(s) degrade —
+                # everyone else gets their real verdict from the
+                # succeeding halves.
+                self._bisect_poison(model, unresolved, err, mesh)
+                return
+            opened = self.breaker.record_failure()
+            if opened:
+                obs.counter("serve.breaker_opened",
+                            failures=self.breaker.consecutive_failures)
+                logger.error(
+                    "circuit breaker OPEN after %d consecutive batch "
+                    "failures (cooldown %.0fs)",
+                    self.breaker.consecutive_failures,
+                    self.breaker.cooldown_s,
+                )
+            metrics.set_gauge("serve.breaker_open",
+                              self.breaker.state == "open")
+            for r in unresolved:
+                metrics.inc("serve.verdicts", verdict="unknown")
+                r.resolve(
+                    {
+                        "valid?": "unknown",
+                        "cause": (
+                            "service batch failed: "
+                            f"{faults.describe(err)}"
+                        ),
+                    },
+                    status="error",
+                )
+                self._journal_done(r)
             return
+        self.breaker.record_success()
+        metrics.set_gauge("serve.breaker_open", False)
         # Settle every member the ladder's early demux didn't (unknowns
         # and confirmation leftovers); _settle_member is idempotent so
         # already-resolved members are skipped.
@@ -1012,6 +1325,139 @@ class CheckService:
             self._parity_checked = True
             self._verify_placement(model, [r.history for r in members],
                                    results)
+
+    def _bisect_poison(self, model, members: list[CheckRequest],
+                       err: BaseException, mesh) -> None:
+        """Blast-radius isolation for a poisoned shared launch: bisect
+        ``members`` with bounded relaunches (serve.health.bisect_poison)
+        — innocents settle with the verdicts the succeeding halves
+        produce; the isolated poison member(s) resolve unknown and land
+        in the TTL'd quarantine registry so repeat offenders skip
+        straight to rejection."""
+        from jepsen_tpu.parallel import batch
+
+        cause0 = faults.describe(err)
+
+        def launch(reqs: list[CheckRequest]) -> list[dict]:
+            def _go():
+                hook = faults.INJECT
+                if hook is not None:
+                    hook({"what": "serve.batch",
+                          "members": [r.fp for r in reqs],
+                          "lanes": len(reqs)}, 0)
+                return batch.batch_analysis(
+                    model, [r.history for r in reqs],
+                    capacity=self.capacity, mesh=mesh, **self._check_opts,
+                )
+
+            if self._watchdog is not None:
+                # A poison member may WEDGE a relaunch instead of
+                # raising; without the cap one bisection step would
+                # hang the scheduler forever.  HungLaunch is an
+                # Exception, so bisect_poison treats it as this
+                # group's failure signature and keeps isolating.
+                return self._watchdog.run(_go)
+            return _go()
+
+        with obs.span("serve.poison_bisect", members=len(members),
+                      error=cause0) as sp:
+            poison, good, launches = _health.bisect_poison(launch, members)
+            sp.set(poison=len(poison), launches=launches)
+        with self._lock:
+            self._totals["bisect_launches"] += launches
+            self._totals["poison_isolated"] += len(poison)
+            self._totals["quarantined"] += len(poison)
+        metrics.inc("serve.poison_bisect_launches", launches)
+        metrics.inc("serve.poison_isolated", len(poison))
+        for r, res in good.items():
+            self._settle_member(r, res)
+        for r in poison:
+            if r.fp:
+                self.quarantine.add(r.fp, cause0)
+            with obs.attach(r.ctx):
+                obs.counter("serve.quarantined", client=r.client,
+                            error=cause0)
+            self._settle_member(
+                r,
+                {
+                    "valid?": "unknown",
+                    "quarantined": True,
+                    "cause": (
+                        "poisoned shared launch (isolated by bisection): "
+                        f"{cause0}; fingerprint quarantined for "
+                        f"{self.quarantine.ttl_s:.0f}s"
+                    ),
+                },
+                status="quarantined",
+            )
+        logger.warning(
+            "poison bisection: %d member(s) quarantined, %d innocent "
+            "verdict(s) recovered in %d relaunch(es) (%s)",
+            len(poison), len(good), launches, cause0,
+        )
+        # The breaker reads the bisection outcome as the device's
+        # health: recovered innocent verdicts prove the device serves
+        # (the REQUEST was the problem); an all-poison outcome is
+        # indistinguishable from a broken device and counts against it.
+        if good:
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure()
+        metrics.set_gauge("serve.breaker_open", self.breaker.state == "open")
+
+    def _retry_hung(self, model, members: list[CheckRequest],
+                    err: BaseException) -> None:
+        """Cancel-and-retry for a hung launch: the abandoned worker
+        thread keeps whatever device it wedged; the still-unresolved
+        members retry ONCE on reduced placement (single device, no
+        continuous admission, a doubled watchdog cap).  A retry that
+        also fails degrades only these members."""
+        from jepsen_tpu.parallel import batch
+
+        with self._lock:
+            self._totals["watchdog_trips"] += 1
+        metrics.inc("serve.watchdog_trips")
+        obs.counter("serve.watchdog_trip", error=faults.describe(err))
+        self.breaker.record_failure()
+        retry = [r for r in members if not r.future.done()]
+        if not retry:
+            return
+
+        def _relaunch():
+            return batch.batch_analysis(
+                model, [r.history for r in retry],
+                capacity=self.capacity, mesh=None, **self._check_opts,
+            )
+
+        try:
+            cap = (self._watchdog.timeout_s() * 2
+                   if self._watchdog is not None else None)
+            if self._watchdog is not None:
+                results = self._watchdog.run(_relaunch, cap)
+            else:  # pragma: no cover — hung implies a watchdog exists
+                results = _relaunch()
+        except Exception as e2:  # noqa: BLE001 — bounded degradation:
+            # these members only, with both failures named
+            for r in retry:
+                metrics.inc("serve.verdicts", verdict="unknown")
+                r.resolve(
+                    {
+                        "valid?": "unknown",
+                        "cause": (
+                            f"hung launch ({faults.describe(err)}); "
+                            "reduced-placement retry failed: "
+                            f"{faults.describe(e2)}"
+                        ),
+                    },
+                    status="error",
+                )
+                self._journal_done(r)
+            return
+        for r, res in zip(retry, results):
+            self._settle_member(r, res)
+        self.breaker.record_success()
+        metrics.set_gauge("serve.breaker_open", False)
+        obs.counter("serve.watchdog_retry_ok", requests=len(retry))
 
     def _verify_placement(self, model, histories, sharded_results) -> None:
         """The placement parity check (first sharded batch only): the
@@ -1026,8 +1472,13 @@ class CheckService:
                 model, histories, capacity=self.capacity, mesh=None,
                 **self._check_opts,
             )
-        except Exception:  # noqa: BLE001 — the probe is best-effort
+        except Exception as e:  # noqa: BLE001 — the probe is best-effort,
+            # but a swallowed probe failure left operators thinking
+            # parity was verified: count it and name the error
             logger.exception("placement parity probe failed")
+            metrics.inc("serve.placement_probe_errors")
+            obs.counter("serve.placement_probe_error",
+                        error=faults.describe(e))
             return
         got = [r["valid?"] for r in sharded_results]
         want = [r["valid?"] for r in single]
@@ -1089,6 +1540,17 @@ class CheckService:
                 "retry_after_hint_s": self._adm.retry_after(
                     "batch", self.max_batch),
                 "uptime_s": round(time.monotonic() - self._t_start, 3),
+                # -- self-healing layer (serve.health) ------------------
+                "breaker": self.breaker.describe(),
+                "quarantine": self.quarantine.describe(),
+                "journal_depth": (
+                    self.journal.depth() if self.journal is not None
+                    else None
+                ),
+                "watchdog_timeout_s": (
+                    round(self._watchdog.timeout_s(), 3)
+                    if self._watchdog is not None else None
+                ),
                 **t,
             }
 
@@ -1165,6 +1627,11 @@ class CheckService:
                                   "was checked"},
                         status="drained",
                     )
+                    # Keep the journal entry under drain=False too?  No:
+                    # the caller explicitly declined a resumable drain,
+                    # so a restart re-running these would contradict the
+                    # resolution the client was handed.
+                    self._journal_done(r)
                 summary["drained"] = len(remaining)
         with self._lock:
             self._totals["drained"] += summary["drained"]
@@ -1215,19 +1682,42 @@ class CheckService:
                         **self._check_opts,
                     )
                     out["checkpoints"].append(str(sub))
-                except Exception:  # noqa: BLE001 — drain is best-effort;
-                    # the futures below still resolve either way
+                except Exception as e:  # noqa: BLE001 — drain stays
+                    # best-effort (the futures below still resolve),
+                    # but the failure is COUNTED and carried on each
+                    # affected request instead of vanishing into a log
+                    # nobody tails: an operator trusting "drained means
+                    # resumable" must see when it wasn't.
                     logger.exception("drain checkpoint failed for %s", sub)
+                    drain_err = faults.describe(e)
+                    with self._lock:
+                        self._totals["drain_errors"] += 1
+                    metrics.inc("serve.drain_errors")
+                    for r in rs:
+                        with obs.attach(r.ctx):
+                            obs.counter("serve.drain_error",
+                                        client=r.client, error=drain_err)
                     sub = None
             cause = "service shut down before this request was checked"
             if sub is not None:
                 cause += f"; resumable drain checkpoint: {sub}"
+            elif self.drain_dir is not None and checkpointable:
+                cause += (
+                    "; drain checkpoint FAILED (not resumable): "
+                    f"{drain_err}"
+                )
             for r in rs:
                 with obs.attach(r.ctx):
                     obs.counter("serve.drained", client=r.client)
                 metrics.inc("serve.verdicts", verdict="unknown")
                 r.resolve({"valid?": "unknown", "cause": cause},
                           status="drained")
+                if sub is not None:
+                    # the drain checkpoint supersedes the journal entry
+                    # (resume_drained is the recovery path now); a
+                    # FAILED drain keeps the entry — the journal is the
+                    # only copy of the request left.
+                    self._journal_done(r)
         return out
 
 

@@ -22,6 +22,21 @@ the check API:
   GET  /queue        queue-status JSON incl. per-class queue depths and
                      retry-after EWMAs (the home page shows a panel)
 
+Oversized ``POST /check`` bodies are rejected 413 BEFORE the JSON parse
+(``make_server(..., max_request_mb=)`` / ``serve --max-request-mb``) so
+one hostile payload can't balloon the process ahead of admission
+validation; an open circuit breaker (``serve.health``) rejects 503 with
+a Retry-After distinct from the backpressure 429.
+
+Operational endpoints (always mounted):
+
+  GET  /healthz          liveness: 200 while the process serves HTTP
+  GET  /readyz           readiness: 200 when a check service is
+                         mounted, admitting, and its circuit breaker
+                         is not open; 503 (with the reason) otherwise
+                         — the probe pair an orchestrator points at a
+                         serving pod
+
 Observability endpoints (always mounted):
 
   GET  /metrics          live Prometheus text (jepsen_tpu.obs.metrics):
@@ -415,6 +430,10 @@ class Handler(BaseHTTPRequestHandler):
     store_dir = None
     check_service = None  # a jepsen_tpu.serve.CheckService, or None
     profiler = None  # a jepsen_tpu.obs.profiler.ProfilerHook, or None
+    #: request-body bound for POST /check, enforced on Content-Length
+    #: BEFORE the body is read or parsed (413 beyond it).
+    max_request_bytes = 32 * 1024 * 1024
+    t_start = time.monotonic()
 
     def log_message(self, fmt, *args):  # quiet
         logger.debug("web: " + fmt, *args)
@@ -456,6 +475,30 @@ class Handler(BaseHTTPRequestHandler):
                 return
             try:
                 length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                self._send_json(400, {"error": "bad Content-Length"})
+                return
+            if length < 0:
+                # rfile.read(-1) would read until EOF — a hostile
+                # keep-alive client could wedge this handler thread
+                # with no size bound at all
+                self._send_json(400, {"error": "bad Content-Length"})
+                return
+            if length > self.max_request_bytes:
+                # Reject BEFORE reading/parsing: one hostile payload
+                # must not balloon the process ahead of admission
+                # validation.  The connection is closed (the unread
+                # body would otherwise wedge keep-alive).
+                obs_metrics.inc("serve.oversized_rejected")
+                self._send_json(
+                    413,
+                    {"error": "request body too large",
+                     "bytes": length, "limit": self.max_request_bytes},
+                    headers={"Connection": "close"},
+                )
+                self.close_connection = True
+                return
+            try:
                 body = json.loads(self.rfile.read(length) or b"{}")
                 history = body["history"]
                 if not isinstance(history, list):
@@ -499,6 +542,17 @@ class Handler(BaseHTTPRequestHandler):
                     429,
                     {"error": "queue full", "depth": e.depth,
                      "limit": e.limit, "retry_after_s": e.retry_after},
+                    headers={"Retry-After": max(1, math.ceil(e.retry_after))},
+                )
+                return
+            except _serve_mod().ServiceUnavailable as e:
+                # Circuit breaker open: the DEVICE isn't serving (K
+                # consecutive batch failures) — distinct from the 429
+                # backpressure case where the queue is merely full.
+                self._send_json(
+                    503,
+                    {"error": "circuit breaker open",
+                     "retry_after_s": e.retry_after},
                     headers={"Retry-After": max(1, math.ceil(e.retry_after))},
                 )
                 return
@@ -565,6 +619,35 @@ class Handler(BaseHTTPRequestHandler):
                     200, obs_metrics.render().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/healthz":
+                # Liveness: this handler running IS the signal.
+                self._send_json(
+                    200,
+                    {"ok": True,
+                     "uptime_s": round(time.monotonic() - self.t_start, 3)},
+                )
+            elif path == "/readyz":
+                # Readiness: mounted + admitting + breaker not open.
+                svc = self.check_service
+                if svc is None:
+                    self._send_json(
+                        503, {"ready": False, "reason": "no check service"})
+                elif getattr(svc, "_closed", False):
+                    self._send_json(
+                        503, {"ready": False, "reason": "shutting down"})
+                else:
+                    br = svc.breaker.describe()
+                    if br["state"] == "open":
+                        self._send_json(
+                            503,
+                            {"ready": False, "reason": "circuit breaker open",
+                             "breaker": br},
+                            headers={"Retry-After":
+                                     max(1, math.ceil(br["retry_after_s"]))},
+                        )
+                    else:
+                        self._send_json(
+                            200, {"ready": True, "breaker": br})
             elif path == "/profile":
                 if self.profiler is None:
                     self._send_json(503, {"error": "no profiler mounted"})
@@ -666,25 +749,29 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def make_server(host="0.0.0.0", port=8080, store_dir=None,
-                check_service=None, profiler=None) -> ThreadingHTTPServer:
+                check_service=None, profiler=None,
+                max_request_mb: float = 32.0) -> ThreadingHTTPServer:
     # A mounted web server IS a serving process: turn the live metrics
     # registry on so /metrics (and the home panel) have data to show.
     obs_metrics.enable_mirror()
     handler = type(
         "BoundHandler", (Handler,),
         {"store_dir": store_dir, "check_service": check_service,
-         "profiler": profiler},
+         "profiler": profiler,
+         "max_request_bytes": int(max_request_mb * 1024 * 1024),
+         "t_start": time.monotonic()},
     )
     return ThreadingHTTPServer((host, port), handler)
 
 
 def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None,
-          profiler=None):
+          profiler=None, max_request_mb: float = 32.0):
     """Blocking server (web.clj:385-390).  With a ``check_service`` the
     check API mounts and shutdown drains it (checkpointing queued work);
     with a ``profiler`` (obs.profiler.ProfilerHook) the /profile
     endpoints drive bounded device captures."""
-    srv = make_server(host, port, store_dir, check_service, profiler)
+    srv = make_server(host, port, store_dir, check_service, profiler,
+                      max_request_mb=max_request_mb)
     logger.info("serving store on http://%s:%d", host, port)
     try:
         srv.serve_forever()

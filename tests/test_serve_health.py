@@ -1,0 +1,380 @@
+"""Self-healing layer tests (jepsen_tpu.serve.health + its service
+integration): poison-quarantine bisection, the circuit breaker, the
+hung-launch watchdog, device-loss re-placement, the fsync'd admission
+journal, inject_scope, and the web health/413 endpoints.
+
+Kernel shapes are shared with tests/test_parallel.py / test_serve*.py —
+(30, 3) register histories at capacity (64, 256) — and every service
+test warms its ladder through the plain ``batch_analysis`` baseline
+first, so no test adds a compile geometry (tier-1 budget is near the
+870 s cap)."""
+
+import json
+import math
+import pathlib
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import faults
+from jepsen_tpu import models as m
+from jepsen_tpu import serve as sv
+from jepsen_tpu.parallel import batch_analysis
+from jepsen_tpu.serve import health
+
+#: the suite-shared ladder (same shapes as test_parallel/test_serve_sched).
+KW = dict(capacity=(64, 256), warm_pool=False)
+
+
+def mixed_histories(n=4):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(30, 3, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+# ---------------------------------------------------------------------------
+# Pure primitives
+# ---------------------------------------------------------------------------
+
+def test_bisect_poison_isolates_single_offender_in_log_launches():
+    """One poison member among n: bisection finds exactly it, recovers
+    every innocent verdict, and stays within the O(log n) budget."""
+    members = [f"m{i}" for i in range(16)]
+    poison = members[11]
+    launches = []
+
+    def launch(group):
+        launches.append(list(group))
+        if poison in group:
+            raise ValueError("poison present")
+        return [f"v-{g}" for g in group]
+
+    bad, good, n_launches = health.bisect_poison(launch, members)
+    assert bad == [poison]
+    assert set(good) == set(members) - {poison}
+    assert good["m0"] == "v-m0"
+    # O(log n): both halves at each of ~log2(16) levels, + slack
+    assert n_launches <= 2 * (math.ceil(math.log2(16)) + 1)
+    assert n_launches == len(launches)
+
+
+def test_bisect_poison_two_offenders_and_budget_exhaustion():
+    members = list(range(8))
+
+    def launch(group):
+        if any(x in (2, 5) for x in group):
+            raise ValueError("boom")
+        return [f"v{x}" for x in group]
+
+    bad, good, _ = health.bisect_poison(launch, members)
+    assert sorted(bad) == [2, 5]
+    assert set(good) == {0, 1, 3, 4, 6, 7}
+
+    def always_fails(group):
+        raise ValueError("x")
+
+    # a zero budget quarantines the whole failing group (conservative:
+    # innocents degrade to unknown, never to a wrong verdict)
+    bad2, good2, n2 = health.bisect_poison(
+        always_fails, members, max_launches=0)
+    assert bad2 == members and not good2 and n2 == 0
+
+
+def test_circuit_breaker_open_halfopen_close():
+    b = health.CircuitBreaker(threshold=2, cooldown_s=0.05)
+    assert b.allow() and b.state == "closed"
+    assert b.record_failure() is False
+    assert b.record_failure() is True  # this one opened it
+    assert b.state == "open" and not b.allow()
+    assert 0 < b.retry_after() <= 0.05
+    time.sleep(0.06)
+    assert b.allow() and b.state == "half-open"  # probe allowed
+    assert b.record_failure() is True  # probe failed: re-open
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.consecutive_failures == 0
+    assert b.describe()["opens"] == 2
+
+
+def test_quarantine_ttl_and_hit_refresh():
+    q = health.Quarantine(ttl_s=0.08)
+    q.add("fp-a", "bad history")
+    e = q.check("fp-a")
+    assert e is not None and e["cause"] == "bad history" and e["hits"] == 1
+    assert len(q) == 1
+    time.sleep(0.12)
+    assert q.check("fp-a") is None  # expired
+    assert len(q) == 0
+
+
+def test_launch_watchdog_trips_and_passes():
+    w = health.LaunchWatchdog(factor=4.0, floor_s=0.05, cap_s=0.2)
+    assert w.run(lambda: "fine", 1.0) == "fine"
+    with pytest.raises(health.HungLaunch):
+        w.run(lambda: time.sleep(1.0), 0.1)
+    assert w.trips == 1
+    with pytest.raises(ZeroDivisionError):  # fn's own error re-raises
+        w.run(lambda: 1 / 0, 1.0)
+    # the cap derives from the launch EWMA, clamped to [floor, cap]
+    assert 0.05 <= w.timeout_s() <= 0.2
+
+
+def test_inject_scope_composes_and_restores():
+    order = []
+    with faults.inject_scope(lambda c, a: order.append("outer")):
+        with faults.inject_scope(lambda c, a: order.append("inner")):
+            faults.INJECT({}, 0)
+        assert order == ["outer", "inner"]  # outer runs first, stacked
+        order.clear()
+        faults.INJECT({}, 0)
+        assert order == ["outer"]  # inner layer torn down alone
+        with faults.inject_scope(lambda c, a: order.append("shadow"),
+                                 compose=False):
+            order.clear()
+            faults.INJECT({}, 0)
+            assert order == ["shadow"]  # outer shadowed, not run
+    assert faults.INJECT is None
+    # the scope restores even when the body raises
+    with pytest.raises(RuntimeError):
+        with faults.inject_scope(lambda c, a: None):
+            raise RuntimeError("body")
+    assert faults.INJECT is None
+
+
+def test_seeded_injector_is_deterministic_and_scoped():
+    inj = faults.seeded_injector(11, transient_rate=0.5, oom_rate=0.0,
+                                 what="ladder.")
+    ctx = {"what": "ladder.async", "stage": 0, "capacity": 64, "lanes": 4}
+    outcomes = []
+    for _ in range(3):
+        try:
+            inj(dict(ctx), 0)
+            outcomes.append("ok")
+        except RuntimeError:
+            outcomes.append("fault")
+    assert len(set(outcomes)) == 1  # same (seed, ctx, attempt) → same roll
+    inj(dict(ctx), 1)  # retries always pass: the plan tests recovery
+    inj({"what": "serve.batch", "lanes": 4}, 0)  # out of scope: untouched
+
+
+def test_admission_journal_roundtrip(tmp_path):
+    j = health.AdmissionJournal(tmp_path / "j")
+    hist = [{"type": "invoke", "process": 0, "f": "write", "value": 1}]
+    assert j.record(req_id="abc", seq=3, model_name="cas-register",
+                    history=hist, priority=1, client="c1", tier="batch",
+                    trace_id="t1", deadline_s=None)
+    j.record(req_id="def", seq=1, model_name="cas-register", history=hist,
+             priority=0, client="c2", tier="interactive", trace_id="t2",
+             deadline_s=4.5)
+    assert j.depth() == 2
+    entries = j.replay()
+    assert [e["id"] for e in entries] == ["def", "abc"]  # seq order
+    assert entries[1]["client"] == "c1" and entries[0]["deadline_s"] == 4.5
+    # unreadable entries are skipped, not fatal
+    (tmp_path / "j" / "req-zzz.json").write_text("{not json")
+    assert len(j.replay()) == 2 and j.errors == 1
+    j.resolve("abc")
+    j.resolve("abc")  # idempotent
+    assert j.depth() == 2  # "def" + the corrupt file still on disk
+
+
+# ---------------------------------------------------------------------------
+# Service integration (suite-shared kernel shapes, warmed baselines)
+# ---------------------------------------------------------------------------
+
+def test_service_poison_quarantine_end_to_end():
+    """A poison member fails the shared launch non-transiently: the
+    bisection quarantines exactly it, innocents get baseline verdicts,
+    and a resubmission skips straight to rejection with zero
+    relaunches."""
+    hists = mixed_histories(4)  # index 2 corrupt
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    poison_fp = health.history_fingerprint(hists[1])
+
+    def poison_inj(ctx, attempt):
+        if (ctx.get("what") == "serve.batch"
+                and poison_fp in (ctx.get("members") or ())):
+            raise ValueError("injected poison member failure")
+
+    svc = sv.CheckService(quarantine_ttl_s=60.0, **KW)
+    with faults.inject_scope(poison_inj):
+        futs = [svc.submit(hh) for hh in hists]
+        svc.step()
+    got = [f.result(timeout=60) for f in futs]
+    for i in (0, 2, 3):
+        assert got[i]["valid?"] == direct[i]["valid?"]
+    assert got[1]["valid?"] == "unknown"
+    assert got[1]["quarantined"] is True
+    assert "bisection" in got[1]["cause"]
+    st = svc.stats()
+    assert st["poison_isolated"] == 1
+    assert 0 < st["bisect_launches"] <= health.bisect_launch_budget(4)
+    assert st["breaker"]["state"] == "closed"  # innocents recovered
+    # repeat offender: rejected at admission, no bisection, no launch
+    r2 = svc.submit(hists[1]).result(timeout=10)
+    assert r2["quarantined"] is True and "repeat poison" in r2["cause"]
+    st2 = svc.stats()
+    assert st2["bisect_launches"] == st["bisect_launches"]
+    assert st2["quarantined"] == 2 and st2["quarantine"]["entries"] == 1
+
+
+def test_breaker_opens_rejects_and_half_open_recovers(monkeypatch):
+    """Consecutive batch failures open the breaker (submit raises
+    ServiceUnavailable with a retry-after); after the cooldown a probe
+    batch closes it again."""
+    from jepsen_tpu.parallel import batch as pb
+
+    hists = mixed_histories(2)
+    batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))  # warm
+    real = pb.batch_analysis
+
+    def failing(*a, **kw):
+        raise RuntimeError("UNAVAILABLE: injected transient device loss")
+
+    svc = sv.CheckService(breaker_threshold=2, breaker_cooldown_s=5.0,
+                          poison_bisect=True, **KW)
+    monkeypatch.setattr(pb, "batch_analysis", failing)
+    for k in range(2):
+        f = svc.submit(hists[0])
+        svc.step()
+        assert f.result(timeout=10)["valid?"] == "unknown"
+    assert svc.breaker.state == "open"
+    with pytest.raises(sv.ServiceUnavailable) as ei:
+        svc.submit(hists[0])
+    assert 0 < ei.value.retry_after <= 5.0
+    assert svc.stats()["breaker_rejected"] == 1
+    svc.breaker.cooldown_s = 0.0  # cooldown elapses "now"
+    monkeypatch.setattr(pb, "batch_analysis", real)
+    f = svc.submit(hists[0])  # half-open probe admits
+    assert svc.breaker.state == "half-open"
+    svc.step()
+    assert f.result(timeout=60)["valid?"] is True
+    assert svc.breaker.state == "closed"
+
+
+def test_watchdog_hung_launch_cancel_and_retry(monkeypatch):
+    """A launch that blows its wall-clock cap is abandoned and retried
+    on reduced placement; the caller still gets baseline verdicts."""
+    from jepsen_tpu.parallel import batch as pb
+
+    hists = mixed_histories(3)
+    # confirm off in BOTH arms: the retry runs under a tight doubled
+    # cap, and a cold confirmation-pool spawn would blow it spuriously
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256),
+                            confirm_refutations=False)
+    real = pb.batch_analysis
+    calls = {"n": 0}
+
+    def slow_once(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(1.2)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pb, "batch_analysis", slow_once)
+    svc = sv.CheckService(watchdog_factor=1e-6, watchdog_floor_s=0.3,
+                          watchdog_cap_s=0.5, confirm_refutations=False,
+                          **KW)
+    futs = [svc.submit(hh) for hh in hists]
+    svc.step()
+    got = [f.result(timeout=30) for f in futs]
+    assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
+    assert svc.stats()["watchdog_trips"] == 1
+    assert calls["n"] >= 2  # the hung call + the reduced retry
+
+
+def test_placement_probe_shrinks_to_survivors():
+    """A failed device-health probe shrinks placement to the surviving
+    devices at the next scheduling opportunity and re-arms the parity
+    probe (no launch here — the shrunk-mesh launch path is covered by
+    tools/chaos_check.py --serve)."""
+
+    def dev_inj(ctx, attempt):
+        if (ctx.get("what") == "placement.probe"
+                and int(ctx.get("device", -1)) == 5):
+            raise RuntimeError("injected device loss")
+
+    svc = sv.CheckService(devices=8, health_probe_every_s=0.0, **KW)
+    assert svc.stats()["placement"]["devices"] == 8
+    svc._parity_checked = True
+    with faults.inject_scope(dev_inj):
+        svc._probe_placement()
+    st = svc.stats()
+    assert st["devices_replaced"] == 1
+    assert st["placement"]["devices"] == 7
+    assert st["placement"]["lost_devices"] == 1
+    assert svc._parity_checked is False  # parity probe re-armed
+    gen = svc._placement.generation
+    assert gen == 1
+    # healthy probes change nothing further
+    svc._t_probe = 0.0
+    svc._probe_placement()
+    assert svc._placement.generation == gen
+
+
+def test_web_health_endpoints_and_oversized_413():
+    """/healthz is liveness, /readyz tracks the breaker, and an
+    oversized POST /check body is rejected 413 before the JSON parse."""
+    from jepsen_tpu import web
+
+    svc = sv.CheckService(**KW)
+    srv = web.make_server("127.0.0.1", 0, check_service=svc,
+                          max_request_mb=0.001)  # ~1 KiB bound
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200 and json.loads(r.read())["ok"] is True
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+            doc = json.loads(r.read())
+            assert r.status == 200 and doc["ready"] is True
+            assert doc["breaker"]["state"] == "closed"
+        # an open breaker flips readiness 503 (with Retry-After)
+        svc.breaker.state = "open"
+        svc.breaker.opened_at = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["reason"] == "circuit breaker open"
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        svc.breaker.state = "closed"
+        # oversized body: 413 before parse (the body is never read)
+        big = json.dumps({"history": [], "pad": "x" * 4096}).encode()
+        req = urllib.request.Request(
+            base + "/check", data=big,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+        doc = json.loads(ei.value.read())
+        assert doc["limit"] == int(0.001 * 1024 * 1024)
+        assert doc["bytes"] == len(big)
+        # a small body still parses (400 on the empty history's model
+        # default being fine -> it actually admits; use a bad one)
+        small = json.dumps({"history": "nope"}).encode()
+        req = urllib.request.Request(
+            base + "/check", data=small,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400  # under the bound: parsed + validated
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        svc.shutdown(drain=False)

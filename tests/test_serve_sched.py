@@ -282,6 +282,40 @@ def test_graph_requests_skip_geometry_buckets():
     assert doc["checker"] == "CycleChecker"
 
 
+def test_journal_replay_restart_recovery(tmp_path):
+    """Crash-safe restart: a service dies with admitted requests still
+    queued/in-flight (its journal entries un-resolved); a fresh service
+    on the same journal dir replays them — SAME request ids, verdicts
+    identical to an uninterrupted run — and the journal drains as they
+    settle.  (The real-SIGKILL variant runs in tools/chaos_check.py
+    --serve; conftest shared kernel shapes, no new compile geometries.)"""
+    hists = mixed_histories(6)  # 2 and 5 corrupt
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    jd = tmp_path / "journal"
+    svc1 = sv.CheckService(journal_dir=jd, **KW)
+    futs1 = [svc1.submit(hh, client=f"c{i}", priority=i % 2)
+             for i, hh in enumerate(hists)]
+    ids = [f.id for f in futs1]
+    assert svc1.journal.depth() == 6  # fsync'd before the queue push
+    # CRASH: svc1 is abandoned mid-queue — never stepped, never shut
+    # down; its futures stay unresolved, only the journal survives.
+    svc2 = sv.CheckService(journal_dir=jd, **KW)
+    assert svc2.recover() == 6
+    assert svc2.recover() == 0  # idempotent per instance
+    assert svc2.stats()["journal_replayed"] == 6
+    while svc2.stats()["queue_depth"]:
+        svc2.step()
+    for i, rid in enumerate(ids):
+        req = svc2.get(rid)  # the ORIGINAL id resolves across the crash
+        assert req is not None and req.future.done()
+        assert req.result["valid?"] == direct[i]["valid?"]
+        assert req.client == f"c{i}"
+    assert svc2.journal.depth() == 0  # entries drained as they settled
+    # a third restart finds nothing to replay
+    svc3 = sv.CheckService(journal_dir=jd, **KW)
+    assert svc3.recover() == 0
+
+
 def test_continuous_service_coalesces_latecomers():
     """Requests submitted while a ladder is running join it at rung
     boundaries (or at worst the next batch): verdict parity holds and

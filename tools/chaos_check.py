@@ -21,10 +21,22 @@ Exit 0 iff the robustness contract holds:
   * the SIGKILL'd-then-resumed run's verdicts are IDENTICAL to the
     clean run's.
 
+``--serve`` adds the CHAOS-UNDER-LOAD gate (ROADMAP 5b) against a LIVE
+``CheckService``: seeded transient faults under open-arrival load with
+a poison member (quarantine bisection isolates it; everyone else's
+verdicts must match the clean baseline), a hung launch (the watchdog
+cancels and retries on reduced placement), device loss (the mesh
+health probe shrinks placement to the survivors), one real SIGKILL
+with journal replay (a restarted service finishes the lost queue with
+identical verdicts), and a ``/metrics`` scrape that must agree with
+the harness's own request accounting.
+
 Usage:
   python tools/chaos_check.py                  # full: 128x? no — pinned default below
   python tools/chaos_check.py --smoke          # tiny variant (tier-1 tests)
   python tools/chaos_check.py --runs 5 --seed 7
+  python tools/chaos_check.py --serve          # chaos-under-load gate
+  python tools/chaos_check.py --serve --smoke  # its docker-entrypoint size
 """
 
 from __future__ import annotations
@@ -37,6 +49,8 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -44,6 +58,16 @@ sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tools"))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--serve" in sys.argv:
+    # The device-loss scenario needs a (virtual) mesh; XLA reads this
+    # before backend init, so it must be set ahead of the jax import
+    # the jepsen_tpu imports below trigger.
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
 
 from genhist import corrupt, valid_register_history  # noqa: E402
 
@@ -106,11 +130,11 @@ def chaos_injector(seed: int):
 
 
 def run_faulted(hists, seed: int):
-    faults.INJECT = chaos_injector(seed)
-    try:
+    # inject_scope (not a bare INJECT assignment): thread-safe
+    # install/restore, so this harness composes with anything else
+    # driving the seam in the same process.
+    with faults.inject_scope(chaos_injector(seed), compose=False):
         return pb.batch_analysis(m.CASRegister(None), hists, **LADDER)
-    finally:
-        faults.INJECT = None
 
 
 #: the child half of the SIGKILL cycle: same pinned workload, checkpoint
@@ -164,6 +188,256 @@ def sigkill_resume_cycle(hists, n, ops, procs, kill_after: int, ckpt_dir: str):
     return killed, resumed
 
 
+#: the child half of the SIGKILL/journal-replay cycle: admit the whole
+#: workload into a journaled service, then die before serving any of it.
+_SERVE_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import chaos_check
+from jepsen_tpu import serve as sv
+hists = chaos_check.build_histories({n}, {ops}, {procs})
+svc = sv.CheckService(warm_pool=False, journal_dir={jdir!r},
+                      **chaos_check.LADDER)
+futs = [svc.submit(h, client="victim") for h in hists]
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def serve_chaos(opts) -> int:
+    """The chaos-under-load gate (ROADMAP 5b) against a LIVE service.
+
+    Five phases over one pinned workload, all diffed against a clean
+    ``batch_analysis`` baseline: (1) open-arrival load with seeded
+    transient faults AND a poison member — the service must stay up,
+    quarantine bisection must isolate exactly the poison request in
+    O(log n) relaunches, every other verdict must MATCH the baseline,
+    and a poison resubmission must skip straight to rejection; (2) a
+    hung launch — the watchdog must trip and the reduced-placement
+    retry must still produce baseline verdicts; (3) device loss — the
+    mesh health probe must shrink placement to the survivors with
+    verdict parity; (4) one real SIGKILL with the admission journal —
+    a restarted service must replay and finish the lost queue with
+    identical verdicts; (5) the /metrics scrape (via the mounted web
+    app + tools/loadgen's scraper) must agree with this harness's own
+    request accounting.  Returns the failure count."""
+    from loadgen import MetricsScraper
+
+    from jepsen_tpu import serve as sv
+    from jepsen_tpu import web
+    from jepsen_tpu.serve import health
+
+    failures = 0
+
+    def check(ok: bool, what: str):
+        nonlocal failures
+        print(f"  {'ok  ' if ok else 'FAIL'} {what}"
+              + ("" if ok else " <<<"), file=sys.stderr if not ok else sys.stdout)
+        if not ok:
+            failures += 1
+
+    n = max(8, opts.histories)
+    hists = build_histories(n, opts.ops, opts.procs)
+    model = m.CASRegister(None)
+    clean = pb.batch_analysis(model, hists, **LADDER)
+    cv = verdicts(clean)
+    print(f"serve-chaos clean verdicts: {cv}")
+
+    # ---- phase 1: poison + seeded transients under open-arrival load
+    poison_i = 1
+    poison_fp = health.history_fingerprint(hists[poison_i])
+
+    def poison_inj(ctx, attempt):
+        if (ctx.get("what") == "serve.batch"
+                and poison_fp in (ctx.get("members") or ())):
+            raise ValueError("chaos: injected poison member failure")
+
+    seeded = faults.seeded_injector(
+        opts.seed, transient_rate=0.25, oom_rate=0.0, what="ladder.",
+    )
+    svc = sv.CheckService(
+        max_batch=8, warm_pool=False, batch_window_s=0,
+        breaker_threshold=4, quarantine_ttl_s=300.0, **LADDER,
+    )
+    srv = web.make_server("127.0.0.1", 0, check_service=svc)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    scraper = MetricsScraper(srv.server_address[1]).start()
+    try:
+        with faults.inject_scope(seeded), faults.inject_scope(poison_inj):
+            futs: dict = {}
+            lock = threading.Lock()
+
+            def tenant(w: int):
+                for i in range(w, n, 4):
+                    f = svc.submit(hists[i], client=f"tenant-{w}")
+                    with lock:
+                        futs[i] = f
+                    time.sleep(0.002)
+
+            # Concurrent tenants race admission; the scheduler starts
+            # once the queue is populated so the poison request is a
+            # BATCH-START member of its geometry group's launch (a
+            # rung-boundary joiner only crashes the ladder mid-flight —
+            # which the bisection also recovers, but the injection seam
+            # that SIMULATES the crash fires at launch start).
+            ths = [threading.Thread(target=tenant, args=(w,))
+                   for w in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            svc.start()
+            got = {i: f.result(timeout=300) for i, f in futs.items()}
+        print("phase 1: poison + transients under load")
+        for i in range(n):
+            if i == poison_i:
+                check(
+                    got[i]["valid?"] == "unknown"
+                    and got[i].get("quarantined") is True,
+                    f"poison history {i} quarantined",
+                )
+            elif got[i]["valid?"] != cv[i]:
+                check(False, f"history {i}: clean={cv[i]!r} "
+                             f"served={got[i]['valid?']!r}")
+        check(all(got[i]["valid?"] == cv[i]
+                  for i in range(n) if i != poison_i),
+              "non-poison verdict parity vs clean baseline")
+        st = svc.stats()
+        check(st["poison_isolated"] == 1, "exactly one member isolated")
+        check(0 < st["bisect_launches"] <= health.bisect_launch_budget(8),
+              f"bisection bounded O(log n) "
+              f"({st['bisect_launches']} relaunches)")
+        rr = svc.submit(hists[poison_i], client="repeat").result(timeout=60)
+        st2 = svc.stats()
+        check(rr.get("quarantined") is True
+              and "repeat poison" in str(rr.get("cause")),
+              "repeat offender skips straight to rejection")
+        check(st2["bisect_launches"] == st["bisect_launches"],
+              "repeat offender costs no relaunches")
+        check(st2["breaker"]["state"] == "closed",
+              "breaker stays closed (innocents recovered)")
+
+        # ---- phase 5 (interleaved): /metrics vs harness accounting
+        mtr = scraper.scrape()
+        expect_submitted = float(n + 1)
+        checks = {
+            "submitted": (mtr.get("jepsen_tpu_serve_submitted_total"),
+                          expect_submitted),
+            "completed": (mtr.get("jepsen_tpu_serve_completed_total"),
+                          expect_submitted),
+            "quarantined": (mtr.get("jepsen_tpu_serve_quarantined_total"),
+                            1.0),
+            "quarantine_hits": (
+                mtr.get("jepsen_tpu_serve_quarantine_hit_total"), 1.0),
+            "queue_depth": (mtr.get("jepsen_tpu_serve_queue_depth"), 0.0),
+        }
+        bad = {k: v for k, v in checks.items() if v[0] != v[1]}
+        check(not bad, f"/metrics agrees with harness accounting {bad or ''}")
+        check(scraper.scrapes > 0, "mid-load /metrics scrapes happened")
+    finally:
+        scraper.stop()
+        srv.shutdown()
+        srv.server_close()
+        svc.shutdown(drain=False)
+
+    # ---- phase 2: hung launch -> watchdog cancel-and-retry
+    print("phase 2: hung launch")
+    state = {"hung": False}
+
+    def hang_inj(ctx, attempt):
+        if ctx.get("what") == "serve.batch" and not state["hung"]:
+            state["hung"] = True
+            time.sleep(6.0)
+
+    svc_h = sv.CheckService(
+        max_batch=8, warm_pool=False, batch_window_s=0,
+        watchdog_factor=4.0, watchdog_floor_s=1.5, watchdog_cap_s=3.0,
+        **LADDER,
+    ).start()
+    try:
+        with faults.inject_scope(hang_inj):
+            futs_h = [svc_h.submit(h) for h in hists[:6]]
+            got_h = [f.result(timeout=120) for f in futs_h]
+        sth = svc_h.stats()
+        check(sth["watchdog_trips"] >= 1, "watchdog tripped on the hang")
+        check(verdicts(got_h) == cv[:6],
+              "reduced-placement retry reproduced baseline verdicts")
+    finally:
+        svc_h.shutdown(drain=False)
+
+    # ---- phase 3: device loss -> placement shrink + parity re-probe
+    print("phase 3: device loss")
+
+    def dev_inj(ctx, attempt):
+        if (ctx.get("what") == "placement.probe"
+                and int(ctx.get("device", -1)) == 3):
+            raise RuntimeError("chaos: injected device loss")
+
+    svc_d = sv.CheckService(
+        devices=4, verify_placement=True, health_probe_every_s=0.0,
+        max_batch=8, warm_pool=False, batch_window_s=0, **LADDER,
+    )
+    futs_d = [svc_d.submit(h) for h in hists[:4]]
+    for _ in range(16):  # one batch per geometry group
+        if not svc_d.stats()["queue_depth"]:
+            break
+        svc_d.step()  # clean mesh batches (4 devices) + parity probe
+    got_d = [f.result(timeout=120) for f in futs_d]
+    check(verdicts(got_d) == cv[:4], "4-device mesh verdict parity")
+    with faults.inject_scope(dev_inj):
+        futs_d2 = [svc_d.submit(h) for h in hists[4:8]]
+        for _ in range(16):
+            if not svc_d.stats()["queue_depth"]:
+                break
+            svc_d.step()  # probe fails device 3 -> shrink to survivors
+    got_d2 = [f.result(timeout=120) for f in futs_d2]
+    std = svc_d.stats()
+    check(std["devices_replaced"] >= 1, "failed device detected")
+    check(std["placement"]["devices"] == 3,
+          "placement shrunk to the 3 survivors")
+    check(verdicts(got_d2) == cv[4:8],
+          "post-shrink verdict parity (parity probe re-ran)")
+
+    # ---- phase 4: real SIGKILL + journal replay
+    print("phase 4: SIGKILL + journal replay")
+    with tempfile.TemporaryDirectory(prefix="chaos-journal-") as jd:
+        src = _SERVE_CHILD_SRC.format(
+            repo=str(REPO), tools=str(REPO / "tools"),
+            n=n, ops=opts.ops, procs=opts.procs, jdir=jd,
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO),
+            timeout=600,
+        )
+        check(p.returncode == -signal.SIGKILL,
+              f"child died by SIGKILL (rc={p.returncode})")
+        entries = sv.health.AdmissionJournal(jd).replay()
+        check(len(entries) == n,
+              f"journal survived with all {n} admitted requests "
+              f"({len(entries)} found)")
+        svc_r = sv.CheckService(warm_pool=False, journal_dir=jd, **LADDER)
+        replayed = svc_r.recover()
+        check(replayed == len(entries), "recover() replayed every entry")
+        for _ in range(64):
+            if not svc_r.stats()["queue_depth"]:
+                break
+            svc_r.step()
+        rv = []
+        for e in entries:
+            req = svc_r.get(e["id"])
+            rv.append(req.result["valid?"]
+                      if req is not None and req.result else None)
+        check(rv == cv, "replayed verdicts identical to clean baseline "
+                        "(ids preserved across the crash)")
+        check(svc_r.journal.depth() == 0,
+              "journal drained as the replayed requests settled")
+        svc_r.shutdown(drain=False)
+
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--histories", type=int, default=16)
@@ -178,11 +452,26 @@ def main(argv=None) -> int:
                     help="skip the subprocess SIGKILL/resume cycle")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny variant for the tier-1 test run")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the chaos-under-load gate against a live "
+                         "CheckService instead of the bare ladder "
+                         "(poison quarantine, hung-launch watchdog, "
+                         "device loss, SIGKILL + journal replay, "
+                         "/metrics consistency)")
     opts = ap.parse_args(argv)
     if opts.smoke:
         opts.histories, opts.ops, opts.procs, opts.runs = 5, 30, 4, 1
         opts.kill_after = 1  # kill right after the first checkpoint: the
         # child pays one stage, the resume still has real ladder work
+
+    if opts.serve:
+        failures = serve_chaos(opts)
+        print(json.dumps({
+            "metric": "chaos_serve",
+            "histories": max(8, opts.histories),
+            "failures": failures,
+        }))
+        return 0 if failures == 0 else 1
 
     hists = build_histories(opts.histories, opts.ops, opts.procs)
     clean = pb.batch_analysis(m.CASRegister(None), hists, **LADDER)

@@ -22,6 +22,7 @@ env > 850 (headroom under the 870 s cap).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import re
 import sys
@@ -64,6 +65,11 @@ def main(argv=None) -> int:
     ap.add_argument("--budget", type=float, default=None,
                     help="budget in seconds (default: "
                          "$JEPSEN_TPU_TIER1_BUDGET_S or 850)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON line (seconds, "
+                         "budget, headroom, ok, slowest tests) instead of "
+                         "prose — for the docker test entrypoint and CI "
+                         "dashboards; the exit code contract is unchanged")
     a = ap.parse_args(argv)
 
     budget = a.budget
@@ -79,9 +85,31 @@ def main(argv=None) -> int:
                 else open(a.log, encoding="utf-8", errors="replace").read())
         seconds, durations = parse_log(text)
         if seconds is None:
-            print("check_tier1_budget: no pytest summary line found "
-                  f"in {a.log!r} (did the suite crash?)", file=sys.stderr)
+            if a.json:
+                print(json.dumps({
+                    "metric": "tier1_budget", "ok": False,
+                    "error": "no pytest summary line found",
+                    "budget_s": budget,
+                }))
+            else:
+                print("check_tier1_budget: no pytest summary line found "
+                      f"in {a.log!r} (did the suite crash?)", file=sys.stderr)
             return 2
+
+    if a.json:
+        ok = seconds <= budget
+        print(json.dumps({
+            "metric": "tier1_budget",
+            "ok": ok,
+            "seconds": round(seconds, 2),
+            "budget_s": budget,
+            "headroom_s": round(budget - seconds, 2),
+            "slowest": [
+                {"seconds": secs, "test": test}
+                for secs, test in durations[:10]
+            ],
+        }))
+        return 0 if ok else 1
 
     if seconds <= budget:
         print(f"tier-1 budget OK: {seconds:.1f}s <= {budget:.0f}s "
