@@ -80,6 +80,15 @@ def add_test_opts(p: argparse.ArgumentParser):
                         "ladder rungs: 'sort' (multi-key hash sort) or "
                         "'bucket' (packed radix buckets); default: env "
                         "JEPSEN_TPU_DEDUP_BACKEND, else 'sort'")
+    p.add_argument("--frontier-budget-mb", type=float, default=None,
+                   metavar="MB",
+                   help="device-memory budget for the exact checker's "
+                        "frontier working set: ladder rungs that don't "
+                        "fit host-spill overflow rows instead of going "
+                        "lossy, and a history fixed memory can't decide "
+                        "returns an unknown carrying a machine-readable "
+                        "undecidability report (default: env "
+                        "JEPSEN_TPU_FRONTIER_BUDGET_MB, else unbounded)")
     p.add_argument("--check-deadline", type=float, default=None,
                    metavar="SECONDS",
                    help="wall-clock budget for the checker phase: on "
@@ -429,6 +438,12 @@ def run_cli(
         # every engine — batched ladder, chunked escalations, confirm
         # launches — without threading through each test map.
         os.environ["JEPSEN_TPU_DEDUP_BACKEND"] = opts.dedup_backend
+    if getattr(opts, "frontier_budget_mb", None) is not None:
+        # Same env-threading as the dedup backend: ops.spill resolves
+        # the budget at call time, so the flag reaches the chunked
+        # exact paths inside every engine without new plumbing.
+        os.environ["JEPSEN_TPU_FRONTIER_BUDGET_MB"] = str(
+            opts.frontier_budget_mb)
     try:
         if opts.command == "test":
             return _cmd_test(test_fn, opts)

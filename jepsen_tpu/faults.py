@@ -200,14 +200,25 @@ def seeded_injector(
 #: launch is recorded.
 _LAUNCH_EWMA_ALPHA = 0.2
 _launch_ewma_s: float | None = None
+_retry_launch_count = 0
 _launch_ewma_lock = threading.Lock()
 
 
-def record_launch_seconds(seconds: float) -> None:
+def record_launch_seconds(seconds: float, *, retry: bool = False) -> None:
     """Fold one device launch's wall clock into the process-wide launch
-    EWMA (called by the ladder's instrumented launch wrapper)."""
-    global _launch_ewma_s
+    EWMA (called by the ladder's instrumented launch wrapper).
+
+    ``retry=True`` marks an OOM-halved or spill-retry sub-launch: those
+    run at a REDUCED size, so folding them in would drag the EWMA down
+    and make the watchdog's ``factor × EWMA`` wall caps trip HEALTHY
+    full-size launches right after an OOM episode (round-8 satellite).
+    Retry launches are counted (``retry_launch_count``) but excluded
+    from the baseline."""
+    global _launch_ewma_s, _retry_launch_count
     with _launch_ewma_lock:
+        if retry:
+            _retry_launch_count += 1
+            return
         if _launch_ewma_s is None:
             _launch_ewma_s = float(seconds)
         else:
@@ -218,9 +229,68 @@ def record_launch_seconds(seconds: float) -> None:
 
 
 def launch_seconds_ewma() -> float | None:
-    """The smoothed per-launch wall clock (None before any launch)."""
+    """The smoothed per-launch wall clock (None before any launch).
+    Fed only by FULL-SIZE launches — see record_launch_seconds."""
     with _launch_ewma_lock:
         return _launch_ewma_s
+
+
+def retry_launch_count() -> int:
+    """How many reduced-size (OOM-halved / spill-retry) launches were
+    excluded from the EWMA baseline (tests and telemetry)."""
+    with _launch_ewma_lock:
+        return _retry_launch_count
+
+
+# ---------------------------------------------------------------------------
+# OOM spill policy: free device memory before shrinking the work
+# ---------------------------------------------------------------------------
+
+#: registered spillers, called in order by try_oom_spill.  A spiller
+#: takes the launch ctx and returns truthy when it actually freed
+#: something (e.g. parallel.batch registers ops.wgl.evict_runner_caches
+#: on non-CPU backends).
+_OOM_SPILLERS: list[Callable[[Mapping], object]] = []
+_OOM_SPILLERS_LOCK = threading.Lock()
+
+
+def register_oom_spiller(fn: Callable[[Mapping], object]) -> None:
+    """Register a device-memory spiller for the OOM policy (idempotent
+    per function object).  Spillers must be safe to call from any
+    launch site and return truthy iff they freed device memory."""
+    with _OOM_SPILLERS_LOCK:
+        if fn not in _OOM_SPILLERS:
+            _OOM_SPILLERS.append(fn)
+
+
+def unregister_oom_spiller(fn: Callable[[Mapping], object]) -> None:
+    with _OOM_SPILLERS_LOCK:
+        if fn in _OOM_SPILLERS:
+            _OOM_SPILLERS.remove(fn)
+
+
+def try_oom_spill(ctx: Mapping | None = None) -> bool:
+    """The OOM ladder's FIRST rung (round 8): before halving the
+    sub-batch — which costs verdict lanes and probes the fault again —
+    ask the registered spillers to free device memory so the SAME
+    launch can retry at full size.  Returns True iff any spiller
+    reported freeing something; the caller then retries once and only
+    falls back to halving if the retry OOMs too.  A broken spiller is
+    swallowed: the spill rung is an optimization, halving still
+    backstops it."""
+    ctx = dict(ctx or {})
+    with _OOM_SPILLERS_LOCK:
+        spillers = list(_OOM_SPILLERS)
+    freed = False
+    for fn in spillers:
+        try:
+            freed = bool(fn(ctx)) or freed
+        except Exception:  # noqa: BLE001 — see docstring
+            continue
+    if freed:
+        # mirrors to /metrics as jepsen_tpu_fault_oom_spill_total
+        obs.counter("fault.oom.spill", what=str(ctx.get("what") or "launch"))
+    return freed
 
 #: substrings that mark an exception as out-of-memory (halve, don't retry
 #: the same shape — the same launch would OOM again).

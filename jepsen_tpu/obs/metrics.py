@@ -36,6 +36,16 @@ restart), and ``serve_drain_errors_total`` /
 ``serve_placement_probe_errors_total`` (previously-swallowed drain and
 parity-probe failures, now counted).
 
+The bounded-memory layer (``jepsen_tpu.ops.spill``) feeds through the
+obs mirror: ``jepsen_tpu_frontier_spill_rows_total`` /
+``jepsen_tpu_frontier_spill_bytes_total`` (host-spilled frontier
+volume), ``jepsen_tpu_frontier_spill_merges_total`` (LSH-bucketed
+recombines), ``jepsen_tpu_frontier_factorizations_total`` (crashed-op
+groups factored away), ``jepsen_tpu_frontier_undecidable_total``
+(honest-exhaustion reports, explicit — events don't mirror), and
+``jepsen_tpu_fault_oom_spill_total`` (OOM launches recovered by
+spilling device memory instead of halving work).
+
 Import-light by design (stdlib only — obs and faults import this
 module, and both must stay jax-free).  Everything is thread-safe; label
 sets are expected to be tiny (verdict, fault kind), never unbounded

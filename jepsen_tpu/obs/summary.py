@@ -25,6 +25,9 @@ later perf PRs report against.
                  "execute_s", "peak_frontier", "lossy", "dedup"}, ...]
    "dedup":    [{"backend", "candidates", "capacity", "probes",
                  "per_round_us"}, ...]                  # dedup.round spans
+   "memory":   {"device_bytes_peak", "spill_rows", "spill_bytes",
+                "spill_merges", "factorizations", "undecidable",
+                "oom_spills"}          # bounded-memory layer (ops.spill)
    "faults":   [{"fault", "count", "seconds", "detail"}, ...]  # fault.* events
    "counters": {name: total}
    "gauges":   {name: last value}
@@ -102,6 +105,10 @@ def summarize(events: Iterable[Mapping]) -> dict:
     #: weighted by rung count (serve.batch spans carry the per-ladder
     #: mean + rung count; joiners admitted at rung boundaries).
     serve_cont = {"rungs": 0, "occ": 0.0, "joined": 0}
+    #: bounded-memory accumulators (frontier.* counters/events + the
+    #: device.buffer_bytes gauge's MAX — the gauges section keeps only
+    #: the last write, which understates a run's true high-water mark).
+    mem = {"device_bytes_peak": 0, "undecidable": 0}
     wall = 0.0
 
     def _fault_row(name: str) -> dict:
@@ -214,10 +221,19 @@ def summarize(events: Iterable[Mapping]) -> dict:
                     f["detail"] = _fault_detail(ev["attrs"])
         elif et == "gauge":
             wall = max(wall, t)
-            gauges[str(ev.get("name"))] = ev.get("value")
+            name = str(ev.get("name"))
+            gauges[name] = ev.get("value")
+            if name == "device.buffer_bytes":
+                try:
+                    mem["device_bytes_peak"] = max(
+                        mem["device_bytes_peak"], int(ev.get("value") or 0))
+                except (TypeError, ValueError):
+                    pass
         elif et == "event":
             wall = max(wall, t)
             name = str(ev.get("name"))
+            if name == "frontier.undecidable":
+                mem["undecidable"] += 1
             if name.startswith("fault."):
                 f = _fault_row(name)
                 f["count"] += 1
@@ -276,6 +292,21 @@ def summarize(events: Iterable[Mapping]) -> dict:
             }
             for tier, sc in sorted(serve_class.items())
         }
+    memory: dict = {}
+    mem_counters = {
+        "spill_rows": "frontier.spill_rows",
+        "spill_bytes": "frontier.spill_bytes",
+        "spill_merges": "frontier.spill_merges",
+        "factorizations": "frontier.factorizations",
+        "oom_spills": "fault.oom.spill",
+    }
+    for out_key, cname in mem_counters.items():
+        if cname in counters:
+            memory[out_key] = counters[cname]
+    if mem["device_bytes_peak"]:
+        memory["device_bytes_peak"] = mem["device_bytes_peak"]
+    if mem["undecidable"]:
+        memory["undecidable"] = mem["undecidable"]
     for cname in ("submitted", "completed", "rejected", "expired", "drained",
                   "fastpath_resolved", "fastpath_escalated",
                   # self-healing layer (serve.health)
@@ -292,6 +323,7 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "serve": serve,
         "ladder": ladder,
         "dedup": out_dedup,
+        "memory": memory,
         "faults": out_faults,
         "counters": counters,
         "gauges": gauges,
@@ -389,6 +421,13 @@ def format_summary(summary: Mapping) -> str:
               d.get("probes"), d.get("per_round_us")]
              for d in summary["dedup"]],
         ))
+    if summary.get("memory"):
+        mm = summary["memory"]
+        parts.append("\nmemory (host spill / factorization / device peak):")
+        rows = [[k, mm[k]] for k in (
+            "device_bytes_peak", "spill_rows", "spill_bytes", "spill_merges",
+            "factorizations", "oom_spills", "undecidable") if k in mm]
+        parts.append(_table(["memory", "value"], rows))
     if summary.get("faults"):
         parts.append("\nfaults (retries / degradations / checkpoints / deadline):")
         parts.append(_table(

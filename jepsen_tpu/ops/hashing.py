@@ -130,6 +130,44 @@ def hash_rows(columns, seed: int):
     return h
 
 
+def np_mix32(x: np.ndarray) -> np.ndarray:
+    """Host-side mirror of ``mix32`` — bit-identical on the same input, so
+    hashes computed on either side of a device→host spill agree (the
+    host-spill merge's LSH bucket keys ARE the kernel's class hashes)."""
+    x = np.asarray(x).astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def np_hash_rows(columns, seed: int) -> np.ndarray:
+    """Host-side mirror of ``hash_rows`` (same constants, same fold
+    order; differential-tested against the device version)."""
+    cols = [np.asarray(c) for c in columns]
+    h = np.full(cols[0].shape, np.uint32(seed ^ 0x9E3779B9), np.uint32)
+    for col in cols:
+        h = np_mix32(h ^ col.astype(np.uint32))
+    return h
+
+
+def np_class_hash(state, fok) -> tuple[np.ndarray, np.ndarray]:
+    """Two 32-bit LSH lanes over a frontier's (state, fok) CLASS columns,
+    host-side.  Identical classes always share both lanes, so the 64-bit
+    key is a locality-sensitive bucket id: the host-spill merge
+    (``jepsen_tpu.ops.spill.merge_frontiers``) sorts on it and runs exact
+    dedup/domination only within equal-key runs — the near-duplicate
+    neighborhoods of the LSH-beam-search literature (PAPERS:
+    1806.00588), on the same packed-key machinery the device bucket
+    backend uses."""
+    state = np.asarray(state)
+    fok = np.asarray(fok)
+    cols = [state] + [fok[:, k] for k in range(fok.shape[1])]
+    return np_hash_rows(cols, 0xB00B_135), np_hash_rows(cols, 0x1CEB_00DA)
+
+
 def _keep_sort(h1, h2, alive, window: int):
     """Hash-dup keep mask, sort formulation: ONE single-key sort carrying
     the hash lanes and a packed (alive | index) payload; a row is a dup

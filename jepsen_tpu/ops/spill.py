@@ -1,0 +1,544 @@
+"""Bounded-memory frontier management for the exact checker.
+
+The exact engine's hard failure mode is an information-heavy history
+whose frontier outgrows every capacity rung: before this module the
+checker's only responses to memory pressure were truncation (lossy),
+capacity escalation (bounded by the ladder), or exhaustion (a bare
+``unknown``).  The paper's rule is "never a wrong verdict, minimize
+:unknown" — so memory pressure becomes a managed, recoverable resource
+with four pieces, all exact:
+
+  * **Host-spill** (``HostRing`` + the slicing loop in
+    ``ops.wgl.chunked_analysis``): the frontier-set sweep is linear in
+    the frontier — scanning a chunk of barriers from A ∪ B equals the
+    union of scanning from A and from B (each configuration's futures
+    are independent; dedup/domination only remove redundant rows).  So
+    a carried frontier that exceeds a rung's device capacity is SPLIT:
+    slices of ≤ capacity rows stream through the same compiled chunk
+    kernel one at a time while the overflow waits in a host ring
+    (device→host copies start asynchronously, overlapping the next
+    device-bound slice), and the slice outputs recombine by exact
+    union.  Rows are never silently dropped; refutation requires EVERY
+    slice to die (the union frontier dies at the latest slice death).
+
+  * **LSH-bucketed merge** (``merge_frontiers``): recombining slices
+    needs exact dedup + domination over an unbounded host-side row set.
+    Rows are bucketed by the 64-bit (state, fok) class hash
+    (``ops.hashing.np_class_hash`` — the same packed-key machinery as
+    the device bucket backend, per 1806.00588's LSH-for-beam-search),
+    and the O(k²) exact compares run only within equal-key runs:
+    identical classes always collide into one bucket, so per-bucket
+    exact work is globally exact, and cross-bucket rows — provably
+    distinct classes — are never compared at all.
+
+  * **Crashed-op group factorization** (``factor_packed``): a crashed
+    group whose op is trace-independent of every other op in the
+    history (legality-preserving in both directions and commuting, over
+    the closed reachable state set — tabulated exactly from the tensor
+    model's step function) splits off as its OWN factor of the search
+    space.  Crashed ops carry no obligations, so that factor's check is
+    closed-form — a witness exists firing none of them — and the factor
+    is removed from the device problem entirely: G shrinks, the fcr
+    product space shrinks structurally, and the verdict provably equals
+    the monolithic one (witnesses map both ways by commuting the
+    independent fires to the end and dropping them).
+
+  * **Honest exhaustion** (``undecidability_report``): when fixed
+    memory still cannot decide — a single barrier's closure overflows
+    the budget ceiling with nothing left to split — the resulting
+    ``unknown`` carries a machine-readable report (peak frontier growth
+    rate, spill volume, budget at exhaustion, factor count) instead of
+    a bare cause string.  The OOM ladder never lies: it either decides,
+    or says exactly why it could not.
+
+Telemetry: ``frontier.spill_rows`` / ``frontier.spill_bytes`` counters,
+``frontier.factorizations``, ``frontier.spill_merges``, and the
+``frontier.undecidable`` event, mirrored to the live /metrics registry
+as ``jepsen_tpu_frontier_spill_bytes_total`` and
+``jepsen_tpu_frontier_factorizations_total`` for serving processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from jepsen_tpu import obs
+from jepsen_tpu.obs import metrics as _metrics
+from jepsen_tpu.ops import hashing
+
+FRONTIER_BUDGET_ENV = "JEPSEN_TPU_FRONTIER_BUDGET_MB"
+
+#: Working-set multiplier: a rung at capacity F materializes the
+#: F·(1+P+G)-row candidate table plus sort scratch and the 2C prune
+#: buffer — ~3x the candidate table covers the measured footprint with
+#: headroom (conservative by construction: a low estimate only spills
+#: earlier, never OOMs later).
+_WORKING_SET_FACTOR = 3
+
+#: process-wide spill/factorization totals (service stats read these;
+#: the obs counters are per-recording, the /metrics mirror per-process).
+_TOTALS = {
+    "spill_rows": 0, "spill_bytes": 0, "spill_merges": 0,
+    "factorizations": 0, "undecidable_reports": 0,
+}
+_TOTALS_LOCK = threading.Lock()
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _TOTALS_LOCK:
+        _TOTALS[key] += n
+
+
+def stats_snapshot() -> dict:
+    """The process-wide bounded-memory totals (CheckService.stats()'s
+    "memory" block)."""
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
+def row_bytes(W: int, G: int) -> int:
+    """Device bytes per frontier row: int32 state + W uint32 fok lanes +
+    G int16 fcr counts + the alive bool."""
+    return 4 + 4 * W + 2 * G + 1
+
+
+def resolve_budget_mb(budget_mb: float | None = None) -> float | None:
+    """Explicit argument > JEPSEN_TPU_FRONTIER_BUDGET_MB env > None
+    (no budget: the capacity ladder alone bounds device rows)."""
+    if budget_mb is not None:
+        return float(budget_mb)
+    v = os.environ.get(FRONTIER_BUDGET_ENV)
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return None
+
+
+def budget_rows(budget_mb: float | None, W: int, G: int, P: int) -> int | None:
+    """The device frontier-row budget a ``--frontier-budget-mb`` value
+    buys at this geometry, under the rung working-set model (candidate
+    table is F·(1+P+G) rows; ×_WORKING_SET_FACTOR for scratch).  Never
+    below 1 — the smallest rung always runs."""
+    if budget_mb is None:
+        return None
+    per_row = row_bytes(W, G) * (1 + P + G) * _WORKING_SET_FACTOR
+    return max(1, int(budget_mb * 1e6) // max(1, per_row))
+
+
+# ---------------------------------------------------------------------------
+# Host spill ring
+# ---------------------------------------------------------------------------
+
+
+class HostRing:
+    """A host-side ring of spilled frontier rows.
+
+    ``push`` accepts device (jax) or host (numpy) arrays; device arrays
+    start their device→host copies ASYNCHRONOUSLY at push time
+    (``copy_to_host_async`` when the backend exposes it), so the copy
+    drains while the next device-bound slice launches — the
+    streaming-overlap shape of 2010.02164's occupancy math.  Rows
+    materialize host-side only at ``pop``.  Nothing is ever dropped:
+    the ring is unbounded by design (host RAM is the spill medium), and
+    its byte/row counters are the spill-volume telemetry the
+    undecidability report and /metrics export."""
+
+    def __init__(self, W: int, G: int):
+        self.W = int(W)
+        self.G = int(G)
+        self._entries: list[tuple] = []  # (state, fok, fcr) pending rows
+        self.rows = 0
+        self.rows_total = 0
+        self.bytes_total = 0
+
+    @staticmethod
+    def _start_async(a):
+        fn = getattr(a, "copy_to_host_async", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — the copy is an overlap
+                pass  # optimization; np.asarray at pop still works
+
+    def push(self, state, fok, fcr, alive=None) -> int:
+        """Spill rows (optionally masked by ``alive``) into the ring;
+        returns the row count.  Device inputs begin their host copies
+        immediately; masking is deferred to pop so the device isn't
+        blocked here."""
+        n = int(state.shape[0]) if alive is None else None
+        for a in (state, fok, fcr, alive):
+            if a is not None:
+                self._start_async(a)
+        self._entries.append((state, fok, fcr, alive))
+        if n is None:
+            # the alive count isn't known without materializing; account
+            # rows at pop time instead (bytes ride along)
+            return 0
+        self._account(n)
+        return n
+
+    def _account(self, n: int) -> None:
+        if n <= 0:
+            return
+        nbytes = n * row_bytes(self.W, self.G)
+        self.rows += n
+        self.rows_total += n
+        self.bytes_total += nbytes
+        _count("spill_rows", n)
+        _count("spill_bytes", nbytes)
+        # obs.counter mirrors into the live /metrics registry by name
+        # when the mirror is on (jepsen_tpu_frontier_spill_bytes_total)
+        obs.counter("frontier.spill_rows", n)
+        obs.counter("frontier.spill_bytes", nbytes)
+
+    def discard(self) -> None:
+        """Drop pending entries WITHOUT accounting them as spill — used
+        when a capacity-escalation retry discards an attempt's outputs
+        (the rows were never part of an accepted pass)."""
+        self._entries = []
+        self.rows = 0
+
+    def pop_all(self) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+        """Materialize and drain every spilled row, in push order.
+        Returns (state, fok, fcr) host arrays or None when empty."""
+        if not self._entries:
+            return None
+        parts = []
+        for state, fok, fcr, alive in self._entries:
+            st = np.asarray(state)
+            fo = np.asarray(fok)
+            fc = np.asarray(fcr)
+            if alive is not None:
+                sel = np.flatnonzero(np.asarray(alive))
+                st, fo, fc = st[sel], fo[sel], fc[sel]
+                self._account(int(sel.size))
+            parts.append((st, fo, fc))
+        self._entries = []
+        self.rows = 0
+        if len(parts) == 1:
+            return parts[0]
+        return (
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts], axis=0),
+            np.concatenate([p[2] for p in parts], axis=0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# LSH-bucketed exact merge (dedup + domination on the host)
+# ---------------------------------------------------------------------------
+
+
+def merge_frontiers(parts) -> tuple[np.ndarray, np.ndarray, np.ndarray, dict]:
+    """Union spilled/sliced frontier parts into one exact antichain.
+
+    ``parts``: iterable of (state [n], fok [n, W], fcr [n, G]) host
+    arrays.  Rows sort by the 64-bit (state, fok) class hash — the LSH
+    bucket key (``hashing.np_class_hash``) — then exact duplicate and
+    domination kills run ONLY within equal-key runs: identical classes
+    always share both hash lanes, so per-run exact work is globally
+    exact (a cross-bucket pair is provably a different class, where
+    domination cannot apply).  Within a class, the antichain keeps
+    pointwise-minimal fcr rows, first copy in input order on ties —
+    the same contract as the device ``exact_prune``.
+
+    Returns (state, fok, fcr, stats) with stats = {"rows_in",
+    "rows_out", "buckets"}.
+    """
+    parts = [p for p in parts if p is not None and p[0].shape[0]]
+    if not parts:
+        z = np.zeros(0, np.int32)
+        return z, np.zeros((0, 1), np.uint32), np.zeros((0, 1), np.int16), {
+            "rows_in": 0, "rows_out": 0, "buckets": 0}
+    state = np.concatenate([np.asarray(p[0]) for p in parts])
+    fok = np.concatenate([np.asarray(p[1]) for p in parts], axis=0)
+    fcr = np.concatenate([np.asarray(p[2]) for p in parts], axis=0)
+    n = state.shape[0]
+    h1, h2 = hashing.np_class_hash(state, fok)
+    order = np.lexsort((np.arange(n), h2, h1))
+    sh1, sh2 = h1[order], h2[order]
+    # equal-(h1,h2) run boundaries — the LSH buckets
+    new_run = np.ones(n, bool)
+    new_run[1:] = (sh1[1:] != sh1[:-1]) | (sh2[1:] != sh2[:-1])
+    starts = np.flatnonzero(new_run)
+    ends = np.append(starts[1:], n)
+    keep = np.ones(n, bool)  # in sorted order
+    for lo, hi in zip(starts, ends):
+        if hi - lo == 1:
+            continue
+        idx = order[lo:hi]  # input order within the bucket (lexsort stable)
+        bst, bfo, bfc = state[idx], fok[idx], fcr[idx]
+        # exact class split inside the bucket (hash collisions between
+        # distinct classes are ~1e-13 but kills must stay content-decided)
+        same = (bst[:, None] == bst[None, :]) & (
+            bfo[:, None, :] == bfo[None, :, :]).all(-1)
+        le = (bfc[:, None, :] <= bfc[None, :, :]).all(-1)
+        lt = (bfc[:, None, :] < bfc[None, :, :]).any(-1)
+        k = hi - lo
+        earlier = np.arange(k)[:, None] < np.arange(k)[None, :]
+        # kill j when an equal-class i is pointwise ≤ and either strictly
+        # smaller or earlier (ties keep the first copy); kills through
+        # killed intermediaries are sound by transitivity
+        killer = same & le & (lt | earlier)
+        np.fill_diagonal(killer, False)
+        keep[lo:hi] = ~killer.any(axis=0)
+    sel = order[keep]
+    sel.sort()  # restore input order (deterministic downstream slicing)
+    stats = {"rows_in": int(n), "rows_out": int(sel.size),
+             "buckets": int(starts.size)}
+    _count("spill_merges")
+    obs.counter("frontier.spill_merges")
+    return state[sel], fok[sel], fcr[sel], stats
+
+
+# ---------------------------------------------------------------------------
+# Crashed-op group factorization
+# ---------------------------------------------------------------------------
+
+
+def _distinct_ops(packed) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Every distinct (f, v1, v2) op the packed history can fire:
+    returning ops (active barriers), open ok movers, and live crashed
+    groups."""
+    bar_f, bar_v1, bar_v2, _slot = packed["bar"]
+    mov_f, mov_v1, mov_v2, mov_open = packed["mov"]
+    grp_f, grp_v1, grp_v2 = packed["grp"]
+    act = np.asarray(packed["bar_active"], bool)
+    mo = np.asarray(mov_open, bool)
+    live = np.asarray(packed["grp_open"]).max(axis=0) > 0
+    triples = np.concatenate([
+        np.stack([np.asarray(bar_f)[act], np.asarray(bar_v1)[act],
+                  np.asarray(bar_v2)[act]], axis=1),
+        np.stack([np.asarray(mov_f)[mo], np.asarray(mov_v1)[mo],
+                  np.asarray(mov_v2)[mo]], axis=1),
+        np.stack([np.asarray(grp_f)[live], np.asarray(grp_v1)[live],
+                  np.asarray(grp_v2)[live]], axis=1),
+    ]).astype(np.int64)
+    return np.unique(triples, axis=0).T
+
+
+def reachable_states(step, init_state: int, ops, max_states: int = 256,
+                     depth_cap: int = 64):
+    """Tabulate the states reachable from ``init`` in at most
+    ``depth_cap`` fires of the distinct ops, via the tensor model's step
+    function (host-driven BFS with per-state min-depth).
+
+    A linearization fires each ok op once and each crashed group at
+    most its open count, so ``depth_cap`` = the history's total fire
+    budget covers every state a witness can visit — models whose state
+    space is unbounded under unlimited re-firing (the counter) still
+    tabulate finitely.  Returns (states sorted, min_depth aligned,
+    closed?) — ``closed`` means a true fixpoint was reached below the
+    cap — or None when the table exceeds ``max_states`` (callers skip
+    factorization: never a wrong factor, only a missed one)."""
+    f, v1, v2 = (np.asarray(a, np.int32) for a in ops)
+    depth = {int(init_state): 0}
+    frontier = np.array([init_state], np.int32)
+    closed = False
+    for d in range(1, depth_cap + 1):
+        nxt, legal = step(frontier[:, None], f[None, :], v1[None, :],
+                          v2[None, :])
+        nxt = np.asarray(nxt)[np.asarray(legal)]
+        new = [int(s) for s in np.unique(nxt) if int(s) not in depth]
+        if not new:
+            closed = True
+            break
+        if len(depth) + len(new) > max_states:
+            return None
+        for s in new:
+            depth[s] = d
+        frontier = np.asarray(new, np.int32)
+    states = np.array(sorted(depth), np.int32)
+    depths = np.array([depth[int(s)] for s in states], np.int32)
+    return states, depths, closed
+
+
+def independent_groups(packed, max_states: int = 256) -> list[int]:
+    """Live crashed-group indices that are trace-independent of EVERY
+    distinct op in the history (themselves included), over the
+    tabulated reachable state set:
+
+      for all reachable s, ops g (the group's) and b:
+        (i)  g preserves b's legality and vice versa
+             (legal(s·g, b) == legal(s, b) whenever g is legal at s, and
+             symmetrically), and
+        (ii) they commute where both are legal (s·g·b == s·b·g).
+
+    Such a group's fires can be commuted to the end of any witness and
+    dropped (crashed ops carry no obligations), and conversely any
+    witness ignoring the group is a witness of the full history — so
+    deleting the group preserves the verdict EXACTLY, refutations
+    included.  The conditions are checked at every state a witness can
+    visit (fire-budget depth); lookup rows one/two fires deeper are in
+    the table by construction.  Returns [] when the state space is
+    unbounded at the cap or there is no tensor-model structure to
+    tabulate."""
+    try:
+        f, v1, v2 = _distinct_ops(packed)
+    except Exception:  # noqa: BLE001 — malformed tables: skip, never fail
+        return []
+    if f.size == 0 or f.size > 128:
+        return []
+    # the history's total fire budget: each active barrier's ok op fires
+    # once; each crashed group at most its max open count
+    fire_budget = int(np.asarray(packed["bar_active"], bool).sum())
+    fire_budget += int(np.asarray(packed["grp_open"]).max(axis=0).sum())
+    tab = reachable_states(
+        packed["step"], int(packed["init_state"]), (f, v1, v2),
+        max_states, depth_cap=fire_budget + 2)
+    if tab is None:
+        return []
+    states, depths, closed = tab
+    S = states.size
+    O = f.size
+    nxt, legal = packed["step"](
+        states[:, None].astype(np.int32), f[None, :].astype(np.int32),
+        v1[None, :].astype(np.int32), v2[None, :].astype(np.int32))
+    N = np.asarray(nxt)          # [S, O] next-state values
+    L = np.asarray(legal)        # [S, O] legality
+    # row index of each next state in the vocabulary; successors of
+    # boundary (deepest) states may fall outside — those rows are only
+    # dereferenced from interior states, masked below
+    Nrow = np.searchsorted(states, N)
+    Nrow = np.clip(Nrow, 0, S - 1)
+    in_vocab = states[Nrow] == N
+    Nrow = np.where(L & in_vocab, Nrow, 0)
+    # states a witness can visit: everything when the table closed, else
+    # fire-budget depth (lookups at +1/+2 fires stay in the table)
+    interior = (
+        np.ones(S, bool) if closed else depths <= max(0, fire_budget)
+    )
+    if closed is False and not (in_vocab | ~L)[interior].all():
+        return []  # a witness state's successor left the table: bail
+
+    def independent(a: int, b: int) -> bool:
+        La, Lb = L[:, a] & interior, L[:, b] & interior
+        # (i) mutual legality preservation
+        if not np.array_equal(L[Nrow[:, a], b][La], L[:, b][La]):
+            return False
+        if not np.array_equal(L[Nrow[:, b], a][Lb], L[:, a][Lb]):
+            return False
+        # (ii) commutation where both legal
+        both = La & Lb
+        if not np.array_equal(N[Nrow[:, a], b][both], N[Nrow[:, b], a][both]):
+            return False
+        return True
+
+    grp_f, grp_v1, grp_v2 = (np.asarray(a) for a in packed["grp"])
+    live = np.asarray(packed["grp_open"]).max(axis=0) > 0
+    # map each live group to its column in the distinct-op table
+    keys = {tuple(t): i for i, t in enumerate(np.stack([f, v1, v2], axis=1))}
+    out = []
+    for g in np.flatnonzero(live):
+        a = keys.get((int(grp_f[g]), int(grp_v1[g]), int(grp_v2[g])))
+        if a is None:
+            continue
+        if all(independent(a, b) for b in range(O)):
+            out.append(int(g))
+    return out
+
+
+def factor_packed(packed, max_states: int = 256) -> tuple[dict, int]:
+    """Split independent crashed-op groups off the packed problem.
+
+    Each independent group is its own factor of the search space; a
+    factor holding only optional crashed ops is decided closed-form
+    (valid — fire nothing) and recombines as a no-op under AND, so the
+    group is REMOVED: the returned pack has the survivors' grp columns
+    only, shrinking G (the fcr product dimension) structurally.  The
+    input dict is not mutated.  Returns (packed', factors_dropped)."""
+    try:
+        drop = independent_groups(packed, max_states)
+    except Exception:  # noqa: BLE001 — factorization is an optimization;
+        # a tabulation bug must degrade to "no factors", never to a crash
+        drop = []
+    if not drop:
+        return packed, 0
+    G0 = packed["G"]
+    keep = [g for g in range(G0) if g not in set(drop)]
+    grp_f, grp_v1, grp_v2 = (np.asarray(a) for a in packed["grp"])
+    grp_open = np.asarray(packed["grp_open"])
+    if keep:
+        k = np.asarray(keep, np.int64)
+        new_grp = (grp_f[k].copy(), grp_v1[k].copy(), grp_v2[k].copy())
+        new_open = grp_open[:, k].copy()
+    else:  # every group factored away: keep one inert zero column
+        new_grp = (np.zeros(1, grp_f.dtype), np.zeros(1, grp_v1.dtype),
+                   np.zeros(1, grp_v2.dtype))
+        new_open = np.zeros((grp_open.shape[0], 1), grp_open.dtype)
+    out = dict(packed)
+    out["grp"] = new_grp
+    out["grp_open"] = new_open
+    out["G"] = new_open.shape[1]
+    n = len(drop)
+    _count("factorizations", n)
+    obs.counter("frontier.factorizations", n)  # mirrors to /metrics
+    return out, n
+
+
+# ---------------------------------------------------------------------------
+# Honest exhaustion
+# ---------------------------------------------------------------------------
+
+
+def undecidability_report(
+    *,
+    capacity: int,
+    frontier_rows: int,
+    peak_frontier: int,
+    barrier: int,
+    barriers_total: int,
+    budget_mb: float | None = None,
+    budget_rows: int | None = None,
+    spill_rows: int = 0,
+    spill_bytes: int = 0,
+    factor_count: int = 0,
+    device_buffer_bytes: int | None = None,
+    reason: str = "closure-overflow",
+) -> dict:
+    """The machine-readable record of WHY fixed memory could not decide:
+    growth rate (closure output over frontier input at the exhausted
+    barrier — how fast the state space outruns any rung), spill volume
+    (how much was already moved to host), and the budget in force at
+    exhaustion.  Attached by the caller to the final ``unknown`` result
+    (``"undecidability"`` key + a json rendering inside ``cause``) —
+    the result either decides or says exactly why it could not."""
+    rep = {
+        "reason": str(reason),
+        "capacity": int(capacity),
+        "frontier_rows": int(frontier_rows),
+        "peak_frontier": int(peak_frontier),
+        "growth_rate": round(float(peak_frontier) / max(1, frontier_rows), 3),
+        "barrier": int(barrier),
+        "barriers_total": int(barriers_total),
+        "spill_rows": int(spill_rows),
+        "spill_bytes": int(spill_bytes),
+        "factor_count": int(factor_count),
+    }
+    if budget_mb is not None:
+        rep["budget_mb"] = float(budget_mb)
+    if budget_rows is not None:
+        rep["budget_rows"] = int(budget_rows)
+    if device_buffer_bytes is not None:
+        rep["device_buffer_bytes"] = int(device_buffer_bytes)
+    _count("undecidable_reports")
+    obs.event(
+        "frontier.undecidable", barrier=rep["barrier"],
+        capacity=rep["capacity"], growth_rate=rep["growth_rate"],
+        spill_bytes=rep["spill_bytes"], factor_count=rep["factor_count"],
+    )
+    _metrics.inc("frontier.undecidable")
+    return rep
+
+
+def undecidable_cause(report: dict) -> str:
+    """The ``cause`` string for an undecidable unknown: a fixed prefix
+    (machine-greppable) + the report as compact json."""
+    return "undecidable under fixed memory: " + json.dumps(
+        report, sort_keys=True, separators=(",", ":"))

@@ -674,6 +674,13 @@ _scan_chunk = functools.partial(
     jax.jit, static_argnames=("step", "F", "R", "P", "G", "W", "fast", "dedup")
 )(_scan_chunk_core)
 
+#: Bound on slices per chunk attempt: slice-width narrowing stops at
+#: ceil(n_in / _MAX_SLICES), so one attempt never exceeds _MAX_SLICES
+#: launches — wall clock stays bounded while headroom per entry row is
+#: still capacity/width (64 slices at the host-bound frontier gives
+#: 8x capacity headroom per row, ample for real closures).
+_MAX_SLICES = 64
+
 #: (step, F, R, P, G, W, fast, dedup) -> jitted vmapped runner over a
 #: leading batch axis.
 _BATCH_RUNNERS: dict = {}
@@ -757,6 +764,21 @@ def _cache_counter(cache: dict, key, kind: str) -> None:
     )
 
 
+def evict_runner_caches() -> int:
+    """Drop every cached jitted runner (batched / async / greedy):
+    releasing the references lets the backend free the executables and
+    their device buffers — the process-level spill lever the OOM policy
+    pulls BEFORE halving work (``jepsen_tpu.faults.try_oom_spill``; the
+    default spiller in ``parallel.batch`` calls this only on non-CPU
+    backends, where allocator pressure is real).  The cost is
+    recompiles later, never correctness.  Returns entries evicted."""
+    n = len(_BATCH_RUNNERS) + len(_ASYNC_RUNNERS) + len(_GREEDY_RUNNERS)
+    _BATCH_RUNNERS.clear()
+    _ASYNC_RUNNERS.clear()
+    _GREEDY_RUNNERS.clear()
+    return n
+
+
 def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
     """Measured fault boundary of the batched exact runner (the round-4
     "cap >= 1024 faults the tunneled TPU worker" cliff, isolated by
@@ -835,9 +857,18 @@ def chunked_analysis(
     fast: bool = False,
     dedup_backend: str | None = None,
     deadline=None,
+    spill: bool | None = None,
+    frontier_budget_mb: float | None = None,
+    spill_factor: float = 4.0,
+    spill_launches: int | None = None,
+    factor_groups: bool | None = None,
+    checkpoint_dir=None,
+    resume: bool = False,
 ) -> dict:
     """Decide linearizability as a chain of chunk scans with a carried
-    frontier (history decomposition — VERDICT round-2 item #2).
+    frontier (history decomposition — VERDICT round-2 item #2), under a
+    BOUNDED device-memory contract (round 8): an overflowing frontier
+    SPILLS to host instead of dying.
 
     Where the whole-history ladder re-ran ALL barriers at the next
     capacity whenever the frontier overflowed ANYWHERE, here only the
@@ -845,6 +876,42 @@ def chunked_analysis(
     capacity; chunks the frontier sails through stay at the cheap
     capacity.  The capacity position adapts: it climbs on overflow and
     steps back down when a chunk's peak leaves 4x headroom.
+
+    BOUNDED MEMORY (``spill``; default: engaged iff a bounded-memory
+    knob — ``frontier_budget_mb`` or ``spill_launches`` — is set, since
+    the recovery levers multiply launches on exactly the histories that
+    are already slow): the frontier-set sweep is
+    linear in the frontier — scanning a chunk from A ∪ B equals the
+    union of scanning from A and from B — so a carried frontier that
+    exceeds the rung capacity streams through the SAME compiled chunk
+    kernel in slices of ≤ capacity rows, the overflow waiting in a
+    host ring (``ops.spill.HostRing``; device→host copies start
+    asynchronously, overlapping the next device-bound slice), and the
+    slice survivors recombine by exact LSH-bucketed union
+    (``ops.spill.merge_frontiers``).  Rows are never silently dropped;
+    refutation requires EVERY slice to die.  ``frontier_budget_mb``
+    (argument > JEPSEN_TPU_FRONTIER_BUDGET_MB env) caps the device
+    frontier working set: ladder rungs that don't fit the budget are
+    skipped and slicing absorbs the difference.  When a chunk still
+    overflows at the highest usable rung, the chunk BISECTS — the
+    frontier is re-checked (and spilled) at the midpoint — down to
+    single-barrier chunks; only a single barrier's closure overflowing
+    the budget is genuine exhaustion.  The lossy/escalation ladder thus
+    engages only once spill is exhausted, and a final ``unknown`` then
+    carries a machine-readable undecidability report
+    (``ops.spill.undecidability_report``: peak frontier growth rate,
+    spill volume, budget at exhaustion) in ``"undecidability"`` and a
+    json rendering in ``cause`` — never a bare unknown (the report is
+    NOT gated on spill: memory-exhausted unknowns carry it in the
+    legacy truncation mode too).  ``spill=False`` forces the
+    pre-round-8 truncate-and-latch-lossy behavior; ``spill=True``
+    forces recovery on without a budget.
+
+    ``factor_groups`` (None = rides the spill opt-in; True forces)
+    first factors the packed problem over trace-independent crashed-op
+    groups (``ops.spill.factor_packed``): each independent group is a
+    factor whose check is closed-form, so it is removed and G shrinks
+    structurally — the verdict provably equals the monolithic one.
 
     Soundness: ``True`` needs only a surviving frontier (any surviving
     config is a constructive witness, truncated or not).  ``False`` is
@@ -870,15 +937,64 @@ def chunked_analysis(
     (jepsen_tpu.faults.call_with_retry); a launch that still fails (or
     OOMs — there is no sub-batch to halve on the single-history path)
     degrades this history alone with the error named in ``cause``.
+
+    ``checkpoint_dir`` persists the scan cursor and the carried —
+    possibly host-spilled, so unbounded-row — frontier after every
+    accepted chunk (``store.checkpoint.save_chunked``); ``resume=True``
+    reloads it (fingerprint + config must match, else the run starts
+    fresh with a warning — resuming against changed inputs could only
+    produce wrong verdicts) and re-enters the chain at the saved
+    barrier: a kill -9 mid-spill then a resume reproduces uninterrupted
+    verdicts (chaos-gated in tools/chaos_check.py --spill).
     """
+    from jepsen_tpu.ops import spill as spill_mod
+
     dedup = resolve_dedup_backend(dedup_backend)
     deadline = faults.Deadline.coerce(deadline)
     B0 = packed["B"]
     quiet = packed["bar_quiet"]
+    budget_mb = spill_mod.resolve_budget_mb(frontier_budget_mb)
+    #: Spill recovery is OPT-IN through the bounded-memory knobs: with
+    #: no budget configured the scan keeps its pre-round-8 cost profile
+    #: (truncate-and-latch-lossy — the escalation ladder alone), because
+    #: the recovery levers multiply launches on exactly the histories
+    #: that are slow already, and this path rides every escalation /
+    #: confirmation fallback in the tier-1 suite.  Honest exhaustion
+    #: reports are NOT gated — every memory-exhausted unknown carries
+    #: one either way.  Resolved ONCE here; the factorization default,
+    #: the checkpoint config, and the scan loop all read this value.
+    spill_on = (
+        bool(spill) if spill is not None
+        else (budget_mb is not None or spill_launches is not None)
+    )
+    #: Factorization rides the same opt-in (None = auto): the
+    #: reachable-state tabulation is cheap but nonzero per call, and the
+    #: structural win matters exactly where memory pressure does.
+    #: ``factor_groups=True`` forces it on.
+    if factor_groups is None:
+        factor_groups = spill_on
+    factors = 0
+    if factor_groups:
+        packed, factors = spill_mod.factor_packed(packed)
     packed = pad_packed(packed, B=B0)  # bucket P/G; keep B for slicing
     P, G, W = packed["P"], packed["G"], packed["W"]
     caps = [int(c) for c in capacities]
-    bounds = _chunk_bounds(quiet, B0, int(chunk_barriers))
+    b_rows = spill_mod.budget_rows(budget_mb, W, G, P)
+
+    def _usable(i: int) -> bool:
+        """Rung i fits the device budget (rung 0 always runs — the
+        documented floor: some capacity is needed to make progress)."""
+        return i == 0 or b_rows is None or caps[i] <= b_rows
+
+    # Host-side frontier bound: the union frontier is exact, which means
+    # it can grow with the TRUE configuration count — exponential on
+    # adversarial histories.  ``spill_factor`` × the widest usable rung
+    # bounds the host rows (and with them the per-chunk launch count);
+    # crossing it is memory exhaustion like any other: honest truncation,
+    # lossy latch, undecidability report with reason "host-budget".
+    top_usable = max(c for i, c in enumerate(caps) if _usable(i))
+    host_rows_max = max(int(spill_factor * top_usable), top_usable)
+
     bar_f, bar_v1, bar_v2, bar_slot = packed["bar"]
     mov_f, mov_v1, mov_v2, mov_open = packed["mov"]
     slot_lane = jnp.asarray(packed["slot_lane"])
@@ -887,13 +1003,141 @@ def chunked_analysis(
 
     f_state = np.array([packed["init_state"]], np.int32)
     f_fok = np.zeros((1, W), np.uint32)
-    f_fcr = np.zeros((1, G), np.int32)
+    f_fcr = np.zeros((1, G), np.int16)
     idx = 0
     lossy_any = False
     peak_g = 1
     verified = 0
     launches = 0
+    start_barrier = 0
+    resume_spill_spent = 0
+    ring = spill_mod.HostRing(W, G)
+    exhaust_rep: dict | None = None
     t0 = time.perf_counter()
+
+    # ------------------------------------------------------------------
+    # Chunk checkpoint / resume (store.checkpoint chunked schema).
+    # ------------------------------------------------------------------
+    ck_cfg = None
+    _ckpt = None
+    if checkpoint_dir is not None or resume:
+        from jepsen_tpu.store import checkpoint as _ckpt_mod
+
+        _ckpt = _ckpt_mod
+        ck_cfg = {
+            "fingerprint": _ckpt.fingerprint([history]),
+            "capacity": caps, "rounds": int(rounds),
+            "chunk_barriers": int(chunk_barriers), "fast": bool(fast),
+            "dedup": dedup, "budget_mb": budget_mb,
+            "spill_factor": float(spill_factor),
+            "spill_launches": spill_launches,
+            "factor_groups": bool(factor_groups), "spill": spill_on,
+        }
+    if (resume and checkpoint_dir is not None and _ckpt is not None
+            and _ckpt.chunked_exists(checkpoint_dir)):
+        saved = None
+        try:
+            saved = _ckpt.load_chunked(checkpoint_dir)
+        except _ckpt.CheckpointError as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "unreadable chunk checkpoint in %s (%s); running fresh",
+                checkpoint_dir, e)
+            obs.counter("fault.checkpoint.mismatch", reason="unreadable")
+        if saved is not None and saved["config"] != ck_cfg:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "chunk checkpoint in %s was written for different inputs "
+                "or config; running fresh", checkpoint_dir)
+            obs.counter("fault.checkpoint.mismatch", reason="fingerprint")
+            saved = None
+        if saved is not None:
+            if saved["result"] is not None:
+                return saved["result"]  # idempotent finished-run resume
+            st, fo, fc = saved["frontier"]
+            f_state = np.asarray(st, np.int32)
+            f_fok = np.asarray(fo, np.uint32)
+            f_fcr = np.asarray(fc, np.int16)
+            start_barrier = saved["barrier"]
+            idx = min(saved["cap_idx"], len(caps) - 1)
+            lossy_any = saved["lossy"]
+            verified = saved["verified"]
+            launches = saved["launches"]
+            resume_spill_spent = saved.get("spill_spent", 0)
+            obs.span_event(
+                "fault.checkpoint.load", 0.0, barrier=start_barrier,
+                rows=int(f_state.shape[0]), chunked=True,
+            )
+
+    def _save_ck(barrier: int, result: dict | None = None) -> str | None:
+        """Persist the chunk cursor + carried (spilled) frontier; a save
+        failure is logged and never fails the analysis."""
+        if checkpoint_dir is None or _ckpt is None:
+            return None
+        try:
+            p = _ckpt.save_chunked(
+                checkpoint_dir, config=ck_cfg, barrier=barrier, cap_idx=idx,
+                frontier=(f_state, f_fok, f_fcr), lossy=lossy_any,
+                verified=verified, launches=launches,
+                spill_rows=ring.rows_total, spill_bytes=ring.bytes_total,
+                spill_spent=spill_spent, result=result,
+            )
+            return str(p)
+        except Exception:  # noqa: BLE001 — recovery aid, not verdict input
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "couldn't write chunk checkpoint to %s", checkpoint_dir,
+                exc_info=True)
+            obs.counter("fault.checkpoint.error")
+            return None
+
+    def _offset_bounds(start: int) -> list[tuple[int, int]]:
+        if start >= B0:
+            return []
+        rel = _chunk_bounds(quiet[start:], B0 - start, int(chunk_barriers))
+        return [(start + a, start + b) for a, b in rel]
+
+    spans = _offset_bounds(start_barrier)
+    n_spans0 = len(spans)
+    #: the WHOLE history's span count: the default spill budget must be
+    #: identical for a resumed and an uninterrupted run (spill_spent is
+    #: restored from the checkpoint; a budget recomputed from only the
+    #: REMAINING spans would shrink on resume and could flip verdicts)
+    n_spans_full = (
+        n_spans0 if start_barrier == 0 else len(_offset_bounds(0))
+    )
+    #: Spill WORK budget: extra launches the spill levers (multi-slice
+    #: attempts, chunk bisection, slice narrowing) may spend beyond the
+    #: one-launch-per-chunk baseline.  The exact union frontier can be
+    #: exponential, so unbounded recovery would trade an unknown for an
+    #: unbounded wall clock; when the budget is spent the scan falls
+    #: back to the pre-spill truncate-and-latch-lossy behavior and the
+    #: final report says so (reason "spill-budget").  The DEFAULT is
+    #: deliberately small — a couple of recovery attempts per chunk —
+    #: because it rides every escalation/confirmation path in the tier-1
+    #: suite; callers with real memory-pressure workloads (the bench
+    #: batch offenders) pass ``spill_launches`` explicitly and pair it
+    #: with a deadline.  Restored from the chunk checkpoint on resume:
+    #: a resumed run must not get FRESH budget, or its verdicts could
+    #: diverge from the uninterrupted run's.
+    spill_budget = (
+        int(spill_launches) if spill_launches is not None
+        else 2 * max(1, n_spans_full) + 8
+    )
+    spill_spent = resume_spill_spent
+
+    def _stats(capacity: int) -> dict:
+        s = {
+            "frontier-peak": peak_g, "capacity": capacity,
+            "lossy?": lossy_any, "chunks": n_spans0, "launches": launches,
+            "spill-rows": ring.rows_total, "spill-bytes": ring.bytes_total,
+        }
+        if factors:
+            s["factors"] = factors
+        return s
 
     def _emit(valid, stats: dict) -> None:
         """One telemetry span per chunked run: the frontier-sweep stats the
@@ -904,26 +1148,44 @@ def chunked_analysis(
             peak_frontier=stats.get("frontier-peak"),
             capacity=stats.get("capacity"), lossy=stats.get("lossy?"),
             verified_barriers=stats.get("verified-barriers"), dedup=dedup,
+            spill_rows=stats.get("spill-rows"),
+            spill_bytes=stats.get("spill-bytes"),
+            factors=stats.get("factors"),
         )
 
-    for lo, hi in bounds:
+    def _attach_report(res: dict) -> dict:
+        """An unknown that exhausted fixed memory carries the
+        machine-readable report — the OOM ladder never lies.  Only the
+        GENERIC capacity cause is rewritten to the report rendering: a
+        deadline or launch-failure unknown keeps its own cause (and its
+        resumable-checkpoint pointer) with the report attached
+        alongside under ``"undecidability"``."""
+        if exhaust_rep is not None and res.get("valid?") == "unknown":
+            res["undecidability"] = exhaust_rep
+            if res.get("cause") in (
+                    None, "frontier capacity or closure rounds exhausted"):
+                res["cause"] = spill_mod.undecidable_cause(exhaust_rep)
+        return res
+
+    si = 0
+    while si < len(spans):
+        lo, hi = spans[si]
         if deadline is not None and deadline.expired():
             obs.counter("fault.deadline.trip")
             obs.event("fault.deadline", at="wgl-chunk", barrier=lo)
-            stats = {
-                "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": True,
-                "chunks": len(bounds), "launches": launches,
-                "verified-barriers": verified,
-            }
+            ck = _save_ck(lo)
+            note = f"; resumable checkpoint: {ck}" if ck else ""
+            stats = _stats(caps[idx])
+            stats["verified-barriers"] = verified
             _emit("unknown", stats)
-            return {
+            return _attach_report({
                 "valid?": "unknown",
                 "cause": (
                     "deadline-exceeded: check budget exhausted at barrier "
-                    f"{lo}/{B0}"
+                    f"{lo}/{B0}{note}"
                 ),
                 "kernel": stats,
-            }
+            })
         Bc = 1 << max(5, (hi - lo - 1).bit_length())
 
         def padc(a, fill=0):
@@ -941,60 +1203,159 @@ def chunked_analysis(
         )
         c_grp_open = jnp.asarray(padc(packed["grp_open"]))
         n_in = f_state.shape[0]
-        while caps[idx] < n_in and idx + 1 < len(caps):
+        # Climb the entry rung while the carried frontier doesn't fit and
+        # a LARGER, budget-usable rung exists (one launch beats many
+        # slices; the budget ceiling routes the rest through spill).
+        while (idx + 1 < len(caps) and caps[idx] < n_in
+               and caps[idx + 1] > caps[idx] and _usable(idx + 1)):
             idx += 1
+        trunc = False
+        width = None  # entry rows per slice; F on entry, halves on retry
         while True:
             F = caps[idx]
-            k = min(n_in, F)
-            # k < n_in: the carried frontier overflows this capacity
-            # (possible with a non-monotone ladder) and live configs are
-            # dropped — loss, IF this attempt's result is the one kept
-            # (retries re-slice the untruncated f_state, so a discarded
-            # lossy attempt loses nothing; latched after the loop).
-            trunc = k < n_in
-            st0 = np.zeros(F, np.int32)
-            fo0 = np.zeros((F, W), np.uint32)
-            fc0 = np.zeros((F, G), np.int16)
-            al0 = np.zeros(F, bool)
-            st0[:k] = f_state[:k]
-            fo0[:k] = f_fok[:k]
-            fc0[:k] = f_fcr[:k]
-            al0[:k] = True
-            try:
-                s, fo, fc, al, failed_at, lossy, peak = faults.call_with_retry(
-                    lambda: _scan_chunk(
-                        packed["step"], F, int(rounds), P, G, W, fast,
-                        jnp.asarray(st0), jnp.asarray(fo0), jnp.asarray(fc0),
-                        jnp.asarray(al0), *c_args, *grp_args, c_grp_open,
-                        slot_lane, slot_onehot, dedup=dedup,
-                    ),
-                    dict(what="wgl.chunk", engine="fast" if fast else "exact",
-                         capacity=F, lanes=1),
-                )
-            except faults.LaunchFailure as lf:
-                cause = faults.describe(lf.cause)
-                obs.counter("fault.launch.degraded", what="wgl.chunk",
-                            capacity=F, lanes=1, error=cause)
-                stats = {
-                    "frontier-peak": peak_g, "capacity": F, "lossy?": True,
-                    "chunks": len(bounds), "launches": launches,
-                    "verified-barriers": verified,
-                }
-                _emit("unknown", stats)
-                return {
-                    "valid?": "unknown",
-                    "cause": f"device launch failed: {cause}",
-                    "kernel": stats,
-                }
-            launches += 1
-            failed_at, lossy, peak = int(failed_at), bool(lossy), int(peak)
-            peak_g = max(peak_g, peak)
-            if lossy and idx + 1 < len(caps):
+            if width is None:
+                width = F
+            if spill_on:
+                # Slices of ≤ width entry rows, each scanned at the FULL
+                # kernel capacity F: width < F buys closure headroom
+                # (F/width growth per entry row) — the in-chunk lever
+                # between bisection and exhaustion.
+                cuts = list(range(0, n_in, width)) or [0]
+                if len(cuts) > 1:
+                    obs.counter("wgl.chunk.slices", len(cuts))
+                    spill_spent += len(cuts) - 1
+            else:
+                cuts = [0]
+            slice_outs = []
+            for a in cuts:
+                b = min(a + width, n_in) if spill_on else min(a + F, n_in)
+                k = max(1, b - a)  # the initial 1-row frontier case
+                # k < n_in with a single cut: the carried frontier
+                # overflows this capacity (spill=False compatibility
+                # path) and live configs are dropped — loss, IF this
+                # attempt is the one kept (discarded attempts re-slice
+                # the untruncated frontier, so they lose nothing).
+                st0 = np.zeros(F, np.int32)
+                fo0 = np.zeros((F, W), np.uint32)
+                fc0 = np.zeros((F, G), np.int16)
+                al0 = np.zeros(F, bool)
+                st0[:k] = f_state[a:a + k]
+                fo0[:k] = f_fok[a:a + k]
+                fc0[:k] = f_fcr[a:a + k]
+                al0[: b - a] = True
+                try:
+                    out = faults.call_with_retry(
+                        lambda: _scan_chunk(
+                            packed["step"], F, int(rounds), P, G, W, fast,
+                            jnp.asarray(st0), jnp.asarray(fo0),
+                            jnp.asarray(fc0), jnp.asarray(al0), *c_args,
+                            *grp_args, c_grp_open,
+                            slot_lane, slot_onehot, dedup=dedup,
+                        ),
+                        dict(what="wgl.chunk",
+                             engine="fast" if fast else "exact",
+                             capacity=F, lanes=1),
+                    )
+                except faults.LaunchFailure as lf:
+                    ring.discard()
+                    cause = faults.describe(lf.cause)
+                    obs.counter("fault.launch.degraded", what="wgl.chunk",
+                                capacity=F, lanes=1, error=cause)
+                    stats = _stats(F)
+                    stats["verified-barriers"] = verified
+                    _emit("unknown", stats)
+                    return _attach_report({
+                        "valid?": "unknown",
+                        "cause": f"device launch failed: {cause}",
+                        "kernel": stats,
+                    })
+                launches += 1
+                slice_outs.append(out)
+            trunc = not spill_on and n_in > F
+            # Materialize the per-slice verdict scalars (blocks until
+            # that slice's scan finishes; later slices keep computing on
+            # the device stream behind it).
+            sliced = []
+            any_lossy = trunc
+            peak_total = 0
+            for s, fo, fc, al, failed_at, lossy, peak in slice_outs:
+                failed_at, lossy, peak = int(failed_at), bool(lossy), int(peak)
+                any_lossy |= lossy
+                peak_total += peak
+                sliced.append((s, fo, fc, al, failed_at))
+            peak_g = max(peak_g, peak_total)
+            nxt = idx + 1
+            if (any_lossy and nxt < len(caps) and caps[nxt] > caps[idx]
+                    and _usable(nxt)):
                 obs.counter("wgl.chunk.escalations")
-                idx += 1  # re-run THIS chunk wider, from the same frontier
+                ring.discard()
+                idx = nxt  # re-run THIS chunk wider, from the same frontier
+                width = None
+                continue
+            width_floor = max(1, (n_in + _MAX_SLICES - 1) // _MAX_SLICES)
+            if (any_lossy and spill_on and spill_spent < spill_budget
+                    and (hi - lo) == 1 and width > width_floor):
+                # Single-barrier floor, still overflowing: narrow the
+                # slices (same kernel capacity, fewer entry rows each)
+                # before declaring exhaustion — down to the _MAX_SLICES
+                # launch bound, where only a near-single config's
+                # closure overflowing the budget rung remains, which is
+                # undecidable under this memory.
+                obs.counter("wgl.chunk.slice_narrowing")
+                ring.discard()
+                spill_spent += 1
+                width = max(width_floor, width // 2)
                 continue
             break
-        lossy_any |= trunc  # input truncation of the ACCEPTED attempt
+        if (any_lossy and spill_on and spill_spent < spill_budget
+                and (hi - lo) > 1):
+            # Spill harder before going lossy: bisect the chunk so the
+            # frontier is re-checked — and its overflow host-spilled —
+            # at the midpoint (preferring a quiet cut, like the original
+            # chunking).  Floor: a single barrier.
+            ring.discard()
+            rel = _chunk_bounds(quiet[lo:hi], hi - lo,
+                                max(1, (hi - lo + 1) // 2))
+            spans[si:si + 1] = [(lo + a, lo + b) for a, b in rel]
+            obs.counter("wgl.chunk.bisections")
+            spill_spent += 1
+            continue
+        if spill_on and spill_spent >= spill_budget:
+            # Spill work budget exhausted: the rest of the scan runs in
+            # the pre-spill truncation mode; the report names the bound
+            # that bit.
+            spill_on = False
+            if exhaust_rep is None:
+                exhaust_rep = spill_mod.undecidability_report(
+                    capacity=caps[idx], frontier_rows=n_in,
+                    peak_frontier=peak_total, barrier=lo, barriers_total=B0,
+                    budget_mb=budget_mb, budget_rows=b_rows,
+                    spill_rows=ring.rows_total, spill_bytes=ring.bytes_total,
+                    factor_count=factors,
+                    device_buffer_bytes=device_buffer_bytes(),
+                    reason="spill-budget",
+                )
+        if any_lossy and exhaust_rep is None:
+            # Memory exhaustion: the accepted attempt lost rows — with
+            # spill engaged that means a single barrier's closure
+            # overflowed the highest budget-usable rung with nothing
+            # left to split; in the legacy mode it is plain capacity
+            # truncation.  Record the evidence; the scan continues
+            # truncated (a surviving frontier still proves True), and
+            # any final unknown carries this report.
+            # the kernel reports the POST-filter peak; a lossy round by
+            # definition overflowed the capacity, so the true closure
+            # peak is at least capacity + 1 (the growth-rate evidence)
+            exhaust_rep = spill_mod.undecidability_report(
+                capacity=caps[idx], frontier_rows=n_in,
+                peak_frontier=max(peak_total, caps[idx] + 1),
+                barrier=lo, barriers_total=B0,
+                budget_mb=budget_mb, budget_rows=b_rows,
+                spill_rows=ring.rows_total, spill_bytes=ring.bytes_total,
+                factor_count=factors,
+                device_buffer_bytes=device_buffer_bytes(),
+            )
+        lossy_any |= any_lossy
         if trunc:
             obs.counter("wgl.frontier.truncations")
         if obs.observing():
@@ -1006,50 +1367,87 @@ def chunked_analysis(
             if db is not None:
                 obs.gauge("device.buffer_bytes", db, at="wgl-chunk",
                           barrier=lo)
-        stats = {
-            "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": lossy or lossy_any,
-            "chunks": len(bounds), "launches": launches,
-        }
-        if failed_at >= 0:
-            gb = lo + failed_at
+        # --------------------------------------------------------------
+        # Recombine: union the slice survivors.  A single slice fetches
+        # directly (its output is already an antichain); multiple slices
+        # stream through the host ring — device→host copies started at
+        # push, exact LSH-bucketed dedup/domination at the merge.
+        # --------------------------------------------------------------
+        all_failed = all(f >= 0 for (_s, _fo, _fc, _al, f) in sliced)
+        if all_failed:
+            gb = lo + max(f for (_s, _fo, _fc, _al, f) in sliced)
             op_pos = int(packed["bar_opid"][gb])
             op = history[op_pos]
+            stats = _stats(caps[idx])
             stats["bar-opid"] = op_pos  # positional id for stop_at_index
             stats["verified-barriers"] = verified
             # barriers the frontier survived carry a constructive witness
             # (prefix-True), loss or not — death at gb means gb barriers
             # were witnessed
             stats["witnessed-barriers"] = gb
-            if lossy or lossy_any:
+            if lossy_any:
                 _emit("unknown", stats)
-                return {
+                return _attach_report({
                     "valid?": "unknown",
                     "cause": "frontier capacity or closure rounds exhausted",
                     "op": op,
                     "kernel": stats,
-                }
+                })
             res = {"valid?": False, "op": op, "kernel": stats}
             if fast:
                 res["provisional?"] = True  # hash-decided kills
             _emit(False, stats)
             return res
-        lossy_any |= lossy
         if not lossy_any:
             verified = hi
-        al_h = np.asarray(al)
-        sel = np.flatnonzero(al_h)
-        f_state = np.asarray(s)[sel]
-        f_fok = np.asarray(fo)[sel]
-        f_fcr = np.asarray(fc)[sel]
-        if idx > 0 and peak * 4 <= caps[idx - 1] and sel.size <= caps[idx - 1]:
+        if len(sliced) == 1:
+            s, fo, fc, al, _f = sliced[0]
+            al_h = np.asarray(al)
+            sel = np.flatnonzero(al_h)
+            f_state = np.asarray(s)[sel]
+            f_fok = np.asarray(fo)[sel]
+            f_fcr = np.asarray(fc)[sel]
+        else:
+            for s, fo, fc, al, f in sliced:
+                if f < 0:  # dead slices contribute no rows
+                    ring.push(s, fo, fc, al)
+            popped = ring.pop_all()
+            f_state, f_fok, f_fcr, _mstats = spill_mod.merge_frontiers(
+                [popped] if popped is not None else [])
+        rows = int(f_state.shape[0])
+        if rows > host_rows_max:
+            # Host budget exceeded: exact union tracking would now cost
+            # more memory/launches than the configured bound — truncate
+            # (candidate order: the most-speculative rows drop first),
+            # latch loss, and record the evidence.  True stays sound.
+            if exhaust_rep is None:
+                exhaust_rep = spill_mod.undecidability_report(
+                    capacity=caps[idx], frontier_rows=rows,
+                    peak_frontier=peak_g, barrier=hi, barriers_total=B0,
+                    budget_mb=budget_mb, budget_rows=b_rows,
+                    spill_rows=ring.rows_total, spill_bytes=ring.bytes_total,
+                    factor_count=factors,
+                    device_buffer_bytes=device_buffer_bytes(),
+                    reason="host-budget",
+                )
+            obs.counter("wgl.frontier.truncations")
+            f_state = f_state[:host_rows_max]
+            f_fok = f_fok[:host_rows_max]
+            f_fcr = f_fcr[:host_rows_max]
+            lossy_any = True
+            rows = host_rows_max
+        if (idx > 0 and peak_total * 4 <= caps[idx - 1]
+                and rows <= caps[idx - 1]):
             idx -= 1
-    stats = {
-        "frontier-peak": peak_g, "capacity": caps[idx], "lossy?": lossy_any,
-        "chunks": len(bounds), "launches": launches, "verified-barriers": verified,
-        "witnessed-barriers": B0,  # the survivor IS the whole-history witness
-    }
+        _save_ck(hi)
+        si += 1
+    stats = _stats(caps[idx])
+    stats["verified-barriers"] = verified
+    stats["witnessed-barriers"] = B0  # the survivor IS the whole-history witness
     _emit(True, stats)
-    return {"valid?": True, "kernel": stats}
+    result = {"valid?": True, "kernel": stats}
+    _save_ck(B0, result=result)
+    return result
 
 
 def analysis(
@@ -1063,6 +1461,13 @@ def analysis(
     fast: bool = False,
     dedup_backend: str | None = None,
     deadline=None,
+    spill: bool | None = None,
+    frontier_budget_mb: float | None = None,
+    spill_factor: float = 4.0,
+    spill_launches: int | None = None,
+    factor_groups: bool | None = None,
+    checkpoint_dir=None,
+    resume: bool = False,
 ) -> dict:
     """Decide linearizability on the accelerator.
 
@@ -1091,7 +1496,10 @@ def analysis(
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     return chunked_analysis(
         model, history, packed, capacities, rounds, chunk_barriers, fast=fast,
-        dedup_backend=dedup_backend, deadline=deadline,
+        dedup_backend=dedup_backend, deadline=deadline, spill=spill,
+        frontier_budget_mb=frontier_budget_mb, spill_factor=spill_factor,
+        spill_launches=spill_launches, factor_groups=factor_groups,
+        checkpoint_dir=checkpoint_dir, resume=resume,
     )
 
 

@@ -262,6 +262,28 @@ def _submit_confirmation(workers: int | None, *args):
     return None, None
 
 
+def _device_oom_spiller(ctx) -> bool:
+    """The default OOM spiller (faults.register_oom_spiller): evict the
+    cached jitted runners so the backend can release their executables'
+    device buffers, then collect.  Only on non-CPU backends — the CPU
+    backend has no allocator pressure worth a recompile, and evicting
+    the process-shared runner caches there would just slow every later
+    ladder (the tier-1 suite shares them)."""
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+    except Exception:  # noqa: BLE001 — no backend: nothing to free
+        return False
+    n = wgl.evict_runner_caches()
+    import gc
+
+    gc.collect()
+    return n > 0
+
+
+faults.register_oom_spiller(_device_oom_spiller)
+
+
 def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
     """A 1-D device mesh over the first ``n_devices`` devices."""
     devs = jax.devices()
@@ -318,6 +340,7 @@ def batch_analysis(
     resume: bool = False,
     deadline=None,
     admission=None,
+    frontier_budget_mb: float | None = None,
 ) -> list[dict]:
     """Check many histories against one model in batched kernel launches.
 
@@ -406,6 +429,19 @@ def batch_analysis(
     marks the remaining packs ``unknown`` with cause
     ``deadline-exceeded`` plus a pointer to the checkpoint, and still
     returns a complete result list.
+
+    Bounded memory (round 8): an OOM first tries the registered
+    device-memory spillers (``faults.try_oom_spill`` — runner-cache
+    eviction on real accelerators) and retries the SAME launch before
+    any lane halving, so the sub-batch ladder engages only once spill
+    is exhausted.  ``frontier_budget_mb`` (or the
+    JEPSEN_TPU_FRONTIER_BUDGET_MB env var) caps the exact engine's
+    device frontier working set: the chunked exact paths (unsafe-shape
+    lanes and device-confirmation fallbacks) then host-spill overflow
+    rows instead of going lossy (``ops.wgl.chunked_analysis``), and a
+    history fixed memory still cannot decide returns ``unknown`` with a
+    machine-readable undecidability report in its ``cause``
+    (``ops.spill.undecidability_report``) — never a bare unknown.
 
     Continuous batching (``admission``): an object with a
     ``poll(stage=, lanes=)`` method is consulted at every rung boundary
@@ -529,6 +565,8 @@ def batch_analysis(
             dedup = cfg.get("dedup", dedup)
             confirm_refutations = cfg.get(
                 "confirm_refutations", confirm_refutations)
+            frontier_budget_mb = cfg.get(
+                "frontier_budget_mb", frontier_budget_mb)
             start_stage = int(restored["stage"])
             obs.span_event(
                 "fault.checkpoint.load", time.perf_counter() - t_load,
@@ -549,6 +587,7 @@ def batch_analysis(
         "greedy_first": bool(greedy_first),
         "carry_frontier": bool(carry_frontier), "dedup": dedup,
         "confirm_refutations": confirm_refutations, "fingerprint": fp,
+        "frontier_budget_mb": frontier_budget_mb,
     }
 
     def _notify(i: int) -> None:
@@ -583,11 +622,14 @@ def batch_analysis(
 
     def _launch(st_engine: str, batch_cap: int, sub: list[dict],
                 sub_resumes: list[tuple | None] | None = None,
-                pad_to: int | None = None):
+                pad_to: int | None = None, retry: bool = False):
         """Instrumented wrapper over the kernel launch: times the launch,
         classifies it compile (fresh shape bucket) vs execute, samples
         the post-launch device-buffer footprint (the stage's memory
-        high-water mark), and emits a ladder.launch telemetry span."""
+        high-water mark), and emits a ladder.launch telemetry span.
+        ``retry`` marks a reduced-size OOM-halved / spill-retry launch —
+        excluded from the watchdog's launch-time EWMA baseline
+        (faults.record_launch_seconds)."""
         with obs.span(
             "ladder.launch", engine=st_engine, capacity=batch_cap, lanes=len(sub)
         ) as sp:
@@ -595,8 +637,9 @@ def batch_analysis(
             out = _launch_impl(st_engine, batch_cap, sub, sub_resumes, pad_to)
             dt = time.perf_counter() - t0
             # Feed the process launch-time EWMA the serving layer's
-            # hung-launch watchdog derives its wall-clock caps from.
-            faults.record_launch_seconds(dt)
+            # hung-launch watchdog derives its wall-clock caps from
+            # (reduced retry launches are tagged out of the baseline).
+            faults.record_launch_seconds(dt, retry=retry)
             key = launch_acc.pop("_key", None)
             compiled = key is not None and key not in _SEEN_SHAPES
             if key is not None:
@@ -1084,7 +1127,7 @@ def batch_analysis(
                 results[i] = wgl.chunked_analysis(
                     model, histories[i], packs[k], exact_ladder,
                     rounds=int(rounds), fast=False, dedup_backend=dedup,
-                    deadline=deadline,
+                    deadline=deadline, frontier_budget_mb=frontier_budget_mb,
                 )
                 _notify(i)
             group = safe
@@ -1124,17 +1167,23 @@ def batch_analysis(
         lane_out: dict[int, tuple] = {}  # pack idx -> (valid, fat, lossy, peak)
         degraded: list[tuple[int, str]] = []  # (pack idx, cause)
 
-        def _launch_ft(part: list[int], pad_to: int | None = None) -> None:
+        def _launch_ft(part: list[int], pad_to: int | None = None,
+                       retry: bool = False, spilled: bool = False) -> None:
             """Launch one sub-batch under the fault policy: transient
             errors retry with backoff inside faults.call_with_retry; an
-            OOM halves the sub-batch recursively (floor one lane — and
-            the stage lane budget shrinks with it, so later chunks don't
+            OOM first asks the registered device-memory spillers to free
+            something (faults.try_oom_spill — runner-cache eviction on
+            real accelerators) and retries the SAME launch once, then
+            halves the sub-batch recursively (floor one lane — and the
+            stage lane budget shrinks with it, so later chunks don't
             re-probe the fault); a part that still fails degrades ONLY
-            its lanes, never the batch.  Successful parts land their
-            verdicts in lane_out and fetch their pending lanes' resume
-            snapshots immediately (at most one part's snapshot is ever
-            device-resident, preserving the lane budget's resident-row
-            bound)."""
+            its lanes, never the batch.  Spill-retry and halved
+            sub-launches run with ``retry=True`` so their reduced sizes
+            stay out of the watchdog's launch-time EWMA.  Successful
+            parts land their verdicts in lane_out and fetch their
+            pending lanes' resume snapshots immediately (at most one
+            part's snapshot is ever device-resident, preserving the
+            lane budget's resident-row bound)."""
             nonlocal budget_scale
             sub_res = (
                 [resumes.get(k) for k in part]
@@ -1148,11 +1197,22 @@ def batch_analysis(
                 out = faults.call_with_retry(
                     lambda: _launch(
                         st_engine, batch_cap, [packs[k] for k in part],
-                        sub_res, pad_to,
+                        sub_res, pad_to, retry,
                     ),
                     ctx,
                 )
             except faults.LaunchFailure as lf:
+                if lf.kind == "oom" and not spilled and faults.try_oom_spill(ctx):
+                    # Spill rung of the OOM ladder: device memory was
+                    # freed — retry the SAME shape once at full size
+                    # before shrinking any work.
+                    obs.counter(
+                        "fault.launch.oom_spill_retry", stage=si,
+                        engine=st_engine, capacity=batch_cap,
+                        lanes=len(part),
+                    )
+                    _launch_ft(part, pad_to, retry=True, spilled=True)
+                    return
                 if lf.kind == "oom" and len(part) > 1:
                     mid = (len(part) + 1) // 2
                     budget_scale = max(budget_scale / 2, 1.0 / max(1, budget))
@@ -1164,8 +1224,8 @@ def batch_analysis(
                     # Fault path: drop the fixed continuous-batching pad
                     # — replaying the halved part back up to the width
                     # that just OOM'd would re-probe the fault.
-                    _launch_ft(part[:mid])
-                    _launch_ft(part[mid:])
+                    _launch_ft(part[:mid], retry=True, spilled=spilled)
+                    _launch_ft(part[mid:], retry=True, spilled=spilled)
                     return
                 cause = faults.describe(lf.cause)
                 obs.counter(
@@ -1439,6 +1499,7 @@ def batch_analysis(
                 r = wgl.chunked_analysis(
                     model, histories[idxs[k]], p, [cap], rounds=int(rounds),
                     fast=False, dedup_backend=dedup, deadline=deadline,
+                    frontier_budget_mb=frontier_budget_mb,
                 )
                 _finish_confirmation(k, fat, res, r["valid?"] is False)
             group = safe_group

@@ -1504,6 +1504,12 @@ class CheckService:
         with self._lock:
             return self._requests.get(request_id)
 
+    @staticmethod
+    def _spill_stats() -> dict:
+        from jepsen_tpu.ops import spill as _spill
+
+        return _spill.stats_snapshot()
+
     def stats(self) -> dict:
         """The queue-status document (GET /queue, web panel)."""
         with self._lock:
@@ -1551,6 +1557,17 @@ class CheckService:
                     round(self._watchdog.timeout_s(), 3)
                     if self._watchdog is not None else None
                 ),
+                # -- bounded-memory layer (ops.spill) -------------------
+                # process-wide spill/factorization totals: how much exact
+                # frontier state moved to host RAM and how many crashed
+                # groups factored away, plus reduced-size retry launches
+                # excluded from the watchdog EWMA baseline.  (spill is
+                # imported lazily: it pulls ops.hashing and with it jax,
+                # which this module defers to function bodies by design.)
+                "memory": {
+                    **self._spill_stats(),
+                    "retry_launches": faults.retry_launch_count(),
+                },
                 **t,
             }
 

@@ -48,6 +48,17 @@ CKPT_NPZ = "checker-checkpoint.npz"
 
 VERSION = 1
 
+#: chunked-scan (single-history) checkpoint pair: the carried — possibly
+#: HOST-SPILLED, so row count is unbounded — frontier between chunk
+#: scans, plus the scan cursor.  Separate files from the ladder
+#: checkpoint: the two can coexist in one run directory (a ladder's
+#: unsafe-shape fallback runs chunked scans inside a checkpointed
+#: ladder).
+CHUNK_JSON = "chunk-checkpoint.json"
+CHUNK_NPZ = "chunk-checkpoint.npz"
+
+CHUNK_VERSION = 1
+
 
 class CheckpointError(Exception):
     """Missing, torn, or version-incompatible checkpoint."""
@@ -159,6 +170,101 @@ def save(
         json_path(d), json.dumps(_store._jsonable(doc), indent=1)
     )
     return json_path(d)
+
+
+def chunk_json_path(d) -> Path:
+    return Path(d) / CHUNK_JSON
+
+
+def chunked_exists(d) -> bool:
+    return chunk_json_path(d).exists()
+
+
+def save_chunked(
+    d,
+    *,
+    config: Mapping,
+    barrier: int,
+    cap_idx: int,
+    frontier: tuple,
+    lossy: bool,
+    verified: int,
+    launches: int,
+    spill_rows: int = 0,
+    spill_bytes: int = 0,
+    spill_spent: int = 0,
+    result: Mapping | None = None,
+) -> Path:
+    """Persist one chunk boundary of a spill-capable chunked scan
+    (ops.wgl.chunked_analysis).  ``frontier`` is the carried
+    (state, fok, fcr) host arrays — spilled rows included, so the row
+    axis is unbounded; a kill -9 between chunks (or mid-spill: the
+    merge happens before the save) then a resume reproduces
+    uninterrupted verdicts.  ``config`` must carry the history
+    fingerprint plus the scan parameters verdict identity depends on.
+    ``result`` marks a FINISHED run (idempotent resume: the saved
+    verdict returns without device work).  npz before json, atomically,
+    same torn-write reasoning as the ladder checkpoint."""
+    d = Path(d)
+    d.mkdir(parents=True, exist_ok=True)
+    st, fo, fc = frontier
+    buf = io.BytesIO()
+    np.savez(buf, st=np.asarray(st), fo=np.asarray(fo), fc=np.asarray(fc))
+    _store._atomic_write(d / CHUNK_NPZ, buf.getvalue())
+    doc = {
+        "version": CHUNK_VERSION,
+        "config": config,
+        "barrier": int(barrier),
+        "cap_idx": int(cap_idx),
+        "lossy": bool(lossy),
+        "verified": int(verified),
+        "launches": int(launches),
+        "spill_rows": int(spill_rows),
+        "spill_bytes": int(spill_bytes),
+        "spill_spent": int(spill_spent),
+        "result": result,
+    }
+    _store._atomic_write(
+        chunk_json_path(d), json.dumps(_store._jsonable(doc), indent=1)
+    )
+    return chunk_json_path(d)
+
+
+def load_chunked(d) -> dict:
+    """Load a chunked-scan checkpoint; raises CheckpointError on a
+    missing/torn/unknown-version file."""
+    p = chunk_json_path(d)
+    if not p.exists():
+        raise CheckpointError(f"no {CHUNK_JSON} in {d}")
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"unreadable {p}: {e}") from e
+    if doc.get("version") != CHUNK_VERSION:
+        raise CheckpointError(
+            f"unknown chunk-checkpoint version {doc.get('version')!r}")
+    npz = Path(d) / CHUNK_NPZ
+    if not npz.exists():
+        raise CheckpointError(f"{p} references missing {CHUNK_NPZ}")
+    try:
+        with np.load(npz) as a:
+            frontier = (a["st"], a["fo"], a["fc"])
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointError(f"unreadable {npz}: {e}") from e
+    return {
+        "config": doc.get("config") or {},
+        "barrier": int(doc.get("barrier") or 0),
+        "cap_idx": int(doc.get("cap_idx") or 0),
+        "lossy": bool(doc.get("lossy")),
+        "verified": int(doc.get("verified") or 0),
+        "launches": int(doc.get("launches") or 0),
+        "spill_rows": int(doc.get("spill_rows") or 0),
+        "spill_bytes": int(doc.get("spill_bytes") or 0),
+        "spill_spent": int(doc.get("spill_spent") or 0),
+        "result": doc.get("result"),
+        "frontier": frontier,
+        "path": str(p),
+    }
 
 
 def load(d) -> dict:

@@ -1,0 +1,545 @@
+"""Bounded-memory checking tests (round 8): host-spill frontiers,
+LSH-bucketed merge, crashed-op group factorization, the OOM spill rung,
+and honest exhaustion reports.
+
+Kernel shapes are file-shared and tiny — (F=16, Bc=32) chunk scans on a
+(40, 4) register history, the (F=8) undecidability shape, and the
+suite-shared (30, 3)@(64, 256) ladder for the OOM test (same compiled
+kernels as tests/test_parallel.py) — no new heavyweight compile
+geometries; the tier-1 budget is near its cap.  The heavier spill
+scenarios (multi-seed differential, kill -9 mid-spill) live in
+tools/chaos_check.py --spill, outside tier-1.
+"""
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+
+from jepsen_tpu import faults
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.ops import hashing, spill, wgl
+
+SPILL_CAPS = (16,)
+SPILL_CHUNK = 8
+
+
+def spill_hist(seed: int, corrupt_seed=None):
+    hh = valid_register_history(40, 4, seed=seed, info_rate=0.35)
+    if corrupt_seed is not None:
+        hh = corrupt(hh, seed=corrupt_seed)
+    return hh
+
+
+# ---------------------------------------------------------------------------
+# Host-side hash mirrors and the LSH merge
+# ---------------------------------------------------------------------------
+
+
+def test_np_hash_mirrors_device():
+    """The host-side hash lanes are bit-identical to the device lanes:
+    LSH bucket keys agree across the device→host spill boundary."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    st = rng.integers(-5, 100, 64).astype(np.int32)
+    fo = rng.integers(0, 2**16, (64, 2)).astype(np.uint32)
+    h1, h2 = hashing.np_class_hash(st, fo)
+    cols = [jnp.asarray(st)] + [jnp.asarray(fo[:, k]) for k in range(2)]
+    assert np.array_equal(h1, np.asarray(hashing.hash_rows(cols, 0xB00B_135)))
+    assert np.array_equal(h2, np.asarray(hashing.hash_rows(cols, 0x1CEB_00DA)))
+
+
+def _merge_reference(state, fok, fcr):
+    """O(n²) reference for merge_frontiers' kill contract: kill j when
+    an equal-(state, fok) row i has pointwise ≤ fcr and is strictly
+    smaller somewhere or earlier."""
+    n = state.shape[0]
+    keep = np.ones(n, bool)
+    for j in range(n):
+        for i in range(n):
+            if i == j:
+                continue
+            if state[i] != state[j] or not (fok[i] == fok[j]).all():
+                continue
+            le = (fcr[i] <= fcr[j]).all()
+            lt = (fcr[i] < fcr[j]).any()
+            if le and (lt or i < j):
+                keep[j] = False
+                break
+    return keep
+
+
+def test_merge_frontiers_matches_reference():
+    rng = np.random.default_rng(3)
+    n = 160
+    state = rng.integers(0, 12, n).astype(np.int32)
+    fok = rng.integers(0, 4, (n, 1)).astype(np.uint32)
+    fcr = rng.integers(0, 3, (n, 3)).astype(np.int16)
+    src = rng.integers(0, n, n // 2)  # inject exact class duplicates
+    state[: n // 2] = state[src]
+    fok[: n // 2] = fok[src]
+    ms, mf, mc, stats = spill.merge_frontiers([(state, fok, fcr)])
+    keep = _merge_reference(state, fok, fcr)
+    assert stats["rows_in"] == n
+    assert stats["rows_out"] == int(keep.sum())
+    got = {(int(s), tuple(f), tuple(c)) for s, f, c in zip(ms, mf, mc)}
+    want = {
+        (int(state[j]), tuple(fok[j]), tuple(fcr[j]))
+        for j in np.flatnonzero(keep)
+    }
+    assert got == want
+    # idempotent: merging an antichain changes nothing
+    ms2, _f2, _c2, stats2 = spill.merge_frontiers([(ms, mf, mc)])
+    assert stats2["rows_out"] == stats["rows_out"]
+
+
+def test_host_ring_accounting():
+    import jax.numpy as jnp
+
+    ring = spill.HostRing(W=1, G=2)
+    st = np.arange(5, dtype=np.int32)
+    fo = np.zeros((5, 1), np.uint32)
+    fc = np.zeros((5, 2), np.int16)
+    ring.push(st, fo, fc)  # host push, unmasked: accounted at push
+    al = np.array([True, False, True, False, False])
+    ring.push(jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+              jnp.asarray(al))  # device push, masked: accounted at pop
+    out = ring.pop_all()
+    assert out is not None and out[0].shape[0] == 7
+    assert ring.rows_total == 7
+    assert ring.bytes_total == 7 * spill.row_bytes(1, 2)
+    # discard drops pending rows WITHOUT accounting
+    before = ring.rows_total
+    ring.push(st, fo, fc)
+    ring.discard()
+    assert ring.pop_all() is None
+    assert ring.rows_total == before + 5  # the unmasked push had accounted
+
+
+# ---------------------------------------------------------------------------
+# Spill differential (the tier-1 slice; multi-seed lives in chaos --spill)
+# ---------------------------------------------------------------------------
+
+
+def test_spill_differential_vs_exact_sweep():
+    """Spill-on engages on an info-heavy history at a tiny rung, decides
+    soundly vs the exact CPU sweep, and spill-off may only be LESS
+    decisive — never disagree."""
+    model = m.CASRegister(None)
+    hist = spill_hist(4100)
+    on = wgl.analysis(model, hist, capacity=SPILL_CAPS,
+                      chunk_barriers=SPILL_CHUNK, spill=True)
+    off = wgl.analysis(model, hist, capacity=SPILL_CAPS,
+                       chunk_barriers=SPILL_CHUNK, spill=False)
+    k = on.get("kernel") or {}
+    assert k.get("spill-rows", 0) > 0, "workload must actually spill"
+    truth = wgl_cpu.sweep_analysis(model, hist, max_configs=500_000)["valid?"]
+    if on["valid?"] != "unknown":
+        assert truth in (on["valid?"], "unknown")
+    else:
+        assert on.get("undecidability"), "unknowns must carry the report"
+    assert off["valid?"] in (on["valid?"], "unknown")
+
+
+def test_slice_union_equals_whole_scan():
+    """The linearity property spill rests on: scanning a chunk of
+    barriers from a frontier union equals the union of scanning the
+    slices — survivor SETS identical after the exact merge, not just
+    verdicts."""
+    import jax.numpy as jnp
+
+    model = m.CASRegister(None)
+    hist = valid_register_history(30, 3, seed=2, info_rate=0.3)
+    packed = wgl.pack(model, hist)
+    B0 = packed["B"]
+    packed = wgl.pad_packed(packed, B=B0)
+    P, G, W = packed["P"], packed["G"], packed["W"]
+    F = 64
+    bar = packed["bar"]
+    mov = packed["mov"]
+    args = (
+        jnp.asarray(packed["bar_active"]),
+        *(jnp.asarray(a) for a in bar),
+        *(jnp.asarray(a) for a in mov),
+        *(jnp.asarray(a) for a in packed["grp"]),
+        jnp.asarray(packed["grp_open"]),
+        jnp.asarray(packed["slot_lane"]),
+        jnp.asarray(packed["slot_onehot"]),
+    )
+
+    def scan(st, fo, fc, al):
+        s, f, c, a, _fat, lossy, _pk = wgl._scan_chunk(
+            packed["step"], F, 8, P, G, W, False,
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), *args, dedup="sort")
+        assert not bool(lossy)
+        sel = np.flatnonzero(np.asarray(a))
+        return np.asarray(s)[sel], np.asarray(f)[sel], np.asarray(c)[sel]
+
+    # grow a non-trivial frontier: scan the first half of the barriers
+    half = np.asarray(packed["bar_active"]).copy()
+    half[B0 // 2:] = False
+    args_half = (jnp.asarray(half),) + args[1:]
+    s0 = np.zeros(F, np.int32)
+    s0[0] = packed["init_state"]
+    fo0 = np.zeros((F, W), np.uint32)
+    fc0 = np.zeros((F, G), np.int16)
+    al0 = np.zeros(F, bool)
+    al0[0] = True
+    sh, fh, ch, ah, _fat, _l, _pk = wgl._scan_chunk(
+        packed["step"], F, 8, P, G, W, False,
+        jnp.asarray(s0), jnp.asarray(fo0), jnp.asarray(fc0),
+        jnp.asarray(al0), *args_half, dedup="sort")
+    sel = np.flatnonzero(np.asarray(ah))
+    fst, ffo, ffc = np.asarray(sh)[sel], np.asarray(fh)[sel], np.asarray(ch)[sel]
+    assert fst.shape[0] >= 2, "need a multi-row frontier to slice"
+    # scan the SECOND half from (a) the whole frontier, (b) two slices
+    rest = np.asarray(packed["bar_active"]).copy()
+    rest[: B0 // 2] = False
+    args_rest = (jnp.asarray(rest),) + args[1:]
+
+    def scan_rest(rows):
+        st = np.zeros(F, np.int32)
+        fo = np.zeros((F, W), np.uint32)
+        fc = np.zeros((F, G), np.int16)
+        al = np.zeros(F, bool)
+        k = rows[0].shape[0]
+        st[:k], fo[:k], fc[:k] = rows
+        al[:k] = True
+        s, f, c, a, _fat, lossy, _pk = wgl._scan_chunk(
+            packed["step"], F, 8, P, G, W, False,
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), *args_rest, dedup="sort")
+        assert not bool(lossy)
+        sel = np.flatnonzero(np.asarray(a))
+        return np.asarray(s)[sel], np.asarray(f)[sel], np.asarray(c)[sel]
+
+    whole = scan_rest((fst, ffo, ffc))
+    mid = fst.shape[0] // 2
+    part_a = scan_rest((fst[:mid], ffo[:mid], ffc[:mid]))
+    part_b = scan_rest((fst[mid:], ffo[mid:], ffc[mid:]))
+    ws, wf, wc, _ = spill.merge_frontiers([whole])
+    us, uf, uc, _ = spill.merge_frontiers([part_a, part_b])
+
+    def rows(s, f, c):
+        return {(int(a), tuple(b), tuple(d)) for a, b, d in zip(s, f, c)}
+
+    assert rows(us, uf, uc) == rows(ws, wf, wc)
+
+
+def test_spill_checkpoint_resume_identity(tmp_path):
+    """Deadline-interrupted spill scan + resume == uninterrupted (the
+    in-process slice of the chaos gate's kill -9 cycle)."""
+    model = m.CASRegister(None)
+    hist = spill_hist(4100)  # same history/shapes as the test above
+    uninterrupted = wgl.analysis(
+        model, hist, capacity=SPILL_CAPS, chunk_barriers=SPILL_CHUNK,
+        spill=True)
+
+    class TripAfter(faults.Deadline):
+        """Expires at the N-th poll — a deterministic mid-chain trip."""
+
+        def __init__(self, polls: int):
+            super().__init__(3600.0)
+            self.polls = polls
+            self.seen = 0
+
+        def expired(self) -> bool:
+            self.seen += 1
+            return self.seen > self.polls
+
+    tripped = wgl.analysis(
+        model, hist, capacity=SPILL_CAPS, chunk_barriers=SPILL_CHUNK,
+        spill=True, checkpoint_dir=tmp_path, deadline=TripAfter(2))
+    assert tripped["valid?"] == "unknown"
+    assert "deadline-exceeded" in tripped["cause"]
+    assert "resumable checkpoint" in tripped["cause"]
+    resumed = wgl.analysis(
+        model, hist, capacity=SPILL_CAPS, chunk_barriers=SPILL_CHUNK,
+        spill=True, checkpoint_dir=tmp_path, resume=True)
+    assert resumed["valid?"] == uninterrupted["valid?"]
+    # a finished run's checkpoint resumes idempotently (no device work)
+    again = wgl.analysis(
+        model, hist, capacity=SPILL_CAPS, chunk_barriers=SPILL_CHUNK,
+        spill=True, checkpoint_dir=tmp_path, resume=True)
+    assert again == resumed
+
+
+# ---------------------------------------------------------------------------
+# Crashed-op group factorization
+# ---------------------------------------------------------------------------
+
+
+def _counter_history(crashed_adds, ok_adds=(1,), with_value_read=False):
+    ops = []
+    t = 0
+    for i, v in enumerate(crashed_adds):
+        t += 1
+        ops.append(h.op(h.INVOKE, 10 + i, "add", v, time=t))
+    for v in ok_adds:
+        t += 1
+        ops.append(h.op(h.INVOKE, 0, "add", v, time=t))
+        t += 1
+        ops.append(h.op(h.OK, 0, "add", v, time=t))
+    t += 1
+    ops.append(h.op(h.INVOKE, 1, "read", None, time=t))
+    t += 1
+    ops.append(h.op(h.OK, 1, "read", sum(ok_adds) if with_value_read else None,
+                    time=t))
+    for i, v in enumerate(crashed_adds):
+        t += 1
+        ops.append(h.op(h.INFO, 10 + i, "add", v, time=t))
+    return h.index(ops)
+
+
+def test_factorization_drops_independent_counter_groups():
+    """Crashed adds in a NIL-read counter history are trace-independent
+    of everything — they factor away, G shrinks, verdicts unchanged."""
+    model = m.MonotonicCounter(0)
+    hist = _counter_history([3, 5], with_value_read=False)
+    packed = wgl.pack(model, hist)
+    factored, n = spill.factor_packed(packed)
+    assert n == 2
+    assert factored["G"] < packed["G"] or factored["grp_open"].max() == 0
+    r_on = wgl.chunked_analysis(model, hist, packed, [64],
+                                 factor_groups=True)
+    r_off = wgl.chunked_analysis(model, hist, dict(packed), [64],
+                                 factor_groups=False)
+    assert r_on["valid?"] is True and r_off["valid?"] is True
+    assert r_on["kernel"].get("factors") == 2
+
+
+def test_factorization_is_conservative():
+    """A value read observes the adds — nothing may factor; register
+    crashed writes with value reads likewise."""
+    model = m.MonotonicCounter(0)
+    hist = _counter_history([3, 5], with_value_read=True)
+    _p, n = spill.factor_packed(wgl.pack(model, hist))
+    assert n == 0
+    reg_hist = h.index([
+        h.op(h.INVOKE, 1, "write", 7, time=1),
+        h.op(h.INVOKE, 0, "read", None, time=2),
+        h.op(h.OK, 0, "read", 7, time=3),
+        h.op(h.INFO, 1, "write", 7, time=4),
+    ])
+    _p2, n2 = spill.factor_packed(wgl.pack(m.CASRegister(None), reg_hist))
+    assert n2 == 0
+
+
+def test_factorized_verdicts_match_oracle():
+    """Factorized and monolithic scans agree with the exact sweep across
+    a small mixed batch (some factorable, some not)."""
+    model = m.MonotonicCounter(0)
+    for reads in (False, True):
+        for adds in ([2], [1, 4], [1, 2, 3]):
+            hist = _counter_history(adds, with_value_read=reads)
+            r_on = wgl.chunked_analysis(model, hist, wgl.pack(model, hist),
+                                        [64], factor_groups=True)
+            r_off = wgl.chunked_analysis(model, hist, wgl.pack(model, hist),
+                                         [64], factor_groups=False)
+            truth = wgl_cpu.sweep_analysis(model, hist)["valid?"]
+            assert r_on["valid?"] == r_off["valid?"] == truth
+
+
+# ---------------------------------------------------------------------------
+# Honest exhaustion: the undecidability report
+# ---------------------------------------------------------------------------
+
+
+def test_undecidable_unknown_carries_report():
+    """A single barrier whose closure antichain exceeds every usable
+    rung is genuine exhaustion: the unknown must carry the machine-
+    readable report, never a bare cause — in the DEFAULT (no budget →
+    legacy truncation) mode too: honesty is not gated on spill."""
+    ops = []
+    t = 0
+    for v in range(1, 13):  # 12 distinct-value crashed writes
+        t += 1
+        ops.append(h.op(h.INVOKE, v, "write", v, time=t))
+    t += 1
+    ops.append(h.op(h.INVOKE, 0, "read", None, time=t))
+    t += 1
+    ops.append(h.op(h.OK, 0, "read", 99, time=t))  # no write(99): dies
+    for v in range(1, 13):
+        t += 1
+        ops.append(h.op(h.INFO, v, "write", v, time=t))
+    hist = h.index(ops)
+    r = wgl.analysis(m.CASRegister(None), hist, capacity=(8,))
+    assert r["valid?"] == "unknown"
+    rep = r.get("undecidability")
+    assert rep, "exhausted unknown must carry the report"
+    assert rep["reason"] in ("closure-overflow", "host-budget",
+                             "spill-budget")
+    for key in ("capacity", "peak_frontier", "growth_rate", "barrier",
+                "barriers_total", "spill_rows", "spill_bytes",
+                "factor_count"):
+        assert key in rep, key
+    assert rep["growth_rate"] > 1.0
+    prefix = "undecidable under fixed memory: "
+    assert r["cause"].startswith(prefix)
+    assert json.loads(r["cause"][len(prefix):]) == rep
+
+
+def test_frontier_budget_env_skips_oversized_rungs(monkeypatch):
+    """A tiny --frontier-budget-mb keeps the ladder off rungs that don't
+    fit: the scan still decides (spill absorbs the difference) or
+    reports honestly; budget fields land in the report when exhausted."""
+    assert spill.resolve_budget_mb(None) is None
+    monkeypatch.setenv(spill.FRONTIER_BUDGET_ENV, "0.25")
+    assert spill.resolve_budget_mb(None) == 0.25
+    assert spill.resolve_budget_mb(7.5) == 7.5
+    rows = spill.budget_rows(0.25, W=1, G=16, P=8)
+    assert rows is not None and rows >= 1
+    model = m.CASRegister(None)
+    hist = spill_hist(4100)
+    r = wgl.analysis(model, hist, capacity=SPILL_CAPS,
+                     chunk_barriers=SPILL_CHUNK,
+                     frontier_budget_mb=0.25)
+    assert r["valid?"] in (True, False, "unknown")
+    if r["valid?"] == "unknown":
+        assert r.get("undecidability", {}).get("budget_mb") == 0.25
+
+
+# ---------------------------------------------------------------------------
+# OOM policy: spill before halving; EWMA retry exclusion
+# ---------------------------------------------------------------------------
+
+
+def test_oom_spill_rung_before_halving():
+    """An OOM first tries the registered spillers and retries the SAME
+    launch; halving engages only when spill fails.  Suite-shared
+    (30, 3)@(64, 256) shapes — no new compiles."""
+    from jepsen_tpu.parallel import batch_analysis
+
+    model = m.CASRegister(None)
+    hists = [valid_register_history(30, 3, seed=i, info_rate=0.1)
+             for i in range(4)]
+    clean = [r["valid?"] for r in
+             batch_analysis(model, hists, capacity=(64, 256))]
+    calls = {"n": 0}
+    state = {"oomed": False}
+
+    def spiller(ctx):
+        calls["n"] += 1
+        return True
+
+    def inject(ctx, attempt):
+        if (str(ctx.get("what") or "").startswith("ladder.")
+                and not state["oomed"] and attempt == 0
+                and int(ctx.get("lanes") or 0) > 1):
+            state["oomed"] = True
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected OOM")
+
+    rc0 = faults.retry_launch_count()
+    faults.register_oom_spiller(spiller)
+    try:
+        with faults.inject_scope(inject):
+            res = batch_analysis(model, hists, capacity=(64, 256))
+    finally:
+        faults.unregister_oom_spiller(spiller)
+    assert [r["valid?"] for r in res] == clean
+    assert calls["n"] == 1, "exactly one spill attempt for one OOM"
+    # the full-size retry is tagged out of the EWMA baseline
+    assert faults.retry_launch_count() > rc0
+
+
+def test_oom_spill_failure_still_halves():
+    """No spiller frees anything (the CPU default): the OOM ladder's
+    halving rung still backstops — verdicts survive."""
+    from jepsen_tpu.parallel import batch_analysis
+
+    model = m.CASRegister(None)
+    hists = [valid_register_history(30, 3, seed=i, info_rate=0.1)
+             for i in range(4)]
+    clean = [r["valid?"] for r in
+             batch_analysis(model, hists, capacity=(64, 256))]
+    state = {"oomed": False}
+
+    def inject(ctx, attempt):
+        if (str(ctx.get("what") or "").startswith("ladder.")
+                and not state["oomed"] and attempt == 0
+                and int(ctx.get("lanes") or 0) > 1):
+            state["oomed"] = True
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected OOM")
+
+    with faults.inject_scope(inject):
+        res = batch_analysis(model, hists, capacity=(64, 256))
+    assert [r["valid?"] for r in res] == clean
+
+
+def test_retry_launches_excluded_from_ewma(monkeypatch):
+    monkeypatch.setattr(faults, "_launch_ewma_s", None)
+    faults.record_launch_seconds(2.0)
+    faults.record_launch_seconds(2.0)
+    base = faults.launch_seconds_ewma()
+    rc0 = faults.retry_launch_count()
+    for _ in range(10):
+        faults.record_launch_seconds(0.001, retry=True)
+    assert faults.launch_seconds_ewma() == base, (
+        "reduced retry launches must not drag the watchdog baseline")
+    assert faults.retry_launch_count() == rc0 + 10
+    faults.record_launch_seconds(2.0)
+    assert faults.launch_seconds_ewma() == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_spill_metrics_export():
+    from jepsen_tpu.obs import metrics
+
+    metrics.enable_mirror(True)
+    before = metrics.REGISTRY.get("frontier.spill_bytes") or 0.0
+    ring = spill.HostRing(W=1, G=2)
+    st = np.arange(3, dtype=np.int32)
+    ring.push(st, np.zeros((3, 1), np.uint32), np.zeros((3, 2), np.int16))
+    after = metrics.REGISTRY.get("frontier.spill_bytes")
+    assert after == before + 3 * spill.row_bytes(1, 2)
+    text = metrics.render()
+    assert "jepsen_tpu_frontier_spill_bytes_total" in text
+
+
+def test_summary_memory_table():
+    from jepsen_tpu.obs.summary import format_summary, summarize
+
+    evs = [
+        {"type": "counter", "name": "frontier.spill_bytes", "n": 2048, "t": 1.0},
+        {"type": "counter", "name": "frontier.spill_rows", "n": 64, "t": 1.0},
+        {"type": "counter", "name": "frontier.factorizations", "n": 2, "t": 1.0},
+        {"type": "gauge", "name": "device.buffer_bytes", "value": 9000, "t": 1.0},
+        {"type": "gauge", "name": "device.buffer_bytes", "value": 100, "t": 2.0},
+        {"type": "event", "name": "frontier.undecidable", "t": 2.0,
+         "attrs": {"barrier": 3}},
+    ]
+    s = summarize(evs)
+    assert s["memory"] == {
+        "spill_rows": 64, "spill_bytes": 2048, "factorizations": 2,
+        "device_bytes_peak": 9000, "undecidable": 1,
+    }
+    assert "memory (host spill" in format_summary(s)
+
+
+def test_service_stats_memory_block():
+    """CheckService.stats() exposes the process-wide bounded-memory
+    totals (no service start needed — the block is a snapshot)."""
+    from jepsen_tpu.serve import CheckService
+
+    svc = CheckService(capacity=(64, 256))
+    try:
+        mem = svc.stats()["memory"]
+    finally:
+        svc.shutdown(drain=False)
+    for key in ("spill_rows", "spill_bytes", "factorizations",
+                "retry_launches"):
+        assert key in mem

@@ -188,6 +188,129 @@ def sigkill_resume_cycle(hists, n, ops, procs, kill_after: int, ckpt_dir: str):
     return killed, resumed
 
 
+#: the child half of the SIGKILL-mid-spill cycle: a spill-forcing
+#: chunked scan with chunk checkpointing, SIGKILL'd after the
+#: KILL_AFTER-th chunk-checkpoint write (mid-chain, carried spilled
+#: frontier on disk).
+_SPILL_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import chaos_check
+from jepsen_tpu.store import checkpoint as ckpt
+orig = ckpt.save_chunked
+state = {{"n": 0}}
+def killing_save(*a, **kw):
+    out = orig(*a, **kw)
+    state["n"] += 1
+    if state["n"] >= {kill_after}:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return out
+ckpt.save_chunked = killing_save
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+hist = chaos_check.spill_history({ops}, {procs}, {seed}, {corrupt_seed!r})
+wgl.analysis(m.CASRegister(None), hist, checkpoint_dir={ckpt_dir!r},
+             **chaos_check.SPILL_LADDER)
+print("CHILD-FINISHED-WITHOUT-KILL")
+"""
+
+#: the spill gate's pinned single-history scan config: a tiny capacity
+#: rung so the exact frontier overflows and the host-spill machinery
+#: (slices, bisection, narrowing, LSH merges) actually engages.
+SPILL_LADDER = dict(capacity=(16,), chunk_barriers=8, spill=True)
+
+
+def spill_history(ops: int, procs: int, seed: int, corrupt_seed=None):
+    hist = valid_register_history(ops, procs, seed=seed, info_rate=0.35)
+    if corrupt_seed is not None:
+        hist = corrupt(hist, seed=corrupt_seed)
+    return hist
+
+
+def spill_gate(opts) -> int:
+    """The bounded-memory gate (round 8): host-spill differential +
+    kill -9 mid-spill resume identity.
+
+    (1) DIFFERENTIAL: a spill-forcing workload (info-heavy histories at
+    a deliberately tiny capacity rung) runs spill-on and spill-off;
+    spill-on must actually spill (kernel spill-rows > 0 somewhere),
+    every decided verdict must agree with the exact CPU sweep, and
+    spill-off may only be LESS decisive (same verdict or unknown) — it
+    must never disagree.  Undecided spill-on results must carry the
+    machine-readable undecidability report, never a bare unknown.
+    (2) SIGKILL MID-SPILL: a child runs the same scan with chunk
+    checkpointing and SIGKILLs itself after the --kill-after-th
+    chunk-checkpoint write (the carried, host-spilled frontier is on
+    disk mid-chain); the parent resumes and must reproduce the
+    uninterrupted verdict exactly.  Returns the failure count."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.ops import wgl
+
+    failures = 0
+
+    def check(ok: bool, what: str):
+        nonlocal failures
+        print(f"  {'ok  ' if ok else 'FAIL'} {what}"
+              + ("" if ok else " <<<"),
+              file=sys.stderr if not ok else sys.stdout)
+        if not ok:
+            failures += 1
+
+    model = m.CASRegister(None)
+    cases = [
+        (opts.ops, opts.procs, 4100 + i, (i if i % 2 else None))
+        for i in range(max(2, opts.histories // 2))
+    ]
+    print("spill gate: differential (spill on/off vs exact sweep)")
+    spilled_any = False
+    for ops_n, procs_n, seed, cseed in cases:
+        hist = spill_history(ops_n, procs_n, seed, cseed)
+        on = wgl.analysis(model, hist, **SPILL_LADDER)
+        off = wgl.analysis(model, hist, **{**SPILL_LADDER, "spill": False})
+        k = on.get("kernel") or {}
+        spilled_any |= bool(k.get("spill-rows"))
+        truth = wgl_cpu.sweep_analysis(model, hist, max_configs=500_000)
+        if on["valid?"] != "unknown":
+            check(truth["valid?"] in (on["valid?"], "unknown"),
+                  f"seed {seed}: spill-on verdict {on['valid?']} matches "
+                  f"exact sweep {truth['valid?']}")
+        else:
+            check(bool(on.get("undecidability"))
+                  and "undecidable under fixed memory" in str(on.get("cause")),
+                  f"seed {seed}: unknown carries an undecidability report")
+        check(off["valid?"] in (on["valid?"], "unknown"),
+              f"seed {seed}: spill-off ({off['valid?']}) never disagrees "
+              f"with spill-on ({on['valid?']})")
+    check(spilled_any, "host spill engaged on the workload")
+
+    if not opts.skip_sigkill:
+        print("spill gate: SIGKILL mid-spill + resume")
+        ops_n, procs_n, seed, cseed = cases[0]
+        hist = spill_history(ops_n, procs_n, seed, cseed)
+        uninterrupted = wgl.analysis(model, hist, **SPILL_LADDER)
+        with tempfile.TemporaryDirectory(prefix="chaos-spill-") as d:
+            src = _SPILL_CHILD_SRC.format(
+                repo=str(REPO), tools=str(REPO / "tools"),
+                kill_after=max(1, opts.kill_after), ops=ops_n, procs=procs_n,
+                seed=seed, corrupt_seed=cseed, ckpt_dir=d,
+            )
+            p = subprocess.run(
+                [sys.executable, "-c", src], capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO),
+                timeout=600,
+            )
+            check(p.returncode == -signal.SIGKILL,
+                  f"child died by SIGKILL mid-spill (rc={p.returncode})")
+            resumed = wgl.analysis(
+                model, hist, checkpoint_dir=d, resume=True, **SPILL_LADDER)
+            check(resumed["valid?"] == uninterrupted["valid?"],
+                  f"resumed verdict {resumed['valid?']} identical to "
+                  f"uninterrupted {uninterrupted['valid?']}")
+    return failures
+
+
 #: the child half of the SIGKILL/journal-replay cycle: admit the whole
 #: workload into a journaled service, then die before serving any of it.
 _SERVE_CHILD_SRC = r"""
@@ -458,11 +581,30 @@ def main(argv=None) -> int:
                          "(poison quarantine, hung-launch watchdog, "
                          "device loss, SIGKILL + journal replay, "
                          "/metrics consistency)")
+    ap.add_argument("--spill", action="store_true",
+                    help="run the bounded-memory gate instead: host-spill "
+                         "differential (spill-on vs spill-off vs the exact "
+                         "CPU sweep, undecidability reports on residual "
+                         "unknowns) plus a kill -9 MID-SPILL with chunk "
+                         "checkpointing — the resumed verdict must equal "
+                         "the uninterrupted one")
     opts = ap.parse_args(argv)
     if opts.smoke:
         opts.histories, opts.ops, opts.procs, opts.runs = 5, 30, 4, 1
         opts.kill_after = 1  # kill right after the first checkpoint: the
         # child pays one stage, the resume still has real ladder work
+        if opts.spill:
+            opts.ops, opts.procs = 40, 4  # enough barriers to spill past
+            # the first chunk checkpoint the child is killed at
+
+    if opts.spill:
+        failures = spill_gate(opts)
+        print(json.dumps({
+            "metric": "chaos_spill",
+            "histories": max(2, opts.histories // 2),
+            "failures": failures,
+        }))
+        return 0 if failures == 0 else 1
 
     if opts.serve:
         failures = serve_chaos(opts)

@@ -32,6 +32,20 @@ ISSUE's acceptance gates into exit-code assertions:
         --max-batch 16 --size-mix 30:0.75,8:0.25 --interactive-max-ops 10 \\
         --min-occupancy 0.8 --slo-interactive-p50-ms 20
 
+``--geometry-spread hostile`` (ROADMAP 5b's last scenario) replaces
+the uniform geometry with a worst-case padding-waste mix: request
+geometries cycle through (ops, procs) pairs chosen to land in FOUR
+different padded (B, P, G) compile buckets with per-bucket counts below
+the padded-batch floor of 8 — so cross-request batching can never fill
+a launch and every batch pays maximal padding waste.  The generator
+computes its own expected-minimum waste from the ACTUAL per-bucket
+counts (``parallel.batch.bucket_geometry``/``padded_batch`` — the same
+functions the scheduler keys on) and exits 1 unless (a) the service's
+measured average padding waste is at least that bound (batching across
+buckets would be a correctness bug, not a win) and (b) the live
+``jepsen_tpu_serve_batch_padding_waste`` gauge equals
+``1 - jepsen_tpu_serve_batch_occupancy`` (the gauge identity).
+
 ``--chaos-seed N`` runs the SERVICE arm under a deterministic seeded
 fault schedule (``faults.inject_scope`` + ``seeded_injector``) — the
 chaos-under-load composition: parity then means clean-verdict-or-
@@ -206,6 +220,14 @@ def main(argv=None) -> int:
                     help="target arrival rate (req/s) for poisson/diurnal")
     ap.add_argument("--burst-idle-ms", type=float, default=150.0,
                     help="idle gap between full-concurrency bursts")
+    ap.add_argument("--geometry-spread", choices=("uniform", "hostile"),
+                    default="uniform",
+                    help="'hostile' cycles requests through a worst-case "
+                         "padding-waste geometry mix (distinct padded "
+                         "(B,P,G) buckets, per-bucket counts < the "
+                         "padded-batch floor) and asserts the padding-"
+                         "waste gauge against the generator's own "
+                         "accounting (module docstring)")
     ap.add_argument("--size-mix", default=None,
                     help='weighted ops-count mix, e.g. "30:0.8,8:0.2" '
                          "(default: every history has --ops ops)")
@@ -278,16 +300,62 @@ def main(argv=None) -> int:
         if a.interactive_max_ops and s <= a.interactive_max_ops else None
         for s in sizes
     ]
+    #: hostile padding-waste mix: procs chosen so the packed P crosses
+    #: the P_BUCKETS boundaries (8 / 16 / 32 / 64) — four distinct
+    #: compile buckets the scheduler can never co-batch; ops = 2x procs
+    #: so (nearly) every proc is exercised and P tracks procs.
+    HOSTILE_GEOMETRY = [(6, 3), (24, 12), (48, 24), (80, 40)]
+    hostile = a.geometry_spread == "hostile"
+    if hostile:
+        geoms = [HOSTILE_GEOMETRY[i % len(HOSTILE_GEOMETRY)]
+                 for i in range(a.requests)]
+        sizes = [g[0] for g in geoms]
+        classes = [None] * a.requests  # the waste bound is batch-tier math
     hists = []
     for i in range(a.requests):
+        procs_i = geoms[i][1] if hostile else a.procs
+        # hostile mode pins info_rate 0: crashed ops would perturb P/G
+        # and with them the bucket accounting the gate asserts against
         hh = valid_register_history(
-            sizes[i], a.procs, seed=a.seed + i, info_rate=a.info_rate)
+            sizes[i], procs_i, seed=a.seed + i,
+            info_rate=0.0 if hostile else a.info_rate)
         if (a.corrupt_every and i % a.corrupt_every == a.corrupt_every - 1
                 and classes[i] is None):
             # corruption stays on the batch tier: the interactive tier's
             # SLO is defined over small LIKELY-VALID histories
             hh = corrupt(hh, seed=a.seed + i)
         hists.append(hh)
+    geometry_acct = None
+    if hostile:
+        # the generator's own padding-waste accounting, from the same
+        # bucketing functions the scheduler keys launches on
+        from jepsen_tpu.ops import wgl as _wgl
+        from jepsen_tpu.parallel import batch as _pb
+
+        counts: dict = {}
+        for hh in hists:
+            p = _wgl.pack(model, hh)
+            bkt = _pb.bucket_geometry(p["B"], p["P"], p["G"])
+            counts[bkt] = counts.get(bkt, 0) + 1
+        per_bucket = {str(k): v for k, v in sorted(counts.items())}
+        # every batch forms within one bucket, so its size n is at most
+        # min(bucket count, max_batch) and its waste at least
+        # 1 - n/padded_batch(n); minimize over feasible n per bucket
+        def min_waste(c: int) -> float:
+            return min(
+                1.0 - n / _pb.padded_batch(n)
+                for n in range(1, min(c, a.max_batch) + 1)
+            )
+        expected_min_waste = min(min_waste(c) for c in counts.values())
+        geometry_acct = {
+            "spread": "hostile", "buckets": len(counts),
+            "per_bucket": per_bucket,
+            "expected_min_waste": round(expected_min_waste, 4),
+        }
+        out_note = [c for c in counts.values() if c >= 8]
+        if out_note:
+            print(f"warning: {len(out_note)} bucket(s) hold >=8 requests; "
+                  "the waste bound degrades to 0 there", file=sys.stderr)
     schedule = _arrival_schedule(
         a.arrival, a.requests, a.rate, rng,
         concurrency=a.concurrency, burst_idle_ms=a.burst_idle_ms,
@@ -295,10 +363,12 @@ def main(argv=None) -> int:
 
     out: dict = {
         "requests": a.requests, "concurrency": a.concurrency,
-        "ops": sorted(set(sizes)) if a.size_mix else a.ops,
+        "ops": sorted(set(sizes)) if (a.size_mix or hostile) else a.ops,
         "capacity": list(capacity), "arrival": a.arrival,
         "interactive": sum(c == "interactive" for c in classes),
     }
+    if geometry_acct is not None:
+        out["geometry"] = geometry_acct
     rc = 0
     baseline_verdicts = None
 
@@ -581,6 +651,30 @@ def main(argv=None) -> int:
                     print(f"METRICS INCONSISTENT: {bad}", file=sys.stderr)
                     rc = 1
                 print(f"metrics:    {out['metrics']}")
+                if geometry_acct is not None:
+                    # hostile-geometry gate: measured waste vs the
+                    # generator's own bucket accounting, and the live
+                    # waste gauge vs the occupancy gauge identity
+                    avg_occ = st["avg_occupancy"] or 0.0
+                    measured_waste = round(1.0 - avg_occ, 4)
+                    geometry_acct["measured_avg_waste"] = measured_waste
+                    bound = geometry_acct["expected_min_waste"]
+                    if measured_waste + 1e-9 < bound:
+                        print(f"PADDING WASTE BELOW GEOMETRY BOUND: "
+                              f"{measured_waste} < {bound} (the scheduler "
+                              "batched across geometry buckets?)",
+                              file=sys.stderr)
+                        rc = 1
+                    g_waste = m.get("jepsen_tpu_serve_batch_padding_waste")
+                    g_occ = m.get("jepsen_tpu_serve_batch_occupancy")
+                    if (g_waste is None or g_occ is None
+                            or abs((1.0 - g_occ) - g_waste) > 2e-4):
+                        print(f"PADDING-WASTE GAUGE INCONSISTENT: "
+                              f"waste={g_waste} occupancy={g_occ}",
+                              file=sys.stderr)
+                        rc = 1
+                    geometry_acct["waste_gauge"] = g_waste
+                    print(f"geometry:   {geometry_acct}")
             finally:
                 chaos_stack.close()
                 scraper.stop()
