@@ -63,6 +63,18 @@ except ValueError:
     _PROBE_TIMEOUT = 300.0  # malformed override must not crash the bench
 
 
+def _fingerprint(probe_devices: bool) -> dict:
+    """The machine-identity block every bench line carries (the ledger
+    groups noise baselines on it): git sha + jax/jaxlib/backend/device/
+    CPU/host via obs.regress.  ``probe_devices=False`` on the outage
+    path — the probe just established the backend is down, and an
+    in-process jax.devices() could hang."""
+    from jepsen_tpu.obs import regress
+
+    fp = regress.fingerprint(probe_devices=probe_devices)
+    return {**fp, "git": regress.git_info().get("sha", "unknown")}
+
+
 def _unavailable_line(reason: str) -> str:
     return json.dumps(
         {
@@ -72,6 +84,7 @@ def _unavailable_line(reason: str) -> str:
             "vs_baseline": 0,
             "tpu_unavailable": True,
             "reason": reason[-2000:],
+            "fingerprint": _fingerprint(probe_devices=False),
         }
     )
 
@@ -288,7 +301,38 @@ def main() -> None:
     }
     if telemetry is not None:
         line["telemetry"] = telemetry
+    # Machine fingerprint: chip rounds and CPU-fallback rounds used to be
+    # distinguishable only by parsing warning text in the driver's
+    # "tail" — now the line says what produced the number, and the perf
+    # ledger groups noise baselines on it.
+    line["fingerprint"] = _fingerprint(probe_devices=True)
     print(json.dumps(line))
+    _append_ledger(line, rec.summary if rec is not None else None)
+
+
+def _append_ledger(line: dict, summary: dict | None) -> None:
+    """Append this run to the perf-regression ledger (obs.regress) —
+    headline + fixed_work metrics and the per-stage telemetry rollup.
+    Best-effort: a full disk or read-only checkout must not turn a
+    successful bench into a failure."""
+    try:
+        from jepsen_tpu.obs import regress
+
+        fw = line.get("fixed_work") or {}
+        metrics = {
+            "ops_per_s": line.get("value"),
+            "vs_baseline": line.get("vs_baseline"),
+            "fixed_work_configs_per_s": fw.get("value"),
+            "fixed_work_s": fw.get("seconds"),
+        }
+        stages, extra_metrics = regress.stage_rollup(summary)
+        metrics.update(extra_metrics)
+        fp = dict(line.get("fingerprint") or {})
+        fp.pop("git", None)  # the record envelope carries git separately
+        record = regress.make_record("bench", metrics, stages=stages, fp=fp)
+        regress.append_record(record)
+    except Exception as e:  # noqa: BLE001 — never fail the bench on this
+        print(f"warning: perf-ledger append failed: {e}", file=sys.stderr)
 
 
 def _is_backend_outage(e: BaseException) -> bool:

@@ -89,6 +89,12 @@ def add_test_opts(p: argparse.ArgumentParser):
                         "returns an unknown carrying a machine-readable "
                         "undecidability report (default: env "
                         "JEPSEN_TPU_FRONTIER_BUDGET_MB, else unbounded)")
+    p.add_argument("--perf-ledger", default=None, metavar="PATH",
+                   help="perf-regression ledger (obs.regress) every "
+                        "bench/loadgen/budget tool in this process tree "
+                        "appends to (sets JEPSEN_TPU_PERF_LEDGER; "
+                        "default store/perf-ledger.jsonl; 'off' "
+                        "disables)")
     p.add_argument("--check-deadline", type=float, default=None,
                    metavar="SECONDS",
                    help="wall-clock budget for the checker phase: on "
@@ -413,6 +419,11 @@ def run_cli(
                               "survivors and re-runs the parity probe "
                               "(default: 10 when --check-devices is set, "
                               "else off; negative disables)")
+    p_serve.add_argument("--perf-ledger", default=None, metavar="PATH",
+                         help="perf-regression ledger the /perf "
+                              "trajectory page and the /metrics headline "
+                              "gauges read (sets JEPSEN_TPU_PERF_LEDGER; "
+                              "default <store-dir>/perf-ledger.jsonl)")
     p_serve.add_argument("--profile-dir", default=None,
                          help="arm the bounded jax.profiler capture hook: "
                               "POST /profile/start (optional {\"seconds\": "
@@ -438,6 +449,11 @@ def run_cli(
         # every engine — batched ladder, chunked escalations, confirm
         # launches — without threading through each test map.
         os.environ["JEPSEN_TPU_DEDUP_BACKEND"] = opts.dedup_backend
+    if getattr(opts, "perf_ledger", None):
+        # Same env-threading as the dedup backend: obs.regress resolves
+        # the ledger path at append/read time, so one flag routes every
+        # producer (bench, loadgen, budget gate) and the web /perf page.
+        os.environ["JEPSEN_TPU_PERF_LEDGER"] = opts.perf_ledger
     if getattr(opts, "frontier_budget_mb", None) is not None:
         # Same env-threading as the dedup backend: ops.spill resolves
         # the budget at call time, so the flag reaches the chunked

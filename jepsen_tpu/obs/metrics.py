@@ -152,6 +152,17 @@ class Registry:
             h["sum"] += value
             h["count"] += 1
 
+    def remove(self, name: str, **labels) -> None:
+        """Drop one series (all three families) so a stale value stops
+        rendering — used when a publisher's source no longer carries a
+        previously-exported label set (e.g. obs.regress headline gauges
+        after the newest ledger record drops a metric)."""
+        key = (metric_name(name), _labels_key(labels))
+        with self._lock:
+            self._counters.pop(key, None)
+            self._gauges.pop(key, None)
+            self._hists.pop(key, None)
+
     def get(self, name: str, **labels):
         """A counter or gauge's current value (tests, the web panel);
         None when the series doesn't exist."""

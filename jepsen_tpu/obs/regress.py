@@ -1,0 +1,728 @@
+"""Performance-regression observatory: run ledger, noise-aware gating,
+stage-level attribution.
+
+Every perf claim in this repo used to live in PERF.md prose and
+write-only BENCH_r0*.json snapshots — nothing could say "PR N regressed
+stage X by Y% beyond noise".  This module is the machinery that can:
+
+  * **Run ledger** — an append-only JSONL file (default
+    ``store/perf-ledger.jsonl``; ``JEPSEN_TPU_PERF_LEDGER`` env or a
+    path argument override, the value ``0``/``off`` disables writes)
+    where every ``bench.py``, ``tools/loadgen.py`` and
+    ``tools/check_tier1_budget.py`` invocation appends one record:
+    git sha, machine fingerprint (jax/jaxlib versions, backend, device
+    kind, CPU model, host), headline metrics, and a per-stage rollup
+    extracted from the run's telemetry summary (ladder stage times,
+    dedup rounds, confirm-queue latency, serve occupancy/latency,
+    spill counters).
+
+  * **Noise-aware comparison** — ``compare_records`` judges the newest
+    record against the ledger history *on the same fingerprint* with a
+    MAD-based noise band per metric: regression (or improvement) is
+    flagged only beyond the band, so the deterministic ``fixed_work``
+    metric (±0.7 % run to run) gates tightly while wall-clock ratios
+    (±20 %) need a real shift to trip.  Metric direction (lower- vs
+    higher-is-better) is inferred from the name (``metric_direction``).
+
+  * **Stage attribution** — when a headline regresses, ``diff_stage
+    _tables`` names the top regressing spans between the two runs'
+    telemetry stage rollups: the answer to "what got slower" is a stage
+    name, not a bisect.  ``tools/trace_summarize.py --diff`` and
+    ``tools/perfwatch.py compare`` share this code.
+
+  * **Competition records** — ``run_competition`` runs a pinned
+    fixed-work ladder workload once per value of an axis (e.g.
+    ``dedup_backend`` = ``sort`` vs ``bucket``), judges the head-to-head
+    with the same noise-band math over the per-value repeat times, and
+    writes a reproducible verdict record into the ledger — routing
+    flips become recorded comparisons instead of PERF.md paragraphs.
+
+Import-light by design: stdlib only at module import (jax / git are
+touched lazily inside ``fingerprint()`` / ``git_info()``), so the
+budget-gate and web paths never drag the checker stack in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform
+import socket
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Iterable, Mapping, Sequence
+
+__all__ = [
+    "ENV_LEDGER", "SCHEMA", "append_record", "attribution", "compare_records",
+    "diff_stage_tables", "fingerprint", "fingerprint_key", "format_comparison",
+    "format_stage_diff", "gate", "git_info", "ledger_path", "make_record",
+    "metric_direction", "noise_band", "publish_gauges", "read_records",
+    "run_competition", "stage_rollup",
+]
+
+ENV_LEDGER = "JEPSEN_TPU_PERF_LEDGER"
+SCHEMA = 1
+
+#: ledger path values that mean "don't write a ledger at all".
+_OFF = {"0", "off", "false", "no", "none", ""}
+
+# ---------------------------------------------------------------------------
+# Fingerprint: which machine/toolchain produced a number.  Noise baselines
+# only make sense within one fingerprint — a chip run and a CPU fallback
+# run of the same sha are different experiments, and the BENCH_r0*.json
+# trajectory couldn't tell them apart without parsing warning text.
+# ---------------------------------------------------------------------------
+
+#: fingerprint fields that define the comparison group (git sha is
+#: deliberately NOT one of them: the whole point is comparing shas).
+_KEY_FIELDS = ("jax", "jaxlib", "backend", "device_kind", "device_count",
+               "cpu", "host")
+
+
+def _cpu_model() -> str:
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                if line.lower().startswith("model name"):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return platform.processor() or platform.machine() or "?"
+
+
+def fingerprint(*, probe_devices: bool = True) -> dict:
+    """The machine/toolchain identity a perf number belongs to: jax +
+    jaxlib versions, active backend and device kind/count, CPU model,
+    host, python.  Works (with ``backend: "none"``) when jax is absent
+    or refuses to initialize — the budget gate must never crash on it.
+    ``probe_devices=False`` skips ``jax.devices()`` entirely (backend
+    ``"unprobed"``): callers that must not initialize a backend — the
+    bench's outage path, where the probe already established the tunnel
+    is down and an in-process device call could hang."""
+    fp: dict = {
+        "host": socket.gethostname(),
+        "cpu": _cpu_model(),
+        "python": platform.python_version(),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        fp["jax"] = getattr(jax, "__version__", "?")
+        fp["jaxlib"] = getattr(jaxlib, "__version__", "?")
+        if not probe_devices:
+            fp["backend"] = "unprobed"
+            return fp
+        try:
+            devs = jax.devices()
+            fp["backend"] = jax.default_backend()
+            fp["device_kind"] = devs[0].device_kind if devs else "?"
+            fp["device_count"] = len(devs)
+        except Exception:  # noqa: BLE001 — backend init can fail (tunnel)
+            fp["backend"] = "uninitialized"
+    except Exception:  # noqa: BLE001 — jax absent entirely
+        fp["backend"] = "none"
+    return fp
+
+
+def fingerprint_key(fp: Mapping) -> str:
+    """A stable 12-hex grouping key over the comparison-defining fields
+    (git sha excluded — records from different PRs on the same machine
+    and toolchain share a key; that sharing IS the baseline)."""
+    basis = {k: fp.get(k) for k in _KEY_FIELDS}
+    blob = json.dumps(basis, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def git_info() -> dict:
+    """``{"sha": ..., "dirty": bool}`` for the working tree (best
+    effort; ``{"sha": "unknown"}`` outside a repo or without git)."""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10,
+        ).stdout.strip()
+        if not sha:
+            return {"sha": "unknown"}
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip())
+        return {"sha": sha, "dirty": dirty}
+    except Exception:  # noqa: BLE001 — git missing/hung must not break a run
+        return {"sha": "unknown"}
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+def ledger_path(path: str | os.PathLike | None = None,
+                store_dir: str | os.PathLike | None = None) -> Path | None:
+    """Resolve the ledger file: explicit path > ``JEPSEN_TPU_PERF_LEDGER``
+    env > ``<store_dir or 'store'>/perf-ledger.jsonl``.  ``None`` when
+    writes are disabled (env/arg set to ``0``/``off``/...)."""
+    if path is None:
+        path = os.environ.get(ENV_LEDGER)
+    if path is not None:
+        if str(path).strip().lower() in _OFF:
+            return None
+        return Path(path)
+    return Path(store_dir or "store") / "perf-ledger.jsonl"
+
+
+def make_record(kind: str, metrics: Mapping[str, float], *,
+                stages: Mapping[str, float] | None = None,
+                axes: Mapping[str, str] | None = None,
+                extra: Mapping | None = None,
+                fp: Mapping | None = None) -> dict:
+    """Assemble a ledger record: schema + timestamps + git + fingerprint
+    (computed when not supplied) around the caller's metrics/stages."""
+    fp = dict(fp) if fp is not None else fingerprint()
+    rec: dict = {
+        "schema": SCHEMA,
+        "kind": str(kind),
+        "ts": round(time.time(), 3),
+        "git": git_info(),
+        "fingerprint": fp,
+        "fingerprint_key": fingerprint_key(fp),
+        "metrics": {str(k): v for k, v in dict(metrics).items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)},
+    }
+    if stages:
+        rec["stages"] = {str(k): round(float(v), 6)
+                         for k, v in dict(stages).items()}
+    if axes:
+        rec["axes"] = {str(k): str(v) for k, v in dict(axes).items()}
+    if extra:
+        rec["extra"] = dict(extra)
+    return rec
+
+
+def append_record(record: Mapping, path: str | os.PathLike | None = None,
+                  store_dir: str | os.PathLike | None = None) -> Path | None:
+    """Append one record line to the ledger (fsync'd — the ledger is the
+    durable trajectory; a crashed run must not lose its number).  Returns
+    the path written, or None when the ledger is disabled.  Raises on IO
+    failure — producers that must never fail wrap this themselves."""
+    p = ledger_path(path, store_dir)
+    if p is None:
+        return None
+    p.parent.mkdir(parents=True, exist_ok=True)
+    line = json.dumps(record, separators=(",", ":"), default=str)
+    with open(p, "a", encoding="utf-8") as fh:
+        fh.write(line + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    return p
+
+
+def read_records(path: str | os.PathLike | None = None,
+                 store_dir: str | os.PathLike | None = None) -> list[dict]:
+    """All parseable ledger records, oldest first.  Tolerant of a
+    truncated last line (a crashed writer) and of junk lines — the
+    ledger outlives every process that appends to it."""
+    p = ledger_path(path, store_dir)
+    if p is None or not p.is_file():
+        return []
+    out: list[dict] = []
+    try:
+        text = p.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("kind"):
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Telemetry stage rollup: the per-stage table a record carries, extracted
+# from an obs.summary dict (the telemetry.json shape).
+# ---------------------------------------------------------------------------
+
+
+def stage_rollup(summary: Mapping | None) -> tuple[dict, dict]:
+    """``(stages, metrics)`` extracted from a telemetry summary dict.
+
+    ``stages`` maps span names to total seconds: one entry per ladder
+    rung (``ladder[<stage>] <engine>@<capacity>``) plus every rolled-up
+    span (phases, confirm device/drain, serve.batch, checker.check, ...)
+    — the table ``diff_stage_tables`` attributes regressions over.
+    ``metrics`` carries the scalar side channels worth trending on their
+    own: serve occupancy and latency means, confirm-queue latency, dedup
+    per-round timings, and the spill counters."""
+    stages: dict[str, float] = {}
+    metrics: dict[str, float] = {}
+    if not summary:
+        return stages, metrics
+    for i, row in enumerate(summary.get("ladder") or []):
+        name = (f"ladder[{row.get('stage', i)}] "
+                f"{row.get('engine', '?')}@{row.get('capacity', '?')}")
+        try:
+            stages[name] = stages.get(name, 0.0) + float(row.get("seconds") or 0)
+        except (TypeError, ValueError):
+            continue
+    for name, s in (summary.get("spans") or {}).items():
+        # ladder.stage's total duplicates the per-rung rows above, but a
+        # summary without a ladder table (partial stream) still gets it
+        if name == "ladder.stage" and any(k.startswith("ladder[") for k in stages):
+            continue
+        try:
+            stages[str(name)] = float(s.get("total_s") or 0)
+        except (TypeError, ValueError, AttributeError):
+            continue
+    for d in summary.get("dedup") or []:
+        key = (f"dedup[{d.get('backend', '?')}@{d.get('candidates', '?')}]"
+               "_per_round_us")
+        try:
+            metrics[key] = float(d.get("per_round_us") or 0)
+        except (TypeError, ValueError):
+            continue
+    serve = summary.get("serve") or {}
+    for k, out in (("avg_occupancy", "serve_occupancy"),
+                   ("continuous_occupancy", "serve_continuous_occupancy"),
+                   ("avg_padding_waste", "serve_padding_waste")):
+        if isinstance(serve.get(k), (int, float)):
+            metrics[out] = float(serve[k])
+    for k in ("admission", "request"):
+        lat = serve.get(k)
+        if isinstance(lat, Mapping) and isinstance(lat.get("mean_s"), (int, float)):
+            metrics[f"serve_{k}_mean_s"] = float(lat["mean_s"])
+    gauges = summary.get("gauges") or {}
+    if isinstance(gauges.get("confirm.queue_latency_s"), (int, float)):
+        metrics["confirm_queue_latency_s"] = float(
+            gauges["confirm.queue_latency_s"])
+    for k, v in (summary.get("memory") or {}).items():
+        if isinstance(v, (int, float)):
+            metrics[f"memory_{k}"] = float(v)
+    return stages, metrics
+
+
+# ---------------------------------------------------------------------------
+# Noise-aware comparison
+# ---------------------------------------------------------------------------
+
+#: name fragments that mark a metric higher-is-better; checked before the
+#: lower-is-better default so "configs_per_s" doesn't read as a time.
+_HIGHER_BETTER = ("per_s", "per_sec", "_rps", "ops_s", "occupancy",
+                  "vs_baseline", "throughput", "speedup", "headroom")
+
+
+def metric_direction(name: str) -> int:
+    """+1 when larger values are better (throughput, occupancy), -1 when
+    smaller values are (seconds, latencies, waste, bytes — the default:
+    everything in a stage table is a time)."""
+    n = str(name).lower()
+    if any(f in n for f in _HIGHER_BETTER):
+        return 1
+    return -1
+
+
+def noise_band(values: Sequence[float], *, k_sigma: float = 4.0,
+               rel_floor: float = 0.02) -> float:
+    """Half-width of the noise band around the history median: ``k_sigma``
+    robust standard deviations (MAD × 1.4826), floored at ``rel_floor``
+    of the median's magnitude so a short or perfectly-repeating history
+    (MAD 0) doesn't flag timer jitter.  With the defaults a metric whose
+    run-to-run noise is ~0.7 % (``fixed_work``) gets a ~4 % band — an
+    injected 10 % regression trips it, two clean runs don't."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return 0.0
+    med = statistics.median(vals)
+    mad = statistics.median(abs(v - med) for v in vals)
+    return max(k_sigma * 1.4826 * mad, rel_floor * abs(med))
+
+
+def _history_values(history: Iterable[Mapping], metric: str) -> list[float]:
+    out = []
+    for rec in history:
+        v = (rec.get("metrics") or {}).get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+    return out
+
+
+def compare_records(new: Mapping, history: Sequence[Mapping], *,
+                    k_sigma: float = 4.0, rel_floor: float = 0.02,
+                    metrics: Sequence[str] | None = None) -> list[dict]:
+    """Judge every metric of ``new`` against the same-fingerprint
+    ``history`` (older records, same kind).  One row per metric:
+
+      {"metric", "new", "median", "n", "band", "delta_pct",
+       "status": "ok" | "regressed" | "improved" | "no-history"}
+
+    ``delta_pct`` is signed new-vs-median; status crosses the noise band
+    in the metric's bad (``regressed``) or good (``improved``) direction.
+    """
+    rows: list[dict] = []
+    new_metrics = new.get("metrics") or {}
+    names = list(metrics) if metrics else sorted(new_metrics)
+    for name in names:
+        v = new_metrics.get(name)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        hist = _history_values(history, name)
+        row: dict = {"metric": name, "new": round(float(v), 6), "n": len(hist)}
+        if not hist:
+            row.update(median=None, band=None, delta_pct=None,
+                       status="no-history")
+            rows.append(row)
+            continue
+        med = statistics.median(hist)
+        band = noise_band(hist, k_sigma=k_sigma, rel_floor=rel_floor)
+        delta = float(v) - med
+        row["median"] = round(med, 6)
+        row["band"] = round(band, 6)
+        row["delta_pct"] = round(100.0 * delta / med, 2) if med else None
+        direction = metric_direction(name)
+        if band <= 0:
+            # an all-zero history (median 0, MAD 0) carries no noise
+            # scale at all — flagging a microscopic absolute change
+            # (padding waste 0.0 -> 0.0001) would be the false positive
+            # the band math exists to prevent
+            row["status"] = "ok"
+            rows.append(row)
+            continue
+        if delta * direction < -band:
+            row["status"] = "regressed"
+        elif delta * direction > band:
+            row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+    return rows
+
+
+def latest_and_history(records: Sequence[Mapping], kind: str) -> tuple[
+        dict | None, list[dict]]:
+    """The newest record of ``kind`` plus its comparison history: older
+    records of the same kind, fingerprint key AND axes (a chaos-seeded
+    or hostile-geometry loadgen run is a different experiment from the
+    clean one), outage records excluded (a value-0 tunnel-down bench is
+    not a baseline)."""
+    of_kind = [r for r in records
+               if r.get("kind") == kind and not r.get("outage")]
+    if not of_kind:
+        return None, []
+    newest = of_kind[-1]
+    key = newest.get("fingerprint_key")
+    axes = newest.get("axes") or {}
+    return newest, [
+        r for r in of_kind[:-1]
+        if r.get("fingerprint_key") == key and (r.get("axes") or {}) == axes
+    ]
+
+
+def format_comparison(kind: str, newest: Mapping | None,
+                      rows: Sequence[Mapping]) -> str:
+    """The compare/gate table as text (perfwatch + docker/bin/test log)."""
+    if newest is None:
+        return f"[{kind}] no ledger records\n"
+    git = (newest.get("git") or {}).get("sha", "?")[:10]
+    head = (f"[{kind}] newest {git} on {newest.get('fingerprint_key')} "
+            f"vs {max((r.get('n') or 0) for r in rows) if rows else 0} "
+            "prior same-fingerprint record(s)")
+    lines = [head]
+    if not rows:
+        lines.append("  (no numeric metrics)")
+        return "\n".join(lines) + "\n"
+    wm = max(len("metric"), *(len(str(r["metric"])) for r in rows))
+    lines.append(f"  {'metric'.ljust(wm)}  {'new':>12}  {'median':>12}  "
+                 f"{'band':>10}  {'delta%':>8}  status")
+    for r in rows:
+        med = "-" if r.get("median") is None else f"{r['median']:.6g}"
+        band = "-" if r.get("band") is None else f"±{r['band']:.4g}"
+        dp = "-" if r.get("delta_pct") is None else f"{r['delta_pct']:+.2f}"
+        mark = {"regressed": " <-- REGRESSED",
+                "improved": " (improved)"}.get(r["status"], "")
+        lines.append(f"  {str(r['metric']).ljust(wm)}  {r['new']:>12.6g}  "
+                     f"{med:>12}  {band:>10}  {dp:>8}  {r['status']}{mark}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Stage attribution: "what got slower" should be a stage name, not a bisect
+# ---------------------------------------------------------------------------
+
+
+def diff_stage_tables(a: Mapping[str, float], b: Mapping[str, float], *,
+                      min_delta_s: float = 0.0) -> list[dict]:
+    """Diff two flat ``{span: seconds}`` stage tables (``stage_rollup``
+    output, or a ledger record's ``stages``): one row per span present in
+    either, sorted by signed delta descending (top regressing spans
+    first — B minus A, so positive = slower in B).  Spans absent on one
+    side diff against 0 (a stage that appeared is itself the story)."""
+    rows: list[dict] = []
+    for name in sorted(set(a) | set(b)):
+        av = float(a.get(name) or 0.0)
+        bv = float(b.get(name) or 0.0)
+        delta = bv - av
+        if abs(delta) < min_delta_s:
+            continue
+        rows.append({
+            "span": name,
+            "a_s": round(av, 6),
+            "b_s": round(bv, 6),
+            "delta_s": round(delta, 6),
+            "delta_pct": round(100.0 * delta / av, 2) if av else None,
+        })
+    rows.sort(key=lambda r: -r["delta_s"])
+    return rows
+
+
+def attribution(new: Mapping, old: Mapping, top: int = 5) -> list[dict]:
+    """Top regressing spans between two ledger records' stage tables
+    (new slower = positive delta first)."""
+    return diff_stage_tables(
+        old.get("stages") or {}, new.get("stages") or {}
+    )[:top]
+
+
+def format_stage_diff(rows: Sequence[Mapping], *, a_label: str = "A",
+                      b_label: str = "B") -> str:
+    """The attribution table as text (perfwatch, trace_summarize --diff)."""
+    if not rows:
+        return "(no stage data on both sides)\n"
+    wm = max(len("span"), *(len(str(r["span"])) for r in rows))
+    lines = [f"{'span'.ljust(wm)}  {a_label + ' (s)':>12}  "
+             f"{b_label + ' (s)':>12}  {'delta (s)':>12}  delta%"]
+    for r in rows:
+        dp = "-" if r.get("delta_pct") is None else f"{r['delta_pct']:+.1f}"
+        lines.append(f"{str(r['span']).ljust(wm)}  {r['a_s']:>12.6g}  "
+                     f"{r['b_s']:>12.6g}  {r['delta_s']:>+12.6g}  {dp}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def gate(records: Sequence[Mapping], *, kinds: Sequence[str] | None = None,
+         k_sigma: float = 4.0, rel_floor: float = 0.02,
+         metrics: Sequence[str] | None = None) -> tuple[bool, str]:
+    """``(ok, report)``: for each record kind present (or ``kinds``),
+    compare its newest record against the same-fingerprint history and
+    flag regressions beyond the noise band.  ``ok`` is False when any
+    metric regressed; the report carries the full comparison tables plus
+    stage attribution for regressed kinds (both runs must carry stage
+    rollups for that)."""
+    if kinds is None:
+        seen: list[str] = []
+        for r in records:
+            k = r.get("kind")
+            if k and k not in seen and k != "compete":
+                seen.append(k)
+        kinds = seen
+    ok = True
+    parts: list[str] = []
+    for kind in kinds:
+        newest, history = latest_and_history(records, kind)
+        rows = [] if newest is None else compare_records(
+            newest, history, k_sigma=k_sigma, rel_floor=rel_floor,
+            metrics=metrics,
+        )
+        parts.append(format_comparison(kind, newest, rows))
+        regressed = [r for r in rows if r["status"] == "regressed"]
+        if regressed:
+            ok = False
+            if newest is not None and history:
+                att = attribution(newest, history[-1])
+                if att:
+                    parts.append("  top moving spans (prior -> new):")
+                    parts.append("  " + format_stage_diff(
+                        att, a_label="prior", b_label="new",
+                    ).replace("\n", "\n  ").rstrip() + "\n")
+    if not parts:
+        parts.append("(empty ledger — nothing to gate)\n")
+    return ok, "\n".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Competition: a recorded, reproducible head-to-head along one axis
+# ---------------------------------------------------------------------------
+
+
+def _default_runner(axis: str, *, histories: int = 6, ops: int = 30,
+                    procs: int = 3, capacity: Sequence[int] = (64, 256),
+                    repeats: int = 3) -> Callable[[str], list[float]]:
+    """The built-in fixed-work competition workload: a pinned batch of
+    register histories (same seeds every run, 1-in-3 corrupted so the
+    refutation path is in the measurement) through the production ladder
+    at suite-shared shapes.  The axis value is applied via its env var
+    (``dedup_backend`` -> ``JEPSEN_TPU_DEDUP_BACKEND`` — the same
+    resolver every engine already reads), one warm pass absorbs
+    compiles, then ``repeats`` timed passes return their wall times."""
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+    from genhist import corrupt, valid_register_history
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.parallel import batch_analysis
+
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(histories):
+        hh = valid_register_history(ops, procs, seed=1000 + i, info_rate=0.1)
+        if i % 3 == 2:
+            hh = corrupt(hh, seed=1000 + i)
+        hists.append(hh)
+    env_var = "JEPSEN_TPU_" + axis.upper()
+    caps = tuple(capacity)
+
+    def run(value: str) -> list[float]:
+        old = os.environ.get(env_var)
+        os.environ[env_var] = str(value)
+        try:
+            batch_analysis(model, hists, capacity=caps)  # warm (compiles)
+            times = []
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                batch_analysis(model, hists, capacity=caps)
+                times.append(time.perf_counter() - t0)
+            return times
+        finally:
+            if old is None:
+                os.environ.pop(env_var, None)
+            else:
+                os.environ[env_var] = old
+
+    return run
+
+
+def run_competition(axis: str, values: Sequence[str], *,
+                    runner: Callable[[str], list[float]] | None = None,
+                    repeats: int = 3, k_sigma: float = 4.0,
+                    rel_floor: float = 0.02,
+                    workload: Mapping | None = None) -> dict:
+    """Head-to-head along ``axis``: run the pinned workload per value,
+    pick the winner by median wall time, and judge decisiveness with the
+    same noise band the gate uses (the winner must clear the loser's
+    band AND its own).  Returns a ``kind: "compete"`` ledger record —
+    the caller appends it.  ``runner(value) -> [seconds, ...]`` overrides
+    the built-in workload (tests use this; chip rounds use the default).
+    """
+    if len({str(v) for v in values}) < 2:
+        raise ValueError("competition needs at least two DISTINCT axis "
+                         "values")
+    wl = dict(workload or {})
+    if runner is None:
+        runner = _default_runner(axis, repeats=repeats, **wl)
+    results: dict[str, dict] = {}
+    for v in values:
+        times = [float(t) for t in runner(str(v))]
+        results[str(v)] = {
+            "times_s": [round(t, 6) for t in times],
+            "median_s": round(statistics.median(times), 6),
+            "band_s": round(noise_band(times, k_sigma=k_sigma,
+                                       rel_floor=rel_floor), 6),
+        }
+    ranked = sorted(results.items(), key=lambda kv: kv[1]["median_s"])
+    winner, runner_up = ranked[0], ranked[1]
+    gap = runner_up[1]["median_s"] - winner[1]["median_s"]
+    decisive = gap > max(winner[1]["band_s"], runner_up[1]["band_s"])
+    margin_pct = (100.0 * gap / runner_up[1]["median_s"]
+                  if runner_up[1]["median_s"] else 0.0)
+    verdict = {
+        "axis": axis,
+        "values": [str(v) for v in values],
+        "results": results,
+        "winner": winner[0],
+        "decisive": decisive,
+        "margin_pct": round(margin_pct, 2),
+        "workload": wl or "default fixed-work ladder",
+    }
+    return make_record("compete", {"compete_margin_pct": round(margin_pct, 2)},
+                       axes={axis: winner[0]}, extra=verdict)
+
+
+# ---------------------------------------------------------------------------
+# Live-gauge publication: the last run's headline numbers in /metrics
+# ---------------------------------------------------------------------------
+
+#: per-path (mtime, size) guard so /metrics scrapes don't re-read an
+#: unchanged ledger.
+_PUBLISH_CACHE: dict[str, tuple[int, int]] = {}
+#: per-path (kind, metric) pairs currently exported, so a newest record
+#: that DROPS a metric retracts the stale series instead of leaving an
+#: older run's value rendering under the same labels.
+_PUBLISHED: dict[str, set[tuple[str, str]]] = {}
+#: per-path newest-record ts by kind: the age gauge must keep advancing
+#: on every scrape even while the ledger file is unchanged (that growing
+#: age is the gauge's entire purpose — "no perf record in N days").
+_PUBLISH_TS: dict[str, dict[str, float]] = {}
+
+
+def _publish_ages(obs_metrics, key: str) -> None:
+    now = time.time()
+    for kind, ts in _PUBLISH_TS.get(key, {}).items():
+        obs_metrics.set_gauge("perf.headline_age_seconds",
+                              round(max(0.0, now - ts), 1), kind=kind)
+
+
+def publish_gauges(path: str | os.PathLike | None = None,
+                   store_dir: str | os.PathLike | None = None) -> bool:
+    """Push the newest ledger record's metrics per kind into the live
+    Prometheus registry as ``jepsen_tpu_perf_headline{kind=,metric=}``
+    gauges (plus ``..._perf_headline_age_seconds``), so a serving
+    process's /metrics carries the last recorded perf trajectory point.
+    Cheap to call per scrape: re-reads only when the file changed.
+    Series the newest records no longer carry are retracted — a mixed
+    scrape of values from different runs would be worse than none."""
+    p = ledger_path(path, store_dir)
+    if p is None or not p.is_file():
+        return False
+    try:
+        st = p.stat()
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return False
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    key = str(p)
+    if _PUBLISH_CACHE.get(key) == sig:
+        # the VALUE gauges are unchanged, but the ages keep growing
+        _publish_ages(obs_metrics, key)
+        return True
+    records = read_records(p)
+    newest_by_kind: dict[str, dict] = {}
+    for r in records:
+        if not r.get("outage"):
+            newest_by_kind[str(r.get("kind"))] = r
+    published: set[tuple[str, str]] = set()
+    ts_by_kind: dict[str, float] = {}
+    for kind, rec in newest_by_kind.items():
+        for name, v in (rec.get("metrics") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                obs_metrics.set_gauge("perf.headline", v,
+                                      kind=kind, metric=name)
+                published.add((kind, name))
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            ts_by_kind[kind] = float(ts)
+            published.add((kind, "__age__"))
+    for kind, name in _PUBLISHED.get(key, set()) - published:
+        if name == "__age__":
+            obs_metrics.REGISTRY.remove("perf.headline_age_seconds",
+                                        kind=kind)
+        else:
+            obs_metrics.REGISTRY.remove("perf.headline",
+                                        kind=kind, metric=name)
+    _PUBLISHED[key] = published
+    _PUBLISH_TS[key] = ts_by_kind
+    _PUBLISH_CACHE[key] = sig
+    _publish_ages(obs_metrics, key)
+    return True

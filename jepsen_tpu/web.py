@@ -48,6 +48,13 @@ Observability endpoints (always mounted):
   GET  /trace/<t>/<ts>   a run's telemetry.jsonl as Chrome/Perfetto
                          trace-event JSON (one lane per request trace
                          id; linked from the run page)
+  GET  /perf             the perf trajectory: per-metric history over
+                         the perf-regression ledger (obs.regress —
+                         bench / loadgen / tier-1-budget records,
+                         sparkline + recent values per metric, plus
+                         perfwatch compete verdicts); the newest
+                         record per kind also rides /metrics as
+                         jepsen_tpu_perf_headline{kind,metric} gauges
   GET  /profile          jax.profiler capture-hook status; POST
   POST /profile/start    /profile/start {"seconds": n} and POST
   POST /profile/stop     /profile/stop drive a bounded device-profile
@@ -77,6 +84,7 @@ from urllib.parse import unquote
 
 from jepsen_tpu import faults, store
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import regress as obs_regress
 from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.obs.summary import _mb
 
@@ -266,7 +274,8 @@ def home_html(store_dir=None, check_service=None) -> str:
         "<h1>jepsen-tpu results</h1>"
         + queue_panel_html(check_service)
         + metrics_panel_html()
-        + "<p><a href='/suite'>suite overview</a></p>"
+        + "<p><a href='/suite'>suite overview</a> — "
+        "<a href='/perf'>perf trajectory</a></p>"
         "<table><tr><th>test</th><th>time</th><th>valid?</th><th></th></tr>"
         + "".join(rows)
         + "</table></body></html>"
@@ -311,6 +320,119 @@ def suite_html(store_dir=None) -> str:
         + "".join(rows)
         + "</table></body></html>"
     )
+
+
+def _sparkline(values: list[float], width: int = 260, height: int = 36) -> str:
+    """An inline-SVG trend line for a metric's ledger history (oldest to
+    newest, left to right).  Flat series render as a midline."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    n = len(values)
+    pts = " ".join(
+        f"{(i * (width - 4) / max(1, n - 1) + 2):.1f},"
+        f"{(height - 4 - (v - lo) / span * (height - 8) + 2):.1f}"
+        for i, v in enumerate(values)
+    )
+    return (
+        f"<svg width='{width}' height='{height}' "
+        "style='background:#f6f6f6;vertical-align:middle'>"
+        f"<polyline points='{pts}' fill='none' stroke='#4477aa' "
+        "stroke-width='1.5'/>"
+        f"<circle cx='{(width - 2):.1f}' "
+        f"cy='{(height - 4 - (values[-1] - lo) / span * (height - 8) + 2):.1f}'"
+        " r='2.5' fill='#cc3311'/></svg>"
+    )
+
+
+def perf_html(store_dir=None) -> str:
+    """The perf-trajectory page: per-metric history over the perf ledger
+    (obs.regress), one sparkline + recent-values table per (kind,
+    metric), grouped by machine fingerprint — the BENCH_r0*.json
+    trajectory, readable instead of write-only.  Competition verdicts
+    (``perfwatch compete``) list below the trends."""
+    base = store.base_dir({"store-dir": store_dir} if store_dir else None)
+    path = obs_regress.ledger_path(store_dir=base)
+    records = obs_regress.read_records(path)
+    parts = ["<html><head><title>jepsen-tpu perf trajectory</title>"
+             "<style>body{font-family:sans-serif}table{border-collapse:"
+             "collapse}td,th{padding:2px 10px;text-align:left;"
+             "border-bottom:1px solid #ddd}</style></head><body>"
+             "<h1>perf trajectory</h1>"
+             f"<p><a href='/'>all runs</a> — ledger: "
+             f"<code>{html.escape(str(path))}</code> "
+             f"({len(records)} records)</p>"]
+    if not records:
+        parts.append("<p>(empty ledger — run bench.py, tools/loadgen.py or "
+                     "the tier-1 budget gate to populate it)</p>")
+        return "".join(parts) + "</body></html>"
+    # (kind, fingerprint_key, metric) -> [(ts, value, sha)] oldest-first
+    series: dict[tuple, list] = {}
+    competes = []
+    for r in records:
+        if r.get("kind") == "compete":
+            competes.append(r)
+            continue
+        if r.get("outage"):
+            continue
+        sha = (r.get("git") or {}).get("sha", "?")[:10]
+        axes = r.get("axes") or {}
+        ax = ",".join(f"{k}={v}" for k, v in sorted(axes.items()))
+        for name, v in (r.get("metrics") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                # str() everything: a hand-written/foreign record missing
+                # fingerprint_key must not make sorted() compare None
+                # against str and 500 the whole page
+                key = (str(r.get("kind")), str(r.get("fingerprint_key")),
+                       ax, str(name))
+                series.setdefault(key, []).append((r.get("ts"), float(v), sha))
+    last_kind = None
+    for (kind, fkey, ax, name) in sorted(series):
+        pts = series[(kind, fkey, ax, name)]
+        if kind != last_kind:
+            parts.append(f"<h2>{html.escape(str(kind))}</h2>")
+            last_kind = kind
+        vals = [v for _, v, _ in pts]
+        label = html.escape(name) + (f" <small>[{html.escape(ax)}]</small>"
+                                     if ax else "")
+        newest = pts[-1]
+        parts.append(
+            f"<p><b>{label}</b> <small>on {html.escape(str(fkey))}</small>"
+            f"<br>{_sparkline(vals)} latest <b>{newest[1]:.6g}</b> "
+            f"@ {html.escape(newest[2])} ({len(pts)} points, "
+            f"min {min(vals):.6g}, max {max(vals):.6g})</p>"
+        )
+        rows = "".join(
+            f"<tr><td>{time.strftime('%Y-%m-%d %H:%M', time.localtime(ts or 0))}"
+            f"</td><td>{html.escape(sha)}</td><td>{v:.6g}</td></tr>"
+            for ts, v, sha in reversed(pts[-10:])
+        )
+        parts.append(
+            "<details><summary>recent values</summary>"
+            "<table><tr><th>time</th><th>git</th><th>value</th></tr>"
+            + rows + "</table></details>"
+        )
+    if competes:
+        parts.append("<h2>competition verdicts</h2>"
+                     "<table><tr><th>time</th><th>axis</th><th>winner</th>"
+                     "<th>margin</th><th>decisive?</th><th>git</th></tr>")
+        for r in reversed(competes):
+            v = r.get("extra") or {}
+            parts.append(
+                "<tr><td>"
+                + time.strftime("%Y-%m-%d %H:%M",
+                                time.localtime(float(r.get("ts") or 0)))
+                + f"</td><td>{html.escape(str(v.get('axis')))}</td>"
+                f"<td>{html.escape(str(v.get('winner')))}</td>"
+                f"<td>{html.escape(str(v.get('margin_pct')))}%</td>"
+                f"<td>{'yes' if v.get('decisive') else 'no (within noise)'}"
+                "</td>"
+                f"<td>{html.escape((r.get('git') or {}).get('sha', '?')[:10])}"
+                "</td></tr>"
+            )
+        parts.append("</table>")
+    return "".join(parts) + "</body></html>"
 
 
 def _serve_mod():
@@ -615,6 +737,13 @@ class Handler(BaseHTTPRequestHandler):
             if path == "/metrics":
                 # Prometheus text exposition: the live registry, fed by
                 # the obs mirror + the serving layer's explicit series.
+                # The perf ledger's newest record per kind rides along as
+                # jepsen_tpu_perf_headline{kind,metric} gauges (refreshed
+                # only when the ledger file changed).
+                try:
+                    obs_regress.publish_gauges(store_dir=base)
+                except Exception:  # noqa: BLE001 — a corrupt ledger must
+                    pass  # not take down the scrape endpoint
                 self._send(
                     200, obs_metrics.render().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
@@ -680,6 +809,8 @@ class Handler(BaseHTTPRequestHandler):
                 )
             elif path == "/suite":
                 self._send(200, suite_html(self.store_dir).encode())
+            elif path == "/perf":
+                self._send(200, perf_html(self.store_dir).encode())
             elif path == "/queue":
                 if self.check_service is None:
                     self._send_json(503, {"error": "no check service mounted"})

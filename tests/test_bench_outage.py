@@ -36,6 +36,13 @@ def _assert_outage_line(r):
     assert rec["value"] == 0 and rec["vs_baseline"] == 0
     assert rec["unit"] == "ops/s"
     assert rec["reason"]
+    # even the outage line says WHAT machine failed (obs.regress
+    # fingerprint; no more parsing warning text in the driver's tail) —
+    # and never via a device probe: the probe just said the backend is
+    # down, and an in-process jax.devices() could hang
+    fp = rec["fingerprint"]
+    assert fp["host"] and fp["cpu"] and "git" in fp
+    assert fp["backend"] in ("unprobed", "none")
     return rec
 
 

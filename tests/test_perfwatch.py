@@ -1,0 +1,520 @@
+"""The performance-regression observatory (obs.regress + tools/perfwatch).
+
+Everything here runs on synthetic ledger records and canned telemetry
+summaries — no new kernel compile geometries (the one end-to-end
+loadgen test reuses the suite-shared (30,3)@(64,256) shapes).  The
+load-bearing pair is the differential: an injected 10 % ``fixed_work``
+regression must be flagged, two clean same-fingerprint runs must not.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from jepsen_tpu.obs import regress  # noqa: E402
+
+#: a pinned fingerprint so records group without touching jax.devices()
+FP = {"jax": "0.4.0", "jaxlib": "0.4.0", "backend": "cpu",
+      "device_kind": "cpu", "device_count": 8, "cpu": "test-cpu",
+      "host": "test-host", "python": "3.10"}
+FP_OTHER = {**FP, "device_kind": "TPU v4", "backend": "tpu"}
+
+
+def _bench(value: float, *, fp=FP, stages=None, **extra_metrics) -> dict:
+    return regress.make_record(
+        "bench", {"fixed_work_configs_per_s": value, **extra_metrics},
+        stages=stages, fp=fp,
+    )
+
+
+def _write(tmp_path, records, name="ledger.jsonl"):
+    p = tmp_path / name
+    for r in records:
+        regress.append_record(r, p)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# ledger basics
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_roundtrip_and_tolerant_read(tmp_path):
+    p = _write(tmp_path, [_bench(100.0), _bench(101.0)])
+    # junk + a truncated last line (a crashed writer) must not break reads
+    with open(p, "a") as fh:
+        fh.write("not json at all\n")
+        fh.write('{"kind": "bench", "metrics": {"fixed_work_configs_per_s"')
+    recs = regress.read_records(p)
+    assert len(recs) == 2
+    assert recs[0]["schema"] == regress.SCHEMA
+    assert recs[0]["metrics"]["fixed_work_configs_per_s"] == 100.0
+    assert recs[0]["fingerprint_key"] == regress.fingerprint_key(FP)
+    assert "sha" in recs[0]["git"]
+
+
+def test_ledger_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(regress.ENV_LEDGER, "off")
+    assert regress.ledger_path() is None
+    assert regress.append_record(_bench(1.0)) is None
+    assert regress.read_records() == []
+    monkeypatch.setenv(regress.ENV_LEDGER, str(tmp_path / "l.jsonl"))
+    assert regress.append_record(_bench(1.0)) == tmp_path / "l.jsonl"
+    assert len(regress.read_records()) == 1
+
+
+def test_fingerprint_fields_and_key_stability():
+    fp = regress.fingerprint()
+    for k in ("host", "cpu", "python", "backend"):
+        assert fp[k]
+    # the key ignores git entirely and is stable across calls
+    assert regress.fingerprint_key(FP) == regress.fingerprint_key(dict(FP))
+    assert regress.fingerprint_key(FP) != regress.fingerprint_key(FP_OTHER)
+    # unprobed mode never initializes a backend but still versions
+    fp2 = regress.fingerprint(probe_devices=False)
+    assert fp2["backend"] in ("unprobed", "none")
+
+
+# ---------------------------------------------------------------------------
+# noise band + direction
+# ---------------------------------------------------------------------------
+
+
+def test_noise_band_mad_and_floor():
+    # identical history: MAD 0 -> the relative floor holds the band open
+    assert regress.noise_band([100.0, 100.0, 100.0]) == pytest.approx(2.0)
+    # a noisy history widens the band beyond the floor
+    assert regress.noise_band([100, 80, 120, 90, 110]) > 2.0
+
+
+def test_metric_direction():
+    assert regress.metric_direction("fixed_work_configs_per_s") == 1
+    assert regress.metric_direction("service_rps") == 1
+    assert regress.metric_direction("serve_occupancy") == 1
+    assert regress.metric_direction("vs_baseline") == 1
+    assert regress.metric_direction("tier1_headroom_s") == 1
+    assert regress.metric_direction("tier1_wall_s") == -1
+    assert regress.metric_direction("service_p95_s") == -1
+    assert regress.metric_direction("ladder[0] fast@128") == -1
+
+
+# ---------------------------------------------------------------------------
+# the differential pair (acceptance criterion): injected 10% regression
+# flagged, clean back-to-back runs quiet
+# ---------------------------------------------------------------------------
+
+
+def test_injected_regression_is_flagged(tmp_path):
+    # clean history at fixed_work's real run-to-run noise (~0.7%)
+    history = [_bench(v) for v in (1000.0, 1004.0, 997.0, 1002.0)]
+    regressed = _bench(900.0)  # injected 10% throughput drop
+    p = _write(tmp_path, history + [regressed])
+    ok, report = regress.gate(regress.read_records(p))
+    assert not ok
+    assert "REGRESSED" in report
+    assert "fixed_work_configs_per_s" in report
+
+
+def test_clean_backtoback_runs_stay_quiet(tmp_path):
+    p = _write(tmp_path, [_bench(1000.0), _bench(1004.0)])  # 0.4% apart
+    ok, report = regress.gate(regress.read_records(p))
+    assert ok, report
+    assert "REGRESSED" not in report
+
+
+def test_improvement_is_not_a_regression(tmp_path):
+    p = _write(tmp_path, [_bench(1000.0), _bench(1001.0), _bench(1200.0)])
+    ok, report = regress.gate(regress.read_records(p))
+    assert ok
+    assert "improved" in report
+
+
+def test_lower_better_direction_flags_time_creep(tmp_path):
+    mk = lambda s: regress.make_record(  # noqa: E731
+        "tier1", {"tier1_wall_s": s}, fp=FP)
+    p = _write(tmp_path, [mk(800.0), mk(802.0), mk(799.0), mk(880.0)])
+    ok, report = regress.gate(regress.read_records(p))
+    assert not ok and "tier1_wall_s" in report
+    # the symmetric drop is an improvement, not a regression
+    p2 = _write(tmp_path, [mk(800.0), mk(802.0), mk(720.0)], name="l2.jsonl")
+    ok2, _ = regress.gate(regress.read_records(p2))
+    assert ok2
+
+
+def test_history_is_fingerprint_and_axes_scoped(tmp_path):
+    # a chip history must not judge a CPU run, nor chaos judge clean
+    records = [_bench(1000.0, fp=FP_OTHER) for _ in range(3)]
+    records += [_bench(500.0)]  # first CPU record: no history -> no verdict
+    p = _write(tmp_path, records)
+    ok, report = regress.gate(regress.read_records(p))
+    assert ok
+    assert "no-history" in report
+    clean = regress.make_record("loadgen", {"service_rps": 100.0}, fp=FP)
+    chaos = regress.make_record("loadgen", {"service_rps": 60.0}, fp=FP,
+                                axes={"chaos": "7"})
+    p2 = _write(tmp_path, [clean, clean, chaos], name="l2.jsonl")
+    ok2, rep2 = regress.gate(regress.read_records(p2))
+    assert ok2, rep2  # the chaos run has its own (empty) baseline
+
+
+def test_zero_median_metric_never_flags(tmp_path):
+    """An all-zero history (e.g. padding waste on uniform geometry) has
+    no noise scale — a microscopic absolute change must not gate."""
+    mk = lambda w: regress.make_record(  # noqa: E731
+        "loadgen", {"serve_padding_waste": w, "service_rps": 100.0}, fp=FP)
+    p = _write(tmp_path, [mk(0.0), mk(0.0), mk(0.0001)])
+    ok, report = regress.gate(regress.read_records(p))
+    assert ok, report
+
+
+def test_outage_records_are_not_baselines(tmp_path):
+    outage = _bench(0.0)
+    outage["outage"] = True
+    p = _write(tmp_path, [_bench(1000.0), outage, _bench(1003.0)])
+    newest, hist = regress.latest_and_history(regress.read_records(p), "bench")
+    assert newest["metrics"]["fixed_work_configs_per_s"] == 1003.0
+    assert len(hist) == 1  # the outage line is neither newest nor history
+
+
+# ---------------------------------------------------------------------------
+# stage rollup + attribution
+# ---------------------------------------------------------------------------
+
+#: a canned telemetry summary (the telemetry.json shape) — rung 1 is the
+#: hot stage, confirm drain rides the spans table.
+SUMMARY_A = {
+    "ladder": [
+        {"stage": 0, "engine": "fast", "capacity": 128, "seconds": 1.0},
+        {"stage": 1, "engine": "fast", "capacity": 512, "seconds": 4.0},
+    ],
+    "spans": {
+        "ladder.stage": {"count": 2, "total_s": 5.0, "max_s": 4.0},
+        "ladder.confirm.drain": {"count": 1, "total_s": 0.5, "max_s": 0.5},
+        "phase.analyze": {"count": 1, "total_s": 6.0, "max_s": 6.0},
+    },
+    "dedup": [{"backend": "sort", "candidates": 2176, "capacity": 128,
+               "probes": 2, "per_round_us": 850.0}],
+    "serve": {"avg_occupancy": 0.9,
+              "request": {"count": 4, "mean_s": 0.2, "max_s": 0.4}},
+    "gauges": {"confirm.queue_latency_s": 0.01},
+    "memory": {"spill_rows": 128},
+}
+#: same run, rung 1 regressed 50% and the drain doubled
+SUMMARY_B = json.loads(json.dumps(SUMMARY_A))
+SUMMARY_B["ladder"][1]["seconds"] = 6.0
+SUMMARY_B["spans"]["ladder.confirm.drain"]["total_s"] = 1.0
+
+
+def test_stage_rollup_extracts_stages_and_side_metrics():
+    stages, metrics = regress.stage_rollup(SUMMARY_A)
+    assert stages["ladder[1] fast@512"] == 4.0
+    assert stages["ladder.confirm.drain"] == 0.5
+    assert "ladder.stage" not in stages  # per-rung rows supersede the span
+    assert metrics["serve_occupancy"] == 0.9
+    assert metrics["serve_request_mean_s"] == 0.2
+    assert metrics["confirm_queue_latency_s"] == 0.01
+    assert metrics["memory_spill_rows"] == 128
+    assert metrics["dedup[sort@2176]_per_round_us"] == 850.0
+    assert regress.stage_rollup(None) == ({}, {})
+
+
+def test_attribution_names_the_top_regressing_span():
+    a, _ = regress.stage_rollup(SUMMARY_A)
+    b, _ = regress.stage_rollup(SUMMARY_B)
+    rows = regress.diff_stage_tables(a, b)
+    assert rows[0]["span"] == "ladder[1] fast@512"
+    assert rows[0]["delta_s"] == pytest.approx(2.0)
+    assert rows[1]["span"] == "ladder.confirm.drain"
+    text = regress.format_stage_diff(rows, a_label="prior", b_label="new")
+    assert "ladder[1] fast@512" in text.splitlines()[1]
+
+
+def test_gate_report_carries_attribution(tmp_path):
+    a_stages, _ = regress.stage_rollup(SUMMARY_A)
+    b_stages, _ = regress.stage_rollup(SUMMARY_B)
+    p = _write(tmp_path, [
+        _bench(1000.0, stages=a_stages), _bench(1001.0, stages=a_stages),
+        _bench(900.0, stages=b_stages),
+    ])
+    ok, report = regress.gate(regress.read_records(p))
+    assert not ok
+    # the answer to "what got slower" is a stage name, not a bisect
+    assert "top moving spans" in report
+    assert "ladder[1] fast@512" in report
+
+
+# ---------------------------------------------------------------------------
+# competition records
+# ---------------------------------------------------------------------------
+
+
+def test_competition_decisive_and_within_noise(tmp_path):
+    times = {"sort": [0.50, 0.505, 0.498], "bucket": [0.30, 0.302, 0.299]}
+    rec = regress.run_competition("dedup_backend", ["sort", "bucket"],
+                                  runner=lambda v: times[v])
+    v = rec["extra"]
+    assert v["winner"] == "bucket" and v["decisive"]
+    assert rec["axes"] == {"dedup_backend": "bucket"}
+    assert v["margin_pct"] == pytest.approx(40.0, abs=1.0)
+    # a coin-flip outcome must NOT be decisive (keep the current default)
+    close = {"sort": [0.50, 0.51, 0.49], "bucket": [0.498, 0.51, 0.492]}
+    rec2 = regress.run_competition("dedup_backend", ["sort", "bucket"],
+                                   runner=lambda v: close[v])
+    assert not rec2["extra"]["decisive"]
+    # duplicate values must fail BEFORE the expensive workload runs
+    with pytest.raises(ValueError):
+        regress.run_competition("dedup_backend", ["sort", "sort"],
+                                runner=lambda v: [0.1])
+    # compete records ride the ledger but are never gated as a trend
+    p = _write(tmp_path, [rec, rec2])
+    ok, report = regress.gate(regress.read_records(p))
+    assert ok and "compete" not in report
+
+
+def test_perfwatch_compete_cli_records_verdict(tmp_path, monkeypatch):
+    import perfwatch
+
+    times = {"sort": [0.5] * 3, "bucket": [0.3] * 3}
+    monkeypatch.setattr(
+        regress, "_default_runner",
+        lambda axis, **kw: (lambda v: times[v]),
+    )
+    led = tmp_path / "ledger.jsonl"
+    rc = perfwatch.main(["compete", "--axis", "dedup_backend",
+                         "--values", "sort,bucket", "--ledger", str(led)])
+    assert rc == 0
+    recs = regress.read_records(led)
+    assert len(recs) == 1 and recs[0]["kind"] == "compete"
+    assert recs[0]["extra"]["winner"] == "bucket"
+
+
+# ---------------------------------------------------------------------------
+# perfwatch CLI: gate exit codes, advisory, list, append
+# ---------------------------------------------------------------------------
+
+
+def test_perfwatch_gate_exit_codes(tmp_path, capsys):
+    import perfwatch
+
+    led = _write(tmp_path, [_bench(1000.0), _bench(1002.0), _bench(900.0)])
+    assert perfwatch.main(["gate", "--ledger", str(led)]) == 1
+    # advisory: same table, exit 0 (the docker/bin/test stage)
+    assert perfwatch.main(["gate", "--advisory", "--ledger", str(led)]) == 0
+    out = capsys.readouterr()
+    assert "REGRESSED" in out.out and "ADVISORY" in out.err
+    # clean ledger gates green
+    led2 = _write(tmp_path, [_bench(1000.0), _bench(1002.0)], name="l2.jsonl")
+    assert perfwatch.main(["gate", "--ledger", str(led2)]) == 0
+    # an absent ledger is not an error (first run ever)
+    assert perfwatch.main(["gate", "--ledger", str(tmp_path / "no.jsonl")]) == 0
+
+
+def test_perfwatch_list_and_append(tmp_path, capsys):
+    import perfwatch
+
+    led = tmp_path / "ledger.jsonl"
+    record = json.dumps({"kind": "bench",
+                         "metrics": {"ops_per_s": 1557.9}, "outage": True})
+    f = tmp_path / "rec.json"
+    f.write_text(record)
+    assert perfwatch.main(["append", "--ledger", str(led),
+                           "--file", str(f)]) == 0
+    recs = regress.read_records(led)
+    assert recs[0]["metrics"]["ops_per_s"] == 1557.9
+    assert recs[0]["outage"] is True  # caller fields survive the stamping
+    assert recs[0]["fingerprint_key"]
+    assert perfwatch.main(["list", "--ledger", str(led)]) == 0
+    assert "OUTAGE" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# producers
+# ---------------------------------------------------------------------------
+
+
+def test_tier1_budget_appends_ledger_record(tmp_path, capsys):
+    import check_tier1_budget as budget
+
+    led = tmp_path / "ledger.jsonl"
+    log = ("12.34s call     tests/test_slowest.py::test_big\n"
+           "2.00s call     tests/test_quick.py::test_small\n"
+           "= 1 passed in 799.10s (0:13:19) =\n")
+    lp = tmp_path / "tier1.log"
+    lp.write_text(log)
+    assert budget.main([str(lp), "--ledger", str(led)]) == 0
+    recs = regress.read_records(led)
+    assert len(recs) == 1 and recs[0]["kind"] == "tier1"
+    assert recs[0]["metrics"]["tier1_wall_s"] == 799.1
+    # the slowest tests double as the record's stage table
+    assert recs[0]["stages"]["tests/test_slowest.py::test_big"] == 12.34
+    # creep differential: history ~800s, new run +10% -> flagged
+    assert budget.main(["--seconds", "801", "--ledger", str(led)]) == 0
+    assert budget.main(["--seconds", "880", "--budget", "1000",
+                        "--ledger", str(led)]) == 0
+    ok, report = regress.gate(regress.read_records(led))
+    assert not ok and "tier1_wall_s" in report
+    # a disabled ledger writes nothing and still gates the budget
+    assert budget.main(["--seconds", "700", "--ledger", "off"]) == 0
+
+
+def test_tier1_stage_table_sums_call_setup_rows(tmp_path):
+    """pytest emits separate call/setup/teardown duration rows for one
+    nodeid; the record's stage table must SUM them, not let the smaller
+    row overwrite the larger (creep attribution would go blind)."""
+    import check_tier1_budget as budget
+
+    led = tmp_path / "ledger.jsonl"
+    log = ("12.34s call     tests/test_big.py::test_kernel\n"
+           "9.50s setup    tests/test_big.py::test_kernel\n"
+           "= 1 passed in 500.00s =\n")
+    lp = tmp_path / "tier1.log"
+    lp.write_text(log)
+    assert budget.main([str(lp), "--ledger", str(led)]) == 0
+    rec = regress.read_records(led)[0]
+    assert rec["stages"]["tests/test_big.py::test_kernel"] == pytest.approx(
+        21.84)
+
+
+def test_bench_append_ledger_helper(tmp_path, monkeypatch):
+    """bench._append_ledger: the real record shape without the ~minutes
+    bench run (the probe is forced green so the module imports)."""
+    monkeypatch.setenv("JEPSEN_TPU_BENCH_PROBE", "true")
+    monkeypatch.setenv(regress.ENV_LEDGER, str(tmp_path / "ledger.jsonl"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    line = {"value": 1557.9, "vs_baseline": 15.97,
+            "fixed_work": {"value": 52000.0, "seconds": 5.77},
+            "fingerprint": {**FP, "git": "abc123"}}
+    bench._append_ledger(line, SUMMARY_A)
+    recs = regress.read_records()
+    assert len(recs) == 1 and recs[0]["kind"] == "bench"
+    m = recs[0]["metrics"]
+    assert m["ops_per_s"] == 1557.9
+    assert m["fixed_work_configs_per_s"] == 52000.0
+    assert m["serve_occupancy"] == 0.9  # the rollup's side metrics ride along
+    assert recs[0]["stages"]["ladder[1] fast@512"] == 4.0
+    assert "git" not in recs[0]["fingerprint"]  # envelope carries git
+    assert recs[0]["fingerprint"]["host"] == "test-host"
+
+
+@pytest.mark.slow
+def test_loadgen_appends_ledger_record_end_to_end(tmp_path, monkeypatch):
+    """loadgen service arm -> ledger record with service metrics, stages
+    from --telemetry-dir, and the web /perf page rendering it — on the
+    suite-shared (30,3)@(64,256) shapes (no new compile geometries).
+    Slow-marked: the tier-1 suite sits at the 870 s cap; this runs in
+    the docker/bin/test chaos tier and by hand
+    (pytest tests/test_perfwatch.py -m slow)."""
+    import loadgen
+
+    from jepsen_tpu import web
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    led = tmp_path / "store" / "perf-ledger.jsonl"
+    monkeypatch.setenv(regress.ENV_LEDGER, str(led))
+    obs_metrics.REGISTRY.reset()  # loadgen's /metrics consistency math
+    rc = loadgen.main([
+        "--requests", "4", "--concurrency", "2", "--mode", "service",
+        "--ops", "30", "--procs", "3", "--capacity", "64,256",
+        "--corrupt-every", "0",
+        "--telemetry-dir", str(tmp_path / "tele"),
+    ])
+    assert rc == 0
+    recs = regress.read_records(led)
+    assert len(recs) == 1 and recs[0]["kind"] == "loadgen"
+    assert recs[0]["metrics"]["service_rps"] > 0
+    assert recs[0]["axes"] == {"arrival": "open", "geometry": "uniform"}
+    assert any(k.startswith("ladder") for k in recs[0]["stages"])
+    page = web.perf_html(store_dir=str(tmp_path / "store"))
+    assert "service_rps" in page and "<svg" in page
+
+
+# ---------------------------------------------------------------------------
+# surfaces: trace_summarize --diff, web /perf, /metrics headline gauges
+# ---------------------------------------------------------------------------
+
+
+def _run_dir(tmp_path, name, summary):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "telemetry.json").write_text(json.dumps(summary))
+    return d
+
+
+def test_trace_summarize_diff_mode(tmp_path, capsys):
+    import trace_summarize
+
+    a = _run_dir(tmp_path, "run_a", SUMMARY_A)
+    b = _run_dir(tmp_path, "run_b", SUMMARY_B)
+    assert trace_summarize.main(["--diff", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    # top regressing span leads the table
+    lines = [ln for ln in out.splitlines() if ln.startswith("ladder")]
+    assert lines[0].startswith("ladder[1] fast@512")
+    assert "+2" in lines[0]
+    assert trace_summarize.main(["--diff", str(a), str(b), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["stages"][0]["span"] == "ladder[1] fast@512"
+    # arg contract: exactly one of path / --diff
+    assert trace_summarize.main([]) == 2
+    assert trace_summarize.main([str(a), "--diff", str(a), str(b)]) == 2
+
+
+def test_web_perf_page_and_headline_gauges(tmp_path, monkeypatch):
+    from jepsen_tpu import web
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    led = tmp_path / "store" / "perf-ledger.jsonl"
+    monkeypatch.setenv(regress.ENV_LEDGER, str(led))
+    for v in (1000.0, 1010.0, 990.0):
+        regress.append_record(_bench(v, ops_per_s=v * 1.5))
+    regress.append_record(regress.run_competition(
+        "dedup_backend", ["sort", "bucket"],
+        runner=lambda v: [0.5] * 3 if v == "sort" else [0.3] * 3))
+    page = web.perf_html(store_dir=str(tmp_path / "store"))
+    assert "fixed_work_configs_per_s" in page
+    assert "<svg" in page  # the trend sparkline
+    assert "competition verdicts" in page and "bucket" in page
+    # without the env override the page reads <store-dir>/perf-ledger.jsonl
+    monkeypatch.delenv(regress.ENV_LEDGER)
+    empty = web.perf_html(store_dir=str(tmp_path / "empty"))
+    assert "empty ledger" in empty
+    monkeypatch.setenv(regress.ENV_LEDGER, str(led))
+    # the newest record's headline rides /metrics as labeled gauges
+    obs_metrics.enable_mirror()
+    obs_metrics.REGISTRY.reset()
+    assert regress.publish_gauges()
+    text = obs_metrics.render()
+    assert ('jepsen_tpu_perf_headline{kind="bench",'
+            'metric="fixed_work_configs_per_s"} 990') in text
+    assert "jepsen_tpu_perf_headline_age_seconds" in text
+    # a newer record that DROPS a metric retracts the stale series — no
+    # mixed scrape of values from different runs
+    regress.append_record(_bench(985.0))  # no ops_per_s this time
+    assert regress.publish_gauges()
+    text = obs_metrics.render()
+    assert 'metric="fixed_work_configs_per_s"} 985' in text
+    assert 'kind="bench",metric="ops_per_s"' not in text
+    # the age gauge keeps advancing on cache-hit scrapes (unchanged
+    # ledger): an alert on perf_headline_age_seconds is its only purpose
+    old = regress.make_record("tier1", {"tier1_wall_s": 800.0}, fp=FP)
+    old["ts"] = old["ts"] - 1000.0
+    regress.append_record(old)
+    assert regress.publish_gauges()
+    assert regress.publish_gauges()  # second call hits the mtime cache
+    age = obs_metrics.REGISTRY.get("perf.headline_age_seconds",
+                                   kind="tier1")
+    assert age is not None and age >= 1000.0
+    # a foreign/hand-written record without a fingerprint_key must not
+    # 500 the page (sorted() over mixed None/str keys)
+    with open(led, "a") as fh:
+        fh.write('{"kind": "foreign", "metrics": {"x": 1}}\n')
+    page = web.perf_html(store_dir=str(tmp_path / "store"))
+    assert "foreign" in page

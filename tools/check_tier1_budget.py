@@ -26,6 +26,9 @@ import json
 import os
 import re
 import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 DEFAULT_BUDGET_S = 850.0
 
@@ -56,6 +59,42 @@ def parse_log(text: str) -> tuple[float | None, list[tuple[float, str]]]:
     return seconds, durations
 
 
+def append_ledger(seconds: float, budget: float,
+                  durations: list[tuple[float, str]],
+                  ledger: str | None = None) -> None:
+    """Record this suite run in the perf-regression ledger (kind
+    ``tier1``): wall seconds + the top-25 test durations.  The budget
+    gate trips only at the 850 s cliff; the ledger is what makes the
+    CREEP toward it visible — ``perfwatch compare`` flags a suite-time
+    shift beyond the same-machine noise band long before the gate does.
+    Best-effort: a ledger failure must never change this gate's verdict.
+    """
+    try:
+        from jepsen_tpu.obs import regress
+
+        # One stage row per test nodeid, SUMMED over pytest's separate
+        # call/setup/teardown duration rows (the shared compile fixtures
+        # are exactly the slow setups here — last-write-wins would drop
+        # the call row and blind the creep attribution to it).
+        per_test: dict[str, float] = {}
+        for secs, test in durations:
+            per_test[test] = per_test.get(test, 0.0) + secs
+        top = dict(sorted(per_test.items(), key=lambda kv: -kv[1])[:25])
+        record = regress.make_record(
+            "tier1",
+            {"tier1_wall_s": round(float(seconds), 2),
+             "tier1_headroom_s": round(budget - float(seconds), 2)},
+            # the suite's own slowest tests double as its stage table, so
+            # a flagged creep names the moving tests via attribution
+            stages=top,
+            extra={"budget_s": budget},
+            fp=regress.fingerprint(probe_devices=False),
+        )
+        regress.append_record(record, ledger)
+    except Exception as e:  # noqa: BLE001 — never fail the gate on this
+        print(f"warning: perf-ledger append failed: {e}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("log", nargs="?", default="-",
@@ -70,6 +109,10 @@ def main(argv=None) -> int:
                          "budget, headroom, ok, slowest tests) instead of "
                          "prose — for the docker test entrypoint and CI "
                          "dashboards; the exit code contract is unchanged")
+    ap.add_argument("--ledger", default=None,
+                    help="perf-ledger path for the suite-time record "
+                         "(default: $JEPSEN_TPU_PERF_LEDGER, else "
+                         "store/perf-ledger.jsonl; 'off' disables)")
     a = ap.parse_args(argv)
 
     budget = a.budget
@@ -95,6 +138,8 @@ def main(argv=None) -> int:
                 print("check_tier1_budget: no pytest summary line found "
                       f"in {a.log!r} (did the suite crash?)", file=sys.stderr)
             return 2
+
+    append_ledger(seconds, budget, durations, a.ledger)
 
     if a.json:
         ok = seconds <= budget

@@ -378,7 +378,7 @@ def main(argv=None) -> int:
         obs.recording(a.telemetry_dir, enabled=True)
         if a.telemetry_dir else contextlib.nullcontext()
     )
-    with rec_ctx:
+    with rec_ctx as rec:
         if a.mode in ("both", "sequential"):
             # One-shot baseline: each caller pays its own batch_analysis
             # (the pre-serve world).  Warm untimed on one valid AND one
@@ -763,6 +763,47 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             rc = 1
         print(f"backpressure: {out['backpressure']}")
+
+    if rc == 0:
+        # Record the round in the perf-regression ledger (obs.regress):
+        # the service/sequential headline numbers plus the telemetry
+        # stage rollup when --telemetry-dir captured one.  Axes mark the
+        # scenario (arrival pattern, geometry spread, chaos) so
+        # perfwatch only baselines like against like.  Failed runs are
+        # not recorded — their numbers are evidence for the failure, not
+        # a baseline.  Best-effort: ledger IO must not fail the load run.
+        try:
+            from jepsen_tpu.obs import regress
+
+            metrics: dict = {}
+            if "service" in out:
+                sv = out["service"]
+                metrics.update(
+                    service_rps=sv["throughput_rps"],
+                    service_p50_s=sv["p50_s"], service_p95_s=sv["p95_s"],
+                )
+                if sv.get("continuous_occupancy") is not None:
+                    metrics["service_occupancy"] = sv["continuous_occupancy"]
+                icls = (sv.get("classes") or {}).get("interactive")
+                if icls:
+                    metrics["interactive_p50_s"] = icls["p50_s"]
+            if "sequential" in out:
+                metrics["sequential_rps"] = out["sequential"]["throughput_rps"]
+            if "speedup" in out:
+                metrics["speedup"] = out["speedup"]
+            axes = {"arrival": a.arrival, "geometry": a.geometry_spread}
+            if a.chaos_seed is not None:
+                axes["chaos"] = str(a.chaos_seed)
+            if a.no_continuous:
+                axes["continuous"] = "off"
+            summary = rec.summary if rec is not None else None
+            stages, extra_metrics = regress.stage_rollup(summary)
+            metrics.update(extra_metrics)
+            regress.append_record(
+                regress.make_record("loadgen", metrics, stages=stages,
+                                    axes=axes))
+        except Exception as e:  # noqa: BLE001 — never fail the run on this
+            print(f"warning: perf-ledger append failed: {e}", file=sys.stderr)
 
     print(json.dumps({"loadgen": out}))
     return rc

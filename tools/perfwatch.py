@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""perfwatch: the perf-regression observatory's CLI (jepsen_tpu.obs.regress).
+
+The ledger (default ``store/perf-ledger.jsonl``; ``--ledger`` or the
+``JEPSEN_TPU_PERF_LEDGER`` env override) accumulates one JSONL record
+per ``bench.py`` / ``tools/loadgen.py`` / ``tools/check_tier1_budget.py``
+run: git sha, machine fingerprint, headline metrics, per-stage telemetry
+rollup.  This tool reads and adjudicates it:
+
+  list            the trajectory: one line per record
+  compare         newest record per kind vs its same-fingerprint history,
+                  with a MAD noise band per metric; regressions print the
+                  top regressing telemetry spans (stage attribution)
+  gate            compare with an exit code: 1 on any regression beyond
+                  the band, 0 otherwise; --advisory always exits 0 but
+                  still prints the full comparison table (docker/bin/test
+                  runs this after the tier-1 budget gate)
+  compete         run the pinned fixed-work ladder workload once per
+                  value of --axis (e.g. dedup_backend: sort vs bucket),
+                  judge the head-to-head beyond noise, and append the
+                  verdict record — a routing flip becomes a recorded
+                  comparison instead of a PERF.md paragraph
+  append          append a caller-assembled record (JSON object on stdin
+                  or --file); stamps schema/ts/git/fingerprint when absent
+
+Examples:
+
+  python tools/perfwatch.py compare
+  python tools/perfwatch.py gate --advisory
+  python tools/perfwatch.py compete --axis dedup_backend --values sort,bucket
+  echo '{"kind":"bench","metrics":{"ops_per_s":1557.9}}' | \\
+      python tools/perfwatch.py append
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jepsen_tpu.obs import regress  # noqa: E402
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default: $JEPSEN_TPU_PERF_LEDGER, "
+                        "else store/perf-ledger.jsonl)")
+
+
+def _add_band(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--k-sigma", type=float, default=4.0,
+                   help="noise-band width in robust (MAD) standard "
+                        "deviations (default 4)")
+    p.add_argument("--rel-floor", type=float, default=0.02,
+                   help="noise-band floor as a fraction of the history "
+                        "median, for short/zero-MAD histories (default "
+                        "0.02 = 2%%)")
+    p.add_argument("--kind", action="append", default=None,
+                   help="record kind(s) to judge (repeatable; default: "
+                        "every non-compete kind in the ledger)")
+    p.add_argument("--metric", action="append", default=None,
+                   help="metric name(s) to judge (repeatable; default: "
+                        "every numeric metric on the newest record)")
+
+
+def _cmd_list(a) -> int:
+    records = regress.read_records(a.ledger)
+    if not records:
+        print("(empty ledger)")
+        return 0
+    for r in records[-a.limit:] if a.limit else records:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(float(r.get("ts") or 0)))
+        git = (r.get("git") or {}).get("sha", "?")[:10]
+        mets = r.get("metrics") or {}
+        head = ", ".join(f"{k}={v:.6g}" for k, v in sorted(mets.items())[:4])
+        axes = r.get("axes") or {}
+        ax = (" [" + ", ".join(f"{k}={v}" for k, v in sorted(axes.items()))
+              + "]") if axes else ""
+        out = "" if not r.get("outage") else " OUTAGE"
+        print(f"{ts}  {r.get('kind', '?'):8s}  {git}  "
+              f"{r.get('fingerprint_key', '?')}  {head}{ax}{out}")
+    return 0
+
+
+def _cmd_compare(a, *, gating: bool) -> int:
+    records = regress.read_records(a.ledger)
+    ok, report = regress.gate(
+        records, kinds=a.kind, k_sigma=a.k_sigma, rel_floor=a.rel_floor,
+        metrics=a.metric,
+    )
+    print(report, end="")
+    if not gating:
+        return 0
+    if not ok:
+        if a.advisory:
+            print("perfwatch: regression beyond noise band (ADVISORY — "
+                  "not failing the build)", file=sys.stderr)
+            return 0
+        print("perfwatch: REGRESSION beyond noise band", file=sys.stderr)
+        return 1
+    print("perfwatch gate OK")
+    return 0
+
+
+def _cmd_compete(a) -> int:
+    values = [v for v in (a.values or "").split(",") if v]
+    if len(set(values)) < 2:
+        print("compete: --values needs at least two DISTINCT comma-"
+              "separated axis values", file=sys.stderr)
+        return 2
+    workload = {
+        "histories": a.histories, "ops": a.ops, "procs": a.procs,
+        "capacity": tuple(int(c) for c in a.capacity.split(",") if c),
+    }
+    record = regress.run_competition(
+        a.axis, values, repeats=a.repeats, k_sigma=a.k_sigma,
+        rel_floor=a.rel_floor, workload=workload,
+    )
+    v = record["extra"]
+    for val in values:
+        r = v["results"][val]
+        print(f"  {a.axis}={val}: median {r['median_s']:.4f}s "
+              f"(band ±{r['band_s']:.4f}s, {len(r['times_s'])} passes)")
+    print(f"winner: {a.axis}={v['winner']} by {v['margin_pct']:.2f}% — "
+          + ("DECISIVE (beyond noise)" if v["decisive"]
+             else "NOT decisive (within noise; keep the current default)"))
+    path = regress.append_record(record, a.ledger)
+    if path is not None:
+        print(f"verdict recorded in {path}")
+    else:
+        print("(ledger disabled; verdict not recorded)", file=sys.stderr)
+    return 0
+
+
+def _cmd_append(a) -> int:
+    text = (sys.stdin.read() if a.file in (None, "-")
+            else Path(a.file).read_text(encoding="utf-8"))
+    try:
+        obj = json.loads(text)
+        if not isinstance(obj, dict) or not obj.get("kind"):
+            raise ValueError("record must be a JSON object with a 'kind'")
+    except ValueError as e:
+        print(f"append: bad record: {e}", file=sys.stderr)
+        return 2
+    # stamp the envelope fields the producer didn't supply
+    rec = regress.make_record(
+        obj.pop("kind"), obj.pop("metrics", {}),
+        stages=obj.pop("stages", None), axes=obj.pop("axes", None),
+        extra=obj.pop("extra", None), fp=obj.pop("fingerprint", None),
+    )
+    rec.update(obj)  # caller-supplied ts/git/outage/... win
+    path = regress.append_record(rec, a.ledger)
+    if path is None:
+        print("(ledger disabled; nothing written)", file=sys.stderr)
+        return 0
+    print(f"appended {rec['kind']} record to {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="command")
+
+    p = sub.add_parser("list", help="print the ledger trajectory")
+    _add_common(p)
+    p.add_argument("--limit", type=int, default=0,
+                   help="only the newest N records (default: all)")
+
+    p = sub.add_parser("compare",
+                       help="newest record per kind vs same-fingerprint "
+                            "history (noise-banded)")
+    _add_common(p)
+    _add_band(p)
+
+    p = sub.add_parser("gate",
+                       help="compare with an exit code: 1 on regression "
+                            "beyond the noise band")
+    _add_common(p)
+    _add_band(p)
+    p.add_argument("--advisory", action="store_true",
+                   help="print the comparison but always exit 0 (CI "
+                        "stages that inform rather than block)")
+
+    p = sub.add_parser("compete",
+                       help="recorded head-to-head along one axis "
+                            "(pinned fixed-work ladder workload)")
+    _add_common(p)
+    p.add_argument("--axis", required=True,
+                   help="the competition axis; its value is applied via "
+                        "JEPSEN_TPU_<AXIS> (e.g. dedup_backend -> "
+                        "JEPSEN_TPU_DEDUP_BACKEND)")
+    p.add_argument("--values", default="sort,bucket",
+                   help="comma-separated axis values (default sort,bucket)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed passes per value, after one warm pass "
+                        "(default 3)")
+    p.add_argument("--histories", type=int, default=6,
+                   help="pinned histories in the workload (default 6)")
+    p.add_argument("--ops", type=int, default=30)
+    p.add_argument("--procs", type=int, default=3)
+    p.add_argument("--capacity", default="64,256",
+                   help="workload ladder capacities (default 64,256 — "
+                        "the suite-shared shapes)")
+    p.add_argument("--k-sigma", type=float, default=4.0)
+    p.add_argument("--rel-floor", type=float, default=0.02)
+
+    p = sub.add_parser("append", help="append a JSON record (stdin/--file)")
+    _add_common(p)
+    p.add_argument("--file", default=None,
+                   help="record file ('-'/omitted: stdin)")
+
+    a = ap.parse_args(argv)
+    if a.command == "list":
+        return _cmd_list(a)
+    if a.command == "compare":
+        return _cmd_compare(a, gating=False)
+    if a.command == "gate":
+        return _cmd_compare(a, gating=True)
+    if a.command == "compete":
+        return _cmd_compete(a)
+    if a.command == "append":
+        return _cmd_append(a)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,13 @@ the same phase / checker / ladder-stage tables the web UI renders.
   python tools/trace_summarize.py store/my-test/latest
   python tools/trace_summarize.py store/my-test/2026.../telemetry.jsonl
   python tools/trace_summarize.py --json telemetry.jsonl   # re-rolled summary
+  python tools/trace_summarize.py --diff RUN_A RUN_B       # stage-table diff
+
+``--diff`` answers "what got slower between these two runs": both runs'
+stage tables (ladder rungs + rolled-up spans, via
+``obs.regress.stage_rollup``) are diffed and printed top-regressing-span
+first — the same attribution code ``tools/perfwatch.py`` uses when a
+ledger headline regresses.
 """
 
 from __future__ import annotations
@@ -71,14 +78,50 @@ def load_summary(path: Path) -> dict:
     return summary
 
 
+def diff_summaries(path_a: Path, path_b: Path, *, as_json: bool) -> int:
+    """The --diff mode: stage-table diff of two runs, top regressing
+    spans (B slower than A) first.  Shares obs.regress's attribution
+    code with perfwatch — one definition of "what got slower"."""
+    from jepsen_tpu.obs import regress
+
+    rollups = []
+    for p in (path_a, path_b):
+        stages, metrics = regress.stage_rollup(load_summary(p))
+        rollups.append((stages, metrics))
+    rows = regress.diff_stage_tables(rollups[0][0], rollups[1][0])
+    metric_rows = regress.diff_stage_tables(rollups[0][1], rollups[1][1])
+    if as_json:
+        print(json.dumps({"stages": rows, "metrics": metric_rows}, indent=1))
+        return 0
+    print(f"stage diff: A={path_a}  B={path_b}  "
+          "(positive delta = slower in B)")
+    print(regress.format_stage_diff(rows), end="")
+    if metric_rows:
+        print("\nside-channel metrics (occupancy, dedup µs, spill, ...):")
+        print(regress.format_stage_diff(metric_rows), end="")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="run directory, telemetry.jsonl, or telemetry.json")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="run directory, telemetry.jsonl, or telemetry.json")
     ap.add_argument("--json", action="store_true",
                     help="print the rolled-up summary as JSON instead of tables"
                          " (scripting: jq '.serve', '.ladder[0]', ...)")
+    ap.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
+                    default=None,
+                    help="diff two runs' stage tables instead of "
+                         "summarizing one (top regressing spans first)")
     opts = ap.parse_args(argv)
+    if (opts.path is None) == (opts.diff is None):
+        print("error: give either a run path or --diff RUN_A RUN_B",
+              file=sys.stderr)
+        return 2
     try:
+        if opts.diff:
+            return diff_summaries(Path(opts.diff[0]), Path(opts.diff[1]),
+                                  as_json=opts.json)
         summary = load_summary(Path(opts.path))
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
