@@ -3,7 +3,9 @@
 The reference delegates transactional anomaly detection to the external
 `elle 0.1.3` library through thin adapters (jepsen/src/jepsen/tests/cycle/
 append.clj, wr.clj).  This module is the native rebuild: dependency-graph
-inference happens host-side (jepsen_tpu.checker.txn_graph), cycle
+inference happens host-side (jepsen_tpu.checker.txn_graph — the
+vectorized column-native engine by default, with the loop reference as
+fallback/oracle; see txn_columns.py), cycle
 classification routes to the measured-fastest backend (CYCLE_BACKEND —
 host sparse SCC by default after the round-5 chip measurements; batched
 boolean matrix powering on the TPU MXU via jepsen_tpu.ops.closure as the
@@ -24,7 +26,7 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
-from jepsen_tpu import store
+from jepsen_tpu import obs, store
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.checker import txn_graph as tg
 from jepsen_tpu.ops import closure as cl
@@ -275,7 +277,10 @@ def _merge_flags(g: tg.TxnGraph, flags: dict, hints: dict, requested) -> dict:
     # If recovery fails (stale/empty hint, adjacency mismatch), the flag
     # must still surface — never a clean True over a flagged graph.
     unwitnessed: list[str] = []
-    if g.n:
+    # The dense unions below are only for witness BFS: on a 10k-node
+    # graph each one is a 100M-entry boolean scan, so a clean (unflagged)
+    # graph must never pay for them.
+    if g.n and any(flags[nm] for nm in ("G0", "G1c", "G-single", "G2")):
         any_adj = g.ww | g.wr | g.extra
         full_adj = any_adj | g.rw
         if flags["G0"] and "G0" in wanted:
@@ -358,11 +363,17 @@ def check_graph(
     if not g.n:
         return _merge_flags(g, dict(cl._EMPTY_FLAGS), dict(cl._EMPTY_HINTS), requested)
     if _device_classify(g.n, backend):
-        flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
+        with obs.span("elle.scc", nodes=g.n, backend="device"):
+            flags, hints = cl.classify_graph(g.ww, g.wr, g.rw, g.extra)
     else:
         from jepsen_tpu.checker.scc import classify_graph_scc
 
-        flags, hints = classify_graph_scc(g.ww, g.wr, g.rw, g.extra)
+        # the sparse edge view skips argwhere over the dense matrices
+        # (the measured bulk of classification at 10k nodes)
+        with obs.span("elle.scc", nodes=g.n, backend="host"):
+            flags, hints = classify_graph_scc(
+                g.ww, g.wr, g.rw, g.extra, edges=g.edge_arrays()
+            )
     return _merge_flags(g, flags, hints, requested)
 
 
@@ -389,9 +400,14 @@ def check_graphs(
     if len(dev_idx) < len(graphs):
         from jepsen_tpu.checker.scc import classify_graph_scc
 
-        for i, g in enumerate(graphs):
-            if results[i] is None:
-                results[i] = classify_graph_scc(g.ww, g.wr, g.rw, g.extra)
+        with obs.span(
+            "elle.scc", graphs=len(graphs) - len(dev_idx), backend="host"
+        ):
+            for i, g in enumerate(graphs):
+                if results[i] is None:
+                    results[i] = classify_graph_scc(
+                        g.ww, g.wr, g.rw, g.extra, edges=g.edge_arrays()
+                    )
     return [
         _merge_flags(g, flags, hints, requested)
         for g, (flags, hints) in zip(graphs, results)
@@ -483,6 +499,14 @@ class _ElleChecker(Checker):
     #: cross-request batching by accident; this makes it explicit).
     geometry_batchable = False
 
+    def batch_key(self) -> tuple:
+        """Column-shape compatibility key for the serve graph lane (the
+        graph analogue of ``parallel.batch.bucket_geometry``): queued
+        requests whose checkers share this key are served by ONE
+        ``check_batch`` call — one batched inference pass plus one
+        host-SCC sweep — instead of per-request checks."""
+        return (type(self).__name__,)
+
     def write_artifacts(self, test, result, opts=None):
         """Render the elle/ anomaly-explanation directory for a stored
         run (called per key by independent.checker on the batch path)."""
@@ -498,29 +522,46 @@ class ListAppendChecker(_ElleChecker):
     Options:
       anomalies          headline anomalies to report (default catches all)
       additional_graphs  iterable of "realtime" / "process"
+      engine             inference engine ("columns"/"loops"; None defers
+                         to txn_graph.resolve_engine — vectorized columns
+                         by default, loop reference on fallback)
     """
 
     def __init__(
         self,
         anomalies: Sequence[str] = DEFAULT_ANOMALIES,
         additional_graphs: Sequence[str] = (),
+        engine: str | None = None,
     ):
         self.anomalies = list(anomalies) + [
             "duplicate-elements",
             "incompatible-order",
         ]
         self.additional_graphs = tuple(additional_graphs)
+        self.engine = engine
+
+    def batch_key(self) -> tuple:
+        return (
+            type(self).__name__, tuple(self.anomalies),
+            self.additional_graphs, self.engine,
+        )
 
     def check(self, test, history, opts):
-        g = tg.list_append_graph(history, self.additional_graphs)
+        g = tg.list_append_graph(
+            history, self.additional_graphs, engine=self.engine
+        )
         res = check_graph(g, self.anomalies)
         self.write_artifacts(test, res, opts)
         return res
 
     def check_batch(self, test, histories, opts):
-        """Check many subhistories in batched device launches (used by
-        independent.checker — one vmapped kernel per size bucket)."""
-        graphs = [tg.list_append_graph(hh, self.additional_graphs) for hh in histories]
+        """Check many histories through the shared batched inference
+        pass (one engine resolution + one span; used by
+        independent.checker per key and by the CheckService's graph
+        lane) followed by one classification sweep."""
+        graphs = tg.list_append_graphs(
+            histories, self.additional_graphs, engine=self.engine
+        )
         return check_graphs(graphs, self.anomalies)
 
 
@@ -533,11 +574,20 @@ class WRRegisterChecker(_ElleChecker):
         additional_graphs: Sequence[str] = (),
         sequential_keys: bool = False,
         linearizable_keys: bool = False,
+        engine: str | None = None,
     ):
         self.anomalies = list(anomalies) + ["duplicate-writes"]
         self.additional_graphs = tuple(additional_graphs)
         self.sequential_keys = sequential_keys
         self.linearizable_keys = linearizable_keys
+        self.engine = engine
+
+    def batch_key(self) -> tuple:
+        return (
+            type(self).__name__, tuple(self.anomalies),
+            self.additional_graphs, self.sequential_keys,
+            self.linearizable_keys, self.engine,
+        )
 
     def _graph(self, history):
         return tg.rw_register_graph(
@@ -545,6 +595,7 @@ class WRRegisterChecker(_ElleChecker):
             self.additional_graphs,
             sequential_keys=self.sequential_keys,
             linearizable_keys=self.linearizable_keys,
+            engine=self.engine,
         )
 
     def check(self, test, history, opts):
@@ -554,7 +605,12 @@ class WRRegisterChecker(_ElleChecker):
 
     def check_batch(self, test, histories, opts):
         """Batched per-key form (see ListAppendChecker.check_batch)."""
-        return check_graphs([self._graph(hh) for hh in histories], self.anomalies)
+        graphs = tg.rw_register_graphs(
+            histories, self.additional_graphs,
+            sequential_keys=self.sequential_keys,
+            linearizable_keys=self.linearizable_keys, engine=self.engine,
+        )
+        return check_graphs(graphs, self.anomalies)
 
 
 class CycleChecker(_ElleChecker):
@@ -593,7 +649,27 @@ class CycleChecker(_ElleChecker):
         self.analyzer = analyzer
         self.backend = backend
 
+    def batch_key(self) -> tuple:
+        # instances share a serve-lane batch only when they share the
+        # SAME analyzer object — its output shape is the compatibility
+        # contract, and there is no cheaper identity for a callable
+        return (type(self).__name__, id(self.analyzer), self.backend)
+
     def check(self, test, history, opts):
+        res = self._check_one(history)
+        self.write_artifacts(test, res, opts)
+        return res
+
+    def check_batch(self, test, histories, opts):
+        """Shared batched sweep for the serve graph lane: one span, one
+        classification loop — instead of the per-request list
+        comprehension that rebuilt everything unbatched."""
+        with obs.span(
+            "elle.infer_batch", histories=len(histories), workload="cycle",
+        ):
+            return [self._check_one(hh) for hh in histories]
+
+    def _check_one(self, history):
         nodes, relations, explainer = self.analyzer(history)
         n = len(nodes)
         adj = np.zeros((n, n), dtype=bool)
@@ -652,7 +728,6 @@ class CycleChecker(_ElleChecker):
                     "cycle": [{"cycle": [nodes[i] for i in cycle], "steps": steps}]
                 },
             }
-        self.write_artifacts(test, res, opts)
         return res
 
     @staticmethod

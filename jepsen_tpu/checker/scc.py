@@ -145,22 +145,42 @@ def _dag_reach_pairs(n: int, comp: np.ndarray, edges: np.ndarray, queries: np.nd
     return np.where(qa == qb, cyclic[qa], reach_refl)
 
 
-def classify_graph_scc(ww, wr, rw, extra):
+def _union_edges(*parts: np.ndarray) -> np.ndarray:
+    """Sorted-unique union of (E, 2) edge arrays — exactly the rows
+    ``np.argwhere`` would produce on the OR of the dense matrices."""
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return np.zeros((0, 2), np.int64)
+    cat = np.concatenate(parts)
+    return np.unique(cat, axis=0)
+
+
+def classify_graph_scc(ww, wr, rw, extra, edges=None):
     """(flags, hints) — same contract as ops/closure.classify_graph, via
-    sparse host algorithms."""
+    sparse host algorithms.
+
+    ``edges`` is an optional precomputed sparse view ({"ww"/"wr"/"rw"/
+    "extra": (E, 2) argwhere-ordered rows} — ``TxnGraph.edge_arrays``):
+    with it, classification of an n-node graph never scans the dense
+    [n, n] matrices (five ``np.argwhere`` passes over 10k-node graphs
+    measured ~1.5 s of config 3's 2.65 s — the edge lists are ~37k
+    rows)."""
     n = ww.shape[0]
     flags = {"G0": False, "G1c": False, "G-single": False, "G2": False}
     hints = {"G0": None, "G1c": None, "G-single": None, "G2": None}
     if n == 0:
         return flags, hints
 
-    def edge_array(m):
-        return np.argwhere(m)
-
-    e_ww = edge_array(ww | extra)
-    e_wr = edge_array(wr)
-    e_rw = edge_array(rw)
-    e_wwr = edge_array(ww | wr | extra)
+    if edges is not None:
+        e_ww = _union_edges(edges["ww"], edges["extra"])
+        e_wr = np.asarray(edges["wr"])
+        e_rw = np.asarray(edges["rw"])
+        e_wwr = _union_edges(edges["ww"], edges["wr"], edges["extra"])
+    else:
+        e_ww = np.argwhere(ww | extra)
+        e_wr = np.argwhere(wr)
+        e_rw = np.argwhere(rw)
+        e_wwr = np.argwhere(ww | wr | extra)
 
     # G0
     comp_ww = tarjan_scc(n, _adj_lists(n, e_ww))
@@ -185,7 +205,10 @@ def classify_graph_scc(ww, wr, rw, extra):
             hints["G-single"] = (int(e_rw[idx[0], 0]), int(e_rw[idx[0], 1]))
 
     # G2 over the full graph
-    e_all = edge_array(ww | wr | rw | extra)
+    if edges is not None:
+        e_all = _union_edges(e_wwr, e_rw)
+    else:
+        e_all = np.argwhere(ww | wr | rw | extra)
     comp_all = tarjan_scc(n, _adj_lists(n, e_all))
     if len(e_rw):
         same = comp_all[e_rw[:, 0]] == comp_all[e_rw[:, 1]]

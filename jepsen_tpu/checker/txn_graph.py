@@ -25,12 +25,30 @@ inference, since they are single-pass folds.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Mapping, Sequence
 
 import numpy as np
 
 from jepsen_tpu import history as h
+from jepsen_tpu import obs
 from jepsen_tpu import txn as t
+
+#: engine selection: per-call arg > env > the vectorized default.  The
+#: "columns" engine (jepsen_tpu.checker.txn_columns) runs inference as
+#: flat int64 column operations and falls back to "loops" (the retained
+#: per-op reference below, also the differential oracle) whenever a
+#: history's values can't ride int64 columns.
+ENGINE_ENV = "JEPSEN_TPU_ELLE_ENGINE"
+DEFAULT_ENGINE = "columns"
+ENGINES = ("columns", "loops")
+
+
+def resolve_engine(engine: str | None = None) -> str:
+    e = engine or os.environ.get(ENGINE_ENV) or DEFAULT_ENGINE
+    if e not in ENGINES:
+        raise ValueError(f"unknown elle engine {e!r}; expected one of {ENGINES}")
+    return e
 
 # ---------------------------------------------------------------------------
 # Transaction nodes
@@ -76,10 +94,27 @@ class TxnGraph:
     explanations: dict[tuple[str, int, int], Any]
     #: non-cycle anomalies found during inference: name → [explanation dict]
     anomalies: dict[str, list]
+    #: optional sparse edge cache: type → (E, 2) int64 (i, j) rows in
+    #: ``np.argwhere`` order.  The columns engine fills it at build time
+    #: so classification never scans the dense matrices; ``edge_arrays``
+    #: computes (and caches) it by argwhere otherwise.
+    edges: dict | None = None
 
     @property
     def n(self) -> int:
         return len(self.nodes)
+
+    def edge_arrays(self) -> dict:
+        """Sparse (i, j) edge rows per type ("ww"/"wr"/"rw"/"extra"),
+        argwhere-ordered; cached."""
+        if self.edges is None:
+            self.edges = {
+                "ww": np.argwhere(self.ww),
+                "wr": np.argwhere(self.wr),
+                "rw": np.argwhere(self.rw),
+                "extra": np.argwhere(self.extra),
+            }
+        return self.edges
 
     def explain(self, et: str, i: int, j: int) -> str:
         """Render the explanation for edge (et, i, j), forcing a lazy
@@ -101,12 +136,17 @@ def _empty(n: int) -> np.ndarray:
     return np.zeros((n, n), dtype=bool)
 
 
-def txn_nodes(history: Sequence[dict]) -> list[TxnNode]:
+def txn_nodes(history: Sequence[dict], pairs=None) -> list[TxnNode]:
     """Extract transaction nodes: ok txns (fully trusted) and info txns
     (indeterminate — their writes may be visible, so they join the graph as
     writers; their reads are not evidence).  Failed txns are excluded — their
-    writes must never be visible (observing one is G1a)."""
-    pairs = h.pair_index(history)
+    writes must never be visible (observing one is G1a).
+
+    ``pairs`` lets a caller that already holds ``h.pair_index(history)``
+    thread it through instead of paying the per-op pairing walk again
+    (batched checks used to recompute it per history per call)."""
+    if pairs is None:
+        pairs = h.pair_index(history)
     nodes: list[TxnNode] = []
     for i, op in enumerate(history):
         if h.is_invoke(op) or not h.is_client_op(op):
@@ -248,6 +288,8 @@ def _internal_anomalies_wr(node: TxnNode) -> list:
 def list_append_graph(
     history: Sequence[dict],
     additional_graphs: Sequence[str] = (),
+    engine: str | None = None,
+    pairs=None,
 ) -> TxnGraph:
     """Infer the dependency graph for a list-append history.
 
@@ -255,8 +297,33 @@ def list_append_graph(
     be a prefix of the longest observed read (else ``incompatible-order``),
     so the longest read *is* the version order of observed values
     (elle's core trick — the paper's "recoverability").
-    """
-    nodes = txn_nodes(history)
+
+    ``engine`` routes between the vectorized column engine (the default;
+    see ``resolve_engine``) and the retained per-op loop reference
+    (``list_append_graph_loops``) — identical results either way,
+    differential-tested.  Histories whose values can't ride int64
+    columns fall back to the loops automatically."""
+    if resolve_engine(engine) == "columns":
+        from jepsen_tpu.checker import txn_columns as tc
+
+        try:
+            return tc.list_append_graph_columns(
+                history, additional_graphs, pairs=pairs
+            )
+        except tc.NotColumnizable:
+            obs.counter("elle.columns_fallback", workload="list-append")
+    return list_append_graph_loops(history, additional_graphs, pairs=pairs)
+
+
+def list_append_graph_loops(
+    history: Sequence[dict],
+    additional_graphs: Sequence[str] = (),
+    pairs=None,
+) -> TxnGraph:
+    """The per-op/per-mop loop reference for ``list_append_graph`` —
+    retained as the differential oracle and the fallback for histories
+    the column engine can't pack."""
+    nodes = txn_nodes(history, pairs)
     n = len(nodes)
     ww, wr, rw = _empty(n), _empty(n), _empty(n)
     expl: dict = {}
@@ -386,6 +453,8 @@ def rw_register_graph(
     additional_graphs: Sequence[str] = (),
     sequential_keys: bool = False,
     linearizable_keys: bool = False,
+    engine: str | None = None,
+    pairs=None,
 ) -> TxnGraph:
     """Infer the dependency graph for unique-write register transactions.
 
@@ -395,8 +464,36 @@ def rw_register_graph(
     version order (hence ww/rw edges); ``sequential_keys`` uses invocation
     order instead (weaker: per-process program order lifted to a total
     order).
-    """
-    nodes = txn_nodes(history)
+
+    ``engine`` routes like ``list_append_graph``'s (vectorized columns by
+    default, loop reference on fallback — identical results)."""
+    if resolve_engine(engine) == "columns":
+        from jepsen_tpu.checker import txn_columns as tc
+
+        try:
+            return tc.rw_register_graph_columns(
+                history, additional_graphs,
+                sequential_keys=sequential_keys,
+                linearizable_keys=linearizable_keys, pairs=pairs,
+            )
+        except tc.NotColumnizable:
+            obs.counter("elle.columns_fallback", workload="rw-register")
+    return rw_register_graph_loops(
+        history, additional_graphs, sequential_keys=sequential_keys,
+        linearizable_keys=linearizable_keys, pairs=pairs,
+    )
+
+
+def rw_register_graph_loops(
+    history: Sequence[dict],
+    additional_graphs: Sequence[str] = (),
+    sequential_keys: bool = False,
+    linearizable_keys: bool = False,
+    pairs=None,
+) -> TxnGraph:
+    """The loop reference for ``rw_register_graph`` (differential oracle
+    + fallback; see ``list_append_graph_loops``)."""
+    nodes = txn_nodes(history, pairs)
     n = len(nodes)
     ww, wr, rw = _empty(n), _empty(n), _empty(n)
     expl: dict = {}
@@ -495,3 +592,53 @@ def rw_register_graph(
         explanations=expl,
         anomalies=anomalies,
     )
+
+
+# ---------------------------------------------------------------------------
+# Batched inference (the shared pass the CheckService's graph lane and
+# independent.checker's check_batch route through)
+# ---------------------------------------------------------------------------
+
+
+def list_append_graphs(
+    histories: Sequence[Sequence[dict]],
+    additional_graphs: Sequence[str] = (),
+    engine: str | None = None,
+) -> list[TxnGraph]:
+    """Infer MANY list-append histories under one shared pass: the
+    engine is resolved once, one ``elle.infer_batch`` span covers the
+    whole batch, and every graph comes out carrying its sparse edge
+    arrays so the batch classification sweep never scans a dense
+    matrix."""
+    engine = resolve_engine(engine)
+    with obs.span(
+        "elle.infer_batch", histories=len(histories),
+        workload="list-append", engine=engine,
+    ):
+        return [
+            list_append_graph(hh, additional_graphs, engine=engine)
+            for hh in histories
+        ]
+
+
+def rw_register_graphs(
+    histories: Sequence[Sequence[dict]],
+    additional_graphs: Sequence[str] = (),
+    sequential_keys: bool = False,
+    linearizable_keys: bool = False,
+    engine: str | None = None,
+) -> list[TxnGraph]:
+    """Batched form of ``rw_register_graph`` (see
+    ``list_append_graphs``)."""
+    engine = resolve_engine(engine)
+    with obs.span(
+        "elle.infer_batch", histories=len(histories),
+        workload="rw-register", engine=engine,
+    ):
+        return [
+            rw_register_graph(
+                hh, additional_graphs, sequential_keys=sequential_keys,
+                linearizable_keys=linearizable_keys, engine=engine,
+            )
+            for hh in histories
+        ]

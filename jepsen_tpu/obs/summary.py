@@ -25,6 +25,10 @@ later perf PRs report against.
                  "execute_s", "peak_frontier", "lossy", "dedup"}, ...]
    "dedup":    [{"backend", "candidates", "capacity", "probes",
                  "per_round_us"}, ...]                  # dedup.round spans
+   "elle":     [{"stage", "seconds", "count", "max_s"}, ...]
+                               # elle.* inference substage spans (nodes /
+                               # anomalies / edges / scc / infer_batch —
+                               # the column-native inference pipeline)
    "memory":   {"device_bytes_peak", "spill_rows", "spill_bytes",
                 "spill_merges", "factorizations", "undecidable",
                 "oom_spills"}          # bounded-memory layer (ops.spill)
@@ -307,8 +311,14 @@ def summarize(events: Iterable[Mapping]) -> dict:
         memory["device_bytes_peak"] = mem["device_bytes_peak"]
     if mem["undecidable"]:
         memory["undecidable"] = mem["undecidable"]
+    elle = [
+        {"stage": name[len("elle."):], "seconds": s["total_s"],
+         "count": s["count"], "max_s": s["max_s"]}
+        for name, s in spans.items() if name.startswith("elle.")
+    ]
     for cname in ("submitted", "completed", "rejected", "expired", "drained",
                   "fastpath_resolved", "fastpath_escalated",
+                  "graphs", "graph_batches",
                   # self-healing layer (serve.health)
                   "quarantined", "quarantine_hit", "breaker_rejected",
                   "breaker_opened", "watchdog_trip", "journal_replayed",
@@ -323,6 +333,7 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "serve": serve,
         "ladder": ladder,
         "dedup": out_dedup,
+        "elle": elle,
         "memory": memory,
         "faults": out_faults,
         "counters": counters,
@@ -420,6 +431,13 @@ def format_summary(summary: Mapping) -> str:
             [[d.get("backend"), d.get("candidates"), d.get("capacity"),
               d.get("probes"), d.get("per_round_us")]
              for d in summary["dedup"]],
+        ))
+    if summary.get("elle"):
+        parts.append("\nelle inference (column-native substages):")
+        parts.append(_table(
+            ["stage", "seconds", "count", "max_s"],
+            [[e.get("stage"), e.get("seconds"), e.get("count"),
+              e.get("max_s")] for e in summary["elle"]],
         ))
     if summary.get("memory"):
         mm = summary["memory"]

@@ -38,6 +38,7 @@ from jepsen_tpu.serve.sched.admission import (
     AdmissionQueues,
     classify,
     geometry_batchable,
+    graph_batch_key,
 )
 from jepsen_tpu.serve.sched.packing import RungFeeder
 from jepsen_tpu.serve.sched.placement import Placement, PlacementMismatch, assert_parity
@@ -51,4 +52,5 @@ __all__ = [
     "assert_parity",
     "classify",
     "geometry_batchable",
+    "graph_batch_key",
 ]

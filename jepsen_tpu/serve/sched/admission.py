@@ -52,6 +52,26 @@ def geometry_batchable(checker) -> bool:
     return bool(getattr(checker, "geometry_batchable", True))
 
 
+def graph_batch_key(checker) -> tuple:
+    """The graph lane's batch-compatibility key — the graph analogue of
+    ``parallel.batch.bucket_geometry``: queued graph requests sharing
+    this key are served by ONE ``check_batch`` call (one vectorized
+    inference pass + one host-SCC sweep) instead of per-request checks.
+
+    Checkers advertise compatibility via a ``batch_key()`` method
+    (elle's checkers key on their config: workload, anomalies,
+    additional graphs, key-order assumptions, engine); anything without
+    one gets a per-instance key and is served unbatched — correctness
+    first, batching by explicit contract."""
+    key = getattr(checker, "batch_key", None)
+    if callable(key):
+        try:
+            return ("graph",) + tuple(key())
+        except Exception:  # noqa: BLE001 — a broken key means no sharing
+            pass
+    return ("graph", type(checker).__name__, id(checker))
+
+
 def classify(requested: str | None, *, B: int, interactive_max_b: int = 0) -> str:
     """The request's latency class.  An explicit ``requested`` class
     wins (validated); otherwise a history with at most

@@ -339,6 +339,7 @@ class CheckService:
             "submitted": 0, "completed": 0, "rejected": 0, "expired": 0,
             "drained": 0, "batches": 0, "batch_errors": 0,
             "fastpath_resolved": 0, "escalated": 0, "graphs": 0,
+            "graph_batches": 0,
             "quarantined": 0, "poison_isolated": 0, "bisect_launches": 0,
             "watchdog_trips": 0, "journal_replayed": 0,
             "devices_replaced": 0, "breaker_rejected": 0, "drain_errors": 0,
@@ -532,8 +533,11 @@ class CheckService:
                         "geometry-batchable work must be submitted as "
                         "model= + history for the service to pack it"
                     )
-                # Graph work: no kernel geometry, no geometry bucket.
-                group: tuple | None = ("graph", type(checker).__name__)
+                # Graph work: no kernel geometry — grouped by the
+                # checker's COLUMN-SHAPE key instead, so compatible
+                # queued requests share one batched inference pass
+                # (sched.graph_batch_key; the graph bucket_geometry).
+                group: tuple | None = _sched_adm.graph_batch_key(checker)
                 pack = None
                 kind = "graph"
                 tier = class_ or "batch"
@@ -602,6 +606,8 @@ class CheckService:
                     # depth-checked at admission and rejected there).
                     req.tier = "batch"
                 self._adm.push(req)
+                if kind == "graph":
+                    self._sync_graph_depth()
                 self._cond.notify_all()
             with obs.attach(req.ctx):
                 obs.counter("serve.submitted", client=client, tier=tier)
@@ -834,9 +840,13 @@ class CheckService:
 
     def _step_graphs(self) -> int:
         """Dispatch queued non-geometry-batchable (graph) requests to
-        the host side lane: a small thread pool when the scheduler
-        thread runs (graph checks must not stall ladder work), inline
-        when tests drive ``step()`` directly (determinism)."""
+        the host side lane, BATCHED by column-shape key: requests whose
+        checkers share a ``graph_batch_key`` are served by one
+        ``check_batch`` call (one vectorized inference pass + one
+        host-SCC sweep), demuxed per request afterwards.  Each group is
+        one task on a small thread pool when the scheduler thread runs
+        (graph checks must not stall ladder work), inline when tests
+        drive ``step()`` directly (determinism)."""
         with self._cond:
             gq = [
                 r for q in self._adm.queues.values() for r in q
@@ -845,16 +855,74 @@ class CheckService:
             self._adm.remove(gq)
             for r in gq:
                 r.status = "running"
+            self._sync_graph_depth()
+        groups: dict[tuple, list[CheckRequest]] = {}
         for r in gq:
+            groups.setdefault(r.group, []).append(r)
+        for rs in groups.values():
             if self._thread is not None:
                 if self._graph_pool is None:
                     self._graph_pool = ThreadPoolExecutor(
                         max_workers=2, thread_name_prefix="check-graph"
                     )
-                self._graph_pool.submit(self._run_graph, r)
+                self._graph_pool.submit(self._run_graph_batch, rs)
             else:
-                self._run_graph(r)
+                self._run_graph_batch(rs)
         return len(gq)
+
+    def _sync_graph_depth(self) -> None:
+        """Refresh the graph-lane queue-depth gauge (caller holds the
+        lock)."""
+        depth = sum(
+            1 for q in self._adm.queues.values() for r in q
+            if r.kind == "graph"
+        )
+        metrics.set_gauge("serve.graph_queue_depth", depth)
+
+    def _run_graph_batch(self, rs: list[CheckRequest]) -> None:
+        """One shared graph-lane dispatch: a single ``check_batch`` for
+        the whole compatibility group when the checker supports it,
+        per-request ``check_safe`` otherwise (and as the fallback when
+        the shared pass fails — one poison graph must degrade alone,
+        never its batchmates)."""
+        chk = rs[0].checker
+        results = None
+        if len(rs) > 1 and hasattr(chk, "check_batch"):
+            trace_ids = [r.trace_id for r in rs]
+            t0 = time.monotonic()
+            try:
+                with obs.attach(trace=trace_ids, parent="serve.graph_batch"):
+                    with obs.span(
+                        "serve.graph_batch", requests=len(rs),
+                        checker=type(chk).__name__, trace_ids=trace_ids,
+                    ):
+                        results = chk.check_batch(
+                            {"name": "serve"},
+                            [list(r.history) for r in rs], {},
+                        )
+                if results is None or len(results) != len(rs):
+                    results = None
+            except Exception:  # noqa: BLE001 — fall back per request
+                logger.exception(
+                    "graph-lane batch failed; retrying per request"
+                )
+                results = None
+            if results is not None:
+                metrics.observe("serve.graph_batch_seconds",
+                                time.monotonic() - t0)
+                metrics.inc("serve.graph_batch_requests", len(rs))
+                obs.counter("serve.graph_batches")
+                with self._lock:
+                    self._totals["graph_batches"] += 1
+        if results is None:
+            for r in rs:
+                self._run_graph(r)
+            return
+        with self._lock:
+            self._totals["graphs"] += len(rs)
+        obs.counter("serve.graphs", len(rs))
+        for r, res in zip(rs, results):
+            self._settle_member(r, res)
 
     def _run_graph(self, r: CheckRequest) -> None:
         from jepsen_tpu import checker as _checker
@@ -873,6 +941,7 @@ class CheckService:
                 )
         with self._lock:
             self._totals["graphs"] += 1
+        obs.counter("serve.graphs")
         self._settle_member(r, res)
 
     # -- interactive fast path ---------------------------------------------
@@ -1521,6 +1590,9 @@ class CheckService:
             t = dict(self._totals)
             return {
                 "queue_depth": self._adm.depth(),
+                "graph_queue_depth": sum(
+                    1 for r in queued if r.kind == "graph"
+                ),
                 "queue_groups": groups,
                 "running": len(self._inflight),
                 "max_queue": self.max_queue,

@@ -203,6 +203,8 @@ def queue_panel_html(service) -> str:
             ("batches", "batches"), ("batch_ewma_s", "batch ewma (s)"),
             ("continuous_occupancy", "rung occupancy"),
             ("fastpath_resolved", "fastpath"),
+            ("graph_queue_depth", "graphs queued"),
+            ("graph_batches", "graph batches"),
         )
     )
     class_rows = ""
@@ -532,6 +534,13 @@ def telemetry_html(run_dir: Path, rel: str | None = None) -> str:
             ["backend", "candidates", "capacity", "probes", "per round (µs)"],
             [[d.get("backend"), d.get("candidates"), d.get("capacity"),
               d.get("probes"), d.get("per_round_us")] for d in s["dedup"]],
+        ))
+    if s.get("elle"):
+        parts.append("<h3>elle inference (column-native substages)</h3>")
+        parts.append(_telemetry_table(
+            ["stage", "seconds", "count", "max (s)"],
+            [[e.get("stage"), e.get("seconds"), e.get("count"),
+              e.get("max_s")] for e in s["elle"]],
         ))
     if s.get("faults"):
         parts.append("<h3>faults (retries / degradations / checkpoints / deadline)</h3>")

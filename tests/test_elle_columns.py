@@ -1,0 +1,430 @@
+"""Differential suite: column-native elle inference vs the loop reference.
+
+The vectorized engine (``checker/txn_columns.py``) must be BIT-IDENTICAL
+to the retained per-op loops (``txn_graph.list_append_graph_loops`` /
+``rw_register_graph_loops``) — same edges, same anomaly dicts (contents
+AND list order), same rendered explanation prose, same classification
+results.  Randomized histories here deliberately hit the tricky corners
+ISSUE 11 names: info txns with a nil completion value (invocation
+fallback), failed writes (G1a), intermediate writes (G1b), duplicate
+appends/writes, and empty/nil mop values.
+
+Tier-1 runs a bounded sweep; the deep sweep is ``slow``-marked (tier-1
+sits at the 870 s cap) and runs in docker/bin/test.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checker import elle
+from jepsen_tpu.checker import txn_columns as tc
+from jepsen_tpu.checker import txn_graph as tg
+
+# ---------------------------------------------------------------------------
+# Randomized history generators (adversarial: fail/info/nil/duplicates)
+# ---------------------------------------------------------------------------
+
+
+def adversarial_append(n_txns, seed, n_keys=4, n_procs=5):
+    rng = random.Random(seed)
+    state = {k: [] for k in range(n_keys)}
+    nxt = {k: 0 for k in range(n_keys)}
+    hist = []
+    t = 0
+    for _ in range(n_txns):
+        p = rng.randrange(n_procs)
+        mops = []
+        for _ in range(rng.randint(1, 3)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.45:
+                mops.append(["r", k, None])
+            else:
+                v = nxt[k]
+                nxt[k] += 1
+                mops.append(["append", k, v])
+        typ = rng.choices(["ok", "fail", "info"], [0.8, 0.1, 0.1])[0]
+        t += 1
+        hist.append(h.op(h.INVOKE, p, "txn", [list(m) for m in mops], time=t))
+        done = []
+        for f, k, v in mops:
+            if f == "r":
+                # occasionally a nil read value (empty/nil mop corner)
+                done.append(
+                    ["r", k, list(state[k]) if rng.random() > 0.1 else None]
+                )
+            else:
+                if typ == "ok" or (typ == "info" and rng.random() < 0.5):
+                    state[k].append(v)
+                done.append(["append", k, v])
+        t += 1
+        if typ == "info" and rng.random() < 0.5:
+            # nil info completion: the node's value falls back to the
+            # invocation (txn_nodes' info-value fallback corner)
+            hist.append(h.op(h.INFO, p, "txn", None, time=t))
+        else:
+            hist.append(h.op(typ, p, "txn", done, time=t))
+        if rng.random() < 0.05 and any(state.values()):
+            # a raw duplicate append (duplicate-elements corner)
+            k = rng.choice([k for k in state if state[k]])
+            v = rng.choice(state[k])
+            t += 1
+            hist.append(h.op(h.INVOKE, p, "txn", [["append", k, v]], time=t))
+            t += 1
+            hist.append(h.op("ok", p, "txn", [["append", k, v]], time=t))
+    return h.index(hist)
+
+
+def adversarial_wr(n_txns, seed, n_keys=4, n_procs=5):
+    rng = random.Random(seed)
+    state = {k: None for k in range(n_keys)}
+    nxt = {k: 0 for k in range(n_keys)}
+    hist = []
+    t = 0
+    for _ in range(n_txns):
+        p = rng.randrange(n_procs)
+        mops = []
+        for _ in range(rng.randint(1, 3)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.5:
+                mops.append(["r", k, None])
+            else:
+                v = nxt[k]
+                nxt[k] += 1
+                mops.append(["w", k, v])
+                if rng.random() < 0.08:
+                    # intermediate write in the same txn (G1b corner)
+                    v2 = nxt[k]
+                    nxt[k] += 1
+                    mops.append(["w", k, v2])
+        typ = rng.choices(["ok", "fail", "info"], [0.8, 0.1, 0.1])[0]
+        t += 1
+        hist.append(h.op(h.INVOKE, p, "txn", [list(m) for m in mops], time=t))
+        done = []
+        for m in mops:
+            f, k, v = m
+            if f == "r":
+                done.append(
+                    ["r", k, state[k] if rng.random() > 0.15 else None]
+                )
+            else:
+                if typ == "ok" or (typ == "info" and rng.random() < 0.5):
+                    state[k] = v
+                done.append(["w", k, v])
+        t += 1
+        if typ == "info" and rng.random() < 0.5:
+            hist.append(h.op(h.INFO, p, "txn", None, time=t))
+        else:
+            hist.append(h.op(typ, p, "txn", done, time=t))
+        if rng.random() < 0.05:
+            # duplicate write value (duplicate-writes corner)
+            k = rng.randrange(n_keys)
+            v = rng.randrange(max(1, nxt[k]))
+            t += 1
+            hist.append(h.op(h.INVOKE, p, "txn", [["w", k, v]], time=t))
+            t += 1
+            hist.append(h.op("ok", p, "txn", [["w", k, v]], time=t))
+    return h.index(hist)
+
+
+# ---------------------------------------------------------------------------
+# The differential assertion
+# ---------------------------------------------------------------------------
+
+
+def assert_graphs_identical(g_ref: tg.TxnGraph, g_col: tg.TxnGraph):
+    for et in ("ww", "wr", "rw", "extra"):
+        a, b = getattr(g_ref, et), getattr(g_col, et)
+        assert (a == b).all(), (et, np.argwhere(a != b)[:5])
+    assert len(g_ref.nodes) == len(g_col.nodes)
+    for i in range(len(g_ref.nodes)):
+        assert g_ref.nodes[i].op == g_col.nodes[i].op, i
+        assert g_ref.nodes[i].invoke_index == g_col.nodes[i].invoke_index, i
+        assert g_ref.nodes[i].complete_index == g_col.nodes[i].complete_index
+        assert g_ref.nodes[i].ok == g_col.nodes[i].ok, i
+    # anomalies: same types, same items, same LIST ORDER (== on dicts
+    # compares contents; the list compare pins the order)
+    assert g_ref.anomalies == g_col.anomalies
+    # explanations: identical rendered prose for every edge
+    for et in ("ww", "wr", "rw"):
+        for i, j in np.argwhere(getattr(g_ref, et)):
+            i, j = int(i), int(j)
+            assert g_ref.explain(et, i, j) == g_col.explain(et, i, j), (
+                et, i, j,
+            )
+    # the columns engine's sparse edge cache matches dense argwhere
+    if g_col.edges is not None:
+        for et in ("ww", "wr", "rw", "extra"):
+            np.testing.assert_array_equal(
+                np.asarray(g_col.edges[et]).reshape(-1, 2),
+                np.argwhere(getattr(g_ref, et)),
+            )
+
+
+def compare_append(hist, ag=(), anomalies=None):
+    g_ref = tg.list_append_graph_loops(hist, ag)
+    g_col = tg.list_append_graph(hist, ag, engine="columns")
+    assert isinstance(g_col.explanations, tc.LazyExplanations)  # really vectorized
+    assert_graphs_identical(g_ref, g_col)
+    want = anomalies or (
+        elle.DEFAULT_ANOMALIES + ["duplicate-elements", "incompatible-order"]
+    )
+    assert elle.check_graph(g_ref, want) == elle.check_graph(g_col, want)
+
+
+def compare_wr(hist, ag=(), **kw):
+    g_ref = tg.rw_register_graph_loops(hist, ag, **kw)
+    g_col = tg.rw_register_graph(hist, ag, engine="columns", **kw)
+    assert isinstance(g_col.explanations, tc.LazyExplanations)
+    assert_graphs_identical(g_ref, g_col)
+    want = elle.DEFAULT_ANOMALIES + ["duplicate-writes"]
+    assert elle.check_graph(g_ref, want) == elle.check_graph(g_col, want)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 sweeps (bounded; the deep sweep below is slow-marked)
+# ---------------------------------------------------------------------------
+
+
+def test_list_append_differential_randomized():
+    for seed in range(12):
+        hist = adversarial_append(35, seed)
+        compare_append(hist)
+    # additional graphs ride the same contract
+    for seed in range(4):
+        hist = adversarial_append(25, 100 + seed)
+        compare_append(hist, ag=("realtime",))
+        compare_append(hist, ag=("process",))
+
+
+def test_rw_register_differential_randomized():
+    for seed in range(8):
+        hist = adversarial_wr(35, seed)
+        compare_wr(hist)
+    for seed in range(4):
+        hist = adversarial_wr(25, 200 + seed)
+        compare_wr(hist, sequential_keys=True)
+        compare_wr(hist, linearizable_keys=True)
+        compare_wr(hist, ag=("realtime",), linearizable_keys=True)
+
+
+def test_config3_shape_differential():
+    """The BASELINE config 3 shape in miniature (tools/gentxn's
+    generator inlined at suite scale): serializable-by-construction
+    multi-key appends, plus the corrupted variant."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    from gentxn import append_history, corrupt_wr
+
+    for seed in range(3):
+        hist = append_history(100, n_keys=6, n_procs=8, seed=seed)
+        compare_append(hist)
+        compare_append(corrupt_wr(hist, seed=seed + 1))
+
+
+@pytest.mark.slow
+def test_deep_differential_sweep():
+    """The deep randomized sweep (docker/bin/test stage): many more
+    seeds, larger histories, every option combination."""
+    for seed in range(60):
+        hist = adversarial_append(80, 1000 + seed)
+        compare_append(hist)
+        compare_append(hist, ag=("realtime",))
+        compare_append(hist, ag=("process",))
+    for seed in range(60):
+        hist = adversarial_wr(80, 2000 + seed)
+        compare_wr(hist)
+        compare_wr(hist, sequential_keys=True)
+        compare_wr(hist, linearizable_keys=True)
+        compare_wr(hist, ag=("realtime", "process"), linearizable_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Column-history (zero-rehydration) path
+# ---------------------------------------------------------------------------
+
+
+def test_column_history_inference_without_rehydration(tmp_path):
+    """A stored run checked straight off its SoA columns: the columns
+    engine reads ``ColumnHistory.cols``/``extras`` directly and must
+    not batch-materialize op dicts (only anomaly/witness emission may
+    touch individual ops)."""
+    from jepsen_tpu.store import format as fmt
+
+    hist = adversarial_append(40, 7)
+    f = tmp_path / "run.jepsen"
+    w = fmt.Writer(f)
+    w.write_test({"name": "t", "start-time-str": "s"})
+    w.write_history(hist)
+    w.write_results({"valid?": True})
+    w.close()
+    cols, fs, extras = fmt.read_columns(f)
+    ch = h.ColumnHistory(cols, fs, extras)
+
+    g_col = tg.list_append_graph(ch, (), engine="columns")
+    # the engine never triggered the full batch materialization
+    assert ch._complete is False
+    g_ref = tg.list_append_graph_loops(hist, ())
+    assert_graphs_identical(g_ref, g_col)
+
+
+def test_column_history_pair_vectorization_parity():
+    """``pair_index_codes`` (the vectorized pairing used by the column
+    front end) agrees with ``history.pair_index`` on adversarial
+    histories (unmatched invokes, double invokes, nemesis ops)."""
+    for seed in range(10):
+        hist = adversarial_append(30, 300 + seed)
+        # sprinkle nemesis ops and orphan invokes
+        rng = random.Random(seed)
+        extra_ops = [
+            h.op(h.INVOKE, h.NEMESIS, "kill", None),
+            h.op("info", h.NEMESIS, "kill", None),
+            h.op(h.INVOKE, 99, "txn", [["r", 0, None]]),
+        ]
+        for o in extra_ops:
+            hist.insert(rng.randrange(len(hist)), o)
+        hist = h.index([dict(o) for o in hist])
+        want = h.pair_index(hist)
+        nc = tc.NodeColumns(hist)
+        np.testing.assert_array_equal(nc.pair, np.asarray(want, np.int64))
+
+
+def test_column_history_negative_client_pid():
+    """Review regression: only NEMESIS_PID (-1) maps back to "nemesis"
+    on the stored-column path — any OTHER negative pid materializes as
+    an int client, so the columns engine must keep its transactions
+    (it used to drop every pid < 0, silently losing edges)."""
+    from jepsen_tpu.store import format as fmt
+
+    hist = [
+        {"type": "invoke", "process": -2, "f": "txn",
+         "value": [["append", 0, 1]]},
+        {"type": "ok", "process": -2, "f": "txn",
+         "value": [["append", 0, 1]]},
+        {"type": "invoke", "process": 3, "f": "txn", "value": [["r", 0, None]]},
+        {"type": "ok", "process": 3, "f": "txn", "value": [["r", 0, [1]]]},
+    ]
+    for i, o in enumerate(hist):
+        o["index"] = i
+        o["time"] = i
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        f = f"{td}/run.jepsen"
+        w = fmt.Writer(f)
+        w.write_test({"name": "t", "start-time-str": "s"})
+        w.write_history(hist)
+        w.write_results({"valid?": True})
+        w.close()
+        cols, fs, extras = fmt.read_columns(f)
+        ch = h.ColumnHistory(cols, fs, extras)
+    g_ref = tg.list_append_graph_loops(list(ch), ())
+    g_col = tc.list_append_graph_columns(ch, ())
+    assert len(g_ref.nodes) == len(g_col.nodes) == 2
+    assert_graphs_identical(g_ref, g_col)
+    assert g_col.wr.sum() == 1  # the wr edge survives
+
+
+def test_txn_nodes_pairs_threading():
+    """The satellite bugfix: ``txn_nodes(history, pairs)`` reuses a
+    caller-supplied pair index instead of recomputing it."""
+    hist = adversarial_append(30, 11)
+    pairs = h.pair_index(hist)
+    a = tg.txn_nodes(hist)
+    b = tg.txn_nodes(hist, pairs)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.op == y.op and x.invoke_index == y.invoke_index
+    # builders thread it through too
+    g1 = tg.list_append_graph(hist, (), pairs=pairs)
+    g2 = tg.list_append_graph(hist, ())
+    assert (g1.ww == g2.ww).all() and g1.anomalies == g2.anomalies
+
+
+# ---------------------------------------------------------------------------
+# Engine routing & fallback
+# ---------------------------------------------------------------------------
+
+
+def test_non_int_values_fall_back_to_loops():
+    """String append values can't ride int64 columns: the front door
+    falls back to the loop reference with identical results."""
+    hist = []
+    t = 0
+    for p, el in ((0, "a"), (1, "b")):
+        t += 1
+        hist.append(h.op(h.INVOKE, p, "txn", [["append", "x", el]], time=t))
+        t += 1
+        hist.append(h.op("ok", p, "txn", [["append", "x", el]], time=t))
+    t += 1
+    hist.append(h.op(h.INVOKE, 0, "txn", [["r", "x", None]], time=t))
+    t += 1
+    hist.append(h.op("ok", 0, "txn", [["r", "x", ["a", "b"]]], time=t))
+    hist = h.index(hist)
+    with pytest.raises(tc.NotColumnizable):
+        tc.list_append_graph_columns(hist, ())
+    g = tg.list_append_graph(hist, ())  # default engine: silent fallback
+    g_ref = tg.list_append_graph_loops(hist, ())
+    assert (g.ww == g_ref.ww).all() and (g.wr == g_ref.wr).all()
+    assert g.anomalies == g_ref.anomalies
+
+
+def test_engine_resolution(monkeypatch):
+    assert tg.resolve_engine(None) == "columns"
+    assert tg.resolve_engine("loops") == "loops"
+    monkeypatch.setenv(tg.ENGINE_ENV, "loops")
+    assert tg.resolve_engine(None) == "loops"
+    with pytest.raises(ValueError):
+        tg.resolve_engine("quantum")
+    hist = adversarial_append(10, 1)
+    g = tg.list_append_graph(hist, ())  # env routes to loops
+    assert not isinstance(g.explanations, tc.LazyExplanations)
+
+
+def test_scc_sparse_edges_param_parity():
+    """classify_graph_scc(edges=...) equals the dense-argwhere path."""
+    from jepsen_tpu.checker.scc import classify_graph_scc
+
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        n = int(rng.integers(2, 30))
+
+        def sprinkle(p):
+            return rng.random((n, n)) < p
+
+        ww, wr, rw, extra = (
+            sprinkle(0.05), sprinkle(0.05), sprinkle(0.05), sprinkle(0.02)
+        )
+        edges = {
+            "ww": np.argwhere(ww), "wr": np.argwhere(wr),
+            "rw": np.argwhere(rw), "extra": np.argwhere(extra),
+        }
+        f1, h1 = classify_graph_scc(ww, wr, rw, extra)
+        f2, h2 = classify_graph_scc(ww, wr, rw, extra, edges=edges)
+        assert f1 == f2 and h1 == h2
+
+
+def test_elle_telemetry_table(tmp_path):
+    """elle.* substage spans roll into the summary's "elle" table (and
+    so into perf-ledger stage tables via regress.stage_rollup)."""
+    from jepsen_tpu import obs
+    from jepsen_tpu.obs import regress, summary
+
+    hist = adversarial_append(30, 5)
+    with obs.recording(tmp_path):
+        elle.list_append().check({}, hist, {})
+    import json
+
+    rolled = json.loads((tmp_path / "telemetry.json").read_text())
+    stages = {e["stage"] for e in rolled["elle"]}
+    assert {"nodes", "anomalies", "edges", "scc"} <= stages
+    table, _metrics = regress.stage_rollup(rolled)
+    assert any(k.startswith("elle.") for k in table)
+    text = summary.format_summary(rolled)
+    assert "elle inference" in text

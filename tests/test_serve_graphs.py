@@ -1,0 +1,193 @@
+"""Graph-lane batching in the CheckService: column-shape-keyed packing
+of elle requests (one shared inference pass + one host-SCC sweep per
+compatibility group), per-request demux, fallback isolation, and the
+graph-lane queue metrics.  All host-side — no device work, no new
+compile geometries."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import serve as sv
+from jepsen_tpu.checker import elle
+from jepsen_tpu.obs import metrics
+from jepsen_tpu.serve import sched
+
+
+def append_hist(seed, n=8, anomaly=False):
+    """A small list-append history; ``anomaly=True`` plants a G1c-style
+    wr cycle."""
+    if anomaly:
+        txns = [
+            (0, [["append", "x", 1], ["r", "y", [2]]]),
+            (1, [["append", "y", 2], ["r", "x", [1]]]),
+        ]
+    else:
+        state: list = []
+        txns = []
+        for i in range(n):
+            state = state + [i]
+            txns.append((i % 3, [["append", "x", i], ["r", "x", list(state)]]))
+    hist = []
+    for p, value in txns:
+        inv = [[f, k, None if f == "r" else v] for f, k, v in value]
+        hist.append({"type": "invoke", "process": p, "f": "txn", "value": inv})
+        hist.append({"type": "ok", "process": p, "f": "txn", "value": value})
+    for i, op in enumerate(hist):
+        op["index"] = i
+        op["time"] = i + seed * 1000
+    return hist
+
+
+def test_graph_lane_batches_compatible_requests():
+    """Compatible elle requests (same batch_key) share ONE check_batch
+    call; incompatible ones get their own; verdicts match per-request
+    one-shot checks."""
+    calls = {"batch": 0, "sizes": []}
+    orig = elle.ListAppendChecker.check_batch
+
+    def counting(self, test, histories, opts):
+        calls["batch"] += 1
+        calls["sizes"].append(len(histories))
+        return orig(self, test, histories, opts)
+
+    svc = sv.CheckService(max_queue=32, batch_window_s=0)
+    hists = [append_hist(s) for s in range(4)] + [append_hist(9, anomaly=True)]
+    try:
+        elle.ListAppendChecker.check_batch = counting
+        futs = [
+            svc.submit(hh, checker=elle.list_append()) for hh in hists
+        ]
+        # a differently-configured checker must NOT share the batch
+        f_other = svc.submit(
+            append_hist(5), checker=elle.list_append(additional_graphs=["realtime"])
+        )
+        assert svc.stats()["graph_queue_depth"] == 6
+        svc.step()
+    finally:
+        elle.ListAppendChecker.check_batch = orig
+    results = [f.result(timeout=30) for f in futs]
+    # ONE shared call for the 5 compatible requests; the singleton group
+    # rides the per-request path (a batch of one buys nothing)
+    assert calls["batch"] == 1
+    assert calls["sizes"] == [5]
+    direct = [
+        elle.list_append().check({"name": "direct"}, hh, {}) for hh in hists
+    ]
+    assert [r["valid?"] for r in results] == [d["valid?"] for d in direct]
+    assert results[-1]["valid?"] is False
+    assert results[-1]["anomaly-types"] == direct[-1]["anomaly-types"]
+    assert f_other.result(timeout=30)["valid?"] is True
+    st = svc.stats()
+    assert st["graphs"] == 6
+    assert st["graph_batches"] >= 1
+    assert st["graph_queue_depth"] == 0
+
+
+def test_graph_batch_key_contract():
+    """batch_key groups by checker CONFIG, not instance; CycleChecker
+    groups by analyzer identity."""
+    a = sched.graph_batch_key(elle.list_append())
+    b = sched.graph_batch_key(elle.list_append())
+    assert a == b
+    assert a != sched.graph_batch_key(
+        elle.list_append(additional_graphs=["realtime"])
+    )
+    assert a != sched.graph_batch_key(elle.wr_register())
+    wa = sched.graph_batch_key(elle.wr_register(linearizable_keys=True))
+    wb = sched.graph_batch_key(elle.wr_register(sequential_keys=True))
+    assert wa != wb
+
+    def analyzer(_h):
+        return [], [], None
+
+    c1, c2 = elle.CycleChecker(analyzer), elle.CycleChecker(analyzer)
+    assert sched.graph_batch_key(c1) == sched.graph_batch_key(c2)
+    # a checker without a batch_key is never shared (per-instance key)
+    class Bare:
+        geometry_batchable = False
+
+        def check(self, test, history, opts):
+            return {"valid?": True}
+
+    assert sched.graph_batch_key(Bare()) != sched.graph_batch_key(Bare())
+
+
+def test_graph_batch_failure_falls_back_per_request():
+    """A failing shared pass degrades to per-request check_safe: innocents
+    still get real verdicts; the failure never poisons batchmates."""
+
+    class Flaky(elle.ListAppendChecker):
+        def check_batch(self, test, histories, opts):
+            raise RuntimeError("shared pass exploded")
+
+    svc = sv.CheckService(max_queue=16, batch_window_s=0)
+    chk = Flaky()
+    futs = [
+        svc.submit(append_hist(s), checker=chk) for s in range(3)
+    ]
+    svc.step()
+    for f in futs:
+        assert f.result(timeout=30)["valid?"] is True
+    assert svc.stats()["graph_batches"] == 0  # the shared pass never landed
+
+
+def test_graph_lane_queue_depth_metric():
+    """The graph-lane depth rides /metrics as a live gauge."""
+    metrics.enable_mirror()
+    svc = sv.CheckService(max_queue=16, batch_window_s=0)
+    futs = [
+        svc.submit(append_hist(s), checker=elle.list_append())
+        for s in range(3)
+    ]
+    text = metrics.render()
+    assert "jepsen_tpu_serve_graph_queue_depth 3" in text
+    svc.step()
+    for f in futs:
+        f.result(timeout=30)
+    text = metrics.render()
+    assert "jepsen_tpu_serve_graph_queue_depth 0" in text
+
+
+@pytest.mark.slow
+def test_graph_lane_live_service_smoke():
+    """Open-arrival smoke against a LIVE service (scheduler thread +
+    graph pool): concurrent elle submissions from several threads all
+    resolve with per-request verdict parity vs sequential one-shot —
+    the CI graph-lane serve smoke (docker/bin/test)."""
+    svc = sv.CheckService(max_queue=64, batch_window_s=0.005).start()
+    try:
+        hists = [append_hist(s, anomaly=(s % 4 == 3)) for s in range(12)]
+        futs: dict[int, object] = {}
+        lock = threading.Lock()
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                f = svc.submit(hists[i], checker=elle.list_append(),
+                               client=f"t{lo}")
+                with lock:
+                    futs[i] = f
+
+        threads = [
+            threading.Thread(target=client, args=(i * 4, (i + 1) * 4))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = {i: futs[i].result(timeout=60) for i in futs}
+        direct = [
+            elle.list_append().check({"name": "d"}, hh, {}) for hh in hists
+        ]
+        for i, d in enumerate(direct):
+            assert results[i]["valid?"] == d["valid?"], i
+            assert results[i].get("anomaly-types") == d.get("anomaly-types")
+        st = svc.stats()
+        assert st["graphs"] == 12
+        assert st["completed"] == 12
+    finally:
+        svc.shutdown(wait=True)

@@ -266,9 +266,12 @@ def test_graph_requests_skip_geometry_buckets():
         checker=ck,
     )
     fl = [svc.submit(hh) for hh in hists]
-    # the graph request shares no geometry bucket with the ladder queue
+    # the graph request shares no geometry bucket with the ladder queue:
+    # its group is the column-shape batch key (sched.graph_batch_key)
     groups = {r.group for q in svc._adm.queues.values() for r in q}
-    assert ("graph", "CycleChecker") in groups
+    assert sched.graph_batch_key(ck) in groups
+    assert all(g[0] != "graph" or g == sched.graph_batch_key(ck)
+               for g in groups)
     svc.step()
     assert fg.result(timeout=30)["valid?"] is False  # the cycle is found
     assert [f.result(timeout=30)["valid?"] for f in fl] == [

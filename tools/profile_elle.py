@@ -1,0 +1,148 @@
+"""Stage-level elle inference profiling on the config 3 / 3b shapes.
+
+Times the column-native inference pipeline (checker/txn_columns.py) on
+the BASELINE config 3 workload (10k-txn multi-key list-append; gentxn)
+and its corrupted 3b variant, end-to-end through the checker — substage
+attribution (nodes / anomalies / edges / scc) comes from the ``elle.*``
+telemetry spans, and the loop-reference engine runs the same histories
+for the speedup column.
+
+The measured run appends a ``kind: "elle"`` record (machine fingerprint
+included) to the perf ledger, so the config-3 claim is a ledger row and
+``tools/perfwatch.py gate`` (kind-generic; docker/bin/test stage 6 runs
+it ``--advisory``) flags any future regression of this path.
+
+  python tools/profile_elle.py [--quick] [--txns N] [--repeat R]
+                               [--ledger PATH] [--smoke]
+
+``--smoke`` (CI): quick shapes + verdict-parity assertions, exit 1 on
+any disagreement between the engines.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "tools"))
+
+from gentxn import append_history, corrupt_wr  # noqa: E402
+
+from jepsen_tpu import obs  # noqa: E402
+from jepsen_tpu.checker import txn_graph as tg  # noqa: E402
+from jepsen_tpu.checker.elle import list_append  # noqa: E402
+from jepsen_tpu.obs import regress  # noqa: E402
+
+
+def _best(fn, repeat: int) -> float:
+    out = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        out.append(time.perf_counter() - t0)
+    return min(out)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quick = "--quick" in argv or "--smoke" in argv
+    smoke = "--smoke" in argv
+    n = 2000 if quick else 10_000
+    repeat = 3
+    ledger = None
+    if "--txns" in argv:
+        n = int(argv[argv.index("--txns") + 1])
+    if "--repeat" in argv:
+        repeat = int(argv[argv.index("--repeat") + 1])
+    if "--ledger" in argv:
+        ledger = argv[argv.index("--ledger") + 1]
+
+    hist = append_history(n, n_keys=50, n_procs=16, seed=5)
+    bad = corrupt_wr(hist, seed=6)
+    col = list_append(engine="columns")
+    loops = list_append(engine="loops")
+
+    # -- end-to-end wall (warm best-of-R), both engines ------------------
+    col.check({"name": "profile"}, hist, {})  # warm allocators
+    config3_s = _best(lambda: col.check({"name": "profile"}, hist, {}),
+                      repeat)
+    config3b_s = _best(lambda: col.check({"name": "profile"}, bad, {}),
+                       repeat)
+    loops3_s = _best(lambda: loops.check({"name": "profile"}, hist, {}),
+                     max(1, repeat - 1))
+    infer_col_s = _best(
+        lambda: tg.list_append_graph(hist, (), engine="columns"), repeat
+    )
+    infer_loops_s = _best(
+        lambda: tg.list_append_graph_loops(hist, ()), max(1, repeat - 1)
+    )
+
+    # -- substage attribution from the elle.* spans ----------------------
+    with tempfile.TemporaryDirectory() as td:
+        with obs.recording(td):
+            r_col = col.check({"name": "profile"}, hist, {})
+            r_bad = col.check({"name": "profile"}, bad, {})
+        summary = json.loads((Path(td) / "telemetry.json").read_text())
+    stages = {
+        f"elle.{row['stage']}": float(row["seconds"])
+        for row in summary.get("elle", [])
+    }
+
+    r_loops = loops.check({"name": "profile"}, hist, {})
+    r_bad_loops = loops.check({"name": "profile"}, bad, {})
+    parity = (r_col == r_loops) and (r_bad == r_bad_loops)
+
+    rows = {
+        "txns": n,
+        "config3_s": round(config3_s, 4),
+        "config3b_s": round(config3b_s, 4),
+        "config3_loops_s": round(loops3_s, 4),
+        "infer_columns_s": round(infer_col_s, 4),
+        "infer_loops_s": round(infer_loops_s, 4),
+        "speedup_vs_loops": round(loops3_s / config3_s, 2) if config3_s else None,
+        "verdicts": {"config3": r_col["valid?"],
+                     "config3b": r_bad["valid?"],
+                     "parity_vs_loops": parity},
+    }
+    print(json.dumps({"elle": rows, "stages": stages}, indent=1))
+
+    # -- perf-ledger record (fingerprinted; perfwatch gate covers it) ----
+    try:
+        rec = regress.make_record(
+            "elle",
+            {
+                "config3_s": config3_s,
+                "config3b_s": config3b_s,
+                "infer_columns_s": infer_col_s,
+                "infer_loops_s": infer_loops_s,
+                "speedup_vs_loops": (loops3_s / config3_s) if config3_s else 0.0,
+            },
+            stages=stages,
+            axes={"txns": str(n), "engine": "columns"},
+            fp=regress.fingerprint(probe_devices=False),
+        )
+        p = regress.append_record(rec, path=ledger, store_dir=ROOT / "store")
+        if p is not None:
+            print(f"ledger: appended kind=elle record to {p}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the measurement stands alone
+        print(f"ledger append failed: {e}", file=sys.stderr)
+
+    if smoke:
+        if not parity:
+            print("SMOKE FAIL: engine verdict disagreement", file=sys.stderr)
+            return 1
+        if r_col["valid?"] is not True or r_bad["valid?"] is not False:
+            print("SMOKE FAIL: unexpected verdicts", file=sys.stderr)
+            return 1
+        print("smoke OK: engines agree, verdicts as expected",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
