@@ -1,0 +1,184 @@
+"""graftlint — static analysis for the checker stack (stdlib ``ast`` only).
+
+Three analyzers, run as a CI gate by ``tools/graftlint.py`` (stage in
+``docker/bin/test``):
+
+  * **trace discipline** (``lint.tracecheck``) — walks the call graph
+    rooted at every ``jax.jit`` / ``shard_map`` / Pallas launch site and
+    flags host-sync and retrace hazards inside traced code: ``.item()``
+    / ``float()`` / ``np.asarray`` on traced values, Python ``if`` /
+    ``while`` / ``for`` on traced values, ``time`` / ``random`` calls,
+    implicit (weak-type-breaking) dtypes, jitted Python config args
+    missing from ``static_argnames``, and launch entry points that
+    bypass the padded-geometry helpers (each such site is a hidden
+    compile bucket).
+  * **lock discipline** (``lint.lockcheck``) — a ``# guarded-by:
+    <lock>`` annotation convention on shared-mutable fields, with an
+    intraprocedural checker that every write (and, for ``[rw]``
+    fields, every read) of a guarded attribute is lexically inside
+    ``with self.<lock>:`` — a real race detector for the CheckService
+    scheduler threads.
+  * **telemetry drift** (``lint.telemetry``) — statically collects
+    every obs span/counter/gauge name and every metrics-registry
+    series, and diffs them against the documented inventories
+    (``obs/summary.py``, README, ``doc/tutorial.md``): undocumented or
+    orphaned names fail the build.
+
+Suppression is two-layer: an inline ``# graftlint: disable=<rule>``
+comment on (or directly above) the flagged line, and a checked-in
+triaged baseline (``.graftlint-baseline.json``) keyed on stable finding
+keys (rule + file + enclosing scope + hazard slug — never line numbers,
+so unrelated edits don't churn it).  Every baseline entry carries a
+one-line ``why``.
+
+The package imports nothing heavyweight (no jax, no numpy): linting the
+whole repo is a sub-second pure-AST pass, cheap enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from pathlib import Path
+
+__all__ = [
+    "BASELINE_NAME", "Baseline", "Finding", "SourceFile", "load_baseline",
+]
+
+BASELINE_NAME = ".graftlint-baseline.json"
+
+#: ``# graftlint: disable=rule1,rule2`` (or ``disable=all``).
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-,*]+)")
+
+
+@dataclass
+class Finding:
+    """One analyzer finding.
+
+    ``key`` is the stable suppression identity: ``rule:path:scope:slug``
+    (+ ``#n`` when the same hazard repeats in one scope) — line numbers
+    stay out of it so baselines survive unrelated edits."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    scope: str         # enclosing qualname ("mod-level" when none)
+    slug: str          # short stable hazard identifier
+    message: str
+    key: str = field(default="")
+
+    def finalize_key(self, n: int = 0, total: int = 1) -> None:
+        base = f"{self.rule}:{self.path}:{self.scope}:{self.slug}"
+        # duplicates carry index AND total: a NEW identical hazard in
+        # the scope changes every sibling's key, so the whole set
+        # resurfaces unsuppressed (fail closed) instead of the newcomer
+        # silently inheriting a baselined key
+        self.key = base if total == 1 else f"{base}#{n}/{total}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "scope": self.scope, "message": self.message, "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def assign_keys(findings: list[Finding]) -> list[Finding]:
+    """Finalize stable keys, disambiguating repeats of the same hazard
+    inside one scope by occurrence order (source order) plus the repeat
+    count — see ``Finding.finalize_key`` for why the count is in the
+    key."""
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.slug))
+    totals: dict[tuple, int] = {}
+    for f in findings:
+        base = (f.rule, f.path, f.scope, f.slug)
+        totals[base] = totals.get(base, 0) + 1
+    seen: dict[tuple, int] = {}
+    for f in findings:
+        base = (f.rule, f.path, f.scope, f.slug)
+        n = seen.get(base, 0)
+        f.finalize_key(n, totals[base])
+        seen[base] = n + 1
+    return findings
+
+
+class SourceFile:
+    """A parsed source file plus the comment-level facts the analyzers
+    need (AST drops comments; one ``tokenize`` pass recovers them)."""
+
+    def __init__(self, path: Path, rel: str, text: str | None = None):
+        import ast
+
+        self.path = path
+        self.rel = rel
+        self.text = (text if text is not None
+                     else path.read_text(encoding="utf-8"))
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+        #: line -> comment text (without leading '#'), from tokenize
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # pragma: no cover — ast parsed, so
+            pass                     # tokenize failing is near-impossible
+        #: line -> set of disabled rules ("*" = all)
+        self.disabled: dict[int, set[str]] = {}
+        for ln, c in self.comments.items():
+            m = _DISABLE_RE.search(c)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                if "all" in rules:
+                    rules = {"*"}
+                self.disabled[ln] = rules
+
+    def is_disabled(self, rule: str, line: int) -> bool:
+        """Inline suppression: a disable comment on the flagged line or
+        the line directly above it."""
+        for ln in (line, line - 1):
+            rules = self.disabled.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+    def comment_on(self, node) -> str:
+        """The trailing comment on a node's (first) line, '' when none —
+        how ``# guarded-by:`` annotations are attached."""
+        return self.comments.get(node.lineno, "")
+
+
+@dataclass
+class Baseline:
+    """The checked-in triaged suppression file."""
+
+    path: Path | None
+    entries: dict[str, str]      # key -> one-line justification
+
+    def split(self, findings: list[Finding]):
+        """(unsuppressed, suppressed, stale_keys)."""
+        live, supp = [], []
+        hit: set[str] = set()
+        for f in findings:
+            if f.key in self.entries:
+                hit.add(f.key)
+                supp.append(f)
+            else:
+                live.append(f)
+        stale = sorted(set(self.entries) - hit)
+        return live, supp, stale
+
+
+def load_baseline(path: Path | None) -> Baseline:
+    if path is None or not path.is_file():
+        return Baseline(path, {})
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries: dict[str, str] = {}
+    for e in data.get("suppressions", []):
+        entries[str(e["key"])] = str(e.get("why", ""))
+    return Baseline(path, entries)

@@ -1,0 +1,341 @@
+"""Lock discipline: the ``# guarded-by:`` annotation convention.
+
+The CheckService is a multi-thread scheduler (admission threads + the
+scheduler loop + the fast-path thread + watchdog workers + the graph
+pool) whose invariants were previously enforced only by review.  This
+analyzer makes the locking contract CHECKED:
+
+Annotate a shared-mutable field at its ``__init__`` assignment::
+
+    self._totals = {...}          # guarded-by: _lock
+    self._inflight = []           # guarded-by: _lock [rw]
+    self.queues = {...}           # guarded-by: caller
+
+  * ``guarded-by: <lock>`` — every WRITE to the field (assignment,
+    augmented assignment, ``del``, subscript store, or a mutating
+    method call: ``append``/``pop``/``update``/…) anywhere in the class
+    must be lexically inside ``with self.<lock>:``.
+  * ``[rw]`` — reads are checked too (for fields where a stale read is
+    itself a bug: iteration during mutation, check-then-act).
+  * ``caller`` — the field is serialized by the OWNING object's lock
+    (documented-external); nothing is checked locally.
+
+``threading.Condition(self._lock)`` aliasing is understood: holding
+``self._cond`` IS holding ``self._lock`` (same underlying lock), so
+either satisfies a ``guarded-by: _lock`` (or ``_cond``) annotation.
+
+Escape hatches, each lexical and explicit:
+
+  * ``__init__``/``__del__`` bodies are exempt (construction
+    happens-before publication);
+  * a method that runs with the lock held by contract declares it with
+    a ``# holds: <lock>`` comment on (or directly above) its ``def``;
+  * ``# graftlint: disable=lock-guard`` on the flagged line.
+
+The check is lexical on purpose: a write inside a nested function
+defined under ``with self._lock:`` does NOT inherit the guard (the
+closure runs later, on whatever thread calls it).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from jepsen_tpu.lint import Finding, SourceFile
+
+RULES = ("lock-guard", "lock-unknown")
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w]*)\s*(?P<rw>\[rw\])?"
+)
+_HOLDS_RE = re.compile(r"#\s*holds:\s*(?P<lock>[A-Za-z_][\w]*)")
+
+#: method calls that mutate their receiver in place.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "appendleft",
+    "clear", "update", "setdefault", "add", "discard", "sort", "reverse",
+    "__setitem__",
+}
+
+#: constructors recognised as locks for the annotation's target.
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+class _Field:
+    def __init__(self, name: str, lock: str, rw: bool, line: int):
+        self.name = name
+        self.lock = lock          # attr name, or "caller"
+        self.rw = rw
+        self.line = line
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class LockChecker:
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.findings: list[Finding] = []
+        #: (rel, line) of guarded-by comments a field actually consumed
+        self._consumed: set[tuple] = set()
+
+    def run(self) -> list[Finding]:
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node)
+        self._flag_unattached()
+        return self.findings
+
+    def _flag_unattached(self) -> None:
+        """A guarded-by comment nothing consumed is a DEAD annotation:
+        the developer believes the field is checked, nothing is — fail
+        loud instead of open."""
+        for ln, c in self.src.comments.items():
+            if not _GUARD_RE.search(c):
+                continue
+            if (self.src.rel, ln) in self._consumed:
+                continue
+            if self.src.is_disabled("lock-unknown", ln):
+                continue
+            self.findings.append(Finding(
+                rule="lock-unknown", path=self.src.rel, line=ln,
+                scope="mod-level", slug=f"unattached@{ln}",
+                message=(
+                    "guarded-by annotation is attached to no __init__ "
+                    "field assignment (place it trailing the assignment, "
+                    "trailing its last line, or directly above it) — as "
+                    "written it checks NOTHING"
+                ),
+            ))
+
+    # -- annotation collection --------------------------------------------
+
+    def _collect(self, cls: ast.ClassDef):
+        """(fields, lock_aliases, declared_locks) from ``__init__``."""
+        fields: dict[str, _Field] = {}
+        aliases: dict[str, set[str]] = {}   # lock name -> equivalence set
+        declared: set[str] = set()
+        init = next(
+            (n for n in cls.body
+             if isinstance(n, ast.FunctionDef) and n.name == "__init__"),
+            None,
+        )
+        stmts = ast.walk(init) if init is not None else iter(())
+        for stmt in stmts:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            attr = next((a for t in targets
+                         if (a := _self_attr(t)) is not None), None)
+            if attr is None:
+                continue
+            # lock declarations + Condition aliasing
+            v = stmt.value
+            if isinstance(v, ast.Call):
+                ctor = v.func.attr if isinstance(v.func, ast.Attribute) \
+                    else (v.func.id if isinstance(v.func, ast.Name) else "")
+                if ctor in _LOCK_CTORS:
+                    declared.add(attr)
+                    wrapped = next(
+                        (w for a in v.args
+                         if (w := _self_attr(a)) is not None), None)
+                    if ctor == "Condition" and wrapped is not None:
+                        group = (aliases.get(wrapped)
+                                 or aliases.get(attr) or set())
+                        group |= {attr, wrapped}
+                        for name in group:
+                            aliases[name] = group
+            # annotation placements accepted: trailing the assignment's
+            # first line, trailing its LAST line (multi-line literals),
+            # or on its OWN line directly above — a comment trailing the
+            # PREVIOUS statement must not leak onto this field
+            candidates = [stmt.lineno, stmt.end_lineno or stmt.lineno]
+            above = stmt.lineno - 1
+            if (0 < above <= len(self.src.lines)
+                    and self.src.lines[above - 1].lstrip().startswith("#")):
+                candidates.append(above)
+            for ln in candidates:
+                m = _GUARD_RE.search(self.src.comments.get(ln, ""))
+                if m:
+                    self._consumed.add((self.src.rel, ln))
+                    fields[attr] = _Field(
+                        attr, m.group("lock"), bool(m.group("rw")),
+                        stmt.lineno,
+                    )
+                    break
+        for name in declared:
+            aliases.setdefault(name, {name})
+        return fields, aliases, declared
+
+    def _holds(self, fn: ast.FunctionDef) -> set[str]:
+        """Locks a method declares held by contract (``# holds:``)."""
+        out: set[str] = set()
+        for ln in (fn.lineno, fn.lineno - 1):
+            m = _HOLDS_RE.search(self.src.comments.get(ln, ""))
+            if m:
+                out.add(m.group("lock"))
+        return out
+
+    # -- per-class walk ----------------------------------------------------
+
+    def _check_class(self, cls: ast.ClassDef) -> None:
+        fields, aliases, declared = self._collect(cls)
+        if not fields:
+            return
+        for f in fields.values():
+            if f.lock != "caller" and f.lock not in declared:
+                if not self.src.is_disabled("lock-unknown", f.line):
+                    self.findings.append(Finding(
+                        rule="lock-unknown", path=self.src.rel, line=f.line,
+                        scope=f"{cls.name}.{f.name}", slug=f.lock,
+                        message=(
+                            f"guarded-by names `{f.lock}`, but __init__ "
+                            "declares no such lock on self"
+                        ),
+                    ))
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in ("__init__", "__del__"):
+                continue
+            self._check_method(cls, fn, fields, aliases)
+
+    def _check_method(self, cls, fn, fields, aliases) -> None:
+        held0 = frozenset(self._holds(fn))
+        self._walk(fn.body, held0, cls, fn, fields, aliases, nested=False)
+
+    def _walk(self, stmts, held: frozenset, cls, fn, fields, aliases,
+              nested: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a closure runs later, on an arbitrary thread: it does
+                # NOT inherit the lexically-enclosing guard (but a
+                # `# holds:` on the nested def still applies)
+                inner = frozenset(self._holds(stmt))
+                self._walk(stmt.body, inner, cls, fn, fields, aliases,
+                           nested=True)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                got = set(held)
+                for item in stmt.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None:
+                        got |= aliases.get(attr, {attr})
+                for item in stmt.items:
+                    self._exprs(item.context_expr, held, cls, fn, fields,
+                                aliases)
+                self._walk(stmt.body, frozenset(got), cls, fn, fields,
+                           aliases, nested)
+                continue
+            # statement-level write detection
+            self._stmt_accesses(stmt, held, cls, fn, fields, aliases)
+            for body_attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, body_attr, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._walk(sub, held, cls, fn, fields, aliases, nested)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk(h.body, held, cls, fn, fields, aliases, nested)
+            for case in getattr(stmt, "cases", []) or []:  # match stmts
+                self._walk(case.body, held, cls, fn, fields, aliases,
+                           nested)
+
+    def _stmt_accesses(self, stmt, held, cls, fn, fields, aliases) -> None:
+        wrote: set[int] = set()  # id()s of attribute nodes already judged
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            flat: list[ast.expr] = []
+
+            def _flatten(t: ast.expr) -> None:
+                # tuple unpacking writes every element, recursively:
+                # `a, (b, self.x) = ...` is a write to self.x
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        _flatten(el)
+                else:
+                    flat.append(t)
+
+            for tgt in targets:
+                _flatten(tgt)
+            for tgt in flat:
+                node = tgt
+                if isinstance(node, ast.Starred):
+                    node = node.value
+                while isinstance(node, ast.Subscript):
+                    node = node.value
+                attr = _self_attr(node)
+                if attr in fields:
+                    wrote.add(id(node))
+                    self._judge(fields[attr], "write", node, held, cls, fn,
+                                aliases)
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                node = tgt
+                while isinstance(node, ast.Subscript):
+                    node = node.value
+                attr = _self_attr(node)
+                if attr in fields:
+                    wrote.add(id(node))
+                    self._judge(fields[attr], "write", node, held, cls, fn,
+                                aliases)
+        # mutating method calls + flagged reads, over every expression
+        # hanging off this statement (but not nested statements)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._exprs(child, held, cls, fn, fields, aliases,
+                            skip=wrote)
+
+    def _exprs(self, e: ast.expr, held, cls, fn, fields, aliases,
+               skip: set | None = None) -> None:
+        skip = skip or set()
+        for node in ast.walk(e):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                recv = node.func.value
+                while isinstance(recv, ast.Subscript):
+                    recv = recv.value
+                attr = _self_attr(recv)
+                if attr in fields:
+                    skip.add(id(recv))
+                    self._judge(fields[attr], "write", node, held, cls, fn,
+                                aliases)
+            elif isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if (attr in fields and fields[attr].rw
+                        and isinstance(node.ctx, ast.Load)):
+                    self._judge(fields[attr], "read", node, held, cls, fn,
+                                aliases)
+
+    def _judge(self, field: _Field, access: str, node, held, cls, fn,
+               aliases) -> None:
+        if field.lock == "caller":
+            return  # documented-external: serialized by the owner
+        ok_locks = aliases.get(field.lock, {field.lock})
+        if held & ok_locks:
+            return
+        if self.src.is_disabled("lock-guard", node.lineno):
+            return
+        self.findings.append(Finding(
+            rule="lock-guard", path=self.src.rel, line=node.lineno,
+            scope=f"{cls.name}.{fn.name}", slug=f"{access}:{field.name}",
+            message=(
+                f"unguarded {access} of `self.{field.name}` (guarded-by: "
+                f"{field.lock}) outside `with self.{field.lock}:`"
+            ),
+        ))
+
+
+def check_source(src: SourceFile) -> list[Finding]:
+    return LockChecker(src).run()

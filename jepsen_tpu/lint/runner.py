@@ -1,0 +1,132 @@
+"""The graftlint run: file discovery, analyzer dispatch, baseline.
+
+``run_lint(repo_root)`` is the whole gate — ``tools/graftlint.py`` is a
+thin argparse shell around it and ``tests/test_graftlint.py`` calls it
+directly for the repo self-scan.
+
+Scopes:
+
+  * trace discipline runs over the compiled-kernel modules
+    (``TRACE_FILES`` — the ``jax.jit``/``shard_map`` launch surface);
+  * lock discipline runs over every package file (it is annotation-
+    driven: files without ``# guarded-by:`` cost one regex scan);
+  * telemetry drift reads every package file for emit sites and diffs
+    against ``DOC_SURFACES``.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from jepsen_tpu.lint import (
+    BASELINE_NAME, Baseline, Finding, SourceFile, assign_keys,
+    load_baseline,
+)
+from jepsen_tpu.lint import lockcheck, telemetry, tracecheck
+
+#: the jit/shard_map/pallas launch surface (repo-relative).
+TRACE_FILES = (
+    "jepsen_tpu/ops/wgl.py",
+    "jepsen_tpu/ops/hashing.py",
+    "jepsen_tpu/ops/spill.py",
+    "jepsen_tpu/ops/closure.py",
+    "jepsen_tpu/parallel/batch.py",
+    "jepsen_tpu/parallel/sharded.py",
+)
+
+#: documented-inventory surfaces for the telemetry-drift diff.
+DOC_SURFACES = (
+    "README.md",
+    "doc/tutorial.md",
+    "jepsen_tpu/obs/summary.py",
+    "jepsen_tpu/obs/metrics.py",
+)
+
+ALL_RULES = tracecheck.RULES + lockcheck.RULES + telemetry.RULES
+
+
+class LintResult:
+    def __init__(self, findings, suppressed, stale, stages, files):
+        self.findings: list[Finding] = findings    # unsuppressed
+        self.suppressed: list[Finding] = suppressed
+        self.stale_baseline: list[str] = stale
+        self.stages: dict[str, float] = stages     # analyzer -> seconds
+        self.files = files
+        self.wall_s = sum(stages.values())
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "files": self.files,
+            "wall_s": round(self.wall_s, 3),
+            "stages": {k: round(v, 3) for k, v in self.stages.items()},
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [f.as_dict() for f in self.suppressed],
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def _load_sources(repo_root: Path) -> list[SourceFile]:
+    out = []
+    pkg = repo_root / "jepsen_tpu"
+    for p in sorted(pkg.rglob("*.py")):
+        rel = p.relative_to(repo_root).as_posix()
+        try:
+            out.append(SourceFile(p, rel))
+        except SyntaxError:
+            # a file that doesn't parse fails tier-1 long before lint;
+            # don't double-report it here
+            continue
+    return out
+
+
+def run_lint(repo_root: str | Path, *, rules: set[str] | None = None,
+             baseline: Baseline | None = None) -> LintResult:
+    """Run every analyzer over the repo; apply the baseline; return the
+    result.  ``rules`` filters to a subset (rule-name match)."""
+    repo_root = Path(repo_root)
+    if baseline is None:
+        baseline = load_baseline(repo_root / BASELINE_NAME)
+    findings: list[Finding] = []
+    stages: dict[str, float] = {}
+
+    # parse is a real stage: ast.parse + tokenize over the whole
+    # package, and the ledger's wall_s must see its creep too
+    t0 = time.monotonic()
+    sources = _load_sources(repo_root)
+    by_rel = {s.rel: s for s in sources}
+    stages["parse"] = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    for rel in TRACE_FILES:
+        src = by_rel.get(rel)
+        if src is not None:
+            findings.extend(tracecheck.check_source(src))
+    stages["trace"] = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    for src in sources:
+        if "guarded-by:" in src.text:
+            findings.extend(lockcheck.check_source(src))
+    stages["lock"] = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    doc_paths = [(repo_root / d, d) for d in DOC_SURFACES]
+    findings.extend(telemetry.check(sources, doc_paths,
+                                    repo_root / "jepsen_tpu"))
+    stages["telemetry"] = time.monotonic() - t0
+
+    assign_keys(findings)
+    # baseline split runs on the UNFILTERED findings: a --rules subset
+    # must not report the other rules' live suppressions as stale
+    live, supp, stale = baseline.split(findings)
+    if rules:
+        live = [f for f in live if f.rule in rules]
+        supp = [f for f in supp if f.rule in rules]
+    return LintResult(live, supp, stale, stages, len(sources))

@@ -1,0 +1,294 @@
+"""Telemetry drift: emitted names vs the documented inventories.
+
+Metric/doc drift has been patched by hand three PRs running (7–9) and a
+double-counted mirror series survived review in PR 8 — the inventory is
+exactly the kind of bookkeeping a machine should hold.  This analyzer:
+
+  * statically collects every obs span/counter/gauge/event name (string
+    literal first argument of ``obs.span`` / ``obs.span_event`` /
+    ``obs.counter`` / ``obs.gauge`` / ``obs.event``) and every live
+    metrics-registry series (``metrics.inc`` / ``metrics.set_gauge`` /
+    ``metrics.observe``) across the package;
+  * collects the *documented* inventory from the doc surfaces — README,
+    ``doc/tutorial.md``, and the ``obs/summary.py`` + ``obs/metrics.py``
+    tables/docstrings (backtick-quoted tokens, ``jepsen_tpu_*`` words,
+    and ``family.*`` wildcards);
+  * diffs the two:
+
+      - ``telemetry-undocumented`` — an emitted name no doc surface
+        mentions (operators can't find what they can't look up);
+      - ``telemetry-orphan`` — a documented telemetry name nothing
+        emits (the docs promise a series that doesn't exist).
+
+Names are canonicalised before comparison (``serve.queue_depth`` ≡
+``jepsen_tpu_serve_queue_depth`` ≡ ``serve_queue_depth_total``'s base),
+so either spelling documents a series.  A ``family.*`` wildcard in a doc
+documents every name under that prefix.  Dynamically-built names
+(f-strings with a literal prefix) register their prefix, so members are
+neither flagged undocumented nor their docs orphaned.
+
+Module paths (``serve.health``, ``jepsen_tpu.ops.spill``) are excluded
+from orphan detection by checking tokens against the package's actual
+module tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from jepsen_tpu.lint import Finding, SourceFile
+
+RULES = ("telemetry-undocumented", "telemetry-orphan")
+
+#: method names that emit a telemetry series, by the qualifier they
+#: must hang off (``obs.counter``, ``_metrics.inc``, ``obs_metrics.
+#: set_gauge`` — alias imports are common).
+_OBS_METHODS = {"span", "span_event", "counter", "gauge", "event"}
+_METRIC_METHODS = {"inc", "set_gauge", "observe"}
+
+#: telemetry name families (first dotted component / leading word) —
+#: the namespace the orphan check patrols in the doc surfaces.
+FAMILIES = {
+    "serve", "fault", "frontier", "elle", "dedup", "ladder", "device",
+    "checker", "phase", "wgl",
+}
+
+_TOKEN_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_.*-]*[A-Za-z0-9_*]")
+_BACKTICK_RE = re.compile(r"`([^`\n]+)`")
+
+
+def canon(name: str) -> str:
+    """Comparison key: ``serve.queue_depth`` ==
+    ``jepsen_tpu_serve_queue_depth`` == its ``_total`` counter form."""
+    n = re.sub(r"[^a-z0-9]+", "_", str(name).lower())
+    if n.startswith("jepsen_tpu_"):
+        n = n[len("jepsen_tpu_"):]
+    n = n.strip("_")
+    for suffix in ("_total", "_bucket", "_sum", "_count"):
+        if n.endswith(suffix):
+            n = n[: -len(suffix)]
+            break
+    return n
+
+
+class EmitSite:
+    def __init__(self, name: str, kind: str, path: str, line: int,
+                 prefix: bool = False):
+        self.name = name
+        self.kind = kind
+        self.path = path
+        self.line = line
+        self.prefix = prefix  # dynamically-built: name is a literal prefix
+
+
+def collect_emitted(sources: list[SourceFile]) -> list[EmitSite]:
+    out: list[EmitSite] = []
+    for src in sources:
+        if src.rel.endswith(("obs/__init__.py", "obs/metrics.py")):
+            continue  # the emit API itself, not an instrumented call site
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            kind = _emit_kind(node)
+            if kind is None:
+                continue
+            for name, prefix in _name_literals(node.args[0]):
+                out.append(EmitSite(name, kind, src.rel, node.lineno,
+                                    prefix=prefix))
+    return out
+
+
+def _name_literals(arg: ast.expr) -> list[tuple[str, bool]]:
+    """(name, is_prefix) pairs a name argument can statically produce:
+    a constant, both arms of a conditional expression, or the literal
+    head of an f-string / ``"lit" + x`` concatenation (a prefix)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [(arg.value, False)]
+    if isinstance(arg, ast.IfExp):
+        return _name_literals(arg.body) + _name_literals(arg.orelse)
+    if isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant):
+        return [(str(arg.values[0].value), True)]
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+            and isinstance(arg.left, ast.Constant) \
+            and isinstance(arg.left.value, str):
+        return [(arg.left.value, True)]
+    return []
+
+
+def _emit_kind(node: ast.Call) -> str | None:
+    """'counter'/'inc'/… when this call is a telemetry emission."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    method = f.attr
+    qual = f.value
+    qual_name = qual.id if isinstance(qual, ast.Name) else (
+        qual.attr if isinstance(qual, ast.Attribute) else ""
+    )
+    if method in _OBS_METHODS and (
+            qual_name == "obs" or qual_name.endswith("obs")):
+        return method
+    if method in _METRIC_METHODS and (
+            qual_name.endswith("metrics") or qual_name == "REGISTRY"):
+        return method
+    return None
+
+
+class DocToken:
+    def __init__(self, token: str, path: str, line: int):
+        self.token = token
+        self.path = path
+        self.line = line
+
+    @property
+    def wildcard(self) -> bool:
+        return self.token.endswith(".*") or self.token.endswith("_*")
+
+
+def collect_documented(doc_paths: list[tuple[Path, str]]) -> list[DocToken]:
+    """Telemetry-name tokens from the doc surfaces: backtick spans in
+    markdown (plus bare ``jepsen_tpu_*`` words — metric names in fenced
+    blocks); for ``.py`` surfaces only string constants and docstrings
+    count (code identifiers like local variables are not documentation).
+    A token immediately followed by ``(`` is a function reference, not a
+    telemetry name."""
+    out: list[DocToken] = []
+    for path, rel in doc_paths:
+        try:
+            text = path.read_text(encoding="utf-8")
+        except OSError:
+            continue
+        if rel.endswith(".py"):
+            try:
+                tree = ast.parse(text, filename=rel)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    _span_tokens(node.value, rel, node.lineno, out)
+            continue
+        for i, ln in enumerate(text.splitlines(), start=1):
+            for span in (_BACKTICK_RE.findall(ln)
+                         + [t for t in ln.split() if "jepsen_tpu_" in t]):
+                _span_tokens(span, rel, i, out)
+    return out
+
+
+def _span_tokens(span: str, rel: str, line: int,
+                 out: list[DocToken]) -> None:
+    for m in _TOKEN_RE.finditer(span):
+        end = m.end()
+        if end < len(span) and span[end] in "(=":
+            continue  # `wgl.device_buffer_bytes()` / `frontier_budget_mb=`
+            # — function and kwarg references, not telemetry names
+        out.append(DocToken(m.group(0), rel, line))
+
+
+def _module_paths(package_root: Path) -> set[str]:
+    """Dotted module paths under the package (``serve.health``,
+    ``obs.metrics``, …) — doc tokens matching one are code references,
+    not telemetry names."""
+    out: set[str] = set()
+    for p in package_root.rglob("*.py"):
+        rel = p.relative_to(package_root)
+        parts = list(rel.parts[:-1])
+        if rel.stem != "__init__":
+            parts.append(rel.stem)
+        for k in range(1, len(parts) + 1):
+            out.add(".".join(parts[:k]))
+    return out
+
+
+def _namelike(tok: str) -> bool:
+    """Does a doc token claim to be a telemetry name?"""
+    if tok.endswith(".py") or not canon(tok):
+        return False  # file references / bare prefixes
+    if tok.startswith("jepsen_tpu_"):
+        return True
+    if "." in tok:
+        return tok.split(".", 1)[0] in FAMILIES
+    m = re.match(r"^(serve|fault|frontier|elle|dedup)_\w+$", tok)
+    return bool(m)
+
+
+def check(sources: list[SourceFile], doc_paths: list[tuple[Path, str]],
+          package_root: Path) -> list[Finding]:
+    emitted = collect_emitted(sources)
+    docs = collect_documented(doc_paths)
+    modules = _module_paths(package_root)
+
+    emitted_canon = {canon(e.name) for e in emitted if not e.prefix}
+    emitted_prefixes = {canon(e.name) for e in emitted if e.prefix}
+
+    doc_canon: set[str] = set()
+    doc_prefixes: set[str] = set()
+    for t in docs:
+        if t.wildcard:
+            doc_prefixes.add(canon(t.token[:-1]))
+        else:
+            doc_canon.add(canon(t.token))
+
+    findings: list[Finding] = []
+
+    def _documented(name: str) -> bool:
+        c = canon(name)
+        if c in doc_canon:
+            return True
+        return any(c.startswith(p) for p in doc_prefixes if p)
+
+    by_rel = {s.rel: s for s in sources}
+    seen_undoc: set[str] = set()
+    for e in emitted:
+        if e.prefix:
+            continue  # dynamic families are documented by wildcard or not
+        c = canon(e.name)
+        if _documented(e.name) or c in seen_undoc:
+            continue
+        src = by_rel.get(e.path)
+        if src is not None and src.is_disabled("telemetry-undocumented",
+                                               e.line):
+            continue
+        seen_undoc.add(c)
+        findings.append(Finding(
+            rule="telemetry-undocumented", path=e.path, line=e.line,
+            scope=e.kind, slug=e.name,
+            message=(
+                f"{e.kind} `{e.name}` is emitted but appears in no doc "
+                "surface (README / doc/tutorial.md / obs summary tables) "
+                "— document it or delete it"
+            ),
+        ))
+
+    def _is_module(tok: str) -> bool:
+        # ≥2 components on purpose: "serve.health" is a module path,
+        # but a bare package name must not exempt its whole family
+        # ("serve" is a package AND the serve.* telemetry namespace)
+        t = tok[len("jepsen_tpu."):] if tok.startswith("jepsen_tpu.") else tok
+        parts = t.split(".")
+        return any(".".join(parts[:k]) in modules
+                   for k in range(2, min(len(parts), 3) + 1))
+
+    seen_orphan: set[str] = set()
+    for t in docs:
+        if t.wildcard or not _namelike(t.token) or _is_module(t.token):
+            continue
+        c = canon(t.token)
+        if c in emitted_canon or c in seen_orphan:
+            continue
+        if any(c.startswith(p) for p in emitted_prefixes if p):
+            continue
+        seen_orphan.add(c)
+        findings.append(Finding(
+            rule="telemetry-orphan", path=t.path, line=t.line,
+            scope="doc", slug=t.token,
+            message=(
+                f"documented telemetry name `{t.token}` is emitted "
+                "nowhere in the package — fix the doc or restore the "
+                "series"
+            ),
+        ))
+    return findings

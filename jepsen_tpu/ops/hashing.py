@@ -125,7 +125,9 @@ def hash_rows(columns, seed: int):
     """Hash a list of equal-length uint32/int32 column arrays to one uint32
     lane, column-by-column (static unroll; column count is small)."""
     h = jnp.full(columns[0].shape, jnp.uint32(seed ^ 0x9E3779B9))
-    for col in columns:
+    # static unroll over a Python list of columns (count is small and
+    # shape-determined, never data-dependent)
+    for col in columns:  # graftlint: disable=trace-host-control
         h = mix32(h ^ col.astype(jnp.uint32))
     return h
 
@@ -178,7 +180,7 @@ def _keep_sort(h1, h2, alive, window: int):
     # alive rides in the payload's top bit so a sentinel-colliding hash
     # can't resurrect or kill anything.
     payload = jnp.where(alive, iota, iota + jnp.int32(1 << 30))
-    pos = jnp.arange(n)
+    pos = iota
     key = jnp.where(alive, h1, jnp.uint32(0xFFFFFFFF))
     k1, k2, spay = jax.lax.sort((key, h2, payload), num_keys=1)
     al = spay < (1 << 30)
@@ -236,7 +238,7 @@ def _keep_bucket(h1, h2, alive, window: int):
     ibits, bbits = _bucket_bits(n)
     assert bbits >= 1, f"bucket geometry infeasible at {n} rows"
     iota = jnp.arange(n, dtype=jnp.int32)
-    pos = jnp.arange(n)
+    pos = iota
     bucket = h1 >> jnp.uint32(32 - bbits)
     packed = (
         jnp.where(alive, jnp.uint32(0), jnp.uint32(1) << 31)

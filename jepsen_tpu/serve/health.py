@@ -79,13 +79,14 @@ class Quarantine:
         self.ttl_s = float(ttl_s)
         self._lock = threading.Lock()
         #: fp -> {"cause", "expires", "hits", "added"}
-        self._entries: dict[str, dict] = {}
+        self._entries: dict[str, dict] = {}      # guarded-by: _lock [rw]
 
     def __len__(self) -> int:
         with self._lock:
             self._purge_locked()
             return len(self._entries)
 
+    # holds: _lock
     def _purge_locked(self) -> None:
         now = time.monotonic()
         dead = [fp for fp, e in self._entries.items() if e["expires"] <= now]
@@ -213,11 +214,12 @@ class CircuitBreaker:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
         self._lock = threading.Lock()
-        self.state = "closed"
-        self.consecutive_failures = 0
-        self.opened_at: float | None = None
-        self.opens = 0
-        self._probe_budget = 0  # half-open admissions left before outcome
+        self.state = "closed"                    # guarded-by: _lock [rw]
+        self.consecutive_failures = 0            # guarded-by: _lock [rw]
+        self.opened_at: float | None = None      # guarded-by: _lock [rw]
+        self.opens = 0                           # guarded-by: _lock [rw]
+        # half-open admissions left before outcome
+        self._probe_budget = 0                   # guarded-by: _lock [rw]
 
     def allow(self) -> bool:
         with self._lock:

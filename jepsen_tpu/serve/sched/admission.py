@@ -106,8 +106,8 @@ class AdmissionQueues:
         self.max_interactive = (
             int(max_interactive) if max_interactive is not None else None
         )
-        self.queues: dict[str, list] = {c: [] for c in CLASSES}
-        self.ewma_s: dict[str, float] = dict(_EWMA_SEED)
+        self.queues: dict[str, list] = {c: [] for c in CLASSES}  # guarded-by: caller
+        self.ewma_s: dict[str, float] = dict(_EWMA_SEED)  # guarded-by: caller
 
     # -- depth / admission ------------------------------------------------
 

@@ -321,21 +321,26 @@ class CheckService:
         self._check_opts = dict(check_opts)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # AdmissionQueues is caller-serialized: every self._adm call in
+        # this class runs under self._lock / self._cond (its fields
+        # carry the caller-guarded annotation in admission.py).
         self._adm = _sched_adm.AdmissionQueues(
             self.max_queue, max_interactive=max_interactive_queue
         )
-        self._reserved = 0  # admission slots held while packing off-lock
-        self._requests: dict[str, CheckRequest] = {}
-        self._seq = itertools.count()
-        self._closed = False
+        # admission slots held while packing off-lock
+        self._reserved = 0                       # guarded-by: _lock
+        self._requests: dict[str, CheckRequest] = {}  # guarded-by: _lock [rw]
+        self._seq = itertools.count()  # thread-safe under the GIL (next())
+        self._closed = False                     # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._fp_thread: threading.Thread | None = None
-        self._graph_pool: ThreadPoolExecutor | None = None
-        self._inflight: list[CheckRequest] = []  # requests on the device
+        self._graph_pool: ThreadPoolExecutor | None = None  # guarded-by: _lock [rw]
+        # requests on the device
+        self._inflight: list[CheckRequest] = []  # guarded-by: _lock [rw]
         self._t_start = time.monotonic()
-        self._parity_checked = False
-        self._totals = {
+        self._parity_checked = False             # guarded-by: _lock [rw]
+        self._totals = {                         # guarded-by: _lock [rw]
             "submitted": 0, "completed": 0, "rejected": 0, "expired": 0,
             "drained": 0, "batches": 0, "batch_errors": 0,
             "fastpath_resolved": 0, "escalated": 0, "graphs": 0,
@@ -362,16 +367,17 @@ class CheckService:
             if journal_dir is not None else None
         )
         self.health_probe_every_s = health_probe_every_s
-        self._t_probe = 0.0
-        self._recovered = False
-        self._occ_sum = 0.0     # per-batch occupancy accumulator
+        self._t_probe = 0.0                      # guarded-by: _lock [rw]
+        self._recovered = False  # start()-serialized (pre-thread)
+        # per-batch occupancy accumulator
+        self._occ_sum = 0.0                      # guarded-by: _lock [rw]
         #: continuous-occupancy accumulators: live lane-seconds over
         #: launched lane-slot-seconds across every rung — the
         #: device-TIME-utilization aggregate the ≥ 0.80 gate reads
         #: (each rung weighted by its wall clock; see RungFeeder).
-        self._rung_lane_sum = 0.0
-        self._rung_slot_sum = 0.0
-        self._rungs = 0
+        self._rung_lane_sum = 0.0                # guarded-by: _lock [rw]
+        self._rung_slot_sum = 0.0                # guarded-by: _lock [rw]
+        self._rungs = 0                          # guarded-by: _lock [rw]
 
     @property
     def mesh(self):
@@ -382,7 +388,8 @@ class CheckService:
     def _batch_ewma_s(self) -> float:
         # Back-compat alias (stats key batch_ewma_s): the batch tier's
         # cycle EWMA now lives in the admission queues, per class.
-        return self._adm.ewma_s["batch"]
+        with self._lock:
+            return self._adm.ewma_s["batch"]
 
     # ------------------------------------------------------------------
     # Admission
@@ -652,8 +659,10 @@ class CheckService:
 
     def _retry_after(self) -> float:
         """Back-compat backpressure hint (batch tier)."""
-        return self._adm.retry_after("batch", self.max_batch)
+        with self._lock:
+            return self._adm.retry_after("batch", self.max_batch)
 
+    # holds: _lock
     def _remember(self, req: CheckRequest) -> None:
         self._requests[req.id] = req
         if len(self._requests) > self.max_queue + _KEEP_DONE:
@@ -860,14 +869,33 @@ class CheckService:
         for r in gq:
             groups.setdefault(r.group, []).append(r)
         for rs in groups.values():
+            pool = None
             if self._thread is not None:
-                if self._graph_pool is None:
-                    self._graph_pool = ThreadPoolExecutor(
-                        max_workers=2, thread_name_prefix="check-graph"
-                    )
-                self._graph_pool.submit(self._run_graph_batch, rs)
-            else:
-                self._run_graph_batch(rs)
+                # Lazy pool creation is racy without the lock: the
+                # scheduler thread and a continuous ladder's rung poll
+                # (running on the watchdog worker thread) both dispatch
+                # graphs, and two creators would leak a pool.  A CLOSED
+                # service must not mint a fresh pool either — shutdown
+                # already swapped the old one out, and a pool created
+                # after that swap would never be joined.
+                with self._lock:
+                    if not self._closed:
+                        if self._graph_pool is None:
+                            self._graph_pool = ThreadPoolExecutor(
+                                max_workers=2,
+                                thread_name_prefix="check-graph",
+                            )
+                        pool = self._graph_pool
+            if pool is not None:
+                try:
+                    pool.submit(self._run_graph_batch, rs)
+                    continue
+                except RuntimeError:
+                    # the pool we grabbed shut down between the locked
+                    # read and the submit (close() joins outside the
+                    # lock) — serve the group inline instead
+                    pass
+            self._run_graph_batch(rs)
         return len(gq)
 
     def _sync_graph_depth(self) -> None:
@@ -1094,7 +1122,9 @@ class CheckService:
             expired = self._adm.take_expired()
             self._totals["expired"] += len(expired)
         self._resolve_expired(expired)
-        if self._adm.depth("interactive"):
+        with self._lock:
+            interactive_waiting = self._adm.depth("interactive") > 0
+        if interactive_waiting:
             # The rung boundary is an interactive service opportunity
             # whether or not the dedicated fast-path thread runs: the
             # ladder pausing here means the wave launches uncontended,
@@ -1176,9 +1206,14 @@ class CheckService:
                 or self._placement.mesh is None):
             return
         now = time.monotonic()
-        if now - self._t_probe < self.health_probe_every_s:
-            return
-        self._t_probe = now
+        with self._lock:
+            # Check-and-set atomically: the scheduler thread and a
+            # continuous ladder's rung poll (on the watchdog worker
+            # thread) both reach here, and two passing the interval
+            # gate together would double-probe the mesh.
+            if now - self._t_probe < self.health_probe_every_s:
+                return
+            self._t_probe = now
         try:
             healthy, failed = self._placement.probe()
         except Exception:  # noqa: BLE001 — a broken probe must not
@@ -1199,7 +1234,7 @@ class CheckService:
         self._placement.shrink_to(healthy)
         with self._lock:
             self._totals["devices_replaced"] += len(failed)
-        self._parity_checked = False
+            self._parity_checked = False
         metrics.inc("serve.devices_lost", len(failed))
         metrics.set_gauge("serve.placement_devices", len(healthy))
         obs.counter("serve.placement_replaced", lost=len(failed),
@@ -1389,11 +1424,16 @@ class CheckService:
         # already-resolved members are skipped.
         for r, res in zip(members, results):
             self._settle_member(r, res)
-        if (self.verify_placement and mesh is not None
-                and not self._parity_checked):
-            self._parity_checked = True
-            self._verify_placement(model, [r.history for r in members],
-                                   results)
+        if self.verify_placement and mesh is not None:
+            with self._lock:
+                # claim-under-lock: a device-loss shrink re-arms the
+                # probe concurrently, and two batches racing the bare
+                # flag could both (or neither) run the parity check
+                run_parity = not self._parity_checked
+                self._parity_checked = True
+            if run_parity:
+                self._verify_placement(model, [r.history for r in members],
+                                       results)
 
     def _bisect_poison(self, model, members: list[CheckRequest],
                        err: BaseException, mesh) -> None:
@@ -1695,9 +1735,12 @@ class CheckService:
         if self._fp_thread is not None:
             self._fp_thread.join(timeout=30.0)
             self._fp_thread = None
-        if self._graph_pool is not None:
-            self._graph_pool.shutdown(wait=True)
-            self._graph_pool = None
+        with self._lock:
+            pool, self._graph_pool = self._graph_pool, None
+        if pool is not None:
+            # joined outside the lock: queued graph batches take it in
+            # _settle_member, and a held lock here would deadlock them
+            pool.shutdown(wait=True)
         with self._lock:
             # _inflight is non-empty only when the join timed out: those
             # requests were admitted and must still settle (drain below).

@@ -1,0 +1,706 @@
+"""graftlint: seeded-violation fixtures per rule + the repo self-scan.
+
+Each fixture plants exactly one violation (or none, for the clean
+variants) and asserts the rule fires exactly on its seed — and stays
+quiet on the clean fixture.  The self-scan asserts the checked-in repo
+has zero unsuppressed findings (the CI gate's contract).  Everything is
+pure stdlib ``ast`` — no jax, no kernels, no device shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.lint import Baseline, SourceFile, assign_keys, load_baseline
+from jepsen_tpu.lint import lockcheck, telemetry, tracecheck
+from jepsen_tpu.lint.runner import run_lint
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _src(code: str, rel: str = "fixture.py") -> SourceFile:
+    return SourceFile(REPO / rel, rel, text=textwrap.dedent(code))
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# trace discipline
+# ---------------------------------------------------------------------------
+
+
+def test_trace_host_sync_item_in_jit_fires():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """))
+    assert _rules(fs) == ["trace-host-sync"]
+    assert fs[0].slug == "item"
+
+
+def test_trace_host_sync_float_and_numpy():
+    fs = tracecheck.check_source(_src("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = np.asarray(x)
+            return a, b
+    """))
+    assert _rules(fs) == ["trace-host-sync", "trace-host-sync"]
+    assert {f.slug for f in fs} == {"float", "np.asarray"}
+
+
+def test_trace_host_control_if_on_traced_value():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x, n):
+            if n > 3:
+                return x
+            return x + 1
+    """))
+    assert _rules(fs) == ["trace-host-control"]
+    assert "static_argnames" in fs[0].message
+
+
+def test_trace_static_argnames_silences_config_branch():
+    fs = tracecheck.check_source(_src("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 3:
+                return x
+            return x + 1
+    """))
+    assert fs == []
+
+
+def test_trace_partial_bound_args_are_static():
+    # the runner-factory idiom: functools.partial binds host config
+    # positionally, jit(vmap(core)) traces the rest
+    fs = tracecheck.check_source(_src("""
+        import functools
+        import jax
+
+        def core(n, fast, x):
+            if fast:
+                return x
+            return x * n
+
+        def runner(n, fast):
+            core2 = functools.partial(core, n, fast)
+            return jax.jit(jax.vmap(core2))
+    """))
+    assert fs == []
+
+
+def test_trace_local_binding_resolves_in_source_order():
+    # a later top-level rebinding shadows an earlier nested one: the
+    # jit target is f (clean), never g (hazardous)
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        def g(x):
+            return x.item()
+
+        def f(x):
+            return x
+
+        def factory(flag):
+            if flag:
+                core = g
+            core = f
+            return jax.jit(core)
+    """))
+    assert fs == []
+
+
+def test_trace_taint_descends_into_local_callee():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        def helper(y):
+            while y > 0:
+                y = y - 1
+            return y
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """))
+    assert _rules(fs) == ["trace-host-control"]
+    assert fs[0].scope == "helper"
+
+
+def test_trace_nondeterminism_time_in_jit():
+    fs = tracecheck.check_source(_src("""
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            t = time.monotonic()
+            return x + t
+    """))
+    assert _rules(fs) == ["trace-nondeterminism"]
+
+
+def test_trace_implicit_dtype_fires_and_explicit_is_quiet():
+    fs = tracecheck.check_source(_src("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            a = jnp.zeros((4,))                      # seeded: implicit
+            b = jnp.zeros((4,), jnp.int32)           # positional dtype
+            c = jnp.arange(4, dtype=jnp.int32)       # kw dtype
+            d = jnp.full((4,), jnp.uint32(7))        # dtyped fill value
+            return a, b, c, d, x
+    """))
+    assert _rules(fs) == ["trace-implicit-dtype"]
+    assert fs[0].slug == "jnp.zeros"
+
+
+def test_trace_raw_geometry_flags_unpadded_launch():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        def _core(x):
+            return x
+
+        _run = jax.jit(_core)
+
+        def bad_launch(histories):
+            return _run(histories)
+
+        def good_launch(histories, pad_B):
+            n = pad_B(len(histories))
+            return _run(histories[:n])
+    """))
+    assert _rules(fs) == ["trace-raw-geometry"]
+    assert fs[0].scope == "bad_launch"
+
+
+def test_trace_shard_map_target_is_a_root():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        def body(x):
+            return int(x)
+
+        fn = jax.jit(shard_map(body, mesh=None, in_specs=(), out_specs=()))
+    """))
+    assert _rules(fs) == ["trace-host-sync"]
+
+
+def test_trace_inline_disable_suppresses():
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()  # graftlint: disable=trace-host-sync
+    """))
+    assert fs == []
+
+
+def test_trace_hazard_inside_comprehension_fires():
+    # the generator target must be tainted BEFORE the element is walked
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(xs):
+            return [float(v) for v in xs]
+    """))
+    assert _rules(fs) == ["trace-host-sync"]
+    assert fs[0].slug == "float"
+
+
+def test_trace_subscript_store_does_not_taint_index():
+    # `scratch[i] = x` writes traced data THROUGH i; i stays a host int
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x, scratch):
+            i = 3
+            scratch[i] = x * 2
+            for k in range(i):
+                x = x + k
+            return x
+    """))
+    assert fs == []
+
+
+def test_trace_static_loop_var_is_host_value():
+    # `for i in range(4)` yields host ints: a condition on i is a
+    # static unroll, not a re-trace
+    fs = tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            acc = x
+            for i in range(4):
+                if i % 2 == 0:
+                    acc = acc + i
+            return acc
+    """))
+    assert fs == []
+
+
+def test_trace_clean_kernel_is_quiet():
+    fs = tracecheck.check_source(_src("""
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n", "fast"))
+        def f(x, n, fast):
+            acc = jnp.zeros((n,), jnp.float32)
+            for k in range(n):          # static bound: fine
+                acc = acc + x
+            if fast:                    # static config: fine
+                acc = acc * 2
+            m = x.shape[0]              # shapes are host values: fine
+            return acc, m
+    """))
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_FIXTURE = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._totals = {{}}       # guarded-by: _lock
+            self._inflight = []     # guarded-by: _lock [rw]
+
+        def guarded(self):
+            with self._lock:
+                self._totals["a"] = 1
+            with self._cond:
+                self._inflight.append(1)
+
+        {body}
+"""
+
+
+def _lock_fs(body: str):
+    return lockcheck.check_source(
+        _src(_LOCK_FIXTURE.format(body=textwrap.dedent(body).replace(
+            "\n", "\n        ").rstrip()))
+    )
+
+
+def test_lock_unguarded_write_fires():
+    fs = _lock_fs("""
+        def bad(self):
+            self._totals["b"] = 2
+    """)
+    assert _rules(fs) == ["lock-guard"]
+    assert fs[0].slug == "write:_totals"
+
+
+def test_lock_mutator_call_is_a_write():
+    fs = _lock_fs("""
+        def bad(self):
+            self._inflight.append(3)
+    """)
+    assert [f.slug for f in fs] == ["write:_inflight"]
+
+
+def test_lock_tuple_unpack_write_is_a_write():
+    fs = _lock_fs("""
+        def swap(self):
+            a, self._totals = self._totals, {}
+    """)
+    assert [f.slug for f in fs] == ["write:_totals"]
+
+
+def test_lock_nested_tuple_unpack_write_is_a_write():
+    fs = _lock_fs("""
+        def swap(self):
+            a, (b, self._totals) = 1, (2, {})
+    """)
+    assert [f.slug for f in fs] == ["write:_totals"]
+
+
+def test_lock_rw_read_checked_write_only_read_not():
+    fs = _lock_fs("""
+        def reads(self):
+            a = len(self._inflight)   # rw field: flagged
+            b = self._totals.get("a")  # write-guarded only: read is free
+            return a, b
+    """)
+    assert [f.slug for f in fs] == ["read:_inflight"]
+
+
+def test_lock_condition_alias_satisfies_lock():
+    fs = _lock_fs("""
+        def ok(self):
+            with self._cond:
+                self._totals["c"] = 3
+    """)
+    assert fs == []
+
+
+def test_lock_holds_annotation_exempts_helper():
+    fs = _lock_fs("""
+        # holds: _lock
+        def helper(self):
+            self._totals["d"] = 4
+    """)
+    assert fs == []
+
+
+def test_lock_closure_does_not_inherit_guard():
+    fs = _lock_fs("""
+        def leaky(self):
+            with self._lock:
+                def cb():
+                    self._totals["e"] = 5
+                return cb
+    """)
+    assert _rules(fs) == ["lock-guard"]
+
+
+def test_lock_annotation_above_and_multiline_placements():
+    fs = lockcheck.check_source(_src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded-by: _lock
+                self._above = 0
+                self._multi = {
+                    "a": 1,
+                }   # guarded-by: _lock
+
+            def w(self):
+                self._above = 1
+                self._multi["b"] = 2
+    """))
+    assert [f.slug for f in fs] == ["write:_above", "write:_multi"]
+
+
+def test_lock_unattached_annotation_fails_loud():
+    # a guarded-by comment nothing consumed checks NOTHING — it must
+    # surface instead of silently failing open
+    fs = lockcheck.check_source(_src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def w(self):
+                # guarded-by: _lock
+                self._x = 1
+    """))
+    assert _rules(fs) == ["lock-unknown"]
+    assert "checks NOTHING" in fs[0].message
+
+
+def test_lock_unknown_lock_name_flagged():
+    fs = lockcheck.check_source(_src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._x = 0   # guarded-by: _mutex
+
+            def w(self):
+                self._x = 1
+    """))
+    assert "lock-unknown" in _rules(fs)
+
+
+def test_lock_caller_annotation_checks_nothing():
+    fs = lockcheck.check_source(_src("""
+        class Q:
+            def __init__(self):
+                self.queues = {}   # guarded-by: caller
+
+            def push(self, r):
+                self.queues[r] = 1
+    """))
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry drift
+# ---------------------------------------------------------------------------
+
+
+def _drift(code: str, doc_md: str, tmp_path: Path):
+    (tmp_path / "doc.md").write_text(textwrap.dedent(doc_md))
+    pkg = tmp_path / "jepsen_tpu"
+    pkg.mkdir(exist_ok=True)
+    src = _src(code, rel="jepsen_tpu/mod.py")
+    return telemetry.check([src], [(tmp_path / "doc.md", "doc.md")], pkg)
+
+
+def test_telemetry_undocumented_metric_fires(tmp_path):
+    fs = _drift("""
+        from jepsen_tpu import obs
+
+        def f():
+            obs.counter("serve.documented_thing")
+            obs.counter("serve.mystery_thing")
+    """, "The service counts `serve.documented_thing` somewhere.\n",
+                tmp_path)
+    assert _rules(fs) == ["telemetry-undocumented"]
+    assert fs[0].slug == "serve.mystery_thing"
+
+
+def test_telemetry_orphan_doc_fires(tmp_path):
+    fs = _drift("""
+        from jepsen_tpu import obs
+
+        def f():
+            obs.gauge("serve.real_gauge", 1)
+    """, "Scrape `serve.real_gauge` and `serve.ghost_gauge`.\n", tmp_path)
+    assert _rules(fs) == ["telemetry-orphan"]
+    assert fs[0].slug == "serve.ghost_gauge"
+
+
+def test_telemetry_prometheus_spelling_matches_obs_name(tmp_path):
+    fs = _drift("""
+        from jepsen_tpu.obs import metrics
+
+        def f():
+            metrics.inc("serve.verdicts", verdict="true")
+    """, "Verdict counts land in `jepsen_tpu_serve_verdicts_total`.\n",
+                tmp_path)
+    assert fs == []
+
+
+def test_telemetry_wildcard_documents_family(tmp_path):
+    fs = _drift("""
+        from jepsen_tpu import obs
+
+        def f(kind):
+            obs.counter("fault.alpha")
+            obs.counter("fault.beta")
+    """, "Every `fault.*` event rolls into the faults table.\n", tmp_path)
+    assert fs == []
+
+
+def test_telemetry_dynamic_prefix_not_orphaned(tmp_path):
+    fs = _drift("""
+        from jepsen_tpu import obs
+
+        def f(which):
+            obs.counter(f"elle.{which}")
+    """, "Substages emit `elle.nodes` spans.\n", tmp_path)
+    assert fs == []
+
+
+def test_telemetry_function_and_kwarg_refs_not_names(tmp_path):
+    fs = _drift("""
+        def f():
+            pass
+    """, "Call `serve.submit()` with `serve_timeout_s=` to bound it.\n",
+                tmp_path)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline / keys / runner
+# ---------------------------------------------------------------------------
+
+
+def test_finding_keys_are_line_free_and_stable():
+    code = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """
+    k1 = assign_keys(tracecheck.check_source(_src(code)))[0].key
+    k2 = assign_keys(tracecheck.check_source(_src("\n\n" + textwrap.dedent(
+        code))))[0].key
+    assert k1 == k2  # shifting the code two lines must not churn the key
+    assert ":f:item" in k1
+
+
+def test_duplicate_hazard_keys_fail_closed():
+    """A NEW identical hazard in a scope must invalidate its siblings'
+    keys (count is part of the key): the newcomer can never silently
+    inherit a baselined suppression."""
+    one = assign_keys(tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)))
+    two = assign_keys(tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            a = x.item()
+            return a, x.item()
+    """)))
+    assert len(one) == 1 and len(two) == 2
+    keys_two = {f.key for f in two}
+    assert one[0].key not in keys_two  # old bare key no longer matches
+    assert all("/2" in k for k in keys_two)
+
+
+def test_baseline_splits_and_reports_stale(tmp_path):
+    p = tmp_path / "base.json"
+    p.write_text(json.dumps({"suppressions": [
+        {"key": "trace-host-sync:fixture.py:f:item", "why": "seeded"},
+        {"key": "gone:rule:that:matches-nothing", "why": "stale"},
+    ]}))
+    fs = assign_keys(tracecheck.check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)))
+    live, supp, stale = load_baseline(p).split(fs)
+    assert live == [] and len(supp) == 1
+    assert stale == ["gone:rule:that:matches-nothing"]
+
+
+def test_rules_filter_does_not_fake_stale_baseline():
+    # a --rules subset must not report other rules' live suppressions
+    # as stale (an operator would delete them and break the full gate)
+    result = run_lint(REPO, rules={"lock-guard"})
+    assert result.stale_baseline == []
+    assert result.findings == []
+
+
+def test_repo_self_scan_is_green():
+    """The CI contract: the checked-in tree has zero unsuppressed
+    findings, no stale baseline entries, and the scan is cheap."""
+    result = run_lint(REPO)
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings
+    )
+    assert result.stale_baseline == []
+    assert result.wall_s < 10.0  # pure-AST pass; keep it tier-1 cheap
+    # the lock annotations and the trace roots must actually be seen —
+    # a silently-empty analyzer would make this test vacuous
+    assert result.files > 50
+    assert len(result.suppressed) >= 1
+
+
+def test_repo_scan_without_baseline_shows_only_triaged(tmp_path):
+    result = run_lint(REPO, baseline=Baseline(None, {}))
+    keys = {f.key for f in result.findings}
+    triaged = set(load_baseline(REPO / ".graftlint-baseline.json").entries)
+    assert keys == triaged  # nothing unsuppressed beyond the triaged set
+
+
+def test_graftlint_cli_json_and_exit_codes(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    rc = graftlint.main(["--json", "--ledger", "off"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0 and doc["ok"] is True
+    assert doc["version"] == 1
+    assert set(doc["stages"]) == {"parse", "trace", "lock", "telemetry"}
+    # rule filter with an unknown rule is a usage error, not findings
+    assert graftlint.main(["--rules", "no-such-rule", "--ledger", "off"]) == 2
+
+
+def test_graftlint_cli_exits_nonzero_on_seeded_violation_tree(tmp_path):
+    """End-to-end over a fixture repo: a seeded lock violation and an
+    undocumented metric must drive the CLI to exit 1."""
+    import sys
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "jepsen_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        import threading
+        from jepsen_tpu import obs
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0   # guarded-by: _lock
+
+            def bump(self):
+                self._n += 1              # seeded: unguarded write
+                obs.counter("serve.undocumented_seed")  # seeded: no docs
+    """))
+    (tmp_path / "README.md").write_text("nothing documented here\n")
+    rc = graftlint.main(["--root", str(tmp_path), "--ledger", "off",
+                         "--json"])
+    assert rc == 1
+
+
+def test_graftlint_appends_lint_ledger_record(tmp_path):
+    import sys
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    ledger = tmp_path / "ledger.jsonl"
+    rc = graftlint.main(["--ledger", str(ledger)])
+    assert rc == 0
+    from jepsen_tpu.obs import regress
+
+    recs = regress.read_records(ledger)
+    assert len(recs) == 1 and recs[0]["kind"] == "lint"
+    assert recs[0]["metrics"]["wall_s"] > 0
+    assert set(recs[0]["stages"]) == {"parse", "trace", "lock", "telemetry"}
+    assert "findings" in recs[0]["extra"]
+    # perfwatch's gate picks the kind up from the ledger automatically
+    ok, report = regress.gate(recs)
+    assert ok and "lint" in report
+
+
+@pytest.mark.parametrize("rel", [
+    "jepsen_tpu/serve/service.py",
+    "jepsen_tpu/serve/health.py",
+    "jepsen_tpu/serve/sched/admission.py",
+])
+def test_serve_stack_is_annotated(rel):
+    """The satellite contract: the shared-mutable serve fields carry
+    guarded-by annotations (the self-scan proves they HOLD; this proves
+    they EXIST — deleting the annotations must fail loudly)."""
+    text = (REPO / rel).read_text()
+    assert text.count("guarded-by:") >= 2, rel

@@ -42,6 +42,24 @@ def append_hist(seed, n=8, anomaly=False):
     return hist
 
 
+def test_closed_service_serves_graphs_inline_without_new_pool():
+    """A closed service must never mint a fresh graph pool (shutdown
+    already swapped the old one out; a late-created pool is never
+    joined) — queued graph work is served inline instead."""
+    svc = sv.CheckService(max_queue=8, batch_window_s=0)
+    fut = svc.submit(append_hist(1), checker=elle.list_append())
+    with svc._cond:
+        svc._closed = True
+    # simulate the scheduler-thread context a rung poll would see
+    svc._thread = object()
+    try:
+        svc._step_graphs()
+    finally:
+        svc._thread = None
+    assert svc._graph_pool is None
+    assert fut.result(timeout=30)["valid?"] is True
+
+
 def test_graph_lane_batches_compatible_requests():
     """Compatible elle requests (same batch_key) share ONE check_batch
     call; incompatible ones get their own; verdicts match per-request

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""graftlint — the checker stack's static-analysis gate.
+
+    python tools/graftlint.py                 # human output, exit 1 on findings
+    python tools/graftlint.py --json          # machine-readable findings
+    python tools/graftlint.py --rules lock-guard,telemetry-orphan
+    python tools/graftlint.py --no-baseline   # show baselined findings too
+
+Three analyzers (see ``jepsen_tpu/lint/``): trace discipline over the
+jit/shard_map launch surface, ``# guarded-by:`` lock discipline over the
+serving stack, and telemetry drift against the documented inventories.
+Suppressions live in ``.graftlint-baseline.json`` (triaged, one-line
+``why`` each) and inline ``# graftlint: disable=<rule>`` comments.
+
+Exit codes: 0 no unsuppressed findings; 1 findings; 2 internal error.
+
+Unless ``--ledger off``, the run appends a ``kind:"lint"`` record (wall
+seconds + per-analyzer stage table) to the perf ledger so ``perfwatch
+gate`` flags analyzer-cost creep the same way it flags suite-time creep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from jepsen_tpu.lint import Baseline, load_baseline  # noqa: E402
+from jepsen_tpu.lint.runner import ALL_RULES, run_lint  # noqa: E402
+
+
+def _append_ledger(result, ledger: str | None) -> None:
+    """Best-effort ``kind:"lint"`` perf-ledger record (analyzer-cost
+    creep shows up in ``perfwatch gate`` next to suite-time creep)."""
+    try:
+        from jepsen_tpu.obs import regress
+
+        # wall_s is the only GATED metric (lower-better, stage-attributed
+        # via the per-analyzer stage table); file/finding counts ride in
+        # extra — the repo growing a file must not read as a regression.
+        rec = regress.make_record(
+            "lint",
+            {"wall_s": round(result.wall_s, 3)},
+            stages=result.stages,
+            extra={"files": result.files,
+                   "findings": len(result.findings),
+                   "suppressed": len(result.suppressed)},
+            fp=regress.fingerprint(probe_devices=False),
+        )
+        regress.append_record(rec, ledger)
+    except Exception as e:  # noqa: BLE001 — the gate must not fail on
+        print(f"graftlint: ledger append failed ({e})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset "
+                         f"(known: {', '.join(sorted(ALL_RULES))})")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: .graftlint-baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--ledger", default=None,
+                    help="perf-ledger path, or 'off' (default: env/store)")
+    ap.add_argument("--root", default=str(REPO), help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(ALL_RULES)
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    root = Path(args.root)
+    baseline = (Baseline(None, {}) if args.no_baseline
+                else load_baseline(
+                    Path(args.baseline) if args.baseline
+                    else root / ".graftlint-baseline.json"))
+    try:
+        result = run_lint(root, rules=rules, baseline=baseline)
+    except Exception as e:  # noqa: BLE001 — a crashing linter must be
+        # loud and distinguishable from "findings exist"
+        print(f"graftlint: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+    if args.ledger != "off":
+        _append_ledger(result, args.ledger)
+
+    if args.json:
+        print(json.dumps(result.as_dict(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+            print(f"    key: {f.key}")
+        for key in result.stale_baseline:
+            print(f"graftlint: stale baseline entry (no longer fires): {key}",
+                  file=sys.stderr)
+        print(
+            f"graftlint: {len(result.findings)} finding(s) "
+            f"({len(result.suppressed)} baselined) over {result.files} "
+            f"files in {result.wall_s:.2f}s "
+            f"[{' '.join(f'{k}={v:.2f}s' for k, v in result.stages.items())}]"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
