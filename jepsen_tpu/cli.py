@@ -253,6 +253,7 @@ def _cmd_serve(opts) -> int:
             probe_s = None
         svc = CheckService(
             capacity=capacity,
+            slo_specs=opts.slo_file,
             max_queue=opts.max_queue,
             max_interactive_queue=opts.max_interactive_queue,
             max_batch=opts.max_batch,
@@ -412,6 +413,13 @@ def run_cli(
                               "wall clock at FACTOR x the launch-time "
                               "EWMA and retry a hung launch once on "
                               "reduced placement (0 disables; default 16)")
+    p_serve.add_argument("--slo-file", default=None, metavar="JSON",
+                         help="SLO spec file for the live burn-rate "
+                              "engine (a JSON list merged over the "
+                              "built-in defaults by name; see "
+                              "jepsen_tpu/serve/slo.py).  GET /alerts "
+                              "and the home-page panel surface the "
+                              "burn rates either way")
     p_serve.add_argument("--health-probe-s", type=float, default=0,
                          metavar="SECONDS",
                          help="mesh device-health probe interval: a "

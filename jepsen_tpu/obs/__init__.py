@@ -182,6 +182,17 @@ def _stamp(ev: dict) -> dict:
     return ev
 
 
+def _stamp_thread(ev: dict) -> dict:
+    """Attach the emitting thread's id to a span event.  The flight
+    analyzer (obs.critpath) nests span instances by interval
+    containment WITHIN a thread — a single thread's overlapping spans
+    are always genuinely nested, while same-interval spans on
+    different threads are concurrent work that must never be charged
+    inside each other."""
+    ev["thread"] = threading.get_ident()
+    return ev
+
+
 class Recorder:
     """Appends events to ``<dir>/telemetry.jsonl``; ``close()`` rolls them
     up into ``<dir>/telemetry.json``.  Thread-safe (checkers run composed
@@ -308,10 +319,10 @@ class _Span:
         # parent: the enclosing local span, else an attach()ed handoff
         # context's parent (the cross-thread link)
         parent = stack[-1].name if stack else getattr(_STACK, "parent", None)
-        ev: dict[str, Any] = _stamp({
+        ev: dict[str, Any] = _stamp_thread(_stamp({
             "type": "span", "name": self.name, "t": round(self._start, 6),
             "dur": round(dur, 6),
-        })
+        }))
         if parent is not None:
             ev["parent"] = parent
         if exc_type is not None:
@@ -339,10 +350,10 @@ def span_event(name: str, seconds: float, **attrs) -> None:
     if r is None:
         return
     now = r.now()
-    ev: dict[str, Any] = _stamp({
+    ev: dict[str, Any] = _stamp_thread(_stamp({
         "type": "span", "name": name,
         "t": round(max(0.0, now - seconds), 6), "dur": round(seconds, 6),
-    })
+    }))
     if attrs:
         ev["attrs"] = attrs
     r.emit(ev)

@@ -180,6 +180,20 @@ class Registry:
                 "count": h["count"], "sum": h["sum"],
             }
 
+    def histogram_buckets(self, name: str, **labels) -> dict | None:
+        """The full bucket view (bounds + per-bucket counts + count/sum)
+        — what the SLO burn-rate engine reads to split a latency
+        histogram into good/bad at a threshold.  None when the series
+        doesn't exist."""
+        key = (metric_name(name), _labels_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            return None if h is None else {
+                "bounds": tuple(h["bounds"]),
+                "buckets": list(h["buckets"]),
+                "count": h["count"], "sum": h["sum"],
+            }
+
     def snapshot(self) -> dict:
         """A JSONable dump: {"counters": {...}, "gauges": {...},
         "histograms": {name: {"count", "sum", "mean"}}}."""

@@ -284,6 +284,18 @@ def stage_rollup(summary: Mapping | None) -> tuple[dict, dict]:
             stages[str(name)] = float(s.get("total_s") or 0)
         except (TypeError, ValueError, AttributeError):
             continue
+    # critical-path seconds per span (obs.critpath, embedded in every
+    # telemetry.json): the ledger then trends what BOUNDS wall clock,
+    # not just inclusive time — a stage that grew but slid off the
+    # critical path is a different story from one that grew on it.
+    cp = summary.get("critpath") or {}
+    for row in cp.get("spans") or []:
+        try:
+            stages[f"critpath[{row['span']}]"] = float(row.get("cp_s") or 0)
+        except (TypeError, ValueError, KeyError):
+            continue
+    if isinstance(cp.get("total_s"), (int, float)):
+        metrics["critpath_total_s"] = float(cp["total_s"])
     for d in summary.get("dedup") or []:
         key = (f"dedup[{d.get('backend', '?')}@{d.get('candidates', '?')}]"
                "_per_round_us")

@@ -33,6 +33,16 @@ later perf PRs report against.
                 "spill_merges", "factorizations", "undecidable",
                 "oom_spills"}          # bounded-memory layer (ops.spill)
    "faults":   [{"fault", "count", "seconds", "detail"}, ...]  # fault.* events
+   "critpath": {"wall_s", "total_s",
+                "spans": [{"span", "cp_s", "count", "total_s",
+                           "slack_s"}, ...]}
+                               # critical-path rollup (obs.critpath):
+                               # what bounds wall clock, ranked — the
+                               # perf ledger records cp seconds per
+                               # stage, not just inclusive time
+   "telemetry": {"skipped_lines"}  # truncated/corrupt jsonl lines the
+                               # tolerant reader dropped (present only
+                               # when nonzero)
    "counters": {name: total}
    "gauges":   {name: last value}
    "spans":    {name: {"count", "total_s", "max_s"}}}
@@ -87,7 +97,8 @@ def _fault_detail(attrs: Mapping) -> str:
     return " ".join(parts)
 
 
-def summarize(events: Iterable[Mapping]) -> dict:
+def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
+    events = list(events)
     spans: dict[str, dict] = {}
     phases: list[dict] = []
     phase_by_name: dict[str, dict] = {}
@@ -325,7 +336,9 @@ def summarize(events: Iterable[Mapping]) -> dict:
                   "placement_replaced", "drain_error"):
         if f"serve.{cname}" in counters:
             serve[cname] = counters[f"serve.{cname}"]
-    return {
+    from jepsen_tpu.obs import critpath as _critpath
+
+    out = {
         "version": 1,
         "wall_s": _r(wall),
         "phases": phases,
@@ -336,10 +349,14 @@ def summarize(events: Iterable[Mapping]) -> dict:
         "elle": elle,
         "memory": memory,
         "faults": out_faults,
+        "critpath": _critpath.critpath_rollup(events),
         "counters": counters,
         "gauges": gauges,
         "spans": spans,
     }
+    if skipped_lines:
+        out["telemetry"] = {"skipped_lines": int(skipped_lines)}
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -446,6 +463,21 @@ def format_summary(summary: Mapping) -> str:
             "device_bytes_peak", "spill_rows", "spill_bytes", "spill_merges",
             "factorizations", "oom_spills", "undecidable") if k in mm]
         parts.append(_table(["memory", "value"], rows))
+    if summary.get("critpath", {}).get("spans"):
+        cp = summary["critpath"]
+        parts.append(
+            f"\ncritical path ({cp.get('total_s', 0)} s on-path of "
+            f"{cp.get('wall_s', 0)} s wall):")
+        parts.append(_table(
+            ["span", "critpath_s", "inclusive_s", "count", "slack_s"],
+            [[r.get("span"), r.get("cp_s"), r.get("total_s"),
+              r.get("count"), r.get("slack_s")]
+             for r in cp["spans"]],
+        ))
+    if summary.get("telemetry", {}).get("skipped_lines"):
+        parts.append(
+            f"\ntelemetry: {summary['telemetry']['skipped_lines']} "
+            "malformed jsonl line(s) skipped")
     if summary.get("faults"):
         parts.append("\nfaults (retries / degradations / checkpoints / deadline):")
         parts.append(_table(

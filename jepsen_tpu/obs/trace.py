@@ -7,18 +7,28 @@ trace-event format (the JSON Perfetto and ``chrome://tracing`` load):
   * one LANE (tid) per request trace id — every event stamped with that
     single ``"trace"`` carries the request's journey (HTTP admission →
     queued wait → demux) on its own row, named after the id;
-  * a shared **device/ladder** lane (tid 0) for spans that belong to
-    the whole process or a shared batch (``ladder.stage``,
-    ``serve.batch``, confirmation drains) — their member trace ids ride
-    along in ``args`` so a lane's request can be found from the shared
-    span and vice versa;
-  * counter tracks (``ph: "C"``) for the live gauges (queue depth,
-    unknowns remaining, device buffer bytes), so occupancy and memory
-    are plotted against the spans that caused them.
+  * one LANE per DEVICE — device-attributed LAUNCH spans
+    (``ladder.launch``, ``sharded.launch``, ``sharded.lane_launch``;
+    the lane-shard placement stamps every member device) render once
+    per device on a ``device N`` row with a stable
+    ``thread_sort_index``, so a multi-device run reads as a per-chip
+    timeline instead of interleaved garbage (``ladder.stage`` carries
+    the attr too but stays on the ladder lane — its launches already
+    paint the device lanes);
+  * a shared **ladder** lane (tid 0) for remaining process/shared-batch
+    spans (``serve.batch``, confirmation drains) — their member trace
+    ids ride along in ``args`` so a lane's request can be found from
+    the shared span and vice versa;
+  * counter tracks (``ph: "C"``) for the live gauges (queue depth —
+    total AND one track per latency class (``serve.queue_depth.*``),
+    unknowns remaining, device buffer bytes), on their own dedicated
+    lane instead of the device lane.
 
 Timestamps are microseconds since the recording opened; the header
 ``meta`` event's ``t0`` epoch (obs.Recorder) is preserved in
-``otherData`` so traces from different processes can be aligned.
+``otherData`` so traces from different processes can be aligned, and
+``otherData.skipped_lines`` reports truncated/corrupt jsonl lines the
+tolerant reader dropped.
 
 Stdlib-only: the web UI (``GET /trace/<run>``) and
 ``tools/trace_export.py`` both import this.
@@ -30,6 +40,8 @@ import json
 from pathlib import Path
 from typing import Iterable, Mapping
 
+from jepsen_tpu.obs.critpath import span_devices as _span_devices
+
 __all__ = ["read_jsonl_events", "to_trace_events"]
 
 #: gauges worth a Perfetto counter track (point samples over time).
@@ -38,18 +50,38 @@ _COUNTER_GAUGES = {
     "ladder.unknowns_remaining",
     "device.buffer_bytes",
     "confirm.queue_latency_s",
+    "serve.rung_occupancy",
 }
 
-_DEVICE_TID = 0
+#: gauge-name prefixes that are counter-track families (one track per
+#: member name — the latency-class queue lanes).
+_COUNTER_PREFIXES = ("serve.queue_depth.",)
+
+_LADDER_TID = 0
+#: the dedicated counter-track lane.
+_COUNTER_TID = 1
+#: device lanes: tid = _DEVICE_TID_BASE + device id.
+_DEVICE_TID_BASE = 1000
+#: request lanes start here (arrival order).
+_REQUEST_TID_BASE = 2000
+
+#: span names eligible for per-device rendering (device-attributed
+#: launches; ladder.stage stays on the ladder lane — its launches
+#: already render per device and duplicating the enclosing stage would
+#: double-paint the timeline).
+_DEVICE_SPAN_NAMES = {"ladder.launch", "sharded.lane_launch",
+                      "sharded.launch"}
 
 
-def read_jsonl_events(path: Path | str) -> list[dict]:
+def read_jsonl_events(path: Path | str) -> tuple[list[dict], int]:
     """Tolerant ``telemetry.jsonl`` reader: a crashed process may leave
     the LAST line truncated mid-write — skip unparseable lines instead
-    of failing the whole stream.  Raises ``FileNotFoundError`` for a
-    missing file and ``ValueError`` when not a single line parses (a
-    clearly-not-telemetry input deserves a loud error, not an empty
-    trace)."""
+    of failing the whole stream.  Returns ``(events, skipped)`` so the
+    skip count travels with the data (``trace_summarize`` reports it on
+    stderr and as ``telemetry.skipped_lines`` in the summary).  Raises
+    ``FileNotFoundError`` for a missing file and ``ValueError`` when
+    not a single line parses (a clearly-not-telemetry input deserves a
+    loud error, not an empty trace)."""
     path = Path(path)
     text = path.read_text()
     events: list[dict] = []
@@ -72,16 +104,15 @@ def read_jsonl_events(path: Path | str) -> list[dict]:
             f"{path}: no parseable telemetry events "
             f"({skipped} malformed line(s))"
         )
-    if skipped:
-        events.append({"type": "meta", "skipped-lines": skipped})
-    return events
+    return events, skipped
 
 
 def _us(t) -> float:
     return round(float(t or 0.0) * 1e6, 1)
 
 
-def to_trace_events(events: Iterable[Mapping]) -> dict:
+def to_trace_events(events: Iterable[Mapping], *,
+                    skipped_lines: int = 0) -> dict:
     """Map a telemetry event stream to ``{"traceEvents": [...]}``
     (Chrome trace-event JSON; Perfetto-loadable)."""
     events = list(events)
@@ -90,25 +121,45 @@ def to_trace_events(events: Iterable[Mapping]) -> dict:
     out: list[dict] = [
         {"ph": "M", "name": "process_name", "pid": pid,
          "args": {"name": f"jepsen-tpu ({meta.get('host', '?')})"}},
-        {"ph": "M", "name": "thread_name", "pid": pid, "tid": _DEVICE_TID,
-         "args": {"name": "device/ladder"}},
-        # keep the device lane on top, requests below in arrival order
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": _LADDER_TID,
+         "args": {"name": "ladder/shared"}},
+        # stable ordering: ladder lane on top, then one lane per device,
+        # then the counter tracks, requests below in arrival order
         {"ph": "M", "name": "thread_sort_index", "pid": pid,
-         "tid": _DEVICE_TID, "args": {"sort_index": -1}},
+         "tid": _LADDER_TID, "args": {"sort_index": -1000}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": _COUNTER_TID,
+         "args": {"name": "counters"}},
+        {"ph": "M", "name": "thread_sort_index", "pid": pid,
+         "tid": _COUNTER_TID, "args": {"sort_index": -100}},
     ]
     lanes: dict[str, int] = {}
+    device_lanes: dict[int, int] = {}
 
     def lane_of(trace) -> int:
         """tid for one request's lane; shared (list) traces and
-        untraced events ride the device lane."""
+        untraced events ride the ladder lane."""
         if not isinstance(trace, str):
-            return _DEVICE_TID
+            return _LADDER_TID
         tid = lanes.get(trace)
         if tid is None:
-            tid = lanes[trace] = len(lanes) + 1
+            tid = lanes[trace] = _REQUEST_TID_BASE + len(lanes)
             out.append({
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                 "args": {"name": f"request {trace}"},
+            })
+        return tid
+
+    def device_lane(dev: int) -> int:
+        tid = device_lanes.get(dev)
+        if tid is None:
+            tid = device_lanes[dev] = _DEVICE_TID_BASE + dev
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": f"device {dev}"},
+            })
+            out.append({
+                "ph": "M", "name": "thread_sort_index", "pid": pid,
+                "tid": tid, "args": {"sort_index": -900 + dev},
             })
         return tid
 
@@ -116,6 +167,7 @@ def to_trace_events(events: Iterable[Mapping]) -> dict:
         et = ev.get("type")
         tr = ev.get("trace")
         if et == "span":
+            name = str(ev.get("name"))
             args = dict(ev.get("attrs") or {})
             if tr is not None:
                 args["trace"] = tr
@@ -123,17 +175,29 @@ def to_trace_events(events: Iterable[Mapping]) -> dict:
                 args["parent"] = ev["parent"]
             if ev.get("err"):
                 args["err"] = ev["err"]
-            out.append({
-                "ph": "X", "name": str(ev.get("name")), "pid": pid,
+            row = {
+                "ph": "X", "name": name, "pid": pid,
                 "tid": lane_of(tr), "ts": _us(ev.get("t")),
                 "dur": max(1.0, _us(ev.get("dur"))), "args": args,
-            })
+            }
+            devs = (_span_devices(ev)
+                    if name in _DEVICE_SPAN_NAMES else [])
+            if devs:
+                # device-attributed launches render once per member
+                # device — the per-chip timeline
+                for d in devs:
+                    out.append({**row, "tid": device_lane(d)})
+            else:
+                out.append(row)
         elif et == "gauge":
             name = str(ev.get("name"))
             v = ev.get("value")
-            if name in _COUNTER_GAUGES and isinstance(v, (int, float)):
+            track = (name in _COUNTER_GAUGES
+                     or name.startswith(_COUNTER_PREFIXES))
+            if track and isinstance(v, (int, float)):
                 out.append({
-                    "ph": "C", "name": name, "pid": pid, "tid": _DEVICE_TID,
+                    "ph": "C", "name": name, "pid": pid,
+                    "tid": _COUNTER_TID,
                     "ts": _us(ev.get("t")), "args": {"value": v},
                 })
         elif et == "event":
@@ -154,5 +218,7 @@ def to_trace_events(events: Iterable[Mapping]) -> dict:
             "host": meta.get("host"),
             "pid": meta.get("pid"),
             "requests": len(lanes),
+            "devices": len(device_lanes),
+            "skipped_lines": int(skipped_lines),
         },
     }

@@ -292,6 +292,16 @@ def make_mesh(n_devices: int | None = None, axis: str = "histories") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def mesh_device_ids(mesh: Mesh | None) -> list[int]:
+    """The device ids a placement launches on: the mesh's members in
+    lane order, or ``[0]`` (jax's default device) single-device — the
+    ONE definition behind every device-attribution site (ladder/launch
+    span attrs, the lane-shard wrapper, the serve bubble gauge)."""
+    if mesh is None:
+        return [0]
+    return [int(d.id) for d in mesh.devices.ravel().tolist()]
+
+
 def _stack(packs: list[dict], B: int, P: int, G: int) -> dict:
     padded = [wgl.pad_packed(p, B=B, P=P, G=G) for p in packs]
     out = {}
@@ -608,6 +618,12 @@ def batch_analysis(
             # lose the ladder; the verdict still lands in the return list
             logger.exception("rung-admission on_result failed (history %d)", i)
 
+    #: device ids every launch of this ladder runs on (lane-sharded
+    #: over the mesh, or jax's default device) — the device-attribution
+    #: attr on ladder.launch/ladder.stage spans that obs.critpath's
+    #: per-device timeline and the Perfetto device lanes read.
+    _dev_ids = mesh_device_ids(mesh)
+
     #: per-stage launch accounting for the telemetry stage table; "_key"
     #: is the launched (engine, shape) bucket, set at each runner site.
     launch_acc: dict = {}
@@ -631,7 +647,8 @@ def batch_analysis(
         excluded from the watchdog's launch-time EWMA baseline
         (faults.record_launch_seconds)."""
         with obs.span(
-            "ladder.launch", engine=st_engine, capacity=batch_cap, lanes=len(sub)
+            "ladder.launch", engine=st_engine, capacity=batch_cap,
+            lanes=len(sub), devices=_dev_ids,
         ) as sp:
             t0 = time.perf_counter()
             out = _launch_impl(st_engine, batch_cap, sub, sub_resumes, pad_to)
@@ -819,6 +836,7 @@ def batch_analysis(
                       at="ladder-stage", stage=stage_attrs.get("stage"))
         obs.span_event(
             "ladder.stage", time.perf_counter() - t_stage,
+            devices=_dev_ids,
             launches=launch_acc["launches"],
             compile_launches=launch_acc["compile_launches"],
             compile_s=round(launch_acc["compile_s"], 6),

@@ -27,6 +27,7 @@ Reference seam: jepsen drives knossos thread-parallel inside one JVM
 from __future__ import annotations
 
 import functools
+import time
 from typing import Sequence
 
 import numpy as np
@@ -35,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jepsen_tpu import _platform
+from jepsen_tpu import _platform, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.ops import wgl
 from jepsen_tpu.ops.hashing import frontier_update, hash_rows
@@ -235,10 +236,33 @@ def lane_shard(fn, mesh: Mesh, *, n_args: int, replicated: Sequence[int] = (),
         out_specs = (
             tuple(P(axis) for _ in range(n_out)) if n_out > 1 else P(axis)
         )
-        _LANE_SHARDED[key] = jax.jit(_platform.shard_map(
+        compiled = jax.jit(_platform.shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         ))
+        from jepsen_tpu.parallel.batch import mesh_device_ids
+
+        dev_ids = mesh_device_ids(mesh)
+
+        def wrapper(*args, _compiled=compiled, _devs=dev_ids):
+            # Device-attributed placement telemetry: every lane-sharded
+            # dispatch stamps its member devices so the per-device
+            # timeline (obs.critpath.device_timeline) and the Perfetto
+            # device lanes can attribute the work.  One module-attr
+            # read when telemetry is off.  The observed path BLOCKS on
+            # the outputs: jax dispatch is async, and a span that
+            # closed at dispatch would record microseconds for a
+            # seconds-long launch — busy_frac ≈ 0 on a real chip, the
+            # exact number the timeline exists to get right.
+            if not obs.observing():
+                return _compiled(*args)
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(_compiled(*args))
+            obs.span_event("sharded.lane_launch", time.perf_counter() - t0,
+                           devices=_devs)
+            return out
+
+        _LANE_SHARDED[key] = wrapper
     return _LANE_SHARDED[key]
 
 
@@ -301,21 +325,28 @@ def sharded_analysis(
 
     capacities = [capacity] if isinstance(capacity, int) else list(capacity)
     result = None
+    from jepsen_tpu.parallel.batch import mesh_device_ids
+
+    dev_ids = mesh_device_ids(mesh)
     for cap in capacities:
         Fl = max(8, (int(cap) + D - 1) // D)
         runner = _sharded_runner(
             mesh, packed["step"], Fl, int(rounds), packed["P"], packed["G"], packed["W"]
         )
-        valid, failed_at, lossy, peak = runner(
-            packed["init_state"],
-            packed["bar_active"],
-            *packed["bar"],
-            *packed["mov"],
-            *packed["grp"],
-            packed["grp_open"],
-            jnp.asarray(packed["slot_lane"]),
-            jnp.asarray(packed["slot_onehot"]),
-        )
+        with obs.span("sharded.launch", devices=dev_ids, capacity=Fl * D):
+            valid, failed_at, lossy, peak = runner(
+                packed["init_state"],
+                packed["bar_active"],
+                *packed["bar"],
+                *packed["mov"],
+                *packed["grp"],
+                packed["grp_open"],
+                jnp.asarray(packed["slot_lane"]),
+                jnp.asarray(packed["slot_onehot"]),
+            )
+            # block INSIDE the span: dispatch is async, and the span
+            # must cover device execution, not the enqueue
+            jax.block_until_ready((valid, failed_at, lossy, peak))
         valid = bool(valid)
         failed_at = int(failed_at)
         lossy = bool(lossy)

@@ -61,6 +61,7 @@ from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
 from jepsen_tpu.obs import metrics
 from jepsen_tpu.serve import health as _health
+from jepsen_tpu.serve import slo as _slo
 from jepsen_tpu.serve.sched import admission as _sched_adm
 from jepsen_tpu.serve.sched import packing as _sched_pack
 from jepsen_tpu.serve.sched import placement as _sched_place
@@ -158,6 +159,7 @@ class CheckRequest:
     __slots__ = (
         "id", "seq", "model", "history", "priority", "deadline", "client",
         "group", "future", "status", "result", "t_submit", "t_done",
+        "t_start", "t_launch", "t_launch_end",
         "trace_id", "ctx", "tier", "kind", "checker", "escalated", "fp",
     )
 
@@ -186,6 +188,13 @@ class CheckRequest:
         self.result: dict | None = None
         self.t_submit = time.monotonic()
         self.t_done: float | None = None
+        # Lifecycle stamps for the per-request latency decomposition
+        # (the "latency" block on results and GET /check/<id>):
+        # picked out of the class queue / joined at a rung boundary,
+        # the shared launch began, the shared launch returned.
+        self.t_start: float | None = None
+        self.t_launch: float | None = None
+        self.t_launch_end: float | None = None
         # The request's trace identity + the admission thread's span
         # context, captured HERE so the scheduler thread's demux events
         # re-attach to it (obs.attach) — parent links and the trace id
@@ -213,7 +222,47 @@ class CheckRequest:
             out["result"] = self.result
         if self.t_done is not None:
             out["latency_s"] = round(self.t_done - self.t_submit, 6)
+            out["latency"] = self.latency()
         return out
+
+    def latency(self) -> dict:
+        """The per-request latency decomposition block: class-queue
+        wait, packing/placement overhead, shared-launch residence, the
+        confirm/demux tail after the launch returned, and the residual
+        (``other_s``).  A request that never reached a launch (queue
+        expiry, quarantine hit, trivial fast path) attributes its whole
+        lifetime to ``queue_s`` — it spent it queued.  The stages sum
+        to ``total_s`` exactly — the live counterpart of
+        ``obs.critpath.decompose_requests`` over the recorded spans
+        (expiry emits a ``serve.admission`` span so the two agree)."""
+        done = self.t_done if self.t_done is not None else time.monotonic()
+        total = max(0.0, done - self.t_submit)
+        pack = launch = confirm = 0.0
+        # never picked out of the queue (expired / drained / quarantine):
+        # the whole lifetime was queue wait
+        queue = total
+        if self.t_start is not None:
+            queue = min(total, max(0.0, self.t_start - self.t_submit))
+            t_launch = self.t_launch if self.t_launch is not None \
+                else self.t_start
+            pack = max(0.0, min(t_launch, done) - self.t_start)
+            if self.t_launch is not None:
+                l_end = min(done, self.t_launch_end
+                            if self.t_launch_end is not None else done)
+                launch = max(0.0, l_end - self.t_launch)
+                confirm = max(0.0, done - max(self.t_launch, l_end))
+        other = total - (queue + pack + launch + confirm)
+        if other < -1e-9:
+            launch = max(0.0, launch + other)
+            other = 0.0
+        return {
+            "queue_s": round(queue, 6),
+            "pack_s": round(pack, 6),
+            "launch_s": round(launch, 6),
+            "confirm_s": round(confirm, 6),
+            "other_s": round(max(0.0, other), 6),
+            "total_s": round(total, 6),
+        }
 
     def resolve(self, result: dict, status: str = "done") -> bool:
         """Resolve the future once; later attempts are no-ops (a zombie
@@ -223,9 +272,13 @@ class CheckRequest:
         it."""
         if self.future.done():
             return False
-        self.result = result
         self.status = status
         self.t_done = time.monotonic()
+        # Every settled result carries the per-request latency
+        # decomposition (satellite contract: CheckFuture.result() and
+        # GET /check/<id> expose the same block).
+        result = {**result, "latency": self.latency()}
+        self.result = result
         try:
             self.future.set_result(result)
         except Exception:  # noqa: BLE001 — lost the race; first write won
@@ -300,6 +353,9 @@ class CheckService:
         watchdog_floor_s: float = 30.0,
         watchdog_cap_s: float = 600.0,
         health_probe_every_s: float | None = None,
+        slo_specs=None,
+        slo_fast_window_s: float = _slo.FAST_WINDOW_S,
+        slo_slow_window_s: float = _slo.SLOW_WINDOW_S,
         **check_opts,
     ):
         for k in ("capacity", "mesh", "deadline", "checkpoint_dir", "resume",
@@ -368,6 +424,16 @@ class CheckService:
         )
         self.health_probe_every_s = health_probe_every_s
         self._t_probe = 0.0                      # guarded-by: _lock [rw]
+        # -- the live SLO burn-rate engine (serve.slo) -------------------
+        #: ``slo_specs``: a spec list, an --slo-file path, or None (the
+        #: built-in defaults).  Evaluated from the scheduler loop (at
+        #: most once per _SLO_EVAL_S) and from every step(); GET /alerts
+        #: and the serve_slo_burn_rate{slo=,window=} gauges read it.
+        self.slo = _slo.SloEngine(
+            slo_specs, fast_window_s=slo_fast_window_s,
+            slow_window_s=slo_slow_window_s,
+        )
+        self._t_slo = 0.0                        # guarded-by: _lock [rw]
         self._recovered = False  # start()-serialized (pre-thread)
         # per-batch occupancy accumulator
         self._occ_sum = 0.0                      # guarded-by: _lock [rw]
@@ -618,7 +684,7 @@ class CheckService:
                 self._cond.notify_all()
             with obs.attach(req.ctx):
                 obs.counter("serve.submitted", client=client, tier=tier)
-                obs.gauge("serve.queue_depth", self._adm.depth())
+                self._gauge_queue_depth()
         if group is None:
             # Trivial fast path: no barriers -> valid, no lanes spent.
             # Resolved OUTSIDE the lock: set_result runs done-callbacks
@@ -661,6 +727,15 @@ class CheckService:
         """Back-compat backpressure hint (batch tier)."""
         with self._lock:
             return self._adm.retry_after("batch", self.max_batch)
+
+    # holds: _lock
+    def _gauge_queue_depth(self) -> None:
+        """Queue-depth gauges: the shared total plus one series per
+        latency class (``serve.queue_depth.<tier>``) — the per-class
+        Perfetto counter lanes and the live registry read these."""
+        obs.gauge("serve.queue_depth", self._adm.depth())
+        for tier in _sched_adm.CLASSES:
+            obs.gauge("serve.queue_depth." + tier, self._adm.depth(tier))
 
     # holds: _lock
     def _remember(self, req: CheckRequest) -> None:
@@ -768,13 +843,42 @@ class CheckService:
         if self.journal is not None and r.kind == "ladder":
             self.journal.resolve(r.id)
 
+    #: minimum seconds between SLO evaluations (loop ticks AND step():
+    #: a busy scheduler cycling at ms scale must not pay a full
+    #: evaluation per cycle; within one step, members settle BEFORE the
+    #: evaluation, so the first evaluation after a batch already sees
+    #: its latencies — step-driven tests stay deterministic).
+    _SLO_EVAL_S = 1.0
+
+    def _maybe_eval_slo(self) -> None:
+        """Throttled SLO evaluation for the scheduler loop: the burn
+        windows are minutes wide, so sub-second sampling buys nothing —
+        but an IDLE service must keep evaluating (a breach's burn rate
+        decays back under threshold only if samples keep arriving)."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._t_slo < self._SLO_EVAL_S:
+                return
+            self._t_slo = now
+        try:
+            self.slo.evaluate()
+        except Exception:  # noqa: BLE001 — a broken spec must not take
+            logger.exception("SLO evaluation failed")  # down the scheduler
+
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while self._adm.depth() == 0 and not self._stop.is_set():
+                if self._adm.depth() == 0 and not self._stop.is_set():
+                    # bounded wait, then fall out to the SLO tick below
+                    # (an idle service still samples its objectives)
                     self._cond.wait(timeout=0.2)
-                if self._stop.is_set():
-                    return
+                stopping = self._stop.is_set()
+                idle = self._adm.depth() == 0
+            if stopping:
+                return
+            self._maybe_eval_slo()
+            if idle:
+                continue
             if self.batch_window_s > 0:
                 # The pile-in window: let concurrent submitters coalesce
                 # into this batch instead of each paying its own launch.
@@ -824,13 +928,23 @@ class CheckService:
         handled += self._step_graphs()
         handled += self._interactive_wave()
         handled += self._step_batch()
+        self._maybe_eval_slo()
         return handled
 
     def _resolve_expired(self, expired: list[CheckRequest]) -> None:
         # Expired futures resolve outside the lock (done-callbacks may
         # re-enter the service); the shared batch is untouched.
+        t_now = time.monotonic()
         for r in expired:
             with obs.attach(r.ctx):
+                # the whole lifetime WAS queue wait — record it as an
+                # admission span so the offline decomposition
+                # (critpath.decompose_requests) attributes it the same
+                # way the live latency block does
+                obs.span_event(
+                    "serve.admission", t_now - r.t_submit,
+                    client=r.client, tier=r.tier, expired=True,
+                )
                 obs.counter("serve.expired", client=r.client, tier=r.tier)
             metrics.inc("serve.verdicts", verdict="unknown")
             r.resolve(
@@ -843,6 +957,14 @@ class CheckService:
                 },
                 status="expired",
             )
+            with obs.attach(r.ctx):
+                # the end-to-end span every settled request gets — an
+                # expired lifecycle must decompose offline too
+                obs.span_event(
+                    "serve.request", r.t_done - r.t_submit,
+                    client=r.client, verdict="unknown", tier=r.tier,
+                    expired=True,
+                )
             self._journal_done(r)
 
     # -- graph side lane ---------------------------------------------------
@@ -862,8 +984,10 @@ class CheckService:
                 if r.kind == "graph"
             ]
             self._adm.remove(gq)
+            t_pick = time.monotonic()
             for r in gq:
                 r.status = "running"
+                r.t_start = t_pick
             self._sync_graph_depth()
         groups: dict[tuple, list[CheckRequest]] = {}
         for r in gq:
@@ -918,6 +1042,8 @@ class CheckService:
         if len(rs) > 1 and hasattr(chk, "check_batch"):
             trace_ids = [r.trace_id for r in rs]
             t0 = time.monotonic()
+            for r in rs:
+                r.t_launch = t0
             try:
                 with obs.attach(trace=trace_ids, parent="serve.graph_batch"):
                     with obs.span(
@@ -949,12 +1075,15 @@ class CheckService:
         with self._lock:
             self._totals["graphs"] += len(rs)
         obs.counter("serve.graphs", len(rs))
+        t_end = time.monotonic()
         for r, res in zip(rs, results):
+            r.t_launch_end = t_end
             self._settle_member(r, res)
 
     def _run_graph(self, r: CheckRequest) -> None:
         from jepsen_tpu import checker as _checker
 
+        r.t_launch = time.monotonic()
         with obs.attach(r.ctx):
             with obs.span(
                 "serve.graph", checker=type(r.checker).__name__,
@@ -970,6 +1099,7 @@ class CheckService:
         with self._lock:
             self._totals["graphs"] += 1
         obs.counter("serve.graphs")
+        r.t_launch_end = time.monotonic()
         self._settle_member(r, res)
 
     # -- interactive fast path ---------------------------------------------
@@ -1003,9 +1133,10 @@ class CheckService:
             for r in wave:
                 r.status = "running"
             self._inflight.extend(wave)
-            obs.gauge("serve.queue_depth", self._adm.depth())
+            self._gauge_queue_depth()
         t0 = time.monotonic()
         for r in wave:
+            r.t_start = t0
             with obs.attach(r.ctx):
                 obs.span_event(
                     "serve.admission", t0 - r.t_submit, client=r.client,
@@ -1018,7 +1149,11 @@ class CheckService:
         with _sched_adm.WaveTimer(self._adm, "interactive"):
             with obs.span(
                 "serve.fastpath", requests=len(wave), engine="host-greedy",
+                trace_ids=[r.trace_id for r in wave],
             ) as sp:
+                t_walk = time.monotonic()
+                for r in wave:
+                    r.t_launch = t_walk
                 flags = []
                 for r in wave:
                     try:
@@ -1031,6 +1166,9 @@ class CheckService:
                         flags.append(False)
                 sp.set(resolved=sum(flags),
                        escalated=len(wave) - sum(flags))
+        t_wave_end = time.monotonic()
+        for r in wave:
+            r.t_launch_end = t_wave_end
         resolved = 0
         for r, ok in zip(wave, flags):
             if ok:
@@ -1041,6 +1179,9 @@ class CheckService:
                 self._settle_member(r, {"valid?": True, "fastpath": "greedy"})
             else:
                 r.escalated = True
+                # the fast-path stamps are void — the batch tier will
+                # re-stamp the ladder lifecycle it actually rides
+                r.t_start = r.t_launch = r.t_launch_end = None
                 with self._cond:
                     self._inflight.remove(r)
                     r.status = "queued"
@@ -1075,9 +1216,10 @@ class CheckService:
             for r in batch_reqs:
                 r.status = "running"
             self._inflight.extend(batch_reqs)
-            obs.gauge("serve.queue_depth", self._adm.depth())
+            self._gauge_queue_depth()
         t_start = time.monotonic()
         for r in batch_reqs:
+            r.t_start = t_start
             # Re-attach each request's admission-thread context: the
             # scheduler thread's per-request events carry the request's
             # trace id, not the scheduler's.
@@ -1181,9 +1323,12 @@ class CheckService:
                 r.status = "running"
             self._inflight.extend(joiners)
             if joiners:
-                obs.gauge("serve.queue_depth", self._adm.depth())
+                self._gauge_queue_depth()
         t = time.monotonic()
         for r in joiners:
+            # a joiner enters the RUNNING launch at its join boundary:
+            # queue wait ends and launch residence begins here
+            r.t_start = r.t_launch = t
             with obs.attach(r.ctx):
                 obs.span_event(
                     "serve.admission", t - r.t_submit, client=r.client,
@@ -1297,6 +1442,8 @@ class CheckService:
                 trace_ids=trace_ids, continuous=feeder is not None,
             ) as sp:
                 t0 = time.monotonic()
+                for r in batch_reqs:
+                    r.t_launch = t0
 
                 def _launch():
                     # The serve-level fault-injection seam: unlike the
@@ -1355,6 +1502,21 @@ class CheckService:
                         continuous_occupancy=feeder.mean_occupancy,
                     )
         members = list(feeder.members) if feeder is not None else batch_reqs
+        t_launch_end = time.monotonic()
+        for r in members:
+            if r.t_launch_end is None:
+                r.t_launch_end = t_launch_end
+        # Per-device bubble attribution: lanes shard contiguously over
+        # the placement, so device k's live-lane count (and with it the
+        # padded-slot bubble) is computable without a device round-trip.
+        # On a single device this is exactly 1 − occupancy — the
+        # identity the acceptance gate (and loadgen) assert.
+        dev_ids = batch.mesh_device_ids(mesh)
+        shard = max(1, n_pad // len(dev_ids))
+        for k, did in enumerate(dev_ids):
+            live = min(max(0, n - k * shard), shard)
+            metrics.set_gauge("serve.device_bubble_ratio",
+                              round(1.0 - live / shard, 4), device=str(did))
         metrics.observe("serve.batch_seconds", dt)
         with self._lock:
             # The batch-tier retry-after quotes SLOT-RECYCLE cadence: a

@@ -18,9 +18,14 @@ the check API:
                      202 + request id + trace id, 200 + result with
                      "wait": true, 429 + Retry-After on backpressure
                      (the estimate is computed per latency class)
-  GET  /check/<id>   request status / result (includes the trace_id)
+  GET  /check/<id>   request status / result (includes the trace_id and
+                     the per-request "latency" decomposition block)
   GET  /queue        queue-status JSON incl. per-class queue depths and
                      retry-after EWMAs (the home page shows a panel)
+  GET  /alerts       the live SLO burn-rate engine's alert document
+                     (jepsen_tpu.serve.slo): firing alerts + the
+                     per-objective fast/slow-window burn table (the
+                     home page shows a panel)
 
 Oversized ``POST /check`` bodies are rejected 413 BEFORE the JSON parse
 (``make_server(..., max_request_mb=)`` / ``serve --max-request-mb``) so
@@ -232,6 +237,40 @@ def queue_panel_html(service) -> str:
     )
 
 
+def slo_panel_html(service) -> str:
+    """The home page's SLO burn-rate panel: one row per objective with
+    its fast/slow-window burn and alert state (firing rows red)."""
+    if service is None or getattr(service, "slo", None) is None:
+        return ""
+    doc = service.slo.alerts()
+    if not doc["slos"]:
+        return ""
+    rows = ""
+    for r in doc["slos"]:
+        color = {"firing": "#FFAA26", "no-data": "#eee"}.get(r["state"], "")
+        style = f" style='background:{color}'" if color else ""
+        rows += (
+            f"<tr{style}><td>{html.escape(r['slo'])}</td>"
+            f"<td>{html.escape(r['kind'])}</td>"
+            f"<td>{r['target']}</td>"
+            f"<td>{r['burn_fast']}</td><td>{r['burn_slow']}</td>"
+            f"<td>{html.escape(r['state'])}</td></tr>"
+        )
+    firing = len(doc["alerts"])
+    head = (f"{firing} alert(s) FIRING" if firing else "all objectives ok")
+    return (
+        "<h2>SLO burn rates</h2>"
+        f"<p>{head} — <a href='/alerts'>alerts JSON</a> "
+        f"(fast window {doc['fast_window_s']:.0f}s, slow "
+        f"{doc['slow_window_s']:.0f}s; burn 1.0 = eating budget exactly "
+        "as fast as allowed)</p>"
+        "<table style='border:1px solid #ddd'>"
+        "<tr><th>slo</th><th>kind</th><th>target</th>"
+        "<th>burn (fast)</th><th>burn (slow)</th><th>state</th></tr>"
+        + rows + "</table>"
+    )
+
+
 def metrics_panel_html() -> str:
     """The home page's live-metrics panel: the current Prometheus text,
     self-refreshing via a tiny fetch loop (the server-rendered snapshot
@@ -275,6 +314,7 @@ def home_html(store_dir=None, check_service=None) -> str:
         "td,th{padding:4px 12px;text-align:left}</style></head><body>"
         "<h1>jepsen-tpu results</h1>"
         + queue_panel_html(check_service)
+        + slo_panel_html(check_service)
         + metrics_panel_html()
         + "<p><a href='/suite'>suite overview</a> — "
         "<a href='/perf'>perf trajectory</a></p>"
@@ -527,6 +567,17 @@ def telemetry_html(run_dir: Path, rel: str | None = None) -> str:
               r.get("execute_s", ""), r.get("peak_frontier", ""),
               r.get("lossy", ""), r.get("dedup", ""),
               _mb(r.get("device_bytes_peak"))] for r in s["ladder"]],
+        ))
+    if s.get("critpath", {}).get("spans"):
+        cp = s["critpath"]
+        parts.append(
+            f"<h3>critical path ({cp.get('total_s', 0)} s on-path of "
+            f"{cp.get('wall_s', 0)} s wall)</h3>")
+        parts.append(_telemetry_table(
+            ["span", "critpath (s)", "inclusive (s)", "count", "slack (s)"],
+            [[r.get("span"), r.get("cp_s"), r.get("total_s"),
+              r.get("count"), r.get("slack_s")]
+             for r in cp["spans"]],
         ))
     if s.get("dedup"):
         parts.append("<h3>dedup rounds (sort vs bucket probe)</h3>")
@@ -798,13 +849,14 @@ class Handler(BaseHTTPRequestHandler):
                     self._send(404, b"not found")
                 else:
                     try:
-                        events = obs_trace.read_jsonl_events(jsonl)
+                        events, skipped = obs_trace.read_jsonl_events(jsonl)
                     except (OSError, ValueError) as e:
                         self._send_json(500, {"error": f"unreadable "
                                                        f"telemetry: {e}"})
                         return
                     body = json.dumps(
-                        obs_trace.to_trace_events(events),
+                        obs_trace.to_trace_events(
+                            events, skipped_lines=skipped),
                         separators=(",", ":"), default=str,
                     ).encode()
                     self._send(
@@ -825,6 +877,16 @@ class Handler(BaseHTTPRequestHandler):
                     self._send_json(503, {"error": "no check service mounted"})
                 else:
                     self._send_json(200, self.check_service.stats())
+            elif path == "/alerts":
+                # The live SLO burn-rate engine's alert document:
+                # currently-firing alerts plus the full per-SLO burn
+                # table (fast/slow windows) — loadgen's acceptance
+                # gates and operators' pagers both read this.
+                svc = self.check_service
+                if svc is None or getattr(svc, "slo", None) is None:
+                    self._send_json(503, {"error": "no check service mounted"})
+                else:
+                    self._send_json(200, svc.slo.alerts())
             elif path.startswith("/check/"):
                 if self.check_service is None:
                     self._send_json(503, {"error": "no check service mounted"})

@@ -1,0 +1,522 @@
+"""Flight-analyzer tests (jepsen_tpu.obs.critpath + jepsen_tpu.serve.slo):
+per-request latency decomposition (synthetic + live service, including
+membership churn: a rung-join, a device-loss shrink, a graph-lane
+batch), span critical-path extraction, per-device bubble attribution,
+and the SLO burn-rate engine.
+
+Kernel shapes are shared with tests/test_serve*.py — (30, 3) register
+histories at capacity (64, 256) — so every launch here re-hits runner
+caches the suite already paid to compile (tier-1 budget is tight)."""
+
+import pathlib
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import faults, obs
+from jepsen_tpu import models as m
+from jepsen_tpu import serve as sv
+from jepsen_tpu.obs import critpath as cp
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs.trace import read_jsonl_events
+from jepsen_tpu.serve import slo as slo_mod
+
+#: the suite-shared ladder (same shapes as test_parallel/test_serve).
+KW = dict(capacity=(64, 256), warm_pool=False)
+
+
+def mixed_histories(n=6):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(30, 3, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+def _stages_sum(row):
+    return (row["queue_s"] + row["pack_s"] + row["launch_s"]
+            + row["confirm_s"] + row["other_s"])
+
+
+def _assert_reconciles(decomp, tol=0.05):
+    assert decomp, "expected at least one decomposed request"
+    for tid, row in decomp.items():
+        total = row["total_s"]
+        assert abs(_stages_sum(row) - total) <= max(1e-5, tol * total), (
+            tid, row)
+        assert all(row[k] >= 0 for k in
+                   ("queue_s", "pack_s", "launch_s", "confirm_s",
+                    "other_s", "total_s")), (tid, row)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic streams: exact, hand-checkable answers
+# ---------------------------------------------------------------------------
+
+
+def test_decompose_synthetic_exact():
+    events = [
+        {"type": "span", "name": "serve.admission", "t": 0.0, "dur": 0.1,
+         "trace": "r1", "attrs": {"tier": "batch"}},
+        {"type": "span", "name": "serve.batch", "t": 0.12, "dur": 0.5,
+         "trace": ["r1", "r2"], "attrs": {"trace_ids": ["r1", "r2"]}},
+        # r1 outlives the batch by 0.08 (confirmation tail)
+        {"type": "span", "name": "serve.request", "t": 0.0, "dur": 0.7,
+         "trace": "r1", "attrs": {"tier": "batch", "verdict": "False"}},
+        # r2 joined late (admission ends inside the running batch) and
+        # resolved mid-ladder (early demux)
+        {"type": "span", "name": "serve.admission", "t": 0.2, "dur": 0.1,
+         "trace": "r2", "attrs": {"tier": "batch", "joined_at_rung": 1}},
+        {"type": "span", "name": "serve.request", "t": 0.2, "dur": 0.3,
+         "trace": "r2", "attrs": {"tier": "batch", "verdict": "True"}},
+        # r3 never launched (expired in queue)
+        {"type": "span", "name": "serve.request", "t": 0.0, "dur": 0.4,
+         "trace": "r3", "attrs": {"tier": "batch", "verdict": "unknown"}},
+    ]
+    d = cp.decompose_requests(events)
+    _assert_reconciles(d, tol=0.0)
+    r1 = d["r1"]
+    assert r1["queue_s"] == pytest.approx(0.1)
+    assert r1["pack_s"] == pytest.approx(0.02)
+    assert r1["launch_s"] == pytest.approx(0.5)
+    assert r1["confirm_s"] == pytest.approx(0.08)
+    assert r1["launch_span"] == "serve.batch"
+    assert r1["verdict"] == "False"
+    r2 = d["r2"]
+    assert r2["queue_s"] == pytest.approx(0.1)
+    assert r2["pack_s"] == pytest.approx(0.0)   # joined a RUNNING batch
+    assert r2["launch_s"] == pytest.approx(0.2)
+    r3 = d["r3"]
+    assert r3["launch_span"] is None
+    assert r3["other_s"] == pytest.approx(0.4)  # nothing attributable
+    # the text renderer shows every request
+    txt = cp.format_requests(d)
+    assert "r1" in txt and "r3" in txt
+
+
+def test_critical_path_synthetic_chain_and_slack():
+    """A known fork-join: the path must follow the LONG arm, charge
+    nested spans as self time (never double-count), stay ≤ wall clock,
+    and give the short arm slack."""
+    events = [
+        {"type": "span", "name": "stage.a", "t": 0.0, "dur": 1.0,
+         "thread": 1},
+        # two parallel arms on their own threads; the long one bounds
+        # stage.a (cross-thread: siblings, never nested in each other)
+        {"type": "span", "name": "arm.long", "t": 0.1, "dur": 0.8,
+         "thread": 2},
+        {"type": "span", "name": "arm.short", "t": 0.1, "dur": 0.4,
+         "thread": 3},
+        # the tail: starts before stage.a ends, ends last
+        {"type": "span", "name": "drain.tail", "t": 0.9, "dur": 0.6,
+         "thread": 1},
+    ]
+    c = cp.critical_path(events)
+    assert c["wall_s"] == pytest.approx(1.5)
+    assert c["total_s"] <= c["wall_s"] + 1e-9
+    by = c["by_span"]
+    # arm.long is stage.a's nested hot region: charged to arm.long,
+    # stage.a keeps only its uncovered self time
+    assert by["arm.long"]["cp_s"] == pytest.approx(0.8)
+    assert by["stage.a"]["cp_s"] == pytest.approx(0.1)
+    assert by["drain.tail"]["cp_s"] == pytest.approx(0.6)
+    # the top critical-path span is the dominant region
+    assert next(iter(by)) == "arm.long"
+    # the dominated parallel arm is off the path, with positive slack
+    assert "arm.short" not in {seg["span"] for seg in c["path"]}
+    assert c["slack"]["arm.short"] == pytest.approx(0.4)
+    # per-request measurement spans never steal the path
+    c2 = cp.critical_path(events + [
+        {"type": "span", "name": "serve.request", "t": 0.0, "dur": 1.5,
+         "trace": "r"}])
+    assert "serve.request" not in c2["by_span"]
+    assert cp.format_critpath(c).startswith("critical path:")
+    # µs-quantization slop: a launch whose ROUNDED end exceeds its
+    # enclosing stage's rounded end by 1 µs is still nested, not a
+    # concurrent root that steals the stage's whole self time
+    c3 = cp.critical_path([
+        {"type": "span", "name": "stage", "t": 0.0, "dur": 0.099999,
+         "thread": 1},
+        {"type": "span", "name": "launch", "t": 0.000001, "dur": 0.099999,
+         "thread": 1},
+    ])
+    assert c3["by_span"]["launch"]["cp_s"] == pytest.approx(0.0999, abs=1e-3)
+    assert c3["by_span"]["stage"]["cp_s"] < 0.001
+
+
+def test_device_timeline_busy_idle_and_imbalance():
+    events = [
+        {"type": "span", "name": "ladder.launch", "t": 0.0, "dur": 0.6,
+         "attrs": {"devices": [0, 1]}},
+        # device 0 gets extra (overlapping) work: union, not sum
+        {"type": "span", "name": "ladder.launch", "t": 0.4, "dur": 0.6,
+         "attrs": {"devices": [0]}},
+        {"type": "span", "name": "sharded.lane_launch", "t": 0.5, "dur": 0.2,
+         "attrs": {"devices": [0]}},
+    ]
+    tl = cp.device_timeline(events)
+    assert tl["window_s"] == pytest.approx(1.0)
+    d0, d1 = tl["devices"][0], tl["devices"][1]
+    assert d0["busy_s"] == pytest.approx(1.0)   # overlap unioned
+    assert d1["busy_s"] == pytest.approx(0.6)
+    for row in (d0, d1):
+        assert row["busy_frac"] + row["idle_frac"] == pytest.approx(1.0)
+    assert tl["imbalance"] == pytest.approx(0.4)
+    assert tl["bubble_ratio"] == pytest.approx(0.2)
+    assert "device" in cp.format_devices(tl)
+    # no device-attributed spans: explicit empty shape, never a crash
+    empty = cp.device_timeline([{"type": "span", "name": "x", "t": 0,
+                                 "dur": 1}])
+    assert empty["devices"] == {} and empty["bubble_ratio"] is None
+
+
+# ---------------------------------------------------------------------------
+# The SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_engine_latency_breach_fires_and_recovers():
+    reg = obs_metrics.Registry()
+    eng = slo_mod.SloEngine(
+        [{"name": "p95", "kind": "latency", "metric": "lat",
+          "threshold_s": 0.05, "target": 0.95}],
+        registry=reg, fast_window_s=60, slow_window_s=600,
+    )
+    # no traffic yet: no-data, never firing
+    rows = eng.evaluate(now=0.0)
+    assert rows[0]["state"] == "no-data"
+    assert eng.alerts()["alerts"] == []
+    # healthy traffic: 100 fast requests
+    for _ in range(100):
+        reg.observe("lat", 0.01)
+    rows = eng.evaluate(now=1.0)
+    assert rows[0]["state"] == "ok" and rows[0]["burn_fast"] == 0.0
+    # breach: half the new requests are slow -> bad_frac 0.5 over a
+    # 0.05 budget -> burn 10, both windows (short history) -> FIRING
+    for _ in range(50):
+        reg.observe("lat", 0.2)
+        reg.observe("lat", 0.01)
+    rows = eng.evaluate(now=2.0)
+    assert rows[0]["state"] == "firing"
+    assert rows[0]["burn_fast"] > 1.0 and rows[0]["burn_slow"] > 1.0
+    doc = eng.alerts()
+    assert [a["slo"] for a in doc["alerts"]] == ["p95"]
+    # recovery: the fast window slides past the breach while healthy
+    # traffic keeps arriving -> burn decays, alert clears
+    for t in range(3, 75):
+        reg.observe("lat", 0.01)
+        rows = eng.evaluate(now=float(t))
+    assert rows[0]["burn_fast"] < 1.0
+    assert rows[0]["state"] == "ok"
+
+
+def test_slo_engine_ratio_gauge_floor_and_specs():
+    reg = obs_metrics.Registry()
+    eng = slo_mod.SloEngine(
+        [{"name": "deadline", "kind": "ratio", "bad": "serve.expired",
+          "total": "serve.submitted", "target": 0.9},
+         {"name": "occ", "kind": "gauge_floor",
+          "metric": "serve.continuous_occupancy", "floor": 0.5,
+          "target": 0.5}],
+        registry=reg, fast_window_s=60, slow_window_s=600,
+    )
+    reg.inc("serve.submitted", 10)
+    reg.set("serve.continuous_occupancy", 0.9)
+    rows = eng.evaluate(now=0.0)
+    assert {r["state"] for r in rows} == {"ok"}
+    # 5 of the next 10 submissions expire: bad_frac 0.5 / budget 0.1
+    reg.inc("serve.submitted", 10)
+    reg.inc("serve.expired", 5)
+    # occupancy collapses below the floor on every sample
+    reg.set("serve.continuous_occupancy", 0.2)
+    for t in (1.0, 2.0, 3.0):
+        rows = eng.evaluate(now=t)
+    by = {r["slo"]: r for r in rows}
+    assert by["deadline"]["state"] == "firing"
+    assert by["occ"]["state"] == "firing"
+    # spec validation is loud
+    with pytest.raises(ValueError):
+        slo_mod.SloEngine([{"name": "x", "kind": "nope"}])
+    with pytest.raises(ValueError):
+        slo_mod.SloEngine([{"name": "x", "kind": "ratio", "bad": "b",
+                            "total": "t", "target": 1.5}])
+
+
+def test_slo_file_merges_over_defaults(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text(
+        '[{"name": "interactive-p50", "kind": "latency",'
+        ' "metric": "serve.class_request_latency_seconds",'
+        ' "labels": {"tier": "interactive"},'
+        ' "threshold_s": 0.5, "target": 0.5},'
+        ' {"name": "extra", "kind": "ratio", "bad": "serve.expired",'
+        ' "total": "serve.submitted", "target": 0.99}]'
+    )
+    specs = {s["name"]: s for s in slo_mod.load_specs(p)}
+    assert specs["interactive-p50"]["threshold_s"] == 0.5  # replaced
+    assert "extra" in specs
+    assert "occupancy-floor" in specs  # defaults retained
+    eng = slo_mod.SloEngine(p)
+    assert {s["name"] for s in eng.specs} >= {"interactive-p50", "extra"}
+
+
+# ---------------------------------------------------------------------------
+# Live service: decomposition reconciles, gauges agree, alerts surface
+# ---------------------------------------------------------------------------
+
+
+def test_service_decomposition_and_bubble_gauge(tmp_path):
+    """A real (step-driven) service round: every request's recorded
+    decomposition reconciles with its serve.request latency, the live
+    latency block sums exactly, the critical path stays ≤ wall with a
+    launch-family span on top, and serve_device_bubble_ratio equals
+    1 − occupancy on this single-bucket load."""
+    hists = mixed_histories(4)
+    obs_metrics.enable_mirror(True)  # conftest restores
+    with obs.recording(tmp_path, enabled=True):
+        svc = sv.CheckService(**KW)
+        futs = [svc.submit(hh, client=f"t{i}")
+                for i, hh in enumerate(hists)]
+        # one valid interactive request: resolves on the greedy wave,
+        # so its decomposition must ride the serve.fastpath span
+        f_fast = svc.submit(hists[0], client="fast", class_="interactive")
+        # one zero-deadline request: expires queued — its whole
+        # lifetime is queue wait, recorded AND live
+        f_exp = svc.submit(hists[1], client="late",
+                           deadline=faults.Deadline(0.0))
+        svc.step()
+        results = [f.result(timeout=30) for f in futs]
+        fast_res = f_fast.result(timeout=30)
+        assert fast_res["fastpath"] == "greedy"
+        exp_res = f_exp.result(timeout=30)
+        assert exp_res["valid?"] == "unknown"
+        exp_lat = exp_res["latency"]
+        assert exp_lat["queue_s"] == pytest.approx(exp_lat["total_s"])
+    # -- the live latency block (CheckFuture.result + GET /check/<id>)
+    for f, r in zip(futs, results):
+        lat = r["latency"]
+        assert lat["total_s"] >= 0
+        assert (lat["queue_s"] + lat["pack_s"] + lat["launch_s"]
+                + lat["confirm_s"] + lat["other_s"]
+                ) == pytest.approx(lat["total_s"], abs=5e-6)
+        assert lat["launch_s"] > 0  # everyone rode the shared launch
+        doc = svc.get(f.id).describe()
+        assert doc["latency"] == svc.get(f.id).latency()
+    # -- the recorded decomposition reconciles within the 5% gate
+    events, skipped = read_jsonl_events(tmp_path / "telemetry.jsonl")
+    assert skipped == 0
+    decomp = cp.decompose_requests(events)
+    assert len(decomp) == 6
+    _assert_reconciles(decomp)
+    rides = {tid: row["launch_span"] for tid, row in decomp.items()}
+    fast_tid = svc.get(f_fast.id).trace_id
+    assert rides.pop(fast_tid) == "serve.fastpath"
+    # the expired request: recorded decomposition agrees with the live
+    # block — all queue, no launch
+    exp_tid = svc.get(f_exp.id).trace_id
+    assert rides.pop(exp_tid) is None
+    exp_row = decomp[exp_tid]
+    assert exp_row["queue_s"] == pytest.approx(exp_row["total_s"],
+                                               rel=0.05, abs=1e-4)
+    assert set(rides.values()) == {"serve.batch"}
+    # -- critical path: bounded by wall, dominated by launch work
+    c = cp.critical_path(events)
+    assert 0 < c["total_s"] <= c["wall_s"] + 1e-9
+    top = next(iter(c["by_span"]))
+    assert top.startswith(("ladder.", "serve.batch", "serve.placement",
+                           "phase."))
+    # -- device timeline: single device, busy+idle = 1
+    tl = cp.device_timeline(events)
+    assert set(tl["devices"]) == {0}
+    d0 = tl["devices"][0]
+    assert d0["busy_frac"] + d0["idle_frac"] == pytest.approx(1.0)
+    # -- the live bubble gauge agrees with 1 - occupancy (single bucket)
+    occ = obs_metrics.REGISTRY.get("serve.batch_occupancy")
+    bubble = obs_metrics.REGISTRY.get("serve.device_bubble_ratio",
+                                      device="0")
+    assert occ is not None and bubble is not None
+    assert bubble == pytest.approx(1.0 - occ, abs=1e-3)
+    # -- per-class queue-depth gauges exist (the Perfetto class lanes)
+    assert obs_metrics.REGISTRY.get("serve.queue_depth.batch") is not None
+    # -- the summary embeds the critpath rollup
+    from jepsen_tpu.obs.summary import summarize
+
+    s = summarize(events)
+    assert s["critpath"]["total_s"] <= s["critpath"]["wall_s"] + 1e-9
+    assert s["critpath"]["spans"]
+
+
+def test_decomposition_under_membership_churn(tmp_path):
+    """The satellite contract: a run with a rung-join (continuous
+    batching), a device-loss shrink, and a graph-lane batch must still
+    reconcile every request's decomposition to its end-to-end latency
+    within tolerance."""
+    from jepsen_tpu.checker import elle
+    from test_serve_graphs import append_hist
+
+    hists = mixed_histories(6)
+    with obs.recording(tmp_path, enabled=True):
+        # -- rung-join: latecomers join the running ladder -------------
+        svc = sv.CheckService(batch_window_s=0, **KW)
+        futs = [svc.submit(hh) for hh in hists[:3]]
+        stepped = threading.Event()
+
+        def run():
+            stepped.set()
+            while svc.stats()["queue_depth"] or svc.stats()["running"]:
+                svc.step()
+
+        th = threading.Thread(target=run)
+        th.start()
+        stepped.wait(5)
+        futs += [svc.submit(hh) for hh in hists[3:]]
+        th.join(timeout=120)
+        [f.result(timeout=30) for f in futs]
+        # -- graph-lane batch: two compatible elle requests ------------
+        gfuts = [svc.submit(append_hist(s), checker=elle.list_append())
+                 for s in range(2)]
+        svc.step()
+        [f.result(timeout=30) for f in gfuts]
+        # -- device-loss shrink on a meshed sibling service ------------
+        def dev_inj(ctx, attempt):
+            if (ctx.get("what") == "placement.probe"
+                    and int(ctx.get("device", -1)) == 5):
+                raise RuntimeError("injected device loss")
+
+        svc2 = sv.CheckService(devices=8, health_probe_every_s=0.0, **KW)
+        svc2._parity_checked = True
+        with faults.inject_scope(dev_inj):
+            svc2._probe_placement()
+        assert svc2.stats()["placement"]["devices"] == 7
+    events, skipped = read_jsonl_events(tmp_path / "telemetry.jsonl")
+    assert skipped == 0
+    decomp = cp.decompose_requests(events)
+    assert len(decomp) == 8  # 6 ladder + 2 graph requests
+    _assert_reconciles(decomp)
+    # the graph requests rode the graph lane, not a geometry batch
+    graph_rides = [row["launch_span"] for tid, row in decomp.items()
+                   if row["launch_span"] in ("serve.graph_batch",
+                                             "serve.graph")]
+    assert len(graph_rides) == 2
+    # every live result's block reconciles too (incl. rung joiners)
+    for f in futs + gfuts:
+        lat = f.result(timeout=1)["latency"]
+        assert (lat["queue_s"] + lat["pack_s"] + lat["launch_s"]
+                + lat["confirm_s"] + lat["other_s"]
+                ) == pytest.approx(lat["total_s"], abs=5e-6)
+    # the placement-shrink left its mark in the stream
+    assert any(e.get("name") == "serve.placement_replaced"
+               for e in events)
+
+
+def test_alerts_endpoint_and_panel(tmp_path):
+    """GET /alerts serves the engine's document over real HTTP; the
+    home page renders the SLO panel; a breach-tuned spec fires after a
+    served round."""
+    import json as _json
+    import urllib.request
+
+    from jepsen_tpu import web
+
+    hists = mixed_histories(2)
+    # a deliberately-unmeetable batch-latency SLO: any served request
+    # breaches it, so one round must fire the alert
+    svc = sv.CheckService(
+        slo_specs=[{"name": "batch-instant", "kind": "latency",
+                    "metric": "serve.class_request_latency_seconds",
+                    "labels": {"tier": "batch"},
+                    "threshold_s": 1e-6, "target": 0.95}],
+        **KW,
+    )
+    obs_metrics.enable_mirror(True)  # step-driven: mirror on by hand
+    srv = web.make_server("127.0.0.1", 0, str(tmp_path), check_service=svc)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        futs = [svc.submit(hh) for hh in hists]
+        svc.step()  # serves + evaluates the SLO engine
+        [f.result(timeout=30) for f in futs]
+        svc.step()  # one more evaluation over the settled histogram
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=10) as r:
+            doc = _json.loads(r.read())
+        assert [a["slo"] for a in doc["alerts"]] == ["batch-instant"]
+        assert doc["alerts"][0]["burn_fast"] > 1.0
+        # the burn-rate gauges ride /metrics
+        assert obs_metrics.REGISTRY.get(
+            "serve.slo_burn_rate", slo="batch-instant", window="fast") > 1.0
+        assert obs_metrics.REGISTRY.get("serve.slo_alerts") == 1
+        # the home page renders the panel
+        panel = web.slo_panel_html(svc)
+        assert "batch-instant" in panel and "FIRING" in panel
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10) as r:
+            assert "SLO burn rates" in r.read().decode()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        svc.shutdown(drain=False)
+
+
+def test_trace_summarize_analyzer_modes(tmp_path, capsys):
+    """The CLI surface: --requests/--critpath/--devices over a recorded
+    stream, --json merged output, --perf-record appending the
+    kind:'critpath' ledger record."""
+    import json as _json
+
+    import trace_summarize
+
+    from jepsen_tpu.obs import regress
+
+    with obs.recording(tmp_path, enabled=True):
+        with obs.attach(trace="rq"):
+            obs.span_event("serve.admission", 0.01, tier="batch")
+        with obs.span("serve.batch", trace_ids=["rq"]):
+            with obs.attach(trace=["rq"]):
+                obs.span_event("ladder.launch", 0.05, engine="async",
+                               devices=[0])
+        with obs.attach(trace="rq"):
+            obs.span_event("serve.request", 0.08, tier="batch",
+                           verdict="True")
+    ledger = tmp_path / "ledger.jsonl"
+    rc = trace_summarize.main(
+        [str(tmp_path), "--requests", "--critpath", "--devices"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "per-request latency decomposition" in out
+    assert "critical path:" in out and "device" in out
+    # --json carries all three sections
+    import os
+
+    os.environ["JEPSEN_TPU_PERF_LEDGER"] = str(ledger)
+    try:
+        rc = trace_summarize.main(
+            [str(tmp_path), "--requests", "--critpath", "--devices",
+             "--json", "--perf-record"])
+    finally:
+        del os.environ["JEPSEN_TPU_PERF_LEDGER"]
+    assert rc == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert "rq" in doc["requests"]
+    assert doc["critpath"]["total_s"] <= doc["critpath"]["wall_s"] + 1e-9
+    assert 0 in doc["devices"]["devices"] or "0" in doc["devices"]["devices"]
+    # the analyzer-cost record landed, fingerprinted, with its metrics
+    records = regress.read_records(ledger)
+    assert [r["kind"] for r in records] == ["critpath"]
+    assert records[0]["metrics"]["analysis_s"] >= 0
+    assert records[0]["metrics"]["requests"] == 1
+    assert records[0]["fingerprint_key"]
+    # the rolled-up stage table ships critpath[...] entries
+    from jepsen_tpu.obs.summary import summarize
+
+    events, _ = read_jsonl_events(tmp_path / "telemetry.jsonl")
+    stages, metrics = regress.stage_rollup(summarize(events))
+    assert any(k.startswith("critpath[") for k in stages)
+    assert "critpath_total_s" in metrics

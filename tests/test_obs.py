@@ -325,9 +325,11 @@ def test_profiler_hook_bounded_exclusive_generation_safe(tmp_path, monkeypatch):
 
 
 def test_trace_export_lanes_and_counters(tmp_path):
-    """The Perfetto export: one lane per request trace id, shared-batch
-    spans on the device lane with their member ids in args, counter
-    tracks for the live gauges — and the CLI wrapper round-trips."""
+    """The Perfetto export: one lane per request trace id, one lane per
+    DEVICE (device-attributed launches render once per member device),
+    shared-batch spans on the ladder lane with their member ids in
+    args, counter tracks on their own dedicated lane (incl. one per
+    latency-class queue) — and the CLI wrapper round-trips."""
     import trace_export
 
     from jepsen_tpu.obs.trace import read_jsonl_events, to_trace_events
@@ -340,24 +342,48 @@ def test_trace_export_lanes_and_counters(tmp_path):
         with obs.span("serve.batch", trace_ids=["req-1", "req-2"]):
             with obs.attach(trace=["req-1", "req-2"]):
                 obs.span_event("ladder.stage", 0.1, stage=0)
+                obs.span_event("ladder.launch", 0.08, engine="async",
+                               devices=[0, 3])
                 obs.gauge("device.buffer_bytes", 1234)
         obs.gauge("serve.queue_depth", 2)
-    trace = to_trace_events(read_jsonl_events(tmp_path / "telemetry.jsonl"))
+        obs.gauge("serve.queue_depth.interactive", 1)
+        obs.gauge("serve.queue_depth.batch", 1)
+    events, skipped = read_jsonl_events(tmp_path / "telemetry.jsonl")
+    assert skipped == 0
+    trace = to_trace_events(events, skipped_lines=skipped)
     evs = trace["traceEvents"]
     lane_names = {
         e["args"]["name"]: e["tid"] for e in evs
         if e["ph"] == "M" and e["name"] == "thread_name"
     }
     assert lane_names["request req-1"] != lane_names["request req-2"]
-    assert lane_names["device/ladder"] == 0
+    assert lane_names["ladder/shared"] == 0
     assert trace["otherData"]["requests"] == 2
+    assert trace["otherData"]["devices"] == 2
+    assert trace["otherData"]["skipped_lines"] == 0
     adm = [e for e in evs if e["ph"] == "X" and e["name"] == "serve.admission"]
     assert {e["tid"] for e in adm} == {
         lane_names["request req-1"], lane_names["request req-2"]}
     [stage] = [e for e in evs if e["ph"] == "X" and e["name"] == "ladder.stage"]
     assert stage["tid"] == 0 and stage["args"]["trace"] == ["req-1", "req-2"]
-    counters = {e["name"] for e in evs if e["ph"] == "C"}
-    assert {"serve.queue_depth", "device.buffer_bytes"} <= counters
+    # the device-attributed launch renders once per member device, on
+    # per-device lanes with stable sort indexes
+    launches = [e for e in evs
+                if e["ph"] == "X" and e["name"] == "ladder.launch"]
+    assert {e["tid"] for e in launches} == {
+        lane_names["device 0"], lane_names["device 3"]}
+    sort_idx = {
+        e["tid"]: e["args"]["sort_index"] for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_sort_index"
+    }
+    assert sort_idx[lane_names["device 0"]] < sort_idx[lane_names["device 3"]]
+    # counter tracks ride their own lane, never the ladder/device lanes
+    counter_evs = [e for e in evs if e["ph"] == "C"]
+    counters = {e["name"] for e in counter_evs}
+    assert {"serve.queue_depth", "device.buffer_bytes",
+            "serve.queue_depth.interactive",
+            "serve.queue_depth.batch"} <= counters
+    assert {e["tid"] for e in counter_evs} == {lane_names["counters"]}
     assert trace["otherData"]["t0"] is not None
     # the CLI writes a loadable trace.json next to the jsonl
     assert trace_export.main([str(tmp_path)]) == 0
@@ -382,9 +408,12 @@ def test_trace_summarize_partial_stream(tmp_path, capsys):
     captured = capsys.readouterr()
     assert "counters" in captured.out
     assert "skipped 1 malformed line" in captured.err
-    # --json still works on the tolerant load
+    # --json still works on the tolerant load, and the summary carries
+    # the skip count (telemetry.skipped_lines — the satellite contract)
     assert trace_summarize.main([str(p), "--json"]) == 0
-    assert json.loads(capsys.readouterr().out)["counters"] == {"hits": 3}
+    rolled = json.loads(capsys.readouterr().out)
+    assert rolled["counters"] == {"hits": 3}
+    assert rolled["telemetry"]["skipped_lines"] == 1
     # nothing parseable -> clear error, exit 1
     bad = tmp_path / "bad" / "telemetry.jsonl"
     bad.parent.mkdir()

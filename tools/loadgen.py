@@ -258,6 +258,23 @@ def main(argv=None) -> int:
                          "allowed to degrade to an attributable unknown "
                          "before exit 1 (default 0.0 — transient-only "
                          "schedules should degrade nothing)")
+    ap.add_argument("--slo-file", default=None, metavar="JSON",
+                    help="SLO spec file for the service's live burn-rate "
+                         "engine (a JSON list merged over the built-in "
+                         "defaults by name; jepsen_tpu/serve/slo.py)")
+    ap.add_argument("--inject-latency-ms", type=float, default=0.0,
+                    help="inject this much latency into every shared "
+                         "batch launch (a deterministic sleeper through "
+                         "the faults.inject_scope seam) — the SLO-breach "
+                         "smoke: injected latency must trip GET /alerts, "
+                         "a clean run must not")
+    ap.add_argument("--assert-alert", action="append", default=None,
+                    metavar="SLO",
+                    help="exit 1 unless this SLO is FIRING on GET "
+                         "/alerts after the load (repeatable)")
+    ap.add_argument("--assert-no-alerts", action="store_true",
+                    help="exit 1 if ANY SLO alert is firing after the "
+                         "load (the clean-run acceptance gate)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the conftest dance) — "
@@ -414,6 +431,7 @@ def main(argv=None) -> int:
                 max_queue=a.max_queue,
                 batch_window_s=a.batch_window_ms / 1000.0,
                 continuous=not a.no_continuous,
+                slo_specs=a.slo_file,
             ).start()
             # Mount the real HTTP app over the service so the load runs
             # with /metrics live — the scrape-vs-accounting consistency
@@ -435,6 +453,18 @@ def main(argv=None) -> int:
                         what="ladder.",
                     )
                 ))
+            if a.inject_latency_ms:
+                # The SLO-breach smoke: a deterministic sleeper on every
+                # shared batch launch (the serve-level inject seam), so
+                # batch-tier latency blows a tight latency SLO without
+                # touching verdict semantics.
+                def _latency_injector(info, attempt,
+                                      _s=a.inject_latency_ms / 1000.0):
+                    if str(info.get("what", "")).startswith("serve.batch"):
+                        time.sleep(_s)
+
+                chaos_stack.enter_context(
+                    faults.inject_scope(_latency_injector))
             try:
                 # warm pass: same histories AND classes, untimed (compile
                 # the padded batch + greedy fast-path shapes the measured
@@ -651,6 +681,42 @@ def main(argv=None) -> int:
                     print(f"METRICS INCONSISTENT: {bad}", file=sys.stderr)
                     rc = 1
                 print(f"metrics:    {out['metrics']}")
+                # --------------------------------------------------------
+                # SLO burn-rate acceptance gates: evaluate once more so
+                # the final latency observations are sampled, then read
+                # the alert document over the REAL HTTP endpoint — the
+                # gate exercises the whole surface an operator's pager
+                # would.
+                # --------------------------------------------------------
+                if a.assert_alert or a.assert_no_alerts:
+                    svc.slo.evaluate()
+                    alerts_url = (f"http://127.0.0.1:"
+                                  f"{srv.server_address[1]}/alerts")
+                    with urllib.request.urlopen(alerts_url, timeout=10) as r:
+                        alerts_doc = json.loads(r.read())
+                    firing = {al["slo"] for al in alerts_doc["alerts"]}
+                    out["slo"] = {
+                        "firing": sorted(firing),
+                        "burn": {
+                            s["slo"]: {"fast": s["burn_fast"],
+                                       "slow": s["burn_slow"],
+                                       "state": s["state"]}
+                            for s in alerts_doc["slos"]
+                        },
+                    }
+                    for name in a.assert_alert or []:
+                        if name not in firing:
+                            print(f"SLO ALERT MISSING: {name!r} did not "
+                                  f"fire (firing: {sorted(firing)}; "
+                                  f"burns: {out['slo']['burn']})",
+                                  file=sys.stderr)
+                            rc = 1
+                    if a.assert_no_alerts and firing:
+                        print(f"UNEXPECTED SLO ALERT(S): {sorted(firing)} "
+                              f"(burns: {out['slo']['burn']})",
+                              file=sys.stderr)
+                        rc = 1
+                    print(f"slo:        {out['slo']}")
                 if geometry_acct is not None:
                     # hostile-geometry gate: measured waste vs the
                     # generator's own bucket accounting, and the live
@@ -794,6 +860,8 @@ def main(argv=None) -> int:
             axes = {"arrival": a.arrival, "geometry": a.geometry_spread}
             if a.chaos_seed is not None:
                 axes["chaos"] = str(a.chaos_seed)
+            if a.inject_latency_ms:
+                axes["inject_latency_ms"] = str(a.inject_latency_ms)
             if a.no_continuous:
                 axes["continuous"] = "off"
             summary = rec.summary if rec is not None else None

@@ -1,10 +1,12 @@
 """Export a run's telemetry.jsonl as Chrome/Perfetto trace-event JSON.
 
 Load the output at https://ui.perfetto.dev (or chrome://tracing): one
-lane per request trace id, a shared device/ladder lane for batch and
-stage spans, and counter tracks for queue depth / unknowns remaining /
-device buffer bytes.  The same converter backs the web UI's
-``GET /trace/<test>/<time>`` download link.
+lane per request trace id, one lane per DEVICE (device-attributed
+launch spans render per chip), a shared ladder lane for batch and
+stage spans, and dedicated counter tracks for queue depth (total +
+per latency class), unknowns remaining, and device buffer bytes.  The
+same converter backs the web UI's ``GET /trace/<test>/<time>``
+download link.
 
   python tools/trace_export.py store/my-test/latest
   python tools/trace_export.py <run-dir>/telemetry.jsonl -o trace.json
@@ -32,16 +34,20 @@ def main(argv=None) -> int:
     if path.is_dir():
         path = path / "telemetry.jsonl"
     try:
-        events = read_jsonl_events(path)
+        events, skipped = read_jsonl_events(path)
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    trace = to_trace_events(events)
+    if skipped:
+        print(f"warning: skipped {skipped} malformed line(s) in {path}",
+              file=sys.stderr)
+    trace = to_trace_events(events, skipped_lines=skipped)
     out = Path(opts.out) if opts.out else path.parent / "trace.json"
     out.write_text(json.dumps(trace, separators=(",", ":"), default=str))
     n = len(trace["traceEvents"])
     print(f"{out}: {n} trace events, "
-          f"{trace['otherData']['requests']} request lane(s) "
+          f"{trace['otherData']['requests']} request lane(s), "
+          f"{trace['otherData']['devices']} device lane(s) "
           "(load at https://ui.perfetto.dev)")
     return 0
 

@@ -9,6 +9,22 @@ the same phase / checker / ladder-stage tables the web UI renders.
   python tools/trace_summarize.py --json telemetry.jsonl   # re-rolled summary
   python tools/trace_summarize.py --diff RUN_A RUN_B       # stage-table diff
 
+Flight-analyzer modes (jepsen_tpu.obs.critpath) — these need the raw
+jsonl (span intervals), not the rolled-up .json:
+
+  --requests   per-request latency decomposition: one row per trace id
+               (queue / pack / launch / confirm / other seconds, summing
+               to the recorded end-to-end latency)
+  --critpath   the span critical path: what bounds wall clock, ranked
+               by on-path seconds, with per-span slack
+  --devices    per-device busy/idle/bubble fractions from the
+               device-attributed launch spans
+  --perf-record  append a fingerprinted ``kind:"critpath"`` record to
+               the perf ledger (obs.regress) timing the analysis pass
+               itself, so ``perfwatch gate`` flags analyzer-cost creep
+
+Any combination composes with ``--json`` (one merged JSON object).
+
 ``--diff`` answers "what got slower between these two runs": both runs'
 stage tables (ladder rungs + rolled-up spans, via
 ``obs.regress.stage_rollup``) are diffed and printed top-regressing-span
@@ -21,12 +37,53 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from jepsen_tpu.obs import critpath as cpm  # noqa: E402
 from jepsen_tpu.obs.summary import format_summary, summarize  # noqa: E402
 from jepsen_tpu.obs.trace import read_jsonl_events  # noqa: E402
+
+
+def _resolve(path: Path) -> Path:
+    """Run dir → its telemetry file (jsonl preferred: source of truth)."""
+    if path.is_dir():
+        jsonl = path / "telemetry.jsonl"
+        rolled = path / "telemetry.json"
+        if jsonl.exists():
+            return jsonl
+        if rolled.exists():
+            return rolled
+        raise FileNotFoundError(
+            f"no telemetry.jsonl/.json in {path} (was the run recorded "
+            "with --no-telemetry?)"
+        )
+    return path
+
+
+def load_events(path: Path) -> tuple[list[dict], int]:
+    """The raw event stream + skipped-line count (jsonl only — the
+    flight-analyzer modes need span intervals the .json rollup doesn't
+    keep)."""
+    path = _resolve(Path(path))
+    if path.suffix != ".jsonl":
+        raise ValueError(
+            f"{path}: --requests/--critpath/--devices need the raw "
+            "telemetry.jsonl (span intervals), not the rolled-up summary"
+        )
+    events, skipped = read_jsonl_events(path)
+    if skipped:
+        print(
+            f"warning: skipped {skipped} malformed line(s) in {path} "
+            "(partially-written stream?)",
+            file=sys.stderr,
+        )
+    if not events:
+        raise ValueError(f"{path}: empty telemetry stream (the "
+                         "recording never wrote its header)")
+    return events, skipped
 
 
 def load_summary(path: Path) -> dict:
@@ -34,38 +91,15 @@ def load_summary(path: Path) -> dict:
     dict.  JSONL is always re-rolled (it is the source of truth; the .json
     rollup may be stale after a crash).  A partially-written JSONL (a
     crashed writer truncates the LAST line mid-write) is read tolerantly
-    — parseable lines summarize, the skip is reported on stderr; a file
-    with nothing parseable, or a corrupt .json rollup, raises ValueError
-    with the path named (main turns that into a clear message + exit 1,
-    never a traceback)."""
-    path = Path(path)
-    if path.is_dir():
-        jsonl = path / "telemetry.jsonl"
-        rolled = path / "telemetry.json"
-        if jsonl.exists():
-            path = jsonl
-        elif rolled.exists():
-            path = rolled
-        else:
-            raise FileNotFoundError(
-                f"no telemetry.jsonl/.json in {path} (was the run recorded "
-                "with --no-telemetry?)"
-            )
+    — parseable lines summarize, the skip is reported on stderr and as
+    the summary's ``telemetry.skipped_lines`` field; a file with nothing
+    parseable, or a corrupt .json rollup, raises ValueError with the
+    path named (main turns that into a clear message + exit 1, never a
+    traceback)."""
+    path = _resolve(Path(path))
     if path.suffix == ".jsonl":
-        events = read_jsonl_events(path)
-        skipped = next(
-            (e["skipped-lines"] for e in events if "skipped-lines" in e), 0
-        )
-        if skipped:
-            print(
-                f"warning: skipped {skipped} malformed line(s) in {path} "
-                "(partially-written stream?)",
-                file=sys.stderr,
-            )
-        if not events:
-            raise ValueError(f"{path}: empty telemetry stream (the "
-                             "recording never wrote its header)")
-        return summarize(events)
+        events, skipped = load_events(path)
+        return summarize(events, skipped_lines=skipped)
     try:
         summary = json.loads(path.read_text())
     except ValueError as e:
@@ -102,6 +136,65 @@ def diff_summaries(path_a: Path, path_b: Path, *, as_json: bool) -> int:
     return 0
 
 
+def analyze(path: Path, *, requests: bool, critpath: bool, devices: bool,
+            as_json: bool, perf_record: bool) -> int:
+    """The flight-analyzer modes over one run's raw event stream."""
+    events, skipped = load_events(path)
+    t0 = time.perf_counter()
+    doc: dict = {}
+    if requests:
+        doc["requests"] = cpm.decompose_requests(events)
+    if critpath:
+        doc["critpath"] = cpm.critical_path(events)
+    if devices:
+        doc["devices"] = cpm.device_timeline(events)
+    analysis_s = time.perf_counter() - t0
+    if skipped:
+        doc["telemetry"] = {"skipped_lines": skipped}
+    if as_json:
+        print(json.dumps(doc, indent=1, default=str))
+    else:
+        if requests:
+            print("per-request latency decomposition:")
+            print(cpm.format_requests(doc["requests"]), end="")
+        if critpath:
+            if requests:
+                print()
+            print(cpm.format_critpath(doc["critpath"]), end="")
+        if devices:
+            if requests or critpath:
+                print()
+            print(cpm.format_devices(doc["devices"]), end="")
+    if perf_record:
+        # The analyzer's own cost, trended: a kind:"critpath" ledger
+        # record so perfwatch gate flags analysis-cost creep the same
+        # way it flags ladder-stage creep.  Best-effort by contract.
+        try:
+            from jepsen_tpu.obs import regress
+
+            metrics = {
+                "analysis_s": round(analysis_s, 6),
+                "events": float(len(events)),
+            }
+            cp = doc.get("critpath")
+            if cp:
+                metrics["critpath_total_s"] = cp["total_s"]
+                metrics["critpath_wall_s"] = cp["wall_s"]
+            if "requests" in doc:
+                metrics["requests"] = float(len(doc["requests"]))
+            # probe_devices=False: a pure-host analysis pass must not
+            # initialize (or hang on) a device backend for its
+            # fingerprint — the same convention as graftlint and the
+            # bench outage path.
+            regress.append_record(regress.make_record(
+                "critpath", metrics,
+                fp=regress.fingerprint(probe_devices=False)))
+        except Exception as e:  # noqa: BLE001 — ledger IO must not fail
+            print(f"warning: perf-ledger append failed: {e}",
+                  file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -109,6 +202,20 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the rolled-up summary as JSON instead of tables"
                          " (scripting: jq '.serve', '.ladder[0]', ...)")
+    ap.add_argument("--requests", action="store_true",
+                    help="per-request latency decomposition from the raw "
+                         "jsonl (queue/pack/launch/confirm/other seconds "
+                         "per trace id)")
+    ap.add_argument("--critpath", action="store_true",
+                    help="span critical path: what bounds wall clock, "
+                         "ranked, with per-span slack")
+    ap.add_argument("--devices", action="store_true",
+                    help="per-device busy/idle/bubble timeline from the "
+                         "device-attributed launch spans")
+    ap.add_argument("--perf-record", action="store_true",
+                    help="append a kind:'critpath' perf-ledger record "
+                         "timing the analysis pass (perfwatch gates "
+                         "analyzer-cost creep)")
     ap.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
                     default=None,
                     help="diff two runs' stage tables instead of "
@@ -118,10 +225,22 @@ def main(argv=None) -> int:
         print("error: give either a run path or --diff RUN_A RUN_B",
               file=sys.stderr)
         return 2
+    if opts.perf_record and not (opts.requests or opts.critpath
+                                 or opts.devices):
+        # --perf-record times the analysis pass; alone it implies the
+        # critical-path mode (silently recording nothing would be worse)
+        opts.critpath = True
+    analyzer = opts.requests or opts.critpath or opts.devices
     try:
         if opts.diff:
             return diff_summaries(Path(opts.diff[0]), Path(opts.diff[1]),
                                   as_json=opts.json)
+        if analyzer:
+            return analyze(
+                Path(opts.path), requests=opts.requests,
+                critpath=opts.critpath, devices=opts.devices,
+                as_json=opts.json, perf_record=opts.perf_record,
+            )
         summary = load_summary(Path(opts.path))
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
