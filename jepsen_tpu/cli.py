@@ -264,6 +264,8 @@ def _cmd_serve(opts) -> int:
             verify_placement=opts.verify_placement,
             drain_dir=opts.drain_dir,
             journal_dir=opts.journal_dir,
+            idempotency_dir=opts.idempotency_dir,
+            idempotency_ttl_s=opts.idempotency_ttl,
             quarantine_ttl_s=opts.quarantine_ttl,
             breaker_threshold=opts.breaker_threshold,
             breaker_cooldown_s=opts.breaker_cooldown,
@@ -391,6 +393,17 @@ def run_cli(
                               "restarted service replays the survivors "
                               "(crash-safe restart; request ids are kept "
                               "so GET /check/<id> works across the crash)")
+    p_serve.add_argument("--idempotency-dir", default=None,
+                         help="journaled idempotency-key map: duplicate "
+                              "POST /check submits carrying the same "
+                              "idempotency_key attach to the original "
+                              "request (or its settled result) instead of "
+                              "re-running the check — across a SIGKILL "
+                              "restart when set (default: in-memory only)")
+    p_serve.add_argument("--idempotency-ttl", type=float, default=3600.0,
+                         help="seconds an idempotency key answers "
+                              "duplicates after its last write "
+                              "(default 3600)")
     p_serve.add_argument("--max-request-mb", type=float, default=32.0,
                          help="POST /check body bound; larger payloads "
                               "are rejected 413 before the JSON parse "

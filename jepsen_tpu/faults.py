@@ -64,7 +64,32 @@ from jepsen_tpu.obs import metrics as _metrics
 
 #: fault-injection hook: ``INJECT(ctx, attempt)`` runs before each launch
 #: attempt and may raise (classified exactly like a real launch error).
+#: Beyond launch sites, the DURABLE-WRITE seams also announce themselves
+#: here — ``store._atomic_write`` (ctx ``what="store.atomic_write"``,
+#: ``step`` in post-tmp / post-fsync / post-rename / pre-dir-fsync) and
+#: the perf-ledger append (``what="ledger.append"``) — so the
+#: crashpoint audit (tools/crashpoint.py) can die at any write step.
+#: Injectors targeting launches must FILTER on ctx ``what``: a raise in
+#: a write seam faults an operation no retry policy covers.
 INJECT: Callable[[dict, int], None] | None = None
+
+
+class CrashPoint(BaseException):
+    """A simulated process death at a durable-write step.
+
+    Raised by a crashpoint injector inside a write seam;
+    ``store._atomic_write`` performs NO cleanup for it (unlike ordinary
+    exceptions, whose tmp file is unlinked), so the on-disk state is
+    exactly what a SIGKILL at that step leaves — tmp present, target
+    old.  A ``BaseException`` on purpose: the best-effort ``except
+    Exception`` guards around checkpoint/journal writes must not
+    swallow a simulated death, it must unwind to the crashpoint
+    harness like the real signal would."""
+
+    def __init__(self, step: str, path: str = "?"):
+        self.step = step
+        self.path = path
+        super().__init__(f"simulated crash at {step} writing {path}")
 
 #: serializes INJECT install/restore (inject_scope); RLock so a scope
 #: may nest inside another on the same thread.
@@ -175,7 +200,17 @@ def seeded_injector(
         return int.from_bytes(h[:8], "big") / 2.0**64
 
     def inject(ctx, attempt):
-        if what is not None and not str(ctx.get("what") or "").startswith(what):
+        w = str(ctx.get("what") or "")
+        if what is not None and not w.startswith(what):
+            return
+        if what is None and w.startswith(("store.", "ledger.")):
+            # The durable-write seams are crashpoint territory: a
+            # rate-based transient/OOM schedule raising inside
+            # _atomic_write / the ledger append would fault writes no
+            # retry policy covers (a checkpoint save is best-effort, a
+            # journal write is counted-and-swallowed — either way the
+            # injected fault would test nothing this schedule means to).
+            # Target them explicitly via ``what=`` to opt in.
             return
         if attempt != 0:
             return  # retries always succeed: the plan tests recovery

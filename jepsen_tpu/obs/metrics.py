@@ -32,9 +32,20 @@ explicit): ``serve_quarantined_total`` /
 ``serve_watchdog_trips_total`` (hung-launch watchdog),
 ``serve_devices_lost_total`` + the ``serve_placement_devices`` gauge
 (device-loss re-placement), ``serve_journal_replayed_total`` (crash-safe
-restart), and ``serve_drain_errors_total`` /
+restart), ``serve_idempotent_hits_total`` (duplicate submits served
+from the idempotency map instead of re-run), and
+``serve_drain_errors_total`` /
 ``serve_placement_probe_errors_total`` (previously-swallowed drain and
 parity-probe failures, now counted).
+
+The durable-record layer (``jepsen_tpu.store.durable``) feeds through
+the obs mirror: ``jepsen_tpu_durable_corrupt_total`` (artifacts
+quarantined aside), ``jepsen_tpu_durable_migrated_total`` (old-format
+payloads upgraded at read), ``jepsen_tpu_durable_tmp_swept_total``
+(orphaned ``*.tmp`` reclamation), and the
+``jepsen_tpu_durable_ledger_skipped`` gauge (perf-ledger lines
+currently dropped by the per-record checksum reader — a gauge, not a
+counter, because the same ledger is read many times per process).
 
 The bounded-memory layer (``jepsen_tpu.ops.spill``) feeds through the
 obs mirror: ``jepsen_tpu_frontier_spill_rows_total`` /

@@ -61,7 +61,7 @@ __all__ = [
     "diff_stage_tables", "fingerprint", "fingerprint_key", "format_comparison",
     "format_stage_diff", "gate", "git_info", "ledger_path", "make_record",
     "metric_direction", "noise_band", "publish_gauges", "read_records",
-    "run_competition", "stage_rollup",
+    "read_records_checked", "run_competition", "stage_rollup",
 ]
 
 ENV_LEDGER = "JEPSEN_TPU_PERF_LEDGER"
@@ -204,37 +204,75 @@ def make_record(kind: str, metrics: Mapping[str, float], *,
     return rec
 
 
+def _append_seam(step: str, path) -> None:
+    """The ledger half of the crashpoint-audit seam (the file-level
+    counterpart of ``store._write_seam``): announces each append step
+    through ``faults.INJECT`` so tools/crashpoint.py can kill a child
+    mid-append and prove the reader's torn-line tolerance.  Lazy import
+    keeps this module stdlib-only at import time."""
+    from jepsen_tpu import faults
+
+    hook = faults.INJECT
+    if hook is not None:
+        hook({"what": "ledger.append", "step": step, "path": str(path)}, 0)
+
+
 def append_record(record: Mapping, path: str | os.PathLike | None = None,
                   store_dir: str | os.PathLike | None = None) -> Path | None:
     """Append one record line to the ledger (fsync'd — the ledger is the
-    durable trajectory; a crashed run must not lose its number).  Returns
-    the path written, or None when the ledger is disabled.  Raises on IO
-    failure — producers that must never fail wrap this themselves."""
+    durable trajectory; a crashed run must not lose its number).  Each
+    line is SEALED with a per-record CRC32 (``durable.seal_line``), so
+    bit rot and hand-edits are detected at read, not just torn tails.
+    Returns the path written, or None when the ledger is disabled.
+    Raises on IO failure — producers that must never fail wrap this
+    themselves."""
+    from jepsen_tpu.store import durable as _durable
+
+    from jepsen_tpu import store as _store
+
     p = ledger_path(path, store_dir)
     if p is None:
         return None
     p.parent.mkdir(parents=True, exist_ok=True)
-    line = json.dumps(record, separators=(",", ":"), default=str)
+    # Canonicalize BEFORE sealing: the CRC is computed over _jsonable
+    # output, so the bytes on disk must be that same structure — a
+    # value json.dumps would coerce differently (np.int64, set) would
+    # otherwise seal a line that fails its own checksum on every read.
+    # No default= here on purpose: after _jsonable nothing should need
+    # one, and a silent str() coercion would be exactly that bug back.
+    sealed = _durable.seal_line(_store._jsonable(dict(record)))
+    line = json.dumps(sealed, separators=(",", ":"))
     with open(p, "a", encoding="utf-8") as fh:
         fh.write(line + "\n")
         fh.flush()
+        _append_seam("post-write", p)
         os.fsync(fh.fileno())
+        _append_seam("post-fsync", p)
     return p
 
 
-def read_records(path: str | os.PathLike | None = None,
-                 store_dir: str | os.PathLike | None = None) -> list[dict]:
-    """All parseable ledger records, oldest first.  Tolerant of a
-    truncated last line (a crashed writer) and of junk lines — the
-    ledger outlives every process that appends to it."""
+def read_records_checked(
+        path: str | os.PathLike | None = None,
+        store_dir: str | os.PathLike | None = None) -> tuple[list[dict], int]:
+    """``(records, skipped)``: all VERIFIED ledger records oldest first,
+    plus how many lines were dropped — torn tails, junk, and sealed
+    lines whose per-record CRC no longer matches (bit rot / hand
+    edits).  Legacy unsealed lines still count as records.  The skipped
+    count is the honesty contract (parity with
+    ``obs.trace.read_jsonl_events``): a reader that silently drops
+    lines turns a corrupt trajectory into a convincing one.  A nonzero
+    count also emits ``durable.ledger_skipped``."""
     p = ledger_path(path, store_dir)
     if p is None or not p.is_file():
-        return []
+        return [], 0
     out: list[dict] = []
+    skipped = 0
     try:
         text = p.read_text(encoding="utf-8", errors="replace")
     except OSError:
-        return []
+        return [], 0
+    from jepsen_tpu.store import durable as _durable
+
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -242,10 +280,34 @@ def read_records(path: str | os.PathLike | None = None,
         try:
             rec = json.loads(line)
         except ValueError:
+            skipped += 1
             continue
-        if isinstance(rec, dict) and rec.get("kind"):
-            out.append(rec)
-    return out
+        if not (isinstance(rec, dict) and rec.get("kind")):
+            skipped += 1
+            continue
+        ok, _legacy = _durable.check_line(rec)
+        if not ok:
+            skipped += 1
+            continue
+        rec.pop("crc", None)
+        out.append(rec)
+    from jepsen_tpu import obs as _obs
+
+    # a GAUGE, not a counter — the same ledger is read many times per
+    # process (publish_gauges per scrape, gate, list) and an
+    # accumulating counter would report reads x skipped — and emitted
+    # unconditionally so a repaired/rotated ledger resets the reading
+    # to 0 instead of alerting on stale corruption forever
+    _obs.gauge("durable.ledger_skipped", skipped, path=str(p))
+    return out, skipped
+
+
+def read_records(path: str | os.PathLike | None = None,
+                 store_dir: str | os.PathLike | None = None) -> list[dict]:
+    """All verified ledger records, oldest first (the records half of
+    ``read_records_checked`` — callers that surface the skipped count
+    use that instead)."""
+    return read_records_checked(path, store_dir)[0]
 
 
 # ---------------------------------------------------------------------------

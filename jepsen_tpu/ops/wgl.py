@@ -1048,9 +1048,17 @@ def chunked_analysis(
         if saved is not None and saved["config"] != ck_cfg:
             import logging
 
+            # Quarantine the stale pair aside (same contract as the
+            # ladder checkpoint): a later resume with matching inputs
+            # must not pick mismatched state back up.
+            quarantined = _ckpt.quarantine_chunked(
+                checkpoint_dir, reason="stale-fingerprint")
             logging.getLogger(__name__).warning(
                 "chunk checkpoint in %s was written for different inputs "
-                "or config; running fresh", checkpoint_dir)
+                "or config; running fresh (stale files quarantined: %s)",
+                checkpoint_dir, quarantined)
+            obs.counter("fault.checkpoint.quarantined",
+                        reason="fingerprint", files=quarantined)
             obs.counter("fault.checkpoint.mismatch", reason="fingerprint")
             saved = None
         if saved is not None:

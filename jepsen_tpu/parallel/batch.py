@@ -551,14 +551,31 @@ def batch_analysis(
         try:
             restored = _ckpt.load(checkpoint_dir)
         except _ckpt.CheckpointError as e:
+            # Corrupt pairs were already quarantined aside by the
+            # durable layer (with the machine-readable report on
+            # e.report); the fresh run below reproduces uninterrupted
+            # verdicts, so this degradation is total-recovery.
             logger.warning("unreadable checkpoint in %s (%s); running fresh",
                            checkpoint_dir, e)
-            obs.counter("fault.checkpoint.mismatch", reason="unreadable")
+            obs.counter("fault.checkpoint.mismatch",
+                        reason=getattr(e, "report", None) and
+                        e.report.get("reason") or "unreadable",
+                        report=getattr(e, "report", None))
         if restored is not None and restored["config"].get("fingerprint") != fp:
+            # The stale pair is QUARANTINED aside, not merely ignored: a
+            # later --resume against the same dir (now with the matching
+            # histories again) must never pick the mismatched state back
+            # up, and the checkpoint this fresh run is about to write
+            # must not interleave with the old files.
+            quarantined = _ckpt.quarantine(checkpoint_dir,
+                                           reason="stale-fingerprint")
             logger.warning(
                 "checkpoint in %s was written for different histories; "
                 "running fresh (resuming against changed inputs could "
-                "only produce wrong verdicts)", checkpoint_dir)
+                "only produce wrong verdicts); stale files quarantined: "
+                "%s", checkpoint_dir, quarantined)
+            obs.counter("fault.checkpoint.quarantined",
+                        reason="fingerprint", files=quarantined)
             obs.counter("fault.checkpoint.mismatch", reason="fingerprint")
             restored = None
         if restored is not None:

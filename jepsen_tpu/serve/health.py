@@ -28,12 +28,21 @@ degrading everyone else — four pillars, composed by
     a launch that exceeds its cap raises ``HungLaunch`` so the service
     can cancel (abandon — first-write-wins result demux discards the
     zombie's late verdicts) and retry on reduced placement.
-  * **Crash-safe restart** (``AdmissionJournal``) — an fsync'd journal
-    of admitted-but-unfinished requests (``store._atomic_write``, one
-    file per request in the drain-dir format) replayed by
+  * **Crash-safe restart** (``AdmissionJournal``) — an fsync'd,
+    checksummed journal of admitted-but-unfinished requests
+    (``store.durable`` envelopes over ``store._atomic_write``, one file
+    per request in the drain-dir format) replayed by
     ``CheckService.start()``: a service crash loses no admitted
     request, and replayed requests keep their ids so ``GET
-    /check/<id>`` keeps working across the restart.
+    /check/<id>`` keeps working across the restart.  Corrupt entries
+    quarantine aside with a machine-readable report instead of
+    blocking (or silently shrinking) the replay.
+  * **Idempotent resubmission** (``IdempotencyMap``) — a journaled
+    TTL'd ``idempotency_key`` registry: the retry behavior the
+    backpressure 429s / breaker 503s / wait timeouts instruct can
+    never double-run a check — duplicates attach to the in-flight
+    future or get the settled result, original request id preserved,
+    across a SIGKILL restart.
 
 Nothing here decides verdicts: quarantine and watchdog degradation
 resolve only to attributable ``unknown``s, never to a flipped verdict.
@@ -41,18 +50,42 @@ resolve only to attributable ``unknown``s, never to a flipped verdict.
 
 from __future__ import annotations
 
-import json
+import contextlib
 import logging
 import math
 import threading
 import time
 from pathlib import Path
-from typing import Callable, Sequence
+from typing import Callable, Mapping, Sequence
 
 from jepsen_tpu import store
 from jepsen_tpu.store import checkpoint as _ckpt
+from jepsen_tpu.store import durable as _durable
 
 logger = logging.getLogger(__name__)
+
+#: durable-record kinds this layer persists (see store.durable): the
+#: admission journal's per-request entries and the idempotency map's
+#: per-key entries.  Both are envelope v1 with a legacy (pre-envelope,
+#: version 0) migration so a pre-durable journal replays unchanged.
+KIND_JOURNAL = "admission-journal"
+KIND_IDEM = "idempotency-entry"
+
+_durable.register_kind(KIND_JOURNAL, 1)
+_durable.register_kind(KIND_IDEM, 1)
+
+
+@_durable.register_migration(KIND_JOURNAL, 0)
+def _journal_v0_to_v1(payload):
+    # v0 was the bare entry dict — same fields, no checksum.
+    return dict(payload), 1
+
+
+@_durable.register_migration(KIND_IDEM, 0)
+def _idem_v0_to_v1(payload):
+    # pre-envelope idem entries (e.g. hand-restored by an operator)
+    # read as payload-only version 0 — same fields
+    return dict(payload), 1
 
 
 def history_fingerprint(history) -> str:
@@ -357,30 +390,51 @@ class LaunchWatchdog:
 # ---------------------------------------------------------------------------
 
 class AdmissionJournal:
-    """An fsync'd record of admitted-but-unfinished requests.
+    """An fsync'd, CHECKSUMMED record of admitted-but-unfinished
+    requests.
 
-    One JSON file per request (``store._atomic_write``: tmp + fsync +
-    rename + dir fsync — the same durability contract checkpoints
-    ride), in the drain-dir format (model name + history + request
-    identity) so ``replay()`` can rebuild the exact submission.
-    ``record`` on admission, ``resolve`` when the request settles (any
-    terminal status — done, expired, quarantined, drained); whatever
-    files remain after a crash ARE the lost queue, replayed by
-    ``CheckService.start()``.  Write failures are counted and logged,
-    never raised into admission — journaling is a recovery aid, not an
-    admission gate."""
+    One JSON file per request in the ``store.durable`` envelope
+    (``store._atomic_write`` underneath: tmp + fsync + rename + dir
+    fsync), in the drain-dir format (model name + history + request
+    identity + idempotency key) so ``replay()`` can rebuild the exact
+    submission.  ``record`` on admission, ``resolve`` when the request
+    settles (any terminal status — done, expired, quarantined,
+    drained); whatever files remain after a crash ARE the lost queue,
+    replayed by ``CheckService.start()``.  A corrupt entry — atomic
+    renames rule out torn writes, but bit rot, partial copies, and
+    operators hand-editing the dir do not go away — is QUARANTINED
+    aside (``<name>.corrupt-<n>``), counted, and its corruption report
+    kept on ``corrupt_reports`` for the stats surface; the rest of the
+    queue still replays.  Write failures are counted and logged, never
+    raised into admission — journaling is a recovery aid, not an
+    admission gate.
+
+    ``depth()`` is a CACHED counter (maintained at record/resolve,
+    reconciled against the directory at ``replay()``) — it used to
+    re-glob the journal dir on every stats call, which made ``GET
+    /queue`` an O(queue-depth) directory walk."""
 
     def __init__(self, journal_dir: str | Path):
         self.dir = Path(journal_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.errors = 0
+        self.corrupt_reports: list[dict] = []    # guarded-by: _lock [rw]
+        self._lock = threading.Lock()
+        self._depth = self._glob_depth()         # guarded-by: _lock [rw]
+
+    def _glob_depth(self) -> int:
+        try:
+            return sum(1 for _ in self.dir.glob("req-*.json"))
+        except OSError:
+            return 0
 
     def _path(self, req_id: str) -> Path:
         return self.dir / f"req-{req_id}.json"
 
     def record(self, *, req_id: str, seq: int, model_name: str, history,
                priority: int, client: str, tier: str,
-               trace_id: str, deadline_s: float | None) -> bool:
+               trace_id: str, deadline_s: float | None,
+               idempotency_key: str | None = None) -> bool:
         entry = {
             "id": req_id, "seq": int(seq), "model": model_name,
             "history": store._jsonable(list(history)),
@@ -388,10 +442,14 @@ class AdmissionJournal:
             "class": str(tier), "trace_id": str(trace_id),
             "deadline_s": deadline_s,
         }
+        if idempotency_key is not None:
+            entry["idempotency_key"] = str(idempotency_key)
         try:
-            store._atomic_write(
-                self._path(req_id), json.dumps(entry, default=str)
-            )
+            existed = self._path(req_id).exists()
+            _durable.write_record(self._path(req_id), KIND_JOURNAL, entry)
+            if not existed:
+                with self._lock:
+                    self._depth += 1
             return True
         except Exception:  # noqa: BLE001 — see docstring
             self.errors += 1
@@ -401,28 +459,287 @@ class AdmissionJournal:
 
     def resolve(self, req_id: str) -> None:
         try:
-            self._path(req_id).unlink(missing_ok=True)
+            self._path(req_id).unlink()
+        except FileNotFoundError:
+            return  # already resolved (or never journaled): depth unchanged
         except OSError:
             self.errors += 1
             logger.warning("admission journal unlink failed for %s",
                            req_id, exc_info=True)
+            return
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
 
     def depth(self) -> int:
-        try:
-            return sum(1 for _ in self.dir.glob("req-*.json"))
-        except OSError:
-            return 0
+        with self._lock:
+            return self._depth
 
     def replay(self) -> list[dict]:
-        """Every surviving entry, in admission (seq) order.  Unreadable
-        files are counted and skipped — a torn write can't exist
-        (atomic rename), but an operator hand-editing the dir can."""
+        """Every surviving VERIFIED entry, in admission (seq) order.
+        Corrupt entries are quarantined aside with their reports
+        collected; the cached depth is reconciled against what is
+        actually on disk afterwards (quarantined files leave the
+        glob)."""
         out = []
         for p in sorted(self.dir.glob("req-*.json")):
             try:
-                out.append(json.loads(p.read_text()))
-            except (OSError, ValueError):
+                rr = _durable.read_verified(p, KIND_JOURNAL)
+                out.append(rr.payload)
+            except _durable.DurableError as e:
                 self.errors += 1
-                logger.warning("unreadable journal entry %s; skipping", p)
+                with self._lock:
+                    self.corrupt_reports.append(e.report)
+                logger.warning("corrupt journal entry %s quarantined: %s",
+                               p, e)
         out.sort(key=lambda e: e.get("seq", 0))
+        with self._lock:
+            self._depth = self._glob_depth()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Idempotent resubmission
+# ---------------------------------------------------------------------------
+
+class IdempotencyMap:
+    """A TTL'd ``idempotency_key -> (request id, settled result)`` map,
+    optionally journaled to disk so it survives a SIGKILL restart.
+
+    The retry story PR 7 built actively INSTRUCTS clients to resubmit:
+    backpressure 429s, breaker 503s and wait timeouts all carry
+    Retry-After hints — and a naive resubmit after a timeout whose
+    first attempt was actually admitted double-runs the check.  This
+    map closes that hole: ``claim`` atomically either binds a fresh
+    key to the new request id or hands back the live entry (the caller
+    then attaches the duplicate to the in-flight future, or returns
+    the settled result — under the ORIGINAL request id).  ``settle``
+    records the verdict against the key; entries expire ``ttl_s`` after
+    their last write (wall clock, so expiry works across restarts).
+
+    With a ``dir``, every bind/settle is persisted as one
+    ``store.durable`` enveloped file per key and ``replay()`` reloads
+    the map at service start — a duplicate submitted AFTER a crash
+    still attaches to the journal-replayed in-flight request (same id)
+    or gets the previously settled result.  Corrupt entries are
+    quarantined aside and counted (``errors``); persistence failures
+    never fail a submit."""
+
+    def __init__(self, dir: str | Path | None = None,  # noqa: A002
+                 ttl_s: float = 3600.0):
+        self.dir = Path(dir) if dir is not None else None
+        self.ttl_s = float(ttl_s)
+        self.errors = 0
+        self._lock = threading.Lock()
+        #: key -> {"key", "req_id", "ts", "result"}
+        self._entries: dict[str, dict] = {}      # guarded-by: _lock [rw]
+        #: monotonic state-transition stamp (every mutation bumps it)
+        self._seq = 0                            # guarded-by: _lock [rw]
+        #: the IO side: disk writes happen OUTSIDE ``_lock`` (an fsync
+        #: under the map lock would stall every stats()/lookup() behind
+        #: disk latency — the hazard class the journal depth cache just
+        #: removed) but serialized under ``_io_lock`` with a per-key
+        #: last-written seq, so an older snapshot can never overwrite a
+        #: newer state on disk.
+        self._io_lock = threading.Lock()
+        self._written: dict[str, int] = {}       # guarded-by: _io_lock [rw]
+        if self.dir is not None:
+            self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        import hashlib as _hashlib
+
+        digest = _hashlib.sha256(key.encode()).hexdigest()[:24]
+        return self.dir / f"idem-{digest}.json"
+
+    # holds: _lock
+    def _purge_locked(self) -> list[str]:
+        """Drop expired entries from memory; returns the expired keys
+        so the caller can reclaim their DISK files outside the lock
+        (a long-lived service must not grow one idem file per key it
+        ever saw until the next restart)."""
+        now = time.time()
+        dead = [k for k, e in self._entries.items()
+                if now - e["ts"] > self.ttl_s]
+        for k in dead:
+            del self._entries[k]
+            self._seq += 1
+        return dead
+
+    def _unlink_keys(self, keys) -> None:
+        """Reclaim dead keys' disk files.  A racing in-flight persist
+        (snapshot taken before the key died) may recreate a file after
+        this unlink; that residue is harmless — replay() either sees
+        an expired ts and deletes it, or an unsettled binding to a
+        request that never ran, which the rebind-after-grace path runs
+        fresh.  What must NOT leak is ``_written``: popping the key
+        here is what keeps the seq map bounded by live entries."""
+        if self.dir is None or not keys:
+            return
+        with self._io_lock:
+            for k in keys:
+                try:
+                    self._path(k).unlink(missing_ok=True)
+                except OSError:
+                    self.errors += 1
+                self._written.pop(k, None)
+
+    def _persist(self, key: str, seq: int, snapshot: dict) -> None:
+        """Write one entry snapshot taken at state-transition ``seq``.
+        Runs outside the map lock; ``_io_lock`` + the per-key
+        last-written seq enforce that disk state never goes BACKWARD
+        even when two transitions race to the writer — in-memory order
+        and on-disk order agree, which is what replay() trusts."""
+        if self.dir is None:
+            return
+        with self._io_lock:
+            if self._written.get(key, 0) >= seq:
+                return  # a newer state for this key already landed
+            self._written[key] = seq
+            try:
+                _durable.write_record(self._path(key), KIND_IDEM, snapshot)
+            except Exception:  # noqa: BLE001 — persistence is a recovery
+                # aid; the in-memory map still dedups within this process
+                self.errors += 1
+                logger.warning("idempotency entry write failed for key %r",
+                               key, exc_info=True)
+
+    def claim(self, key: str, req_id: str,
+              fp: str | None = None) -> dict | None:
+        """Atomically bind ``key`` to ``req_id`` — unless a live entry
+        already holds it, in which case THAT entry (a copy) is returned
+        and nothing is written.  None means the claim is ours.  ``fp``
+        (the history fingerprint) is stored on the entry so the caller
+        can detect KEY REUSE across different histories — without it a
+        key collision would hand one caller another history's
+        verdict."""
+        key = str(key)
+        with self._lock:
+            dead = self._purge_locked()
+            e = self._entries.get(key)
+            if e is not None:
+                snapshot, seq = dict(e), None
+            else:
+                self._seq += 1
+                seq = self._seq
+                e = {"key": key, "req_id": str(req_id), "ts": time.time(),
+                     "result": None, "fp": fp}
+                self._entries[key] = e
+                snapshot = dict(e)
+        self._unlink_keys(dead)
+        if seq is None:
+            return snapshot
+        self._persist(key, seq, snapshot)
+        return None
+
+    def rebind(self, key: str, old_req_id: str, new_req_id: str) -> bool:
+        """CAS a STALE entry (its request evaporated — e.g. evicted
+        before settling) onto a new request id.  False when the entry
+        changed underneath (someone else rebound or settled it)."""
+        key = str(key)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e["req_id"] != str(old_req_id) \
+                    or e["result"] is not None:
+                return False
+            e["req_id"] = str(new_req_id)
+            e["ts"] = time.time()
+            self._seq += 1
+            seq, snapshot = self._seq, dict(e)
+        self._persist(key, seq, snapshot)
+        return True
+
+    def settle(self, key: str, result: Mapping | None) -> None:
+        """Record the settled verdict against ``key`` (refreshes the
+        TTL: a settled entry answers duplicates for a full window after
+        the verdict, not after the submit)."""
+        key = str(key)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            e["result"] = store._jsonable(dict(result)) \
+                if result is not None else None
+            e["ts"] = time.time()
+            self._seq += 1
+            seq, snapshot = self._seq, dict(e)
+        self._persist(key, seq, snapshot)
+
+    def release(self, key: str, req_id: str) -> None:
+        """Drop OUR unsettled claim (the submit it covered failed
+        admission) so the client's retry isn't answered with a request
+        that never existed."""
+        key = str(key)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e["req_id"] != str(req_id) \
+                    or e["result"] is not None:
+                return
+            del self._entries[key]
+            self._seq += 1
+        self._unlink_keys([key])
+
+    def lookup(self, key: str) -> dict | None:
+        with self._lock:
+            dead = self._purge_locked()
+            e = self._entries.get(str(key))
+            out = dict(e) if e is not None else None
+        self._unlink_keys(dead)
+        return out
+
+    def depth(self) -> int:
+        with self._lock:
+            dead = self._purge_locked()
+            n = len(self._entries)
+        self._unlink_keys(dead)
+        return n
+
+    def replay(self) -> int:
+        """Reload the journaled map (service start).  Expired files are
+        deleted, corrupt ones quarantined + counted; returns live
+        entries loaded."""
+        if self.dir is None:
+            return 0
+        n = 0
+        now = time.time()
+        for p in sorted(self.dir.glob("idem-*.json")):
+            try:
+                rr = _durable.read_verified(p, KIND_IDEM)
+            except _durable.DurableError as e:
+                self.errors += 1
+                logger.warning("corrupt idempotency entry %s quarantined: "
+                               "%s", p, e)
+                continue
+            e = rr.payload
+            if not isinstance(e, dict) or "key" not in e:
+                self.errors += 1
+                continue
+            if now - float(e.get("ts") or 0) > self.ttl_s:
+                with contextlib.suppress(OSError):
+                    p.unlink()
+                continue
+            with self._lock:
+                self._entries[str(e["key"])] = {
+                    "key": str(e["key"]),
+                    "req_id": str(e.get("req_id") or ""),
+                    "ts": float(e.get("ts") or now),
+                    "result": e.get("result"),
+                    # fp must survive the restart or key-reuse-across-
+                    # histories rejection silently turns off after it
+                    "fp": e.get("fp"),
+                }
+            n += 1
+        return n
+
+    def describe(self) -> dict:
+        with self._lock:
+            dead = self._purge_locked()
+            out = {
+                "entries": len(self._entries),
+                "settled": sum(1 for e in self._entries.values()
+                               if e["result"] is not None),
+                "ttl_s": self.ttl_s,
+                "errors": self.errors,
+                "journaled": self.dir is not None,
+            }
+        self._unlink_keys(dead)
         return out

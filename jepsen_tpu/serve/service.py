@@ -48,7 +48,6 @@ never how they are decided.
 from __future__ import annotations
 
 import itertools
-import json
 import logging
 import threading
 import time
@@ -61,6 +60,7 @@ from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
 from jepsen_tpu.obs import metrics
 from jepsen_tpu.serve import health as _health
+from jepsen_tpu.store import durable as _durable
 from jepsen_tpu.serve import slo as _slo
 from jepsen_tpu.serve.sched import admission as _sched_adm
 from jepsen_tpu.serve.sched import packing as _sched_pack
@@ -83,8 +83,19 @@ _KEEP_DONE = 1024
 
 #: drain metadata file (model name + histories + request ids), written
 #: next to the store.checkpoint files so resume_drained can rebuild the
-#: exact batch_analysis call the scheduler would have made.
+#: exact batch_analysis call the scheduler would have made.  Written as
+#: a store.durable envelope (checksummed + versioned); pre-envelope
+#: drain dirs resume through the registered legacy migration.
 DRAIN_META = "drained.json"
+KIND_DRAIN = "drain-meta"
+
+_durable.register_kind(KIND_DRAIN, 1)
+
+
+@_durable.register_migration(KIND_DRAIN, 0)
+def _drain_v0_to_v1(payload):
+    # v0 was the bare meta dict — same fields, no checksum.
+    return dict(payload), 1
 
 
 def model_by_name(name: str) -> m.Model:
@@ -161,6 +172,7 @@ class CheckRequest:
         "group", "future", "status", "result", "t_submit", "t_done",
         "t_start", "t_launch", "t_launch_end",
         "trace_id", "ctx", "tier", "kind", "checker", "escalated", "fp",
+        "idem_key",
     )
 
     def __init__(self, *, seq, model, history, priority, deadline, client,
@@ -182,6 +194,7 @@ class CheckRequest:
         self.kind = kind          # "ladder" | "graph"
         self.checker = checker    # graph requests: the Checker instance
         self.escalated = False    # fast path couldn't finish; rode the ladder
+        self.idem_key = None      # idempotency key (service sets + settles)
         self.future = CheckFuture()
         self.future.id = self.id
         self.status = "queued"
@@ -345,6 +358,8 @@ class CheckService:
         warm_pool: bool = True,
         drain_dir: str | Path | None = None,
         journal_dir: str | Path | None = None,
+        idempotency_dir: str | Path | None = None,
+        idempotency_ttl_s: float = 3600.0,
         quarantine_ttl_s: float = 900.0,
         poison_bisect: bool = True,
         breaker_threshold: int = 5,
@@ -404,6 +419,7 @@ class CheckService:
             "quarantined": 0, "poison_isolated": 0, "bisect_launches": 0,
             "watchdog_trips": 0, "journal_replayed": 0,
             "devices_replaced": 0, "breaker_rejected": 0, "drain_errors": 0,
+            "idempotent_hits": 0,
         }
         # -- the self-healing layer (serve.health) ----------------------
         self.quarantine = _health.Quarantine(ttl_s=quarantine_ttl_s)
@@ -422,6 +438,17 @@ class CheckService:
             _health.AdmissionJournal(journal_dir)
             if journal_dir is not None else None
         )
+        #: the idempotent-resubmission registry: in-memory always (a
+        #: duplicate within one process dedups regardless), journaled
+        #: when ``idempotency_dir`` is set so it survives SIGKILL.
+        self.idempotency = _health.IdempotencyMap(
+            idempotency_dir, ttl_s=idempotency_ttl_s
+        )
+        #: keys with a submit currently mid-_admit (claim taken, request
+        #: not yet in _requests): count per key — the live signal that
+        #: stops a concurrent duplicate from treating the claim as
+        #: stale, however long the admission's journal fsync stalls.
+        self._idem_admitting: dict[str, int] = {}  # guarded-by: _lock [rw]
         self.health_probe_every_s = health_probe_every_s
         self._t_probe = 0.0                      # guarded-by: _lock [rw]
         # -- the live SLO burn-rate engine (serve.slo) -------------------
@@ -472,6 +499,7 @@ class CheckService:
         trace_id: str | None = None,
         class_: str | None = None,
         checker=None,
+        idempotency_key: str | None = None,
     ) -> CheckFuture:
         """Admit one history; returns a future resolving to its verdict.
 
@@ -486,7 +514,13 @@ class CheckService:
         at admission and run on the host side lane, never occupying a
         geometry bucket.  ``trace_id`` joins this request to a caller's
         existing trace (HTTP clients pass it in the POST body); None
-        mints a fresh id.  Raises ``QueueFull`` (backpressure, with a
+        mints a fresh id.  ``idempotency_key``: a caller-chosen token
+        making resubmission safe — a duplicate submit (a retry after a
+        timeout / 429 / breaker 503, even across a SIGKILL restart when
+        ``idempotency_dir`` + ``journal_dir`` are set) attaches to the
+        in-flight request's future or returns the already-settled
+        result, under the ORIGINAL request id, instead of running the
+        check again.  Raises ``QueueFull`` (backpressure, with a
         per-class retry-after) or ``ServiceClosed``."""
         # Coerce every argument BEFORE reserving a slot: a reservation
         # leaked past a bad-argument raise would shrink admission
@@ -503,6 +537,164 @@ class CheckService:
                 f"unknown latency class {class_!r}; expected one of "
                 f"{_sched_adm.CLASSES}"
             )
+        idem_key = (str(idempotency_key)
+                    if idempotency_key is not None else None)
+        idem_req_id = None
+        idem_fp = None
+        if idem_key is None:
+            return self._admit(
+                model=model, history=history, priority=priority,
+                deadline=deadline, client=client, trace_id=trace_id,
+                class_=class_, checker=checker,
+            )
+        # Claim-before-admit: the claim is atomic in the map, so two
+        # racing duplicates can't both reach a launch.  The claim holds
+        # the request id we WOULD mint; if this submit fails admission
+        # (queue full, breaker, bad input), the claim is released so
+        # the client's retry runs fresh.  The history fingerprint rides
+        # the entry so key REUSE across different histories is rejected
+        # instead of handing this caller someone else's verdict.
+        idem_req_id = uuid.uuid4().hex[:12]
+        if checker is None:
+            idem_fp = _health.history_fingerprint(history)
+        with self._lock:
+            self._idem_admitting[idem_key] = \
+                self._idem_admitting.get(idem_key, 0) + 1
+        try:
+            hit = self._idem_claim(idem_key, idem_req_id, client, idem_fp)
+            if hit is not None:
+                return hit
+            try:
+                return self._admit(
+                    model=model, history=history, priority=priority,
+                    deadline=deadline, client=client, trace_id=trace_id,
+                    class_=class_, checker=checker, idem_key=idem_key,
+                    request_id=idem_req_id, fp_hint=idem_fp,
+                )
+            except BaseException as e:
+                # A simulated crash (faults.CrashPoint) must leave the
+                # SIGKILL disk state — the key stays bound, exactly as
+                # a real kill would leave it; every OTHER failure
+                # releases the claim so the client's retry runs fresh.
+                if not isinstance(e, faults.CrashPoint):
+                    self.idempotency.release(idem_key, idem_req_id)
+                raise
+        finally:
+            with self._lock:
+                n = self._idem_admitting.get(idem_key, 0) - 1
+                if n <= 0:
+                    self._idem_admitting.pop(idem_key, None)
+                else:
+                    self._idem_admitting[idem_key] = n
+
+    #: safety cap on how long a duplicate waits for a same-key submit
+    #: that is mid-admission.  The live "is someone admitting this key"
+    #: signal is the ``_idem_admitting`` counter (exact, no clock); the
+    #: cap only bounds the wait against a pathologically wedged
+    #: admission so the duplicate eventually treats the entry as stale.
+    _IDEM_ADMIT_WAIT_CAP_S = 60.0
+
+    def _idem_claim(self, key: str, new_req_id: str, client: str,
+                    fp: str | None) -> CheckFuture | None:
+        """The duplicate-submit check: None means the claim is OURS (a
+        fresh request proceeds under ``new_req_id``); a future means
+        this key is already live — the in-flight original's future, or
+        a fresh future pre-resolved with the settled result (original
+        request id either way).  Raises ValueError when the key is
+        bound to a DIFFERENT history's fingerprint — key reuse must
+        never hand this caller someone else's verdict."""
+        t0 = time.monotonic()
+        while True:
+            entry = self.idempotency.claim(key, new_req_id, fp=fp)
+            if entry is None:
+                return None
+            if (fp is not None and entry.get("fp")
+                    and entry["fp"] != fp):
+                raise ValueError(
+                    f"idempotency_key {key!r} is already bound to a "
+                    "submission with a DIFFERENT history; reusing a key "
+                    "across histories would return the wrong verdict — "
+                    "pick a fresh key per logical request"
+                )
+            if entry.get("result") is not None:
+                fut = CheckFuture()
+                fut.id = str(entry["req_id"])
+                fut.set_result(entry["result"])
+                self._count_idem_hit(client)
+                return fut
+            with self._lock:
+                req = self._requests.get(str(entry["req_id"]))
+                admitting = self._idem_admitting.get(key, 0)
+            if req is not None:
+                self._count_idem_hit(client)
+                return req.future
+            if (admitting > 1
+                    and time.monotonic() - t0 < self._IDEM_ADMIT_WAIT_CAP_S):
+                # Claimed but not yet registered, and another submit of
+                # THIS key (the original) is verifiably mid-_admit on a
+                # live thread (the counter includes us, so > 1 means
+                # someone else): wait for it to land in _requests or
+                # release — rebinding now would run the check twice.
+                # The counter, not a clock: a stalled journal fsync in
+                # the original's _admit cannot fake staleness.
+                time.sleep(0.005)
+                continue
+            # Genuinely stale: the bound request evaporated unsettled
+            # (evicted, or a crash without the journal).  CAS the key
+            # onto our fresh request; a lost race means someone else
+            # just did — loop and read their entry.
+            if self.idempotency.rebind(key, entry["req_id"], new_req_id):
+                return None
+
+    def _count_idem_hit(self, client: str) -> None:
+        with self._lock:
+            self._totals["idempotent_hits"] += 1
+        # mirrors to /metrics as jepsen_tpu_serve_idempotent_hits_total
+        obs.counter("serve.idempotent_hits", client=client)
+
+    def _idem_watch(self, req: CheckRequest, key: str | None) -> None:
+        """Wire a request to settle its idempotency entry: a DONE
+        verdict is recorded against the key (duplicates for the next
+        TTL window get it without a run); any other terminal status —
+        expired, drained, quarantined, batch error — RELEASES the key
+        instead: the check never (usefully) ran, so a retry should run
+        it, and none of those paths can double-run anything."""
+        if key is None:
+            return
+        req.idem_key = key
+
+        def _done(f):
+            try:
+                if not f.cancelled() and req.status == "done":
+                    self.idempotency.settle(key, req.result)
+                else:
+                    self.idempotency.release(key, req.id)
+            except Exception:  # noqa: BLE001 — bookkeeping must not
+                # break the resolve path
+                logger.exception("idempotency settle failed for key %r",
+                                 key)
+
+        req.future.add_done_callback(_done)
+
+    def _admit(
+        self,
+        *,
+        model,
+        history,
+        priority,
+        deadline,
+        client,
+        trace_id,
+        class_,
+        checker,
+        idem_key=None,
+        request_id=None,
+        fp_hint=None,
+    ) -> CheckFuture:
+        """The admission body behind ``submit`` (arguments already
+        coerced, idempotency claim already held by the caller;
+        ``fp_hint`` is the history fingerprint the claim path already
+        computed, so it isn't hashed twice)."""
         if not self.breaker.allow():
             # The breaker gates ADMISSION, not the queue: K consecutive
             # batch failures mean the device isn't serving — queueing
@@ -514,7 +706,7 @@ class CheckService:
             raise ServiceUnavailable(self.breaker.retry_after())
         fp = None
         if checker is None:
-            fp = _health.history_fingerprint(history)
+            fp = fp_hint or _health.history_fingerprint(history)
             q = self.quarantine.check(fp)
             if q is not None:
                 # Repeat offender: skip straight to rejection — this
@@ -527,8 +719,9 @@ class CheckService:
                     seq=next(self._seq), model=model, history=history,
                     priority=priority, deadline=deadline, client=client,
                     group=None, trace_id=trace_id,
-                    tier=class_ or "batch", fp=fp,
+                    tier=class_ or "batch", fp=fp, request_id=request_id,
                 )
+                self._idem_watch(req, idem_key)
                 with self._lock:
                     if self._closed:
                         raise ServiceClosed(
@@ -631,13 +824,16 @@ class CheckService:
                 seq=next(self._seq), model=model, history=history,
                 priority=priority, deadline=deadline, client=client,
                 group=group, trace_id=trace_id, tier=tier, kind=kind,
-                checker=checker, fp=fp,
+                checker=checker, fp=fp, request_id=request_id,
             )
+            self._idem_watch(req, idem_key)
             if (self.journal is not None and kind == "ladder"
                     and group is not None):
                 # Journal BEFORE the queue push: a crash between the
                 # two replays a request nobody queued (harmless — it
                 # just runs) instead of losing one somebody admitted.
+                # The idempotency key rides along so a post-crash
+                # duplicate still binds to the replayed request.
                 self.journal.record(
                     req_id=req.id, seq=req.seq, model_name=model.name,
                     history=req.history, priority=req.priority,
@@ -645,6 +841,7 @@ class CheckService:
                     trace_id=req.trace_id,
                     deadline_s=(deadline.remaining()
                                 if deadline is not None else None),
+                    idempotency_key=idem_key,
                 )
         except BaseException:
             with self._lock:
@@ -759,6 +956,24 @@ class CheckService:
         if self._thread is not None:
             return self
         metrics.enable_mirror()
+        # Reclaim *.tmp orphans crashed writers left in the durable
+        # dirs this service owns (store.durable.sweep_tmp counts them
+        # as durable.tmp_swept).  The journal/idempotency dirs are
+        # exclusively ours — a starting service means their previous
+        # writer is dead, so no age gate; the drain dir may be shared
+        # with a concurrently-draining sibling, so its sweep keeps the
+        # age gate.
+        if self.journal is not None:
+            _durable.sweep_tmp(self.journal.dir, min_age_s=0.0,
+                               what="serve.journal")
+        if self.idempotency.dir is not None:
+            _durable.sweep_tmp(self.idempotency.dir, min_age_s=0.0,
+                               what="serve.idempotency")
+        if self.drain_dir is not None and self.drain_dir.is_dir():
+            _durable.sweep_tmp(self.drain_dir, what="serve.drain")
+            for sub in self.drain_dir.iterdir():
+                if sub.is_dir():
+                    _durable.sweep_tmp(sub, what="serve.drain")
         self.recover()
         if self.warm_pool and self._check_opts.get(
                 "confirm_refutations", True) is True:
@@ -792,9 +1007,19 @@ class CheckService:
         ``GET /check/<id>`` across the crash still finds its request.
         Called by ``start()``; step()-driven tests call it directly.
         Idempotent per service instance.  Returns requests replayed."""
-        if self.journal is None or self._recovered:
+        if self._recovered:
             return 0
         self._recovered = True
+        # The idempotency map replays FIRST — and regardless of whether
+        # an admission journal exists: a service configured with only
+        # idempotency_dir still owes duplicates their settled results
+        # across a restart.  With a journal, the map's entries point at
+        # the request ids about to be resurrected, so a duplicate
+        # arriving mid-recovery binds to the replayed request, not a
+        # fresh run.
+        self.idempotency.replay()
+        if self.journal is None:
+            return 0
         n = 0
         for e in self.journal.replay():
             try:
@@ -818,6 +1043,19 @@ class CheckService:
                 request_id=str(e.get("id") or "") or None,
                 fp=_health.history_fingerprint(history),
             )
+            idem_key = e.get("idempotency_key")
+            if idem_key:
+                # Re-bind the key to the resurrected request: the idem
+                # journal normally already points at this id, but if
+                # ITS entry was lost/corrupt the admission journal is
+                # the backup copy of the binding.
+                existing = self.idempotency.claim(idem_key, req.id,
+                                                  fp=req.fp)
+                if (existing is not None and existing.get("result") is None
+                        and existing["req_id"] != req.id):
+                    self.idempotency.rebind(idem_key, existing["req_id"],
+                                            req.id)
+                self._idem_watch(req, str(idem_key))
             with self._cond:
                 self._totals["submitted"] += 1
                 self._totals["journal_replayed"] += 1
@@ -1823,8 +2061,13 @@ class CheckService:
                 # -- self-healing layer (serve.health) ------------------
                 "breaker": self.breaker.describe(),
                 "quarantine": self.quarantine.describe(),
+                "idempotency": self.idempotency.describe(),
                 "journal_depth": (
                     self.journal.depth() if self.journal is not None
+                    else None
+                ),
+                "journal_errors": (
+                    self.journal.errors if self.journal is not None
                     else None
                 ),
                 "watchdog_timeout_s": (
@@ -1965,10 +2208,7 @@ class CheckService:
                             store._jsonable(list(r.history)) for r in rs
                         ],
                     }
-                    store._atomic_write(
-                        sub / DRAIN_META,
-                        json.dumps(meta, indent=1, default=str),
-                    )
+                    _durable.write_record(sub / DRAIN_META, KIND_DRAIN, meta)
                     batch.batch_analysis(
                         rs[0].model, [r.history for r in rs],
                         capacity=self.capacity, mesh=self._placement.mesh,
@@ -2017,9 +2257,14 @@ class CheckService:
 
 def resume_drained(drain_dir: str | Path, **kw) -> list[dict]:
     """Finish work a shutdown drained: for each group subdir, reload the
-    histories from DRAIN_META and re-enter the saved checkpoint
-    (``batch_analysis(resume=True)`` — the saved ladder config wins).
-    Returns [{"dir", "model", "ids", "results"}] per group."""
+    histories from DRAIN_META (verified + migrated by ``store.durable``;
+    pre-envelope drain dirs still resume) and re-enter the saved
+    checkpoint (``batch_analysis(resume=True)`` — the saved ladder
+    config wins).  Returns [{"dir", "model", "ids", "results"}] per
+    group; a group whose meta is CORRUPT is quarantined aside and
+    reported as {"dir", "error": <corruption report>} instead of being
+    silently skipped — the operator learns which group's work is gone,
+    the rest still resume."""
     from jepsen_tpu.parallel import batch
 
     out = []
@@ -2028,7 +2273,12 @@ def resume_drained(drain_dir: str | Path, **kw) -> list[dict]:
         meta_p = sub / DRAIN_META
         if not meta_p.is_file():
             continue
-        meta = json.loads(meta_p.read_text())
+        try:
+            meta = _durable.read_verified(meta_p, KIND_DRAIN).payload
+        except _durable.DurableError as e:
+            logger.warning("corrupt drain meta %s: %s", meta_p, e)
+            out.append({"dir": str(sub), "error": e.report})
+            continue
         model = model_by_name(meta["model"])
         results = batch.batch_analysis(
             model, meta["histories"], checkpoint_dir=sub, resume=True, **kw

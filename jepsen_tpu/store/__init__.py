@@ -107,17 +107,44 @@ def _fsync_dir(d: Path) -> None:
         os.close(fd)
 
 
+def _write_seam(step: str, path) -> None:
+    """The crashpoint-audit seam: every ``_atomic_write`` step announces
+    itself through ``faults.INJECT`` so ``tools/crashpoint.py`` can kill
+    (SIGKILL in a child) or simulate a death (``faults.CrashPoint``) at
+    exactly post-tmp / post-fsync / post-rename / pre-dir-fsync.
+    Injectors MUST filter on ``ctx["what"] == "store.atomic_write"`` —
+    a rate-based launch-fault schedule raising here would fault writes
+    no retry policy covers (``faults.seeded_injector`` skips these
+    seams unless explicitly targeted).  Lazy import: the store package
+    stays importable without the faults layer resolved first."""
+    from jepsen_tpu import faults
+
+    hook = faults.INJECT
+    if hook is not None:
+        hook({"what": "store.atomic_write", "step": step,
+              "path": str(path)}, 0)
+
+
 def _atomic_write(path: Path, data: str | bytes):
     """tmp + fsync + rename + dir fsync: a reader never sees a torn
     file (rename atomicity), and a completed write survives a hard
     power cut (the data AND the directory entry are durable before the
     tmp name disappears).  Checkpoints and results both ride this.
+    Torn-by-other-means (bit rot, hand edits, partial copies) is the
+    durable-record layer's job — see ``store.durable``, whose checksums
+    wrap every artifact that outlives a process.
 
     The tmp name is UNIQUE per writer (mkstemp), not ``<path>.tmp``:
     composed checkers write into one run dir concurrently, and two
     writers sharing a fixed tmp name could publish a torn mix of both.
     Concurrent same-path writers thus stay last-writer-wins, each write
-    atomic."""
+    atomic.  (Crashed writers leave their unique ``*.tmp`` behind;
+    ``durable.sweep_tmp`` reclaims them at store open / service start.)
+
+    Each write step runs the ``faults.INJECT`` crashpoint seam
+    (``_write_seam``).  A ``faults.CrashPoint`` raised there simulates
+    the process dying at that step: NO cleanup runs, so the on-disk
+    state is exactly what a SIGKILL at that instant leaves."""
     path = Path(path)
     fd, tmp = tempfile.mkstemp(
         dir=str(path.parent or "."), prefix=path.name + ".", suffix=".tmp"
@@ -127,13 +154,20 @@ def _atomic_write(path: Path, data: str | bytes):
         with os.fdopen(fd, "wb" if binary else "w") as f:
             f.write(data)
             f.flush()
+            _write_seam("post-tmp", path)
             os.fsync(f.fileno())
+        _write_seam("post-fsync", path)
         os.chmod(tmp, 0o644)  # mkstemp's 0600 would hide artifacts from the web UI user
         os.replace(tmp, path)
-    except BaseException:
-        with _contextlib.suppress(OSError):
-            os.unlink(tmp)
+    except BaseException as e:
+        from jepsen_tpu import faults as _faults
+
+        if not isinstance(e, _faults.CrashPoint):
+            with _contextlib.suppress(OSError):
+                os.unlink(tmp)
         raise
+    _write_seam("post-rename", path)
+    _write_seam("pre-dir-fsync", path)
     _fsync_dir(path.parent)
 
 
@@ -166,6 +200,12 @@ def save_0(test: Mapping) -> Mapping:
 
     d = test_dir(test)
     d.mkdir(parents=True, exist_ok=True)
+    # Store open is the sweep point for ``*.tmp`` orphans a crashed
+    # writer left in this run dir (age-gated: a concurrently-writing
+    # composed checker's live tmp survives).
+    from jepsen_tpu.store import durable as _durable
+
+    _durable.sweep_tmp(d, what="store")
     _write_json(d / "test.json", serializable_test(test))
     w = fmt.Writer(_run_file(d))
     w.write_test(test)

@@ -17,9 +17,17 @@ to an uninterrupted run:
       (bsnap, state, fok, fcr, alive) tuples), keyed by history index.
 
 Both files ride ``store._atomic_write`` (tmp + fsync + rename + dir
-fsync), npz BEFORE json — the json names the stage the npz belongs to,
-so a crash between the two leaves a json that simply predates the npz's
-extra rows (never the reverse: a json pointing at missing frontiers).
+fsync) and the ``store.durable`` envelope: the json carries a CRC32
+over its payload plus a per-file digest MANIFEST of the npz it belongs
+to, npz written BEFORE json.  A crash between the two (or bit rot,
+truncation, hand-editing on either file) is therefore *detected* at
+load — the mismatched pair is quarantined aside
+(``<name>.corrupt-<n>``) and the raised ``CheckpointError`` carries a
+machine-readable corruption report (``.report``); the consumer runs
+fresh, which reproduces uninterrupted verdicts, never resumes a
+mixed-generation pair.  Old pre-envelope checkpoints load through the
+``durable`` migration registry instead of being rejected for their
+version.
 
 Resume semantics: ``load()`` hands the saved state back;
 ``batch_analysis(resume=True)`` verifies the fingerprint against the
@@ -42,11 +50,15 @@ from typing import Mapping, Sequence
 import numpy as np
 
 from jepsen_tpu import store as _store
+from jepsen_tpu.store import durable as _durable
 
 CKPT_JSON = "checker-checkpoint.json"
 CKPT_NPZ = "checker-checkpoint.npz"
 
-VERSION = 1
+#: payload version 2 = the durable-envelope era (checksummed json with
+#: an npz digest manifest); version 1 was the bare pre-envelope doc,
+#: readable through the migration below.
+VERSION = 2
 
 #: chunked-scan (single-history) checkpoint pair: the carried — possibly
 #: HOST-SPILLED, so row count is unbounded — frontier between chunk
@@ -57,11 +69,39 @@ VERSION = 1
 CHUNK_JSON = "chunk-checkpoint.json"
 CHUNK_NPZ = "chunk-checkpoint.npz"
 
-CHUNK_VERSION = 1
+CHUNK_VERSION = 2
+
+KIND_LADDER = "ladder-checkpoint"
+KIND_CHUNK = "chunk-checkpoint"
+
+_durable.register_kind(KIND_LADDER, VERSION)
+_durable.register_kind(KIND_CHUNK, CHUNK_VERSION)
+
+
+@_durable.register_migration(KIND_LADDER, 1)
+def _ladder_v1_to_v2(payload):
+    # v1 was the bare doc with its own "version" key and no checksums;
+    # the field shapes are otherwise identical.
+    payload = {k: v for k, v in dict(payload).items() if k != "version"}
+    return payload, 2
+
+
+@_durable.register_migration(KIND_CHUNK, 1)
+def _chunk_v1_to_v2(payload):
+    payload = {k: v for k, v in dict(payload).items() if k != "version"}
+    return payload, 2
 
 
 class CheckpointError(Exception):
-    """Missing, torn, or version-incompatible checkpoint."""
+    """Missing, torn, corrupt, or version-incompatible checkpoint.
+
+    ``report`` (when present) is the durable layer's machine-readable
+    corruption report — consumers embed it in their ``cause`` / fault
+    telemetry instead of a bare string."""
+
+    def __init__(self, message: str, report: dict | None = None):
+        self.report = report
+        super().__init__(message)
 
 
 def json_path(d) -> Path:
@@ -143,6 +183,7 @@ def save(
     d = Path(d)
     d.mkdir(parents=True, exist_ok=True)
     resumes = dict(resumes or {})
+    files = None
     if resumes:
         arrays = {}
         for i, (bsnap, st, fo, fc, al) in resumes.items():
@@ -153,9 +194,13 @@ def save(
             arrays[f"{i}_al"] = np.asarray(al)
         buf = io.BytesIO()
         np.savez(buf, **arrays)
-        _store._atomic_write(d / CKPT_NPZ, buf.getvalue())
+        data = buf.getvalue()
+        _store._atomic_write(d / CKPT_NPZ, data)
+        # The json's manifest digests THIS npz: load() can prove the
+        # pair belongs together (a crash between the two writes, or a
+        # corrupted sibling, is detected instead of assumed away).
+        files = {CKPT_NPZ: _durable.digest_bytes(data)}
     doc = {
-        "version": VERSION,
         "complete": bool(complete),
         "config": config,
         "stage": int(stage),
@@ -166,8 +211,8 @@ def save(
         "resumes": sorted(int(i) for i in resumes),
         "rungs": {str(i): int(r) for i, r in (rungs or {}).items()},
     }
-    _store._atomic_write(
-        json_path(d), json.dumps(_store._jsonable(doc), indent=1)
+    _durable.write_record(
+        json_path(d), KIND_LADDER, _store._jsonable(doc), files=files
     )
     return json_path(d)
 
@@ -210,9 +255,9 @@ def save_chunked(
     st, fo, fc = frontier
     buf = io.BytesIO()
     np.savez(buf, st=np.asarray(st), fo=np.asarray(fo), fc=np.asarray(fc))
-    _store._atomic_write(d / CHUNK_NPZ, buf.getvalue())
+    data = buf.getvalue()
+    _store._atomic_write(d / CHUNK_NPZ, data)
     doc = {
-        "version": CHUNK_VERSION,
         "config": config,
         "barrier": int(barrier),
         "cap_idx": int(cap_idx),
@@ -224,33 +269,67 @@ def save_chunked(
         "spill_spent": int(spill_spent),
         "result": result,
     }
-    _store._atomic_write(
-        chunk_json_path(d), json.dumps(_store._jsonable(doc), indent=1)
+    _durable.write_record(
+        chunk_json_path(d), KIND_CHUNK, _store._jsonable(doc),
+        files={CHUNK_NPZ: _durable.digest_bytes(data)},
     )
     return chunk_json_path(d)
 
 
+def _quarantine_pair(d, names, kind: str, reason: str) -> list[str]:
+    out = []
+    for name in names:
+        p = Path(d) / name
+        if p.exists():
+            q = _durable.quarantine_file(p, reason=reason, kind=kind)
+            if q:
+                out.append(q)
+    return out
+
+
+def quarantine(d, *, reason: str = "stale") -> list[str]:
+    """Move the ladder checkpoint pair in ``d`` aside
+    (``<name>.corrupt-<n>``) — the fingerprint-mismatch / corruption
+    path: the files must leave the resume glob so a LATER ``--resume``
+    can't pick the stale state back up, but they stay on disk as
+    evidence.  Returns the quarantine paths."""
+    return _quarantine_pair(d, (CKPT_JSON, CKPT_NPZ), KIND_LADDER, reason)
+
+
+def quarantine_chunked(d, *, reason: str = "stale") -> list[str]:
+    """``quarantine`` for the chunked-scan checkpoint pair."""
+    return _quarantine_pair(d, (CHUNK_JSON, CHUNK_NPZ), KIND_CHUNK, reason)
+
+
 def load_chunked(d) -> dict:
-    """Load a chunked-scan checkpoint; raises CheckpointError on a
-    missing/torn/unknown-version file."""
+    """Load a chunked-scan checkpoint; raises CheckpointError (with the
+    durable layer's ``.report`` when applicable) on a missing, torn,
+    corrupt, or unmigratable file.  Corrupt pairs are quarantined
+    aside by the durable layer before the raise."""
     p = chunk_json_path(d)
-    if not p.exists():
-        raise CheckpointError(f"no {CHUNK_JSON} in {d}")
     try:
-        doc = json.loads(p.read_text())
-    except (OSError, ValueError) as e:
-        raise CheckpointError(f"unreadable {p}: {e}") from e
-    if doc.get("version") != CHUNK_VERSION:
-        raise CheckpointError(
-            f"unknown chunk-checkpoint version {doc.get('version')!r}")
+        rr = _durable.read_verified(p, KIND_CHUNK)
+    except _durable.DurableError as e:
+        raise CheckpointError(str(e), e.report) from e
+    doc = rr.payload
     npz = Path(d) / CHUNK_NPZ
     if not npz.exists():
-        raise CheckpointError(f"{p} references missing {CHUNK_NPZ}")
+        # legacy pairs carry no manifest; enveloped ones already proved
+        # the sibling exists with matching digest
+        raise CheckpointError(
+            f"{p} references missing {CHUNK_NPZ}",
+            {"artifact": KIND_CHUNK, "path": str(npz),
+             "reason": "missing-sibling"})
     try:
         with np.load(npz) as a:
             frontier = (a["st"], a["fo"], a["fc"])
     except (OSError, ValueError, KeyError) as e:
-        raise CheckpointError(f"unreadable {npz}: {e}") from e
+        q = _durable.quarantine_file(npz, reason="npz-unreadable",
+                                     kind=KIND_CHUNK)
+        raise CheckpointError(
+            f"unreadable {npz}: {e}",
+            {"artifact": KIND_CHUNK, "path": str(npz),
+             "reason": "npz-unreadable", "quarantined_to": q}) from e
     return {
         "config": doc.get("config") or {},
         "barrier": int(doc.get("barrier") or 0),
@@ -270,16 +349,15 @@ def load_chunked(d) -> dict:
 def load(d) -> dict:
     """Load a checkpoint back into live shapes: int-keyed results/
     confirms, resume tuples rebuilt from the npz.  Raises
-    CheckpointError on a missing/torn/unknown-version file."""
+    CheckpointError (with the durable layer's ``.report`` when
+    applicable) on a missing, torn, corrupt, or unmigratable file;
+    corrupt json/npz pairs are quarantined aside before the raise."""
     p = json_path(d)
-    if not p.exists():
-        raise CheckpointError(f"no {CKPT_JSON} in {d}")
     try:
-        doc = json.loads(p.read_text())
-    except (OSError, ValueError) as e:
-        raise CheckpointError(f"unreadable {p}: {e}") from e
-    if doc.get("version") != VERSION:
-        raise CheckpointError(f"unknown checkpoint version {doc.get('version')!r}")
+        rr = _durable.read_verified(p, KIND_LADDER)
+    except _durable.DurableError as e:
+        raise CheckpointError(str(e), e.report) from e
+    doc = rr.payload
     out = {
         "complete": bool(doc.get("complete")),
         "config": doc.get("config") or {},
@@ -296,19 +374,34 @@ def load(d) -> dict:
     if want:
         npz = Path(d) / CKPT_NPZ
         if not npz.exists():
-            raise CheckpointError(f"{p} references missing {CKPT_NPZ}")
-        with np.load(npz) as a:
-            for i in want:
-                try:
-                    out["resumes"][i] = (
-                        int(a[f"{i}_bsnap"]),
-                        a[f"{i}_st"],
-                        a[f"{i}_fo"],
-                        a[f"{i}_fc"],
-                        a[f"{i}_al"],
-                    )
-                except KeyError as e:
-                    raise CheckpointError(
-                        f"{CKPT_NPZ} is missing frontier arrays for lane {i}"
-                    ) from e
+            raise CheckpointError(
+                f"{p} references missing {CKPT_NPZ}",
+                {"artifact": KIND_LADDER, "path": str(npz),
+                 "reason": "missing-sibling"})
+        try:
+            with np.load(npz) as a:
+                for i in want:
+                    try:
+                        out["resumes"][i] = (
+                            int(a[f"{i}_bsnap"]),
+                            a[f"{i}_st"],
+                            a[f"{i}_fo"],
+                            a[f"{i}_fc"],
+                            a[f"{i}_al"],
+                        )
+                    except KeyError as e:
+                        raise CheckpointError(
+                            f"{CKPT_NPZ} is missing frontier arrays for "
+                            f"lane {i}",
+                            {"artifact": KIND_LADDER, "path": str(npz),
+                             "reason": "missing-lane", "lane": i},
+                        ) from e
+        except (OSError, ValueError) as e:
+            # torn legacy npz (enveloped pairs already passed the digest)
+            q = _durable.quarantine_file(npz, reason="npz-unreadable",
+                                         kind=KIND_LADDER)
+            raise CheckpointError(
+                f"unreadable {npz}: {e}",
+                {"artifact": KIND_LADDER, "path": str(npz),
+                 "reason": "npz-unreadable", "quarantined_to": q}) from e
     return out

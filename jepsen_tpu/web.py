@@ -12,9 +12,15 @@ the check API:
 
   POST /check        submit a history ({"history": [...], "model": ...,
                      "priority", "deadline", "client", "trace_id",
-                     "class", "wait"}); "class" picks the latency tier
-                     ("interactive": the speculative greedy fast path;
-                     "batch": the continuous ladder — the default);
+                     "class", "wait", "idempotency_key"}); "class"
+                     picks the latency tier ("interactive": the
+                     speculative greedy fast path; "batch": the
+                     continuous ladder — the default);
+                     "idempotency_key" makes resubmission safe: a
+                     duplicate submit (retry after a timeout / 429 /
+                     503, even across a service restart) attaches to
+                     the original request — same id — or returns its
+                     settled result instead of re-running the check;
                      202 + request id + trace id, 200 + result with
                      "wait": true, 429 + Retry-After on backpressure
                      (the estimate is computed per latency class)
@@ -695,6 +701,9 @@ class Handler(BaseHTTPRequestHandler):
                 trace_id = body.get("trace_id")
                 if trace_id is not None:
                     trace_id = str(trace_id)
+                idem_key = body.get("idempotency_key")
+                if idem_key is not None:
+                    idem_key = str(idem_key)
                 deadline = body.get("deadline")
                 if deadline is not None:
                     deadline = faults.Deadline.coerce(float(deadline))
@@ -707,10 +716,14 @@ class Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
             try:
+                # idempotency_key makes the retry behavior this API
+                # actively instructs (429/503 Retry-After, 202-then-poll
+                # timeouts) safe: a duplicate submit attaches to the
+                # original request — same id — instead of re-running it.
                 fut = svc.submit(
                     history, model=model, priority=priority,
                     deadline=deadline, client=client, trace_id=trace_id,
-                    class_=latency_class,
+                    class_=latency_class, idempotency_key=idem_key,
                 )
             except (KeyError, TypeError, ValueError, IndexError) as e:
                 # malformed op dicts surface from pack() at admission —
@@ -760,6 +773,14 @@ class Handler(BaseHTTPRequestHandler):
                     return
                 self._send_json(
                     200, {"id": fut.id, "trace_id": tid, "result": result})
+            elif fut.done():
+                # Already settled at submit time: an idempotent
+                # duplicate of a finished request (whose original may
+                # have been evicted — the 202 href would 404 forever),
+                # or a trivially-valid history.  Hand the result over.
+                self._send_json(
+                    200, {"id": fut.id, "trace_id": tid,
+                          "result": fut.result()})
             else:
                 self._send_json(
                     202, {"id": fut.id, "status": "queued",

@@ -1,0 +1,546 @@
+"""Durable-state hardening tests (jepsen_tpu.store.durable + consumers).
+
+The envelope layer (CRC32 + version + kind + sibling digests +
+migration registry + quarantine-aside), the crashpoint-matrix unit
+cells (crash-step simulation through the ``faults.CrashPoint`` seam +
+corruption modes, each asserting verdicts identical to uninterrupted
+or an honest machine-readable report), the ledger's per-record
+checksums, the journal/idempotency surfaces, and the idempotent
+resubmission contract across a (simulated) service restart.
+
+Kernel shapes are shared with tests/test_fault_tolerance.py — (40, 5)
+register histories at capacity (16, 64, 512) — so no test adds a
+compile geometry (tier-1 budget is near the 870 s cap).  The full
+(surface x crash-step x corruption-mode) matrix incl. real SIGKILL
+children runs in docker/bin/test via ``chaos_check --crashpoint``.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import faults  # noqa: E402
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.checker import wgl_cpu  # noqa: E402
+from jepsen_tpu.obs import regress  # noqa: E402
+from jepsen_tpu.parallel import batch as pb  # noqa: E402
+from jepsen_tpu.serve import health  # noqa: E402
+from jepsen_tpu.serve import service as svc_mod  # noqa: E402
+from jepsen_tpu.store import checkpoint as ckpt  # noqa: E402
+from jepsen_tpu.store import durable  # noqa: E402
+
+#: test_fault_tolerance's exact shapes (same seeds, same capacities) —
+#: the suite compiles these kernels once.
+KW = dict(capacity=(16, 64, 512), cpu_fallback=False, exact_escalation=(),
+          confirm_refutations=False)
+
+_HIST_CACHE: dict = {}
+
+
+def make_histories(n=5, ops=40, procs=5, seed0=900, info=0.3):
+    key = (n, ops, procs, seed0, info)
+    if key not in _HIST_CACHE:
+        hists, expect = [], []
+        for i in range(n):
+            hist = valid_register_history(ops, procs, seed=seed0 + i,
+                                          info_rate=info)
+            if i % 2:
+                hist = corrupt(hist, seed=i)
+                expect.append(wgl_cpu.sweep_analysis(
+                    m.CASRegister(None), hist)["valid?"])
+            else:
+                expect.append(True)
+            hists.append(hist)
+        _HIST_CACHE[key] = (hists, expect)
+    return _HIST_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# The envelope layer
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_roundtrip(tmp_path):
+    durable.register_kind("t-round", 3)
+    p = tmp_path / "r.json"
+    durable.write_record(p, "t-round", {"a": [1, 2], "b": "x"})
+    rr = durable.read_verified(p, "t-round")
+    assert rr.payload == {"a": [1, 2], "b": "x"}
+    assert rr.version == 3 and not rr.legacy and not rr.migrated
+
+
+def test_crc_mismatch_quarantines_with_report(tmp_path):
+    """A bit flip that keeps the JSON valid still fails the payload CRC
+    — and the corrupt file moves aside so no later reader trusts it."""
+    durable.register_kind("t-crc", 1)
+    p = tmp_path / "c.json"
+    durable.write_record(p, "t-crc", {"n": 12345})
+    doc = json.loads(p.read_text())
+    doc["payload"]["n"] = 54321  # the flip the checksum exists to catch
+    p.write_text(json.dumps(doc))
+    with pytest.raises(durable.DurableError) as ei:
+        durable.read_verified(p, "t-crc")
+    rep = ei.value.report
+    assert rep["reason"] == "crc-mismatch"
+    assert rep["quarantined_to"] == [str(tmp_path / "c.json.corrupt-0")]
+    assert not p.exists()
+    assert (tmp_path / "c.json.corrupt-0").exists()
+
+
+def test_quarantine_slots_increment(tmp_path):
+    durable.register_kind("t-q", 1)
+    for i in range(2):
+        p = tmp_path / "q.json"
+        p.write_text("garbage {{{")
+        with pytest.raises(durable.DurableError):
+            durable.read_verified(p, "t-q")
+        assert (tmp_path / f"q.json.corrupt-{i}").exists()
+
+
+def test_sibling_digest_mismatch(tmp_path):
+    """The json proves which sibling it belongs to: a crash between the
+    npz and json writes (old npz digested, new npz on disk) is detected,
+    both files quarantine, the report names the sibling."""
+    durable.register_kind("t-sib", 1)
+    sib = tmp_path / "data.bin"
+    sib.write_bytes(b"generation-1")
+    durable.write_record(tmp_path / "m.json", "t-sib", {"ok": 1},
+                         files={"data.bin": durable.file_digest(sib)})
+    sib.write_bytes(b"generation-2!!")  # the crash window
+    with pytest.raises(durable.DurableError) as ei:
+        durable.read_verified(tmp_path / "m.json", "t-sib")
+    assert ei.value.report["reason"] == "sibling-crc-mismatch"
+    assert ei.value.report["sibling"] == "data.bin"
+    assert not (tmp_path / "m.json").exists() and not sib.exists()
+
+
+def test_legacy_reads_through_migration(tmp_path):
+    """A pre-envelope file is never rejected for its age: the registry
+    carries it to the current version, counted as durable.migrated."""
+    durable.register_kind("t-mig", 2)
+    durable.register_migration(
+        "t-mig", 0, lambda pl: ({**pl, "upgraded": True}, 2))
+    p = tmp_path / "legacy.json"
+    p.write_text(json.dumps({"old_field": 7}))  # bare doc, no version
+    rr = durable.read_verified(p, "t-mig")
+    assert rr.legacy and rr.migrated and rr.version == 0
+    assert rr.payload == {"old_field": 7, "upgraded": True}
+
+
+def test_future_version_is_not_quarantined(tmp_path):
+    """A FUTURE version means the reader is old, not that the file is
+    corrupt — DurableError(no-migration-path), file untouched."""
+    durable.register_kind("t-fut", 1)
+    p = tmp_path / "f.json"
+    durable.write_record(p, "t-fut", {"x": 1}, version=9)
+    with pytest.raises(durable.DurableError) as ei:
+        durable.read_verified(p, "t-fut")
+    assert ei.value.report["reason"] == "no-migration-path"
+    assert p.exists()  # evidence stays where it was
+
+
+def test_seal_and_check_line():
+    sealed = durable.seal_line({"kind": "bench", "metrics": {"x": 1.5}})
+    assert durable.check_line(sealed) == (True, False)
+    assert durable.check_line({"kind": "bench"}) == (True, True)  # legacy
+    bad = dict(sealed, metrics={"x": 9.9})
+    assert durable.check_line(bad)[0] is False
+
+
+def test_sweep_tmp_age_gate(tmp_path):
+    old = tmp_path / "a.json.x1.tmp"
+    old.write_text("torn")
+    import os
+
+    os.utime(old, (time.time() - 3600, time.time() - 3600))
+    live = tmp_path / "b.json.x2.tmp"
+    live.write_text("in-flight")
+    kept = tmp_path / "real.json"
+    kept.write_text("{}")
+    assert durable.sweep_tmp(tmp_path, min_age_s=60.0) == 1
+    assert not old.exists() and live.exists() and kept.exists()
+    assert durable.sweep_tmp(tmp_path, min_age_s=0.0) == 1
+    assert not live.exists() and kept.exists()
+
+
+# ---------------------------------------------------------------------------
+# Ledger: per-record checksums + the (records, skipped) contract
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_checked_reads(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    for i in range(3):
+        regress.append_record(
+            regress.make_record("bench", {"ops_per_s": 100.0 + i},
+                                fp={"backend": "cpu"}), p)
+    recs, skipped = regress.read_records_checked(p)
+    assert len(recs) == 3 and skipped == 0
+    assert all("crc" not in r for r in recs)  # seal stripped on read
+    # torn tail (crashed writer) + a bit-flipped middle line
+    lines = p.read_text().splitlines()
+    mid = lines[1].replace("101.0", "404.0", 1)
+    assert mid != lines[1]
+    p.write_text("\n".join([lines[0], mid, lines[2]]) + "\n"
+                 + '{"kind":"bench","metrics":{"ops')
+    recs, skipped = regress.read_records_checked(p)
+    assert len(recs) == 2 and skipped == 2
+    # the compat wrapper still returns just the records
+    assert len(regress.read_records(p)) == 2
+    ok, _report = regress.gate(recs)
+    assert ok is True
+
+
+# ---------------------------------------------------------------------------
+# Crashpoint unit cells (suite-shared kernel shapes)
+# ---------------------------------------------------------------------------
+
+
+def _crash_injector(step, path_substr, nth=1):
+    seen = {"n": 0}
+
+    def inject(ctx, attempt):
+        if (ctx.get("what") == "store.atomic_write"
+                and ctx.get("step") == step
+                and path_substr in str(ctx.get("path") or "")):
+            seen["n"] += 1
+            if seen["n"] == nth:
+                raise faults.CrashPoint(step, str(ctx.get("path")))
+
+    return inject
+
+
+def test_crashpoint_seam_announces_every_step(tmp_path):
+    from jepsen_tpu import store
+
+    steps = []
+
+    def watch(ctx, attempt):
+        if ctx.get("what") == "store.atomic_write":
+            steps.append(ctx["step"])
+
+    with faults.inject_scope(watch):
+        store._atomic_write(tmp_path / "x.json", "{}")
+    assert steps == ["post-tmp", "post-fsync", "post-rename",
+                     "pre-dir-fsync"]
+
+
+def test_crashpoint_leaves_sigkill_state(tmp_path):
+    """A CrashPoint at post-tmp leaves what SIGKILL leaves: the torn
+    tmp present, the target absent — NOT the ordinary-exception cleanup
+    path that unlinks the tmp."""
+    from jepsen_tpu import store
+
+    with faults.inject_scope(_crash_injector("post-tmp", "y.json")):
+        with pytest.raises(faults.CrashPoint):
+            store._atomic_write(tmp_path / "y.json", "data")
+    assert not (tmp_path / "y.json").exists()
+    assert len(list(tmp_path.glob("y.json.*.tmp"))) == 1
+
+
+@pytest.mark.parametrize("step", ["post-tmp", "post-rename"])
+def test_ladder_crash_step_then_resume_identical(tmp_path, step):
+    """One crashpoint-matrix crash-step cell per artifact state: die at
+    the given _atomic_write step of the 2nd checkpoint write, resume,
+    verdicts identical to uninterrupted."""
+    hists, _ = make_histories()
+    clean = pb.batch_analysis(m.CASRegister(None), hists, **KW)
+    with faults.inject_scope(_crash_injector(step, ckpt.CKPT_JSON, nth=2)):
+        with pytest.raises(faults.CrashPoint):
+            pb.batch_analysis(m.CASRegister(None), hists,
+                              checkpoint_dir=tmp_path, **KW)
+    res = pb.batch_analysis(m.CASRegister(None), hists,
+                            checkpoint_dir=tmp_path, resume=True, **KW)
+    assert [r["valid?"] for r in res] == [r["valid?"] for r in clean]
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip-json", "junk"])
+def test_ladder_corruption_quarantined_and_fresh(tmp_path, mode):
+    """Corruption-mode cells: a torn/bit-flipped/garbage checkpoint is
+    quarantined aside and the resume runs fresh — verdicts identical,
+    never an unhandled exception, never a wrong resume."""
+    hists, _ = make_histories()
+    clean = pb.batch_analysis(m.CASRegister(None), hists, **KW)
+    with faults.inject_scope(
+            _crash_injector("post-rename", ckpt.CKPT_JSON, nth=2)):
+        with pytest.raises(faults.CrashPoint):
+            pb.batch_analysis(m.CASRegister(None), hists,
+                              checkpoint_dir=tmp_path, **KW)
+    target = tmp_path / ckpt.CKPT_JSON
+    data = target.read_bytes()
+    if mode == "truncate":
+        target.write_bytes(data[: len(data) // 2])
+    elif mode == "bitflip-json":
+        doc = json.loads(data)
+        doc["payload"]["stage"] = 99  # valid JSON, wrong bytes
+        target.write_text(json.dumps(doc))
+    else:
+        target.write_bytes(b"\x00\xff garbage")
+    res = pb.batch_analysis(m.CASRegister(None), hists,
+                            checkpoint_dir=tmp_path, resume=True, **KW)
+    assert [r["valid?"] for r in res] == [r["valid?"] for r in clean]
+    assert list(tmp_path.glob(f"{ckpt.CKPT_JSON}.corrupt-*"))
+
+
+def test_fingerprint_mismatch_quarantines_stale_files(tmp_path):
+    """Satellite: the mismatch path used to warn-and-run-fresh but LEAVE
+    the stale files where a later --resume could pick them up — now they
+    quarantine aside and the fault counter records it."""
+    hists_a, _ = make_histories()
+    hists_b, expect_b = make_histories(2, seed0=2000)
+    pb.batch_analysis(m.CASRegister(None), hists_a,
+                      checkpoint_dir=tmp_path, **KW)
+    assert (tmp_path / ckpt.CKPT_JSON).exists()
+    res = pb.batch_analysis(m.CASRegister(None), hists_b,
+                            checkpoint_dir=tmp_path, resume=True, **KW)
+    assert [r["valid?"] for r in res] == expect_b
+    # the stale pair moved aside; the fresh run's own checkpoint (for
+    # hists_b) now owns the filenames
+    quarantined = list(tmp_path.glob(f"{ckpt.CKPT_JSON}.corrupt-*"))
+    assert quarantined, "stale checkpoint was not quarantined"
+    saved = ckpt.load(tmp_path)
+    assert saved["config"]["fingerprint"] == ckpt.fingerprint(hists_b)
+
+
+def test_legacy_v1_checkpoint_migrates(tmp_path):
+    """A pre-envelope (version 1) checkpoint still loads — through the
+    migration registry, not a CheckpointError."""
+    legacy = {
+        "version": 1, "complete": True,
+        "config": {"engine": "sync", "fingerprint": "zz"},
+        "stage": 3, "results": {"0": {"valid?": True}}, "pending": [],
+        "confirms": {}, "device_confirms": [], "resumes": [], "rungs": {},
+    }
+    (tmp_path / ckpt.CKPT_JSON).write_text(json.dumps(legacy))
+    out = ckpt.load(tmp_path)
+    assert out["complete"] and out["results"][0]["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# Journal: checksums, quarantine, cached depth
+# ---------------------------------------------------------------------------
+
+
+def _journal_entry_kw(i=0):
+    return dict(req_id=f"r{i}", seq=i, model_name="cas-register",
+                history=[{"type": "invoke", "f": "read", "process": 0,
+                          "value": None}],
+                priority=0, client="t", tier="batch", trace_id="tr",
+                deadline_s=None)
+
+
+def test_journal_depth_cached_and_reconciled(tmp_path):
+    j = health.AdmissionJournal(tmp_path)
+    assert j.depth() == 0
+    for i in range(3):
+        j.record(**_journal_entry_kw(i))
+    assert j.depth() == 3
+    j.resolve("r1")
+    j.resolve("r1")  # double-resolve must not underflow
+    assert j.depth() == 2
+    # a SECOND journal instance over the same dir re-counts at init
+    j2 = health.AdmissionJournal(tmp_path)
+    assert j2.depth() == 2
+    assert {e["id"] for e in j2.replay()} == {"r0", "r2"}
+    assert j2.depth() == 2
+
+
+def test_journal_corrupt_entry_quarantined_others_replay(tmp_path):
+    j = health.AdmissionJournal(tmp_path)
+    for i in range(3):
+        j.record(**_journal_entry_kw(i), idempotency_key=f"k{i}")
+    victim = tmp_path / "req-r1.json"
+    victim.write_bytes(victim.read_bytes()[:30])  # torn by other means
+    entries = j.replay()
+    assert {e["id"] for e in entries} == {"r0", "r2"}
+    assert entries[0]["idempotency_key"] == "k0"
+    assert j.errors == 1 and len(j.corrupt_reports) == 1
+    assert j.corrupt_reports[0]["reason"] == "unparseable"
+    assert list(tmp_path.glob("req-r1.json.corrupt-*"))
+    assert j.depth() == 2  # reconciled: the quarantined file left the glob
+
+
+def test_journal_legacy_entry_replays(tmp_path):
+    (tmp_path / "req-old1.json").write_text(json.dumps(
+        {"id": "old1", "seq": 0, "model": "cas-register", "history": [],
+         "priority": 0, "client": "c", "class": "batch",
+         "trace_id": "t", "deadline_s": None}))
+    j = health.AdmissionJournal(tmp_path)
+    assert [e["id"] for e in j.replay()] == ["old1"]
+
+
+# ---------------------------------------------------------------------------
+# Idempotency map + service contract
+# ---------------------------------------------------------------------------
+
+
+def test_idempotency_map_claim_settle_release(tmp_path):
+    im = health.IdempotencyMap(tmp_path, ttl_s=300)
+    assert im.claim("k", "r1") is None           # ours
+    entry = im.claim("k", "r2")
+    assert entry["req_id"] == "r1"               # theirs
+    im.settle("k", {"valid?": False})
+    assert im.lookup("k")["result"]["valid?"] is False
+    # release refuses to drop a settled entry
+    im.release("k", "r1")
+    assert im.lookup("k") is not None
+    # a journaled map survives a "restart"
+    im2 = health.IdempotencyMap(tmp_path, ttl_s=300)
+    assert im2.replay() == 1
+    assert im2.lookup("k")["result"]["valid?"] is False
+    # an unsettled claim CAN be released
+    assert im2.claim("k2", "r9") is None
+    im2.release("k2", "r9")
+    assert im2.lookup("k2") is None
+
+
+def test_idempotency_ttl_expiry(tmp_path):
+    im = health.IdempotencyMap(tmp_path, ttl_s=0.0)
+    im.claim("k", "r1")
+    assert im.lookup("k") is None  # immediately stale
+    im3 = health.IdempotencyMap(tmp_path, ttl_s=0.0)
+    assert im3.replay() == 0  # expired files are reclaimed at replay
+    assert not list(pathlib.Path(tmp_path).glob("idem-*.json"))
+
+
+def test_service_duplicate_attaches_to_inflight(tmp_path):
+    """A duplicate submit while the original is still QUEUED returns the
+    same future (same id) and the check runs exactly once."""
+    hists, expect = make_histories()
+    svc = svc_mod.CheckService(warm_pool=False, **KW)
+    f1 = svc.submit(hists[0], idempotency_key="dup")
+    f2 = svc.submit(hists[0], idempotency_key="dup")
+    assert f2 is f1 and f2.id == f1.id
+    while not f1.done():
+        svc.step()
+    assert f1.result(5)["valid?"] == expect[0]
+    st = svc.stats()
+    assert st["idempotent_hits"] == 1 and st["batches"] == 1
+    # post-settle duplicate: settled-entry path, same id, no extra run
+    f3 = svc.submit(hists[0], idempotency_key="dup")
+    assert f3.id == f1.id and f3.result(1)["valid?"] == expect[0]
+    assert svc.stats()["batches"] == 1
+    assert svc.stats()["idempotent_hits"] == 2
+
+
+def test_service_idempotent_across_restart(tmp_path):
+    """The acceptance cell, in-process: submit with a key into a
+    journaled service, abandon it (nothing in memory survives — the
+    SIGKILL-equivalent; the REAL SIGKILL child runs in chaos_check
+    --crashpoint), restart over the same dirs, resubmit the same key:
+    the duplicate attaches to the replayed request (original id) and
+    the check runs exactly once."""
+    hists, expect = make_histories()
+    jdir, idir = tmp_path / "j", tmp_path / "i"
+    svc_a = svc_mod.CheckService(journal_dir=jdir, idempotency_dir=idir,
+                                 warm_pool=False, **KW)
+    orig = svc_a.submit(hists[1], idempotency_key="K-restart")
+    orig_id = orig.id
+    del svc_a  # the crash: queued work survives only on disk
+    svc_b = svc_mod.CheckService(journal_dir=jdir, idempotency_dir=idir,
+                                 warm_pool=False, **KW)
+    assert svc_b.recover() == 1
+    # the fingerprint scoping survives the restart too: the key is
+    # still bound to hists[1], a different history is still rejected
+    with pytest.raises(ValueError, match="DIFFERENT history"):
+        svc_b.submit(hists[0], idempotency_key="K-restart")
+    dup = svc_b.submit(hists[1], idempotency_key="K-restart")
+    assert dup.id == orig_id
+    for _ in range(16):
+        if dup.done():
+            break
+        svc_b.step()
+    assert dup.result(5)["valid?"] == expect[1]
+    st = svc_b.stats()
+    assert st["idempotent_hits"] == 1
+    assert st["batches"] <= 1, "exactly-once violated across restart"
+    assert st["journal_depth"] == 0  # settled: the entry was dropped
+
+
+def test_service_idem_key_reuse_across_histories_rejected(tmp_path):
+    """An idempotency key is scoped to ONE history (by fingerprint):
+    reusing it with a different history must raise, never hand the
+    caller the other submission's verdict."""
+    hists, _ = make_histories()
+    svc = svc_mod.CheckService(warm_pool=False, **KW)
+    f = svc.submit(hists[0], idempotency_key="scoped")
+    with pytest.raises(ValueError, match="DIFFERENT history"):
+        svc.submit(hists[1], idempotency_key="scoped")
+    while not f.done():
+        svc.step()
+    # and after settling, the reuse is still rejected (entry holds fp)
+    with pytest.raises(ValueError, match="DIFFERENT history"):
+        svc.submit(hists[1], idempotency_key="scoped")
+    # the SAME history keeps hitting normally
+    dup = svc.submit(hists[0], idempotency_key="scoped")
+    assert dup.id == f.id
+
+
+def test_service_idem_only_recovery(tmp_path):
+    """A service configured with ONLY idempotency_dir (no admission
+    journal) still reloads its settled entries at recover(): duplicates
+    after a restart get the settled result, not a re-run."""
+    hists, expect = make_histories()
+    idir = tmp_path / "i"
+    svc_a = svc_mod.CheckService(idempotency_dir=idir, warm_pool=False,
+                                 **KW)
+    f = svc_a.submit(hists[0], idempotency_key="K-only")
+    while not f.done():
+        svc_a.step()
+    orig_id = f.id
+    del svc_a
+    svc_b = svc_mod.CheckService(idempotency_dir=idir, warm_pool=False,
+                                 **KW)
+    assert svc_b.recover() == 0  # nothing journaled to re-admit
+    dup = svc_b.submit(hists[0], idempotency_key="K-only")
+    assert dup.id == orig_id and dup.result(1)["valid?"] == expect[0]
+    assert svc_b.stats()["batches"] == 0 \
+        and svc_b.stats()["idempotent_hits"] == 1
+
+
+def test_service_failed_admission_releases_key(tmp_path):
+    """A rejected submit (queue full) must not leave the key claimed —
+    the client's instructed retry would otherwise bind to a request
+    that never existed."""
+    hists, _ = make_histories()
+    svc = svc_mod.CheckService(warm_pool=False, max_queue=1, **KW)
+    svc.submit(hists[0])  # fills the queue (scheduler not running)
+    with pytest.raises(svc_mod.QueueFull):
+        svc.submit(hists[1], idempotency_key="rej")
+    assert svc.idempotency.lookup("rej") is None
+    # after the queue drains, the retried key binds fresh and resolves
+    while svc.stats()["queue_depth"]:
+        svc.step()
+    f = svc.submit(hists[1], idempotency_key="rej")
+    while not f.done():
+        svc.step()
+    assert f.result(5)["valid?"] is not None
+    assert svc.stats()["idempotent_hits"] == 0
+
+
+def test_drain_meta_corruption_reports_honestly(tmp_path):
+    """resume_drained over a corrupt drain meta yields a machine-
+    readable error entry for that group instead of a crash or a silent
+    skip."""
+    hists, expect = make_histories()
+    ddir = tmp_path / "drain"
+    svc = svc_mod.CheckService(drain_dir=ddir, warm_pool=False, **KW)
+    for h in hists[:2]:
+        svc.submit(h)
+    svc.shutdown(drain=True)
+    subs = [p for p in ddir.iterdir() if p.is_dir()]
+    assert subs
+    meta = subs[0] / svc_mod.DRAIN_META
+    meta.write_bytes(b"\xff\x00 rotted")
+    out = svc_mod.resume_drained(
+        ddir, **{k: v for k, v in KW.items() if k != "capacity"})
+    bad = [g for g in out if "error" in g]
+    assert bad and bad[0]["error"]["reason"] == "unparseable"
+    assert list(subs[0].glob(f"{svc_mod.DRAIN_META}.corrupt-*"))

@@ -183,12 +183,15 @@ def test_admission_journal_roundtrip(tmp_path):
     entries = j.replay()
     assert [e["id"] for e in entries] == ["def", "abc"]  # seq order
     assert entries[1]["client"] == "c1" and entries[0]["deadline_s"] == 4.5
-    # unreadable entries are skipped, not fatal
+    # unreadable entries are QUARANTINED aside (store.durable), not
+    # fatal and not left where the next replay re-trips on them
     (tmp_path / "j" / "req-zzz.json").write_text("{not json")
     assert len(j.replay()) == 2 and j.errors == 1
+    assert list((tmp_path / "j").glob("req-zzz.json.corrupt-*"))
+    assert j.corrupt_reports[0]["reason"] == "unparseable"
     j.resolve("abc")
     j.resolve("abc")  # idempotent
-    assert j.depth() == 2  # "def" + the corrupt file still on disk
+    assert j.depth() == 1  # "def" (the corrupt file left the glob)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,642 @@
+#!/usr/bin/env python
+"""Crash-consistency audit for every durable surface.
+
+The checker's own durable state (ladder + chunk checkpoints, the
+admission journal, drain dirs, the perf ledger, the idempotency map)
+must survive exactly the fault classes this repo exists to inject.
+This tool enumerates the (surface x crash-step x corruption-mode)
+matrix and drives each surface's CONSUMER through every cell, asserting
+one invariant:
+
+    after recovery the verdicts are IDENTICAL to an uninterrupted
+    run, or the consumer degrades to a machine-readable corruption
+    report — never a wrong verdict, never an unhandled exception.
+
+Crash steps ride the ``faults.INJECT`` seam ``store._atomic_write``
+announces (post-tmp / post-fsync / post-rename / pre-dir-fsync): an
+injected ``faults.CrashPoint`` dies at the step with NO cleanup, so the
+on-disk state is exactly what a SIGKILL there leaves — and one cell per
+run uses a REAL SIGKILL in a child process through the same seam to
+keep the simulation honest.  Corruption modes (truncate, bitflip, junk,
+missing-sibling) synthesize the faults atomic renames can NOT rule out:
+bit rot, hand edits, partial copies.
+
+The SIGKILL idempotency round-trip is the serving acceptance cell: a
+request submitted with an ``idempotency_key`` into a journaled service,
+SIGKILL before it runs, restart, duplicate resubmission — the check
+runs EXACTLY once and the duplicate gets the original request id.
+
+Usage:
+  python tools/crashpoint.py --matrix     # the full matrix
+  python tools/crashpoint.py --smoke      # the docker/bin/test subset
+  python tools/crashpoint.py --surface ladder --matrix
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import traceback
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from genhist import corrupt, valid_register_history  # noqa: E402
+
+from jepsen_tpu import faults  # noqa: E402
+from jepsen_tpu import models as m  # noqa: E402
+from jepsen_tpu.obs import regress  # noqa: E402
+from jepsen_tpu.parallel import batch as pb  # noqa: E402
+from jepsen_tpu.serve import service as _svc_mod  # noqa: E402
+from jepsen_tpu.store import checkpoint as ckpt  # noqa: E402
+from jepsen_tpu.store import durable  # noqa: E402
+
+#: the pinned ladder (chaos_check's shapes, so docker runs share warm
+#: kernels with the chaos gates that precede this stage).
+LADDER = dict(capacity=(8, 64, 512), cpu_fallback=False,
+              exact_escalation=(), confirm_refutations=False)
+
+#: the chunk surface's spill-forcing scan (chaos_check.SPILL_LADDER).
+CHUNK = dict(capacity=(16,), chunk_barriers=8, spill=True)
+
+#: CheckService kwargs whose launches run the SAME ladder as the
+#: baseline (verdict identity is the invariant; a config drift here
+#: would fail cells for the wrong reason).
+SVC_OPTS = dict(warm_pool=False, **LADDER)
+
+STEPS = ("post-tmp", "post-fsync", "post-rename", "pre-dir-fsync")
+MODES = ("truncate", "bitflip", "junk", "missing-sibling")
+
+
+def build_histories(n: int, ops: int = 30, procs: int = 3,
+                    seed0: int = 7000):
+    out = []
+    for i in range(n):
+        h = valid_register_history(ops, procs, seed=seed0 + i,
+                                   info_rate=0.35)
+        if i % 3 == 2:
+            h = corrupt(h, seed=i)
+        out.append(h)
+    return out
+
+
+def verdicts(results):
+    return [r["valid?"] for r in results]
+
+
+# ---------------------------------------------------------------------------
+# Cell harness
+# ---------------------------------------------------------------------------
+
+RESULTS: list[dict] = []
+
+
+def cell(surface: str, kind: str, label: str, fn) -> bool:
+    """Run one matrix cell; the invariant check lives inside ``fn``
+    (assertions).  ANY unhandled exception fails the cell — that IS the
+    invariant."""
+    try:
+        fn()
+        ok, err = True, None
+    except AssertionError as e:
+        ok, err = False, f"invariant violated: {e}"
+    except BaseException as e:  # noqa: BLE001 — "never an unhandled
+        # exception" is the contract being audited
+        ok, err = False, f"unhandled {type(e).__name__}: {e}"
+        traceback.print_exc()
+    RESULTS.append({"surface": surface, "kind": kind, "label": label,
+                    "ok": ok, "error": err})
+    print(f"  [{'ok' if ok else 'FAIL'}] {surface} / {kind} / {label}"
+          + (f" — {err}" if err else ""))
+    return ok
+
+
+def crash_injector(step: str, path_substr: str, nth: int = 1):
+    """An INJECT hook that dies (CrashPoint) at the ``nth`` matching
+    write-step of a matching path."""
+    seen = {"n": 0}
+
+    def inject(ctx, attempt):
+        if ctx.get("what") != "store.atomic_write":
+            return
+        if ctx.get("step") != step:
+            return
+        if path_substr not in str(ctx.get("path") or ""):
+            return
+        seen["n"] += 1
+        if seen["n"] == nth:
+            raise faults.CrashPoint(step, str(ctx.get("path")))
+
+    return inject
+
+
+def corrupt_file(path: Path, mode: str) -> None:
+    data = path.read_bytes()
+    if mode == "truncate":
+        path.write_bytes(data[: max(1, len(data) // 2)])
+    elif mode == "bitflip":
+        b = bytearray(data)
+        i = int(len(b) * 0.6)
+        b[i] ^= 0xFF
+        path.write_bytes(bytes(b))
+    elif mode == "junk":
+        path.write_bytes(b"\x00\xffnot json at all {{{" * 8)
+    else:
+        raise ValueError(mode)
+
+
+# ---------------------------------------------------------------------------
+# Surface: ladder checkpoint
+# ---------------------------------------------------------------------------
+
+
+def ladder_mid_state(hists, d: Path) -> None:
+    """Run the checkpointed ladder killed (CrashPoint) at the 2nd
+    json-checkpoint write — leaves a mid-ladder json/npz pair on disk."""
+    with faults.inject_scope(
+            crash_injector("post-rename", ckpt.CKPT_JSON, nth=2)):
+        try:
+            pb.batch_analysis(m.CASRegister(None), hists,
+                              checkpoint_dir=d, **LADDER)
+            raise AssertionError("crash injector never fired")
+        except faults.CrashPoint:
+            pass
+
+
+def ladder_cells(hists, baseline, *, smoke: bool) -> None:
+    model = m.CASRegister(None)
+    steps = STEPS if not smoke else ("post-tmp", "post-rename")
+    for step in steps:
+        def _run(step=step):
+            d = Path(tempfile.mkdtemp(prefix=f"cp-ladder-{step}-"))
+            with faults.inject_scope(
+                    crash_injector(step, ckpt.CKPT_JSON, nth=2)):
+                try:
+                    pb.batch_analysis(model, hists, checkpoint_dir=d,
+                                      **LADDER)
+                    raise AssertionError("crash injector never fired")
+                except faults.CrashPoint:
+                    pass
+            res = pb.batch_analysis(model, hists, checkpoint_dir=d,
+                                    resume=True, **LADDER)
+            assert verdicts(res) == baseline, \
+                f"{verdicts(res)} != {baseline}"
+
+        cell("ladder", "crash-step", step, _run)
+    modes = MODES if not smoke else ("truncate", "bitflip",
+                                     "missing-sibling")
+    for mode in modes:
+        def _run(mode=mode):
+            d = Path(tempfile.mkdtemp(prefix=f"cp-ladder-{mode}-"))
+            ladder_mid_state(hists, d)
+            target = d / ckpt.CKPT_JSON
+            npz = d / ckpt.CKPT_NPZ
+            if mode == "missing-sibling":
+                if not npz.exists():
+                    return  # no pending lanes this run: cell is vacuous
+                npz.unlink()
+            else:
+                corrupt_file(target, mode)
+            res = pb.batch_analysis(model, hists, checkpoint_dir=d,
+                                    resume=True, **LADDER)
+            assert verdicts(res) == baseline, \
+                f"{verdicts(res)} != {baseline}"
+            if mode in ("truncate", "junk", "missing-sibling"):
+                assert list(d.glob("*.corrupt-*")), \
+                    "corrupt artifact was not quarantined aside"
+
+        cell("ladder", "corruption", mode, _run)
+
+
+#: the child half of the REAL-SIGKILL-at-write-step cell: same pinned
+#: workload, an injector that SIGKILLs the process through the
+#: _atomic_write seam at the given step of the 2nd checkpoint write.
+_KILL_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import crashpoint
+from jepsen_tpu import faults
+from jepsen_tpu import models as m
+from jepsen_tpu.parallel import batch as pb
+from jepsen_tpu.store import checkpoint as ckpt
+seen = {{"n": 0}}
+def inject(ctx, attempt):
+    if (ctx.get("what") == "store.atomic_write"
+            and ctx.get("step") == {step!r}
+            and ckpt.CKPT_JSON in str(ctx.get("path") or "")):
+        seen["n"] += 1
+        if seen["n"] == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+hists = crashpoint.build_histories({n})
+with faults.inject_scope(inject):
+    pb.batch_analysis(m.CASRegister(None), hists,
+                      checkpoint_dir={ckpt_dir!r}, **crashpoint.LADDER)
+print("CHILD-FINISHED-WITHOUT-KILL")
+"""
+
+
+def sigkill_step_cell(hists, baseline, step: str) -> None:
+    def _run():
+        d = tempfile.mkdtemp(prefix=f"cp-sigkill-{step}-")
+        src = _KILL_CHILD_SRC.format(
+            repo=str(REPO), tools=str(REPO / "tools"), step=step,
+            n=len(hists), ckpt_dir=d,
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO),
+            timeout=600,
+        )
+        assert p.returncode == -signal.SIGKILL, (
+            f"child exited {p.returncode} (expected SIGKILL); stderr "
+            f"tail: {p.stderr[-400:]}")
+        res = pb.batch_analysis(m.CASRegister(None), hists,
+                                checkpoint_dir=d, resume=True, **LADDER)
+        assert verdicts(res) == baseline, f"{verdicts(res)} != {baseline}"
+
+    cell("ladder", "real-sigkill", step, _run)
+
+
+# ---------------------------------------------------------------------------
+# Surface: chunk/spill checkpoint
+# ---------------------------------------------------------------------------
+
+
+def chunk_cells(*, smoke: bool) -> None:
+    from jepsen_tpu.ops import wgl
+
+    hist = valid_register_history(24, 3, seed=7100, info_rate=0.35)
+    model = m.CASRegister(None)
+    base = wgl.analysis(model, hist, **CHUNK)["valid?"]
+    steps = ("post-rename",) if smoke else STEPS
+    for step in steps:
+        def _run(step=step):
+            d = Path(tempfile.mkdtemp(prefix=f"cp-chunk-{step}-"))
+            with faults.inject_scope(
+                    crash_injector(step, ckpt.CHUNK_JSON, nth=2)):
+                try:
+                    wgl.analysis(model, hist, checkpoint_dir=d, **CHUNK)
+                    raise AssertionError("crash injector never fired")
+                except faults.CrashPoint:
+                    pass
+            r = wgl.analysis(model, hist, checkpoint_dir=d, resume=True,
+                             **CHUNK)
+            assert r["valid?"] == base, f"{r['valid?']} != {base}"
+
+        cell("chunk", "crash-step", step, _run)
+    modes = ("bitflip",) if smoke else ("truncate", "bitflip", "junk",
+                                        "missing-sibling")
+    for mode in modes:
+        def _run(mode=mode):
+            d = Path(tempfile.mkdtemp(prefix=f"cp-chunk-{mode}-"))
+            with faults.inject_scope(
+                    crash_injector("post-rename", ckpt.CHUNK_JSON, nth=2)):
+                try:
+                    wgl.analysis(model, hist, checkpoint_dir=d, **CHUNK)
+                    raise AssertionError("crash injector never fired")
+                except faults.CrashPoint:
+                    pass
+            if mode == "missing-sibling":
+                (d / ckpt.CHUNK_NPZ).unlink()
+            else:
+                corrupt_file(d / ckpt.CHUNK_JSON, mode)
+            r = wgl.analysis(model, hist, checkpoint_dir=d, resume=True,
+                             **CHUNK)
+            assert r["valid?"] == base, f"{r['valid?']} != {base}"
+
+        cell("chunk", "corruption", mode, _run)
+
+
+# ---------------------------------------------------------------------------
+# Surface: admission journal
+# ---------------------------------------------------------------------------
+
+
+def journal_cells(hists, baseline, *, smoke: bool) -> None:
+    def make_queue(jdir: str) -> list[str]:
+        """A journaled queue nobody ran: submit into a never-started
+        service (the scheduler never picks the work up), keep the ids,
+        abandon the instance — the journal files ARE the lost queue."""
+        svc = _svc_mod.CheckService(journal_dir=jdir, **SVC_OPTS)
+        ids = [svc.submit(h).id for h in hists]
+        return ids
+
+    def drive(jdir: str) -> dict:
+        """A fresh service over the same journal: recover + step until
+        the queue drains; returns {req_id: verdict}."""
+        svc = _svc_mod.CheckService(journal_dir=jdir, **SVC_OPTS)
+        svc.recover()
+        for _ in range(64):
+            if svc.stats()["queue_depth"] == 0:
+                break
+            svc.step()
+        out = {}
+        for rid, req in list(svc._requests.items()):
+            out[rid] = (req.result or {}).get("valid?")
+        return out
+
+    def _crash_window(leave: str):
+        jdir = tempfile.mkdtemp(prefix="cp-journal-")
+        ids = make_queue(jdir)
+        # synthesize the crash window on the LAST entry: pre-rename
+        # steps leave only a torn tmp (no entry), post-rename leaves
+        # the complete entry
+        lost = []
+        if leave in ("post-tmp", "post-fsync"):
+            victim = Path(jdir) / f"req-{ids[-1]}.json"
+            torn = victim.read_bytes()[:20]
+            victim.unlink()
+            (Path(jdir) / f"req-{ids[-1]}.json.xyz123.tmp").write_bytes(torn)
+            lost = [ids[-1]]
+        got = drive(jdir)
+        for i, rid in enumerate(ids):
+            if rid in lost:
+                assert rid not in got, "a torn tmp must not replay"
+                continue
+            assert got.get(rid) == baseline[i], (
+                f"replayed {rid}: {got.get(rid)} != {baseline[i]}")
+        # the torn tmp is an orphan the start-time sweep reclaims
+        swept = durable.sweep_tmp(jdir, min_age_s=0.0, what="crashpoint")
+        assert swept == (1 if lost else 0), (swept, lost)
+        assert not list(Path(jdir).glob("*.tmp"))
+
+    steps = ("post-tmp", "post-rename") if smoke else STEPS
+    for step in steps:
+        cell("journal", "crash-step", step,
+             lambda step=step: _crash_window(step))
+
+    modes = ("bitflip",) if smoke else ("truncate", "bitflip", "junk")
+    for mode in modes:
+        def _run(mode=mode):
+            jdir = tempfile.mkdtemp(prefix="cp-journal-")
+            ids = make_queue(jdir)
+            victim = Path(jdir) / f"req-{ids[0]}.json"
+            corrupt_file(victim, mode)
+            got = drive(jdir)
+            assert list(Path(jdir).glob("*.corrupt-*")), \
+                "corrupt journal entry was not quarantined"
+            for i, rid in enumerate(ids[1:], start=1):
+                assert got.get(rid) == baseline[i], (
+                    f"replayed {rid}: {got.get(rid)} != {baseline[i]}")
+            assert got.get(ids[0]) is None, \
+                "a corrupt entry must not replay (it must quarantine)"
+
+        cell("journal", "corruption", mode, _run)
+
+
+# ---------------------------------------------------------------------------
+# Surface: drain dir
+# ---------------------------------------------------------------------------
+
+
+def drain_cells(hists, baseline, *, smoke: bool) -> None:
+    def make_drain() -> Path:
+        ddir = Path(tempfile.mkdtemp(prefix="cp-drain-"))
+        svc = _svc_mod.CheckService(drain_dir=ddir, **SVC_OPTS)
+        for h in hists:
+            svc.submit(h)
+        svc.shutdown(drain=True)
+        return ddir
+
+    def _clean():
+        ddir = make_drain()
+        out = _svc_mod.resume_drained(ddir, **{
+            k: v for k, v in LADDER.items() if k != "capacity"})
+        assert out and "results" in out[0], f"no resumable group: {out}"
+        got = [r["valid?"] for g in out for r in g["results"]]
+        assert sorted(map(str, got)) == sorted(map(str, baseline))
+
+    cell("drain", "crash-step", "post-rename(clean-resume)", _clean)
+
+    modes = ("junk",) if smoke else ("truncate", "bitflip", "junk")
+    for mode in modes:
+        def _meta(mode=mode):
+            ddir = make_drain()
+            subs = [p for p in ddir.iterdir() if p.is_dir()]
+            corrupt_file(subs[0] / _svc_mod.DRAIN_META, mode)
+            out = _svc_mod.resume_drained(ddir, **{
+                k: v for k, v in LADDER.items() if k != "capacity"})
+            bad = [g for g in out if "error" in g]
+            assert bad and bad[0]["error"].get("reason"), (
+                "corrupt drain meta must surface a machine-readable "
+                f"report, got {out}")
+
+        cell("drain", "corruption", f"meta-{mode}", _meta)
+
+    def _ckpt_corrupt():
+        # a corrupt drain CHECKPOINT (meta intact): resume runs fresh —
+        # honest full recovery, verdicts identical
+        ddir = make_drain()
+        subs = [p for p in ddir.iterdir() if p.is_dir()]
+        corrupt_file(subs[0] / ckpt.CKPT_JSON, "bitflip")
+        out = _svc_mod.resume_drained(ddir, **{
+            k: v for k, v in LADDER.items() if k != "capacity"})
+        got = [r["valid?"] for g in out for r in g.get("results", [])]
+        assert sorted(map(str, got)) == sorted(map(str, baseline))
+
+    cell("drain", "corruption", "checkpoint-bitflip", _ckpt_corrupt)
+
+
+# ---------------------------------------------------------------------------
+# Surface: perf ledger
+# ---------------------------------------------------------------------------
+
+
+def ledger_cells(*, smoke: bool) -> None:
+    def fresh(n=3) -> Path:
+        p = Path(tempfile.mkdtemp(prefix="cp-ledger-")) / "ledger.jsonl"
+        for i in range(n):
+            regress.append_record(
+                regress.make_record("bench", {"ops_per_s": 100.0 + i},
+                                    fp={"backend": "cpu"}),
+                p,
+            )
+        return p
+
+    def _torn_tail():
+        p = fresh()
+        with open(p, "a", encoding="utf-8") as fh:
+            fh.write('{"kind":"bench","metrics":{"ops_per_s"')  # crash here
+        recs, skipped = regress.read_records_checked(p)
+        assert len(recs) == 3 and skipped == 1, (len(recs), skipped)
+        ok, _rep = regress.gate(recs)
+        assert ok is True
+
+    cell("ledger", "crash-step", "post-write(torn-tail)", _torn_tail)
+
+    def _bitflip():
+        p = fresh()
+        lines = p.read_text().splitlines()
+        # flip the middle record's metric value out from under its CRC
+        mid = lines[1].replace("101.0", "404.25", 1)
+        assert mid != lines[1], "workload drifted; fix the cell"
+        p.write_text("\n".join([lines[0], mid, lines[2]]) + "\n")
+        recs, skipped = regress.read_records_checked(p)
+        assert len(recs) == 2 and skipped == 1, (len(recs), skipped)
+
+    cell("ledger", "corruption", "bitflip", _bitflip)
+
+    if not smoke:
+        def _junk():
+            p = fresh()
+            with open(p, "a", encoding="utf-8") as fh:
+                fh.write("\x00\xff garbage line\n{}\n")
+            recs, skipped = regress.read_records_checked(p)
+            assert len(recs) == 3 and skipped == 2, (len(recs), skipped)
+
+        cell("ledger", "corruption", "junk", _junk)
+
+
+# ---------------------------------------------------------------------------
+# The SIGKILL idempotency round trip (serving acceptance cell)
+# ---------------------------------------------------------------------------
+
+_IDEM_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import crashpoint
+from jepsen_tpu.serve import service as svc_mod
+hists = crashpoint.build_histories({n})
+svc = svc_mod.CheckService(journal_dir={jdir!r}, idempotency_dir={idir!r},
+                           **crashpoint.SVC_OPTS)
+fut = svc.submit(hists[0], idempotency_key="cp-idem-key")
+print("REQ-ID", fut.id, flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def idempotency_cell(hists, baseline) -> None:
+    def _run():
+        jdir = tempfile.mkdtemp(prefix="cp-idem-j-")
+        idir = tempfile.mkdtemp(prefix="cp-idem-i-")
+        src = _IDEM_CHILD_SRC.format(
+            repo=str(REPO), tools=str(REPO / "tools"), n=len(hists),
+            jdir=jdir, idir=idir,
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO),
+            timeout=600,
+        )
+        assert p.returncode == -signal.SIGKILL, (
+            f"child exited {p.returncode}; stderr: {p.stderr[-400:]}")
+        orig_id = None
+        for ln in p.stdout.splitlines():
+            if ln.startswith("REQ-ID "):
+                orig_id = ln.split()[1]
+        assert orig_id, f"child printed no request id: {p.stdout!r}"
+        # restart: recover the journal + idempotency map, then the
+        # duplicate resubmission must attach to the replayed request
+        svc = _svc_mod.CheckService(
+            journal_dir=jdir, idempotency_dir=idir, **SVC_OPTS,
+        )
+        svc.recover()
+        fut = svc.submit(hists[0], idempotency_key="cp-idem-key")
+        assert fut.id == orig_id, (
+            f"duplicate got a fresh id {fut.id} != original {orig_id}")
+        for _ in range(32):
+            if fut.done():
+                break
+            svc.step()
+        stats = svc.stats()
+        assert fut.result(timeout=5)["valid?"] == baseline[0]
+        assert stats["idempotent_hits"] == 1, stats["idempotent_hits"]
+        assert stats["batches"] <= 1, (
+            f"the check ran {stats['batches']} batches — exactly-once "
+            "violated")
+        # second duplicate AFTER settling: served from the settled
+        # entry, still the original id, still no extra run
+        fut2 = svc.submit(hists[0], idempotency_key="cp-idem-key")
+        assert fut2.id == orig_id
+        assert fut2.result(timeout=5)["valid?"] == baseline[0]
+        assert svc.stats()["batches"] <= 1
+
+    cell("idempotency", "real-sigkill", "journal+idem round trip", _run)
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
+
+def run(surfaces, *, smoke: bool, real_sigkill: bool) -> int:
+    hists = build_histories(4)
+    print(f"crashpoint: baseline over {len(hists)} histories "
+          f"(capacity {LADDER['capacity']})")
+    baseline = verdicts(
+        pb.batch_analysis(m.CASRegister(None), hists, **LADDER))
+    print(f"  baseline verdicts: {baseline}")
+    if "ladder" in surfaces:
+        print("surface: ladder checkpoint")
+        ladder_cells(hists, baseline, smoke=smoke)
+        if real_sigkill:
+            for step in (("post-fsync",) if smoke else STEPS):
+                sigkill_step_cell(hists, baseline, step)
+    if "chunk" in surfaces:
+        print("surface: chunk/spill checkpoint")
+        chunk_cells(smoke=smoke)
+    if "journal" in surfaces:
+        print("surface: admission journal")
+        journal_cells(hists, baseline, smoke=smoke)
+    if "drain" in surfaces:
+        print("surface: drain dir")
+        drain_cells(hists, baseline, smoke=smoke)
+    if "ledger" in surfaces:
+        print("surface: perf ledger")
+        ledger_cells(smoke=smoke)
+    if "idempotency" in surfaces and real_sigkill:
+        print("surface: idempotent resubmission (SIGKILL round trip)")
+        idempotency_cell(hists, baseline)
+    failed = [r for r in RESULTS if not r["ok"]]
+    print(f"crashpoint matrix: {len(RESULTS) - len(failed)}/{len(RESULTS)} "
+          "cells green")
+    for r in failed:
+        print(f"  FAILED {r['surface']}/{r['kind']}/{r['label']}: "
+              f"{r['error']}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+ALL_SURFACES = ("ladder", "chunk", "journal", "drain", "ledger",
+                "idempotency")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--matrix", action="store_true",
+                    help="the full (surface x crash-step x corruption) "
+                         "matrix incl. one real SIGKILL child per step")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the docker/bin/test subset (fewer cells, one "
+                         "real SIGKILL child)")
+    ap.add_argument("--surface", action="append", default=None,
+                    choices=ALL_SURFACES,
+                    help="restrict to one or more surfaces (repeatable)")
+    ap.add_argument("--no-sigkill", action="store_true",
+                    help="skip the real-SIGKILL child cells (pure "
+                         "in-process simulation)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the cell results as JSON at the end")
+    a = ap.parse_args(argv)
+    smoke = a.smoke or not a.matrix
+    surfaces = tuple(a.surface) if a.surface else ALL_SURFACES
+    rc = run(surfaces, smoke=smoke, real_sigkill=not a.no_sigkill)
+    if a.json:
+        print(json.dumps(RESULTS, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

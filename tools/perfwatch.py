@@ -67,8 +67,16 @@ def _add_band(p: argparse.ArgumentParser) -> None:
                         "every numeric metric on the newest record)")
 
 
+def _warn_skipped(skipped: int) -> None:
+    if skipped:
+        print(f"perfwatch: skipped {skipped} corrupt/unparseable ledger "
+              "line(s) — torn tail, bit rot, or hand edits (per-record "
+              "CRCs; see store.durable)", file=sys.stderr)
+
+
 def _cmd_list(a) -> int:
-    records = regress.read_records(a.ledger)
+    records, skipped = regress.read_records_checked(a.ledger)
+    _warn_skipped(skipped)
     if not records:
         print("(empty ledger)")
         return 0
@@ -88,7 +96,8 @@ def _cmd_list(a) -> int:
 
 
 def _cmd_compare(a, *, gating: bool) -> int:
-    records = regress.read_records(a.ledger)
+    records, skipped = regress.read_records_checked(a.ledger)
+    _warn_skipped(skipped)
     ok, report = regress.gate(
         records, kinds=a.kind, k_sigma=a.k_sigma, rel_floor=a.rel_floor,
         metrics=a.metric,
