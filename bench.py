@@ -256,8 +256,10 @@ def main() -> None:
             "file": str(tele_dir / "telemetry.json"),
         }
         if rec.summary.get("dedup"):
-            # per-round dedup probe, sort vs bucket, at this run's
-            # first-rung candidate shape (ops.hashing.dedup_round_probe)
+            # per-round dedup probe, every resolvable backend (sort /
+            # bucket / pallas-where-feasible), at this run's first-rung
+            # candidate shape (ops.hashing.dedup_round_probe); pallas
+            # rows carry an honest `interpret` flag off-chip
             telemetry["dedup"] = rec.summary["dedup"]
 
     # Fixed-work secondary metric (deterministic work, pinned histories):

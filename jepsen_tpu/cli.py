@@ -75,11 +75,15 @@ def add_test_opts(p: argparse.ArgumentParser):
                            "(default: on; env JEPSEN_TPU_TELEMETRY)")
     tele.add_argument("--no-telemetry", dest="telemetry", action="store_false",
                       help="disable telemetry recording for this run")
-    p.add_argument("--dedup-backend", choices=("sort", "bucket"), default=None,
+    p.add_argument("--dedup-backend", choices=("sort", "bucket", "pallas"),
+                   default=None,
                    help="frontier dedup backend for the TPU checker's "
-                        "ladder rungs: 'sort' (multi-key hash sort) or "
-                        "'bucket' (packed radix buckets); default: env "
-                        "JEPSEN_TPU_DEDUP_BACKEND, else 'sort'")
+                        "ladder rungs: 'sort' (multi-key hash sort), "
+                        "'bucket' (packed radix buckets), or 'pallas' "
+                        "(fused wide-stage Pallas kernel — wide rungs "
+                        "only, interpret mode on CPU; infeasible "
+                        "geometry falls back to bucket/sort); default: "
+                        "env JEPSEN_TPU_DEDUP_BACKEND, else 'sort'")
     p.add_argument("--frontier-budget-mb", type=float, default=None,
                    metavar="MB",
                    help="device-memory budget for the exact checker's "

@@ -719,6 +719,18 @@ def run_competition(axis: str, values: Sequence[str], *,
         "margin_pct": round(margin_pct, 2),
         "workload": wl or "default fixed-work ladder",
     }
+    if "pallas" in verdict["values"]:
+        # Honest separation of chip records from CPU-interpret ones: a
+        # pallas competitor that ran under the Pallas interpreter must
+        # never pass for a chip measurement when the flip decision
+        # reads the ledger (the fingerprint separates machines; this
+        # separates execution modes on the SAME machine).
+        try:
+            from jepsen_tpu.ops import wide_kernel
+
+            verdict["pallas_interpret"] = bool(wide_kernel.interpret_default())
+        except Exception:  # noqa: BLE001 — never lose a record to a tag
+            pass
     return make_record("compete", {"compete_margin_pct": round(margin_pct, 2)},
                        axes={axis: winner[0]}, extra=verdict)
 

@@ -24,7 +24,10 @@ later perf PRs report against.
                  "launches", "compile_launches", "compile_s",
                  "execute_s", "peak_frontier", "lossy", "dedup"}, ...]
    "dedup":    [{"backend", "candidates", "capacity", "probes",
-                 "per_round_us"}, ...]                  # dedup.round spans
+                 "per_round_us", "interpret"?}, ...]    # dedup.round spans
+                               # ("interpret" only on pallas probes: True
+                               # marks interpreter-mode timings that must
+                               # never compare against chip rows)
    "elle":     [{"stage", "seconds", "count", "max_s"}, ...]
                                # elle.* inference substage spans (nodes /
                                # anomalies / edges / scc / infer_batch —
@@ -78,6 +81,11 @@ _STAGE_KEYS = (
     "resolved", "refuted", "unknowns_remaining", "launches",
     "compile_launches", "compile_s", "execute_s", "peak_frontier", "lossy",
     "dedup", "degraded", "device_bytes_peak",
+    # fused-kernel rungs (dedup backend "pallas"): static routing
+    # verdict + the kernel's tile/VMEM occupancy + execution mode —
+    # the rows the chip-day flip decision reads next to the compete
+    # ledger record
+    "pallas_routed", "pallas_tile", "pallas_vmem_bytes", "pallas_interpret",
 )
 
 
@@ -193,6 +201,10 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
                 })
                 d["probes"] += 1
                 d["_total_us"] += float(attrs.get("per_round_us") or dur * 1e6)
+                if "interpret" in attrs:
+                    # pallas probes tag their execution mode so interpret
+                    # rows never read as chip rows in the rollup
+                    d["interpret"] = bool(attrs["interpret"])
             elif name == "serve.batch":
                 serve_batch["count"] += 1
                 serve_batch["requests"] += int(attrs.get("requests") or 0)
@@ -442,11 +454,14 @@ def format_summary(summary: Mapping) -> str:
         parts.append("\nladder stages:")
         parts.append(_table(headers, rows))
     if summary.get("dedup"):
-        parts.append("\ndedup rounds (per-round probe, sort vs bucket):")
+        parts.append("\ndedup rounds (per-round probe, per backend; "
+                     "interp=True marks Pallas-interpreter timings):")
         parts.append(_table(
-            ["backend", "candidates", "capacity", "probes", "per_round_us"],
+            ["backend", "candidates", "capacity", "probes", "per_round_us",
+             "interp"],
             [[d.get("backend"), d.get("candidates"), d.get("capacity"),
-              d.get("probes"), d.get("per_round_us")]
+              d.get("probes"), d.get("per_round_us"),
+              d.get("interpret", "")]
              for d in summary["dedup"]],
         ))
     if summary.get("elle"):

@@ -3,7 +3,10 @@
 This package is L7's device half: the host-side checker framework
 (jepsen_tpu.checker) packs histories into tensors and calls these kernels.
 
-  wgl      — frontier-parallel Wing–Gong–Lowe linearizability search
-  hashing  — row hashing + sort-based frontier dedup/compaction
-  scc      — dense reachability / SCC kernels for the Elle-style txn checker
+  wgl         — frontier-parallel Wing–Gong–Lowe linearizability search
+  hashing     — row hashing + frontier dedup/compaction (sort/bucket
+                backends + the dedup-backend resolver)
+  wide_kernel — the fused Pallas wide-stage frontier update (the
+                "pallas" dedup backend; interpret mode off-chip)
+  scc         — dense reachability / SCC kernels for the Elle-style txn checker
 """

@@ -20,10 +20,10 @@ maintenance strategies live here:
 Row hashes (murmur3-style mixing) order sorts and fingerprint frontiers;
 no kill decision rides on hash identity anywhere.
 
-Both maintenance strategies support two interchangeable DEDUP BACKENDS
-(``dedup_backend="sort"|"bucket"``, selectable per engine/ladder and via
-the ``JEPSEN_TPU_DEDUP_BACKEND`` env var, the way CYCLE_BACKEND selects
-cycle classification):
+Both maintenance strategies support interchangeable DEDUP BACKENDS
+(``dedup_backend="sort"|"bucket"|"pallas"``, selectable per
+engine/ladder and via the ``JEPSEN_TPU_DEDUP_BACKEND`` env var, the way
+CYCLE_BACKEND selects cycle classification):
 
   * "sort"   — the original full-width multi-operand ``lax.sort`` over
     the hash lanes (reference behavior).
@@ -46,6 +46,15 @@ cycle classification):
     ``_keep_bucket``).  When the candidate table is too large for the
     packed-key geometry (``bucket_feasible``), the round statically
     routes to the sort path — never a silent drop.
+  * "pallas" — the fused wide-stage Pallas TPU kernel
+    (jepsen_tpu.ops.wide_kernel): bucket-backend kill semantics
+    WITHOUT the sort, plus the MXU domination prune and cumsum-rank
+    compaction, fused into one ``pl.pallas_call`` with every table
+    VMEM-resident.  Routed only on statically feasible WIDE geometry
+    (``wide_kernel.fused_feasible``); everything else falls back down
+    the bucket -> sort ladder at trace time.  Interpret mode executes
+    the real kernel body on CPU, so the differential suite gates it
+    like any other backend.
 """
 
 from __future__ import annotations
@@ -69,8 +78,27 @@ honor_env_platform()
 _C1 = jnp.uint32(0x85EBCA6B)
 _C2 = jnp.uint32(0xC2B2AE35)
 
-#: Recognized dedup/compaction backends (see module docstring).
-DEDUP_BACKENDS = ("sort", "bucket")
+#: Row-hash / fingerprint fold seeds.  Named because ops.wide_kernel
+#: recomputes the identical hashes INSIDE its fused Pallas kernel —
+#: bit-identical folds are what make the cross-backend differential
+#: suite (and the fingerprint fixpoint contract) meaningful.
+HASH_SEED_1 = 0xB00B_135
+HASH_SEED_2 = 0x1CEB_00DA
+FP_SEED_1 = 0xFEED_0001
+FP_SEED_2 = 0xFEED_0002
+
+#: Recognized dedup/compaction backends (see module docstring).  A
+#: third backend rides beside sort/bucket since round 11:
+#:
+#:   * "pallas" — the fused wide-stage kernel (ops.wide_kernel): bucket
+#:     semantics without the sort, plus the MXU domination prune and
+#:     cumsum-rank compaction fused into ONE pl.pallas_call with every
+#:     table VMEM-resident.  Routed only on statically feasible WIDE
+#:     geometry (wide_kernel.fused_feasible); anything else falls back
+#:     to bucket, then sort, at trace time.  On CPU the kernel runs
+#:     under Pallas interpret mode, so differential tests execute the
+#:     real kernel body.
+DEDUP_BACKENDS = ("sort", "bucket", "pallas")
 
 #: Process-wide default backend; the env var below overrides it, an
 #: explicit ``dedup_backend=`` argument overrides both.
@@ -167,7 +195,7 @@ def np_class_hash(state, fok) -> tuple[np.ndarray, np.ndarray]:
     state = np.asarray(state)
     fok = np.asarray(fok)
     cols = [state] + [fok[:, k] for k in range(fok.shape[1])]
-    return np_hash_rows(cols, 0xB00B_135), np_hash_rows(cols, 0x1CEB_00DA)
+    return np_hash_rows(cols, HASH_SEED_1), np_hash_rows(cols, HASH_SEED_2)
 
 
 def _keep_sort(h1, h2, alive, window: int):
@@ -338,14 +366,28 @@ def frontier_update_fast(
     n = state.shape[0]
     w = fok.shape[1]
     g = fcr.shape[1]
-    row_cols = [state] + [fok[:, k] for k in range(w)] + [fcr[:, k] for k in range(g)]
-    h1 = hash_rows(row_cols, 0xB00B_135)
-    h2 = hash_rows(row_cols, 0x1CEB_00DA)
-    iota = jnp.arange(n, dtype=jnp.int32)
-    pos = jnp.arange(n)
     if dedup_backend not in DEDUP_BACKENDS:
         raise ValueError(f"unknown dedup backend {dedup_backend!r}")
-    if dedup_backend == "bucket" and bucket_feasible(n):
+    if dedup_backend == "pallas":
+        # The fused wide-stage kernel replaces this WHOLE function body
+        # (hash + dedup + buffer prune + compaction) with one
+        # pl.pallas_call on feasible wide geometry; otherwise the round
+        # statically routes down the bucket -> sort ladder, exactly
+        # like an infeasible bucket geometry.  Lazy import: wide_kernel
+        # imports this module for the shared hash folds.
+        from jepsen_tpu.ops import wide_kernel
+
+        if wide_kernel.fused_feasible(n, capacity, max_count):
+            return wide_kernel.fused_frontier_update(
+                state, fok, fcr, alive, cost, capacity, window=window,
+                n_parents=n_parents, max_count=max_count,
+            )
+    row_cols = [state] + [fok[:, k] for k in range(w)] + [fcr[:, k] for k in range(g)]
+    h1 = hash_rows(row_cols, HASH_SEED_1)
+    h2 = hash_rows(row_cols, HASH_SEED_2)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.arange(n)
+    if dedup_backend in ("bucket", "pallas") and bucket_feasible(n):
         keep_orig, _bovf = _keep_bucket(h1, h2, alive, window)
     else:
         keep_orig = _keep_sort(h1, h2, alive, window)
@@ -457,8 +499,8 @@ def exact_prune_mxu(state, fok, fcr, alive, max_count: int):
 
 def _fingerprint(kst, kfo, kfc, new_alive, w, g):
     out_cols = [kst] + [kfo[:, k] for k in range(w)] + [kfc[:, k] for k in range(g)]
-    r1 = hash_rows(out_cols, 0xFEED_0001)
-    r2 = hash_rows(out_cols, 0xFEED_0002)
+    r1 = hash_rows(out_cols, FP_SEED_1)
+    r2 = hash_rows(out_cols, FP_SEED_2)
     am = new_alive.astype(jnp.uint32)
     return jnp.stack([(r1 * am).sum(), (r2 * am).sum(), am.sum()])
 
@@ -505,12 +547,15 @@ def frontier_update(
     w = fok.shape[1]
     g = fcr.shape[1]
     class_cols = [state] + [fok[:, k] for k in range(w)]
-    ch1 = hash_rows(class_cols, 0xB00B_135)
-    ch2 = hash_rows(class_cols, 0x1CEB_00DA)
+    ch1 = hash_rows(class_cols, HASH_SEED_1)
+    ch2 = hash_rows(class_cols, HASH_SEED_2)
     iota = jnp.arange(n, dtype=jnp.int32)
     if dedup_backend not in DEDUP_BACKENDS:
         raise ValueError(f"unknown dedup backend {dedup_backend!r}")
-    if dedup_backend == "bucket" and bucket_feasible(n):
+    # The exact engine's kills are content-decided under every backend;
+    # the pallas kernel is the FAST stage's fusion, so here "pallas"
+    # rides the bucket stage-1 partition (same class-hash buckets).
+    if dedup_backend in ("bucket", "pallas") and bucket_feasible(n):
         ibits, bbits = _bucket_bits(n)
         packed = (
             jnp.where(alive, jnp.uint32(0), jnp.uint32(1) << 31)
@@ -567,8 +612,8 @@ def frontier_update(
     new_alive = jnp.arange(capacity) < jnp.minimum(n_x, capacity)
     overflowed = (n_w > b2) | (n_x > capacity)
     row_cols = [kst] + [kfo[:, k] for k in range(w)] + [kfc[:, k] for k in range(g)]
-    r1 = hash_rows(row_cols, 0xFEED_0001)
-    r2 = hash_rows(row_cols, 0xFEED_0002)
+    r1 = hash_rows(row_cols, FP_SEED_1)
+    r2 = hash_rows(row_cols, FP_SEED_2)
     am = new_alive.astype(jnp.uint32)
     fp = jnp.stack([(r1 * am).sum(), (r2 * am).sum(), am.sum()])
     return kst, kfo, kfc, new_alive, overflowed, fp
@@ -659,15 +704,24 @@ def dominate(state, fok, fcr, alive, chunk_rows: int = 0):
 
 def _dedup_stage(state, fok, fcr, alive, window: int, dedup_backend: str):
     """JUST the dedup stage of frontier_update_fast (row hash + partition
-    + windowed kills + candidate-order keep mask) — the part the two
+    + windowed kills + candidate-order keep mask) — the part the
     backends implement differently.  dedup_round_probe times it; the
-    compaction/prune tail is shared and would only dilute the
-    comparison."""
+    compaction/prune tail is shared (sort/bucket) or fused behind the
+    same contract (pallas) and would only dilute the comparison.  The
+    pallas stage hashes IN-KERNEL, so its probe window covers the same
+    work as the sort/bucket ones (which include hash_rows here)."""
+    if dedup_backend == "pallas":
+        from jepsen_tpu.ops import wide_kernel
+
+        if wide_kernel.keep_feasible(state.shape[0]):
+            keep, _ovf = wide_kernel.keep_mask(state, fok, fcr, alive, window)
+            return keep
+        dedup_backend = "bucket"  # the same trace-time fallback ladder
     w = fok.shape[1]
     g = fcr.shape[1]
     row_cols = [state] + [fok[:, k] for k in range(w)] + [fcr[:, k] for k in range(g)]
-    h1 = hash_rows(row_cols, 0xB00B_135)
-    h2 = hash_rows(row_cols, 0x1CEB_00DA)
+    h1 = hash_rows(row_cols, HASH_SEED_1)
+    h2 = hash_rows(row_cols, HASH_SEED_2)
     if dedup_backend == "bucket" and bucket_feasible(state.shape[0]):
         keep, _ovf = _keep_bucket(h1, h2, alive, window)
         return keep
@@ -709,13 +763,25 @@ def dedup_round_probe(
     (device rounds run inside a jitted scan where host spans can't
     reach, so the probe times the identical stage standalone).
 
+    Probes every RESOLVABLE backend at the shape: "pallas" is skipped
+    when the keep-mask geometry is statically infeasible there (the
+    engines would have routed it away too), and its span carries an
+    honest ``interpret`` attr so interpret-mode CPU probes never pass
+    for chip measurements in the rolled-up comparison.
+
     Returns ``{backend: mean seconds per round}``.
     """
     from jepsen_tpu import obs
+    from jepsen_tpu.ops import wide_kernel
 
     state, fok, fcr, alive = probe_candidates(capacity, P, G, W, seed)
     out: dict = {}
     for b in backends:
+        extra = {}
+        if b == "pallas":
+            if not wide_kernel.keep_feasible(int(state.shape[0])):
+                continue  # the engines statically route this shape away
+            extra["interpret"] = wide_kernel.interpret_default()
         r = _dedup_stage_jit(state, fok, fcr, alive, 4, b)
         r.block_until_ready()  # compile outside the timed window
         t0 = time.perf_counter()
@@ -728,7 +794,7 @@ def dedup_round_probe(
             obs.span_event(
                 "dedup.round", dt, backend=b, candidates=int(state.shape[0]),
                 capacity=int(capacity), rounds=int(rounds),
-                per_round_us=round(dt * 1e6, 1),
+                per_round_us=round(dt * 1e6, 1), **extra,
             )
     return out
 

@@ -45,7 +45,10 @@ from __future__ import annotations
 
 import bisect
 import functools
+import json
+import os
 import time
+import warnings
 from typing import Sequence
 
 import numpy as np
@@ -779,6 +782,109 @@ def evict_runner_caches() -> int:
     return n
 
 
+#: Env var naming a MEASURED multi-lane fault-grid file
+#: (tools/fault_sweep.py artifact): when set and valid, measured cells
+#: replace the conservative lanes x capacity product-model inference in
+#: exact_scan_safe — the round-6 caveat's fix.  Queries no measured
+#: cell dominates still fall back to the product model (never less
+#: conservative than the data actually covers).
+EXACT_GRID_ENV = "JEPSEN_TPU_EXACT_GRID"
+
+#: path -> (mtime_ns, size, cells-or-None) parse cache; re-reads only
+#: when the file changes, so the hot routing path stays file-free.
+_EXACT_GRID_CACHE: dict = {}
+_EXACT_GRID_WARNED: set = set()
+
+
+def validate_exact_grid(obj) -> list[dict]:
+    """Validate a fault-grid artifact (tools/fault_sweep.py schema) and
+    return its normalized cells.  Raises ValueError naming the first
+    defect — the tool's --dry-run and the loader both gate on this, so
+    a malformed grid can only ever fall back to the product model,
+    never silently mis-route."""
+    if not isinstance(obj, dict):
+        raise ValueError("grid must be a JSON object")
+    if obj.get("version") != 1:
+        raise ValueError(f"unsupported grid version {obj.get('version')!r}")
+    if obj.get("kind") != "exact-fault-grid":
+        raise ValueError(f"grid kind must be 'exact-fault-grid', "
+                         f"got {obj.get('kind')!r}")
+    cells = obj.get("cells")
+    if not isinstance(cells, list) or not cells:
+        raise ValueError("grid needs a non-empty 'cells' list")
+    out = []
+    for i, c in enumerate(cells):
+        if not isinstance(c, dict):
+            raise ValueError(f"cell {i} is not an object")
+        missing = {"lanes", "capacity", "barriers", "ok"} - c.keys()
+        if missing:
+            raise ValueError(f"cell {i} is missing {sorted(missing)}")
+        if not isinstance(c["ok"], bool):
+            raise ValueError(f"cell {i}: 'ok' must be a boolean")
+        try:
+            lanes, cap, bars = (
+                int(c["lanes"]), int(c["capacity"]), int(c["barriers"])
+            )
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"cell {i}: lanes/capacity/barriers must be integers"
+            ) from None
+        if min(lanes, cap, bars) < 1:
+            raise ValueError(f"cell {i}: lanes/capacity/barriers must be >= 1")
+        out.append({"lanes": lanes, "capacity": cap, "barriers": bars,
+                    "ok": bool(c["ok"])})
+    return out
+
+
+def _exact_grid_cells(path: str) -> list[dict] | None:
+    """Cached load of the measured grid; None (with a one-shot warning)
+    on an unreadable/invalid file — conservative fallback, never a
+    crash on the routing path."""
+    try:
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    cached = _EXACT_GRID_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    cells = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            cells = validate_exact_grid(json.load(f))
+    except (OSError, ValueError) as e:
+        if path not in _EXACT_GRID_WARNED:
+            _EXACT_GRID_WARNED.add(path)
+            warnings.warn(
+                f"ignoring {EXACT_GRID_ENV}={path!r} ({e}); the "
+                "conservative product model stays in effect",
+                stacklevel=3,
+            )
+        obs.counter("wgl.exact_grid.invalid")
+    _EXACT_GRID_CACHE[path] = (key, cells)
+    return cells
+
+
+def _exact_grid_decide(cells: list[dict], B: int, capacity: int,
+                       lanes: int) -> bool | None:
+    """Decide a (B, capacity, lanes) query against measured cells.
+    Fault danger is monotone in every axis (longer scans, wider
+    frontiers, more resident lanes), so: a FAULT at a componentwise-
+    dominated shape proves the query faults; an OK at a componentwise-
+    dominating shape proves it is safe.  Contradictory data resolves
+    conservatively (fault wins); an uncovered query returns None and
+    the product model decides."""
+    for c in cells:
+        if (not c["ok"] and c["lanes"] <= lanes
+                and c["capacity"] <= capacity and c["barriers"] <= B):
+            return False
+    for c in cells:
+        if (c["ok"] and c["lanes"] >= lanes
+                and c["capacity"] >= capacity and c["barriers"] >= B):
+            return True
+    return None
+
+
 def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
     """Measured fault boundary of the batched exact runner (the round-4
     "cap >= 1024 faults the tunneled TPU worker" cliff, isolated by
@@ -806,7 +912,24 @@ def exact_scan_safe(B: int, capacity: int, lanes: int = 1) -> bool:
     "Honest limits").  Callers must route shapes where this returns False to
     the async engine (which executes them — see PERF.md) or to
     chunked_analysis (whose chunk scans keep B <= the chunk size, far
-    below the cliff)."""
+    below the cliff).
+
+    MEASURED-GRID OVERRIDE (round 11, the round-6 caveat's fix): when
+    ``JEPSEN_TPU_EXACT_GRID`` names a ``tools/fault_sweep.py``
+    artifact, its measured multi-lane cells decide first — a query
+    dominated by a measured fault is unsafe, a query dominated BY a
+    measured pass is safe (fault wins on contradiction) — and only
+    queries the grid doesn't cover fall back to the inferred product
+    model below.  A measured grid thus wins back exactly the mid-size
+    batched-exact launches the inference conservatively re-routes,
+    with zero new inference."""
+    grid_path = os.environ.get(EXACT_GRID_ENV)
+    if grid_path:
+        cells = _exact_grid_cells(grid_path)
+        if cells:
+            verdict = _exact_grid_decide(cells, B, capacity, max(1, lanes))
+            if verdict is not None:
+                return verdict
     rows = capacity * max(1, lanes) * B
     if B >= 8192:  # faulted at EVERY measured cap; untested below 512
         return False

@@ -1,0 +1,673 @@
+"""Fused Pallas TPU wide-stage frontier update (``dedup_backend="pallas"``).
+
+The wide (cap-2048) rung is the ladder's dominant cost — 56% of wall
+clock for the 6 straggler lanes (PERF.md "Honest limits") — and its
+cost is THREE separate XLA passes (hash sort, MXU prune, cumsum-rank
+gather) that round-trip the full candidate table through HBM between
+each.  This module fuses the whole stage into a single
+``pl.pallas_call``: every table (hashes, keep masks, the 2C domination
+buffer, the compacted output) stays VMEM-resident for the full sweep —
+the candidate table at the headline wide shape (26,624 rows, W=1, G=4)
+is ~1.5 MB against 16 MB of VMEM, which is the entire point: the LSH-
+bucketed beam kernels win by keeping their buckets on-chip (PAPERS:
+1806.00588), and the wide rung's working set fits.
+
+One grid sweep over 128-row tiles (T=128 — one full 128-lane stride,
+so the ≥128-lane Mosaic stride constraint is satisfied by
+construction; the <128 limitation simply doesn't bind at cap 2048):
+
+  * **dedup** — the bucket backend's packed-radix semantics WITHOUT the
+    sort.  ``_keep_bucket`` sorts ``[dead|bucket|index]`` and kills a
+    row when an alive predecessor within ``window`` sorted slots has
+    both 64-bit hash lanes equal.  Because the packed sort is stable by
+    candidate index and same-bucket rows land contiguously, that is
+    EXACTLY: kill row i iff some alive j < i (candidate order) has both
+    hash lanes equal and ``pre[i] - pre[j] <= window``, where
+    ``pre[i]`` counts alive same-bucket predecessors of i.  Both
+    ``pre`` and the kills are windowed all-pairs tile sweeps
+    ([128 x 128] VPU compares, the tiles resident), so the sort — the
+    measured per-round floor — disappears from the stage entirely.
+    The keep mask is BIT-IDENTICAL to ``_keep_bucket``'s (differential-
+    gated in tests/test_wide_kernel.py), so the fused stage inherits
+    the bucket backend's kill contract unchanged: a kill needs both
+    hash lanes equal on an alive earlier copy, survivors are the first
+    copy in candidate order, overflow never drops a row.
+  * **domination** — ``exact_prune_mxu``'s one-hot contract on the 2C
+    buffer: cumulative one-hot u-planes against saturating exact
+    v-planes, one bf16 matmul per [128 x 128] tile pair on the MXU
+    (``preferred_element_type=f32``; counts <= G so bf16 is exact),
+    ``cnt > G - 0.5`` ⟹ pointwise ≤, saturating last plane so the
+    test stays sound at any true count — the round-5 contract, tiled.
+  * **compaction** — cumsum-rank, as matmuls: per-tile ranks from a
+    lower-triangular f32 matmul, then a rank-one-hot matmul gathers
+    survivors to the tile front (row contents ride as BYTE planes so
+    f32 accumulation is exact for full u32 lanes), and overlapping
+    ragged dynamic stores advance a running SMEM cursor — each tile's
+    garbage tail is overwritten by the next tile's write, the classic
+    ragged-output pattern.  No scatter, no gather, no sort.
+
+On CPU the kernel runs under Pallas INTERPRET mode (``interpret=True``
+— resolved at trace time from the backend, overridable via
+``JEPSEN_TPU_PALLAS_INTERPRET``), so the tier-1 differential suite
+executes the real kernel body, jitted/vmapped inside the production
+runners like any other backend.  Compiled Mosaic execution is a
+chip-day validation (PERF.md round 11 records the honest status); the
+routing below is static, so an infeasible geometry — stride < 128
+rows, bucket bits < ``BUCKET_MIN_BITS``, a non-wide rung below
+``wide_min_capacity()``, or a missing ``max_count`` — falls back to
+the bucket/sort paths at trace time and never a runtime branch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jepsen_tpu.ops import hashing
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+
+#: Row-tile: one full 128-lane stride — the pair sweeps are [T, T]
+#: VPU/MXU tiles and every ragged store moves T rows.
+TILE = 128
+
+#: Env override for the wide-rung routing floor (see wide_min_capacity).
+PALLAS_MIN_CAPACITY_ENV = "JEPSEN_TPU_PALLAS_MIN_CAPACITY"
+
+#: Env override for interpret mode (default: interpret unless the
+#: default jax backend is a real TPU).
+PALLAS_INTERPRET_ENV = "JEPSEN_TPU_PALLAS_INTERPRET"
+
+#: Default routing floor: the kernel exists for the WIDE rungs (the
+#: cap-2048 straggler stage); narrow rungs keep the measured bucket/sort
+#: routing.  Matches the ops.wgl.async_ticks wide/narrow boundary.
+PALLAS_MIN_CAPACITY = 1024
+
+
+def wide_min_capacity() -> int:
+    """The smallest rung capacity routed to the fused kernel (env
+    override > module default).  Resolved at TRACE time, like
+    ``resolve_dedup_backend`` — engines thread it through their runner
+    caches, so tests that lower it must build fresh runner shapes (or
+    evict the runner caches)."""
+    v = os.environ.get(PALLAS_MIN_CAPACITY_ENV)
+    return int(v) if v else PALLAS_MIN_CAPACITY
+
+
+def interpret_default() -> bool:
+    """Whether the kernel should run under the Pallas interpreter:
+    anything that is not a real TPU backend (CPU CI, tests) interprets;
+    ``JEPSEN_TPU_PALLAS_INTERPRET=0/1`` forces.  Resolved at trace
+    time; recorded honestly in telemetry/ledger rows so chip records
+    stay separable from interpret ones."""
+    v = os.environ.get(PALLAS_INTERPRET_ENV)
+    if v is not None and v != "":
+        return v not in ("0", "false", "no")
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # noqa: BLE001 — no backend at all: interpret
+        return True
+
+
+def keep_feasible(n: int) -> bool:
+    """Static geometry gate for the dedup (keep-mask) stage: at least
+    one full 128-lane stride of candidates, and a usable packed-radix
+    bucket geometry (the kernel's ``pre`` ranks are bucket ranks)."""
+    return n >= TILE and hashing.bucket_feasible(n)
+
+
+def fused_feasible(n: int, capacity: int, max_count: int | None) -> bool:
+    """Static geometry gate for the FUSED update (dedup + domination +
+    compaction).  Beyond ``keep_feasible``: the MXU prune needs the
+    static ``max_count`` plane bound; the 2C domination buffer must
+    tile evenly (capacity % 64 == 0 so 2C % TILE == 0) and actually be
+    2C (n >= 2C — engine candidate tables are F*(1+P+G) >= 3F, so this
+    only excludes exotic direct calls); and the rung must be wide
+    (``wide_min_capacity()`` — the routing floor, not a correctness
+    bound).  A False routes the round to bucket/sort at trace time."""
+    return (
+        keep_feasible(n)
+        and max_count is not None
+        and capacity % (TILE // 2) == 0
+        and n >= 2 * capacity
+        and capacity >= wide_min_capacity()
+    )
+
+
+def _pad_rows(n: int) -> int:
+    return ((n + TILE - 1) // TILE) * TILE
+
+
+# ---------------------------------------------------------------------------
+# In-kernel helpers (traced inside the pallas kernel body)
+# ---------------------------------------------------------------------------
+
+
+#: numpy scalars, NOT jnp: a pallas kernel may not close over concrete
+#: jax arrays (even scalar ones) — numpy scalars embed as literals.
+_MIX_C1 = np.uint32(0x85EBCA6B)
+_MIX_C2 = np.uint32(0xC2B2AE35)
+
+
+def _mix32(x):
+    """hashing.mix32's murmur3 fmix32 fold, with literal-safe constants
+    (bit-identical — differential-gated against the host fold)."""
+    x = x ^ (x >> 16)
+    x = x * _MIX_C1
+    x = x ^ (x >> 13)
+    x = x * _MIX_C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def _iota1(n: int):
+    """[n] int32 iota — built 2D then collapsed (TPU requires >=2D iota)."""
+    return jax.lax.broadcasted_iota(I32, (n, 1), 0)[:, 0]
+
+
+def _tri_f32():
+    """[T, T] lower-triangular ones (inclusive) — the cumsum matmul."""
+    ii = jax.lax.broadcasted_iota(I32, (TILE, TILE), 0)
+    jj = jax.lax.broadcasted_iota(I32, (TILE, TILE), 1)
+    return (jj <= ii).astype(F32)
+
+
+def _row_hashes(state_ref, fok_ref, fcr_ref, W: int, G: int):
+    """64-bit row hashes (two u32 lanes) over the full candidate table —
+    hashing.hash_rows' fold, computed in-kernel so the hash arrays never
+    exist in HBM."""
+    cols = (
+        [state_ref[:]]
+        + [fok_ref[:, w] for w in range(W)]  # graftlint: disable=trace-host-control
+        + [fcr_ref[:, g] for g in range(G)]  # graftlint: disable=trace-host-control
+    )
+    n_pad = cols[0].shape[0]
+    h1 = jnp.full((n_pad,), np.uint32(hashing.HASH_SEED_1 ^ 0x9E3779B9))
+    h2 = jnp.full((n_pad,), np.uint32(hashing.HASH_SEED_2 ^ 0x9E3779B9))
+    for col in cols:  # graftlint: disable=trace-host-control
+        c = col.astype(U32)
+        h1 = _mix32(h1 ^ c)
+        h2 = _mix32(h2 ^ c)
+    return h1, h2
+
+
+def _dedup_tile(i, h1_s, h2_s, alive_ref, pre_s, keep_s, window: int,
+                bbits: int):
+    """Phases A+B for row tile ``i``: bucket prefix-ranks, then windowed
+    64-bit-hash kills — ``_keep_bucket``'s exact semantics, sort-free.
+    Returns (keep_i bool [T], pre_i [T])."""
+    shift = np.uint32(32 - bbits)
+    row0 = i * TILE
+    sl = pl.ds(row0, TILE)
+    h1_i = h1_s[sl]
+    h2_i = h2_s[sl]
+    b_i = h1_i >> shift
+    al_i = alive_ref[sl] != 0
+    ii = jax.lax.broadcasted_iota(I32, (TILE, TILE), 0)
+    jj = jax.lax.broadcasted_iota(I32, (TILE, TILE), 1)
+
+    def pre_body(J, pre_i):
+        sj = pl.ds(J * TILE, TILE)
+        b_j = h1_s[sj] >> shift
+        al_j = alive_ref[sj] != 0
+        lt = (J * TILE + jj) < (row0 + ii)  # global j strictly before i
+        m = (b_i[:, None] == b_j[None, :]) & al_j[None, :] & lt
+        return pre_i + m.astype(I32).sum(axis=1)
+
+    pre_i = jax.lax.fori_loop(0, i + 1, pre_body, jnp.zeros((TILE,), I32))
+    pre_s[sl] = pre_i
+
+    def kill_body(J, kill):
+        sj = pl.ds(J * TILE, TILE)
+        al_j = alive_ref[sj] != 0
+        lt = (J * TILE + jj) < (row0 + ii)
+        eq = (h1_i[:, None] == h1_s[sj][None, :]) & (
+            h2_i[:, None] == h2_s[sj][None, :]
+        )
+        near = (pre_i[:, None] - pre_s[sj][None, :]) <= window
+        return kill | (eq & al_j[None, :] & lt & near).any(axis=1)
+
+    kill = jax.lax.fori_loop(0, i + 1, kill_body,
+                             jnp.zeros((TILE,), jnp.bool_))
+    keep_i = al_i & ~kill
+    keep_s[sl] = keep_i.astype(I32)
+    return keep_i, pre_i
+
+
+# Byte-plane layout for the compaction matmuls: row contents ride as
+# bytes so the f32 one-hot gather is exact for full u32 lanes (a one-hot
+# row selects exactly one value <= 255 — trivially exact in f32).
+# [ state:4 | fok: 4 per lane | fcr: 2 per group (counts gated <= 32767
+#   at pack time) | child-bit:1 ]
+
+
+def _plane_cols(W: int, G: int) -> int:
+    return 4 + 4 * W + 2 * G + 1
+
+
+def _u32_bytes(x):
+    u = x if x.dtype == jnp.uint32 else jax.lax.bitcast_convert_type(x, U32)
+    return [((u >> np.uint32(8 * k)) & np.uint32(0xFF)).astype(I32)
+            for k in range(4)]
+
+
+def _tile_planes(state_t, fok_t, fcr_t, child_t, W: int, G: int):
+    """[T, CC] int32 byte-plane matrix for one row tile."""
+    cols = _u32_bytes(state_t)
+    for w in range(W):  # graftlint: disable=trace-host-control
+        cols += _u32_bytes(fok_t[:, w])
+    for g in range(G):  # graftlint: disable=trace-host-control
+        v = fcr_t[:, g]
+        cols += [v & np.int32(0xFF), (v >> np.int32(8)) & np.int32(0xFF)]
+    cols.append(child_t.astype(I32))
+    return jnp.stack(cols, axis=1)
+
+
+def _planes_rows(buf_t, W: int, G: int):
+    """Inverse of _tile_planes: (state [T] i32, fok [T, W] u32,
+    fcr [T, G] i32, child [T] i32) from a byte-plane tile."""
+
+    def u32_of(c0):
+        b = [buf_t[:, c0 + k].astype(U32) for k in range(4)]
+        return (b[0] | (b[1] << np.uint32(8)) | (b[2] << np.uint32(16))
+                | (b[3] << np.uint32(24)))
+
+    state = jax.lax.bitcast_convert_type(u32_of(0), I32)
+    fok = jnp.stack(
+        [u32_of(4 + 4 * w) for w in range(W)],  # graftlint: disable=trace-host-control
+        axis=1,
+    )
+    f0 = 4 + 4 * W
+    fcr = jnp.stack(
+        [buf_t[:, f0 + 2 * g] | (buf_t[:, f0 + 2 * g + 1] << np.int32(8))
+         for g in range(G)],  # graftlint: disable=trace-host-control
+        axis=1,
+    )
+    child = buf_t[:, f0 + 2 * G]
+    return state, fok, fcr, child
+
+
+def _compact_tile(keep_t, planes_t):
+    """Rank the kept rows of one tile (triangular f32 matmul) and gather
+    them to the tile front with a rank-one-hot matmul.  Returns
+    ([T, CC] compacted planes — zeros past the kept count, [] count)."""
+    kf = keep_t.astype(F32).reshape(TILE, 1)
+    lr = (
+        jax.lax.dot_general(_tri_f32(), kf, (((1,), (0,)), ((), ())),
+                            preferred_element_type=F32)
+        .reshape(TILE).astype(I32) - 1
+    )
+    ii = jax.lax.broadcasted_iota(I32, (TILE, TILE), 0)
+    onehot = ((ii == lr[None, :]) & keep_t[None, :]).astype(F32)
+    out = jax.lax.dot_general(
+        onehot, planes_t.astype(F32), (((1,), (0,)), ((), ())),
+        preferred_element_type=F32,
+    ).astype(I32)
+    return out, keep_t.astype(I32).sum()
+
+
+def _prune_uv(fcr_t, G: int, m: int):
+    """exact_prune_mxu's one-hot planes for one tile: cumulative u and
+    SATURATING exact v, [T, G*m] (counts at or past the last plane
+    compare saturating — sound at any count, exact below m-1)."""
+    c = _iota1(m)
+    u = (fcr_t[:, :, None] <= c[None, None, :]).reshape(TILE, G * m)
+    sat = jnp.minimum(fcr_t, m - 1)
+    v = (sat[:, :, None] == c[None, None, :]).reshape(TILE, G * m)
+    return u.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _keep_kernel(window: int, bbits: int, W: int, G: int,
+                 state_ref, fok_ref, fcr_ref, alive_ref,
+                 keep_ref, ovf_ref, h1_s, h2_s, pre_s):
+    """Dedup stage only: the keep mask in candidate order + the bucket
+    overflow flag (a survivor whose whole window was same-bucket alive
+    rows — possible bloat, never loss)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        h1, h2 = _row_hashes(state_ref, fok_ref, fcr_ref, W, G)
+        h1_s[:] = h1
+        h2_s[:] = h2
+        ovf_ref[0] = I32(0)
+
+    keep_i, pre_i = _dedup_tile(i, h1_s, h2_s, alive_ref, pre_s, keep_ref,
+                                window, bbits)
+    full_any = (keep_i & (pre_i >= window)).any()
+    ovf_ref[0] = ovf_ref[0] | full_any.astype(I32)
+
+
+def _fused_kernel(n: int, C: int, Cb: int, window: int, bbits: int,
+                  W: int, G: int, m: int, n_parents: int,
+                  state_ref, fok_ref, fcr_ref, alive_ref,
+                  kst_ref, kfo_ref, kfc_ref, alv_ref, chd_ref,
+                  flg_ref, fp_ref,
+                  h1_s, h2_s, pre_s, keep_s, buf_s, dead_s, out_s, sm_s):
+    """The fused wide-stage update: dedup + 2C-buffer MXU domination +
+    cumsum-rank compaction to capacity, one grid sweep, VMEM-resident.
+    Output contract is frontier_update_fast's (see the wrapper)."""
+    i = pl.program_id(0)
+    nt = pl.num_programs(0)
+    CC = _plane_cols(W, G)
+
+    @pl.when(i == 0)
+    def _():
+        h1, h2 = _row_hashes(state_ref, fok_ref, fcr_ref, W, G)
+        h1_s[:] = h1
+        h2_s[:] = h2
+        sm_s[0] = I32(0)  # stage-1 compaction cursor (dedup survivors)
+        sm_s[1] = I32(0)  # stage-2 compaction cursor (prune survivors)
+        buf_s[...] = jnp.zeros_like(buf_s)
+        out_s[...] = jnp.zeros_like(out_s)
+        dead_s[:] = jnp.zeros_like(dead_s)
+
+    _dedup_tile(i, h1_s, h2_s, alive_ref, pre_s, keep_s, window, bbits)
+
+    @pl.when(i == nt - 1)
+    def _final():
+        tidx = _iota1(TILE)
+
+        # ---- stage 1: compact dedup survivors into the 2C buffer ----
+        # (candidate order preserved; overlapping ragged stores — each
+        # tile's zero tail is overwritten by the next tile's rows)
+        def s1(J, _):
+            sj = pl.ds(J * TILE, TILE)
+            keep_j = keep_s[sj] != 0
+            gidx = J * TILE + tidx
+            child_j = (
+                (gidx >= n_parents) if n_parents >= 0
+                else jnp.zeros((TILE,), jnp.bool_)
+            )
+            planes = _tile_planes(
+                state_ref[sj], fok_ref[sj, :], fcr_ref[sj, :], child_j, W, G
+            )
+            compacted, cnt = _compact_tile(keep_j, planes)
+            base = sm_s[0]
+            buf_s[pl.ds(jnp.minimum(base, Cb), TILE), :] = compacted
+            sm_s[0] = base + cnt
+            return 0
+
+        jax.lax.fori_loop(0, nt, s1, 0)
+        nk0 = sm_s[0]
+        nk0c = jnp.minimum(nk0, Cb)
+        spill = nk0 > Cb
+
+        # ---- stage 2: content-exact domination antichain on the buffer
+        # (exact_prune_mxu's one-hot contract, [T, T] bf16 MXU tiles,
+        # saturating last plane; ties keep the earlier row) ----
+        nb = Cb // TILE
+        gm_half = np.float32(G) - np.float32(0.5)
+
+        def pr_i(I2, _):
+            si = pl.ds(I2 * TILE, TILE)
+            st_i, fok_i, fcr_i, _c = _planes_rows(buf_s[si, :], W, G)
+            al_i = (I2 * TILE + tidx) < nk0c
+            u_i, v_i = _prune_uv(fcr_i, G, m)
+            ii = jax.lax.broadcasted_iota(I32, (TILE, TILE), 0)
+            jj = jax.lax.broadcasted_iota(I32, (TILE, TILE), 1)
+
+            def pr_j(J2, _):
+                sj = pl.ds(J2 * TILE, TILE)
+                st_j, fok_j, fcr_j, _c2 = _planes_rows(buf_s[sj, :], W, G)
+                al_j = (J2 * TILE + tidx) < nk0c
+                u_j, v_j = _prune_uv(fcr_j, G, m)
+                # cnt[i, j] counts groups with fcr_i <= sat(fcr_j): == G
+                # => pointwise <=.  The second product is le_ji
+                # TRANSPOSED for free (contract the plane axis of v_i
+                # against u_j) — no in-kernel transpose.
+                cnt = jax.lax.dot_general(
+                    u_i, v_j, (((1,), (1,)), ((), ())),
+                    preferred_element_type=F32,
+                )
+                cnt_t = jax.lax.dot_general(
+                    v_i, u_j, (((1,), (1,)), ((), ())),
+                    preferred_element_type=F32,
+                )
+                le_ij = cnt > gm_half
+                le_ji_t = cnt_t > gm_half
+                same = st_i[:, None] == st_j[None, :]
+                for w in range(W):  # graftlint: disable=trace-host-control
+                    same &= fok_i[:, w][:, None] == fok_j[:, w][None, :]
+                earlier = (I2 * TILE + ii) < (J2 * TILE + jj)
+                killer = (
+                    same & le_ij & (~le_ji_t | earlier)
+                    & al_i[:, None] & al_j[None, :]
+                )
+                dead_s[sj] = dead_s[sj] | killer.any(axis=0).astype(I32)
+                return 0
+
+            jax.lax.fori_loop(0, nb, pr_j, 0)
+            return 0
+
+        jax.lax.fori_loop(0, nb, pr_i, 0)
+
+        # ---- stage 3: compact the antichain to capacity ----
+        def s3(J2, _):
+            sj = pl.ds(J2 * TILE, TILE)
+            keep2 = ((J2 * TILE + tidx) < nk0c) & (dead_s[sj] == 0)
+            compacted, cnt = _compact_tile(keep2, buf_s[sj, :])
+            base = sm_s[1]
+            out_s[pl.ds(jnp.minimum(base, C), TILE), :] = compacted
+            sm_s[1] = base + cnt
+            return 0
+
+        jax.lax.fori_loop(0, nb, s3, 0)
+        nk = sm_s[1]
+        overflowed = spill | (nk > C)
+
+        # ---- outputs: reassemble planes, alive/child masks, flags,
+        # order-insensitive content fingerprint ----
+        kst, kfo, kfc, child = _planes_rows(out_s[0:C, :], W, G)
+        new_alive = _iota1(C) < jnp.minimum(nk, C)
+        kst_ref[:] = kst
+        kfo_ref[:, :] = kfo
+        kfc_ref[:, :] = kfc
+        alv_ref[:] = new_alive.astype(I32)
+        chd_ref[:] = ((child != 0) & new_alive).astype(I32)
+        flg_ref[0] = overflowed.astype(I32)
+        flg_ref[1] = nk
+        r1 = jnp.full((C,), np.uint32(hashing.FP_SEED_1 ^ 0x9E3779B9))
+        r2 = jnp.full((C,), np.uint32(hashing.FP_SEED_2 ^ 0x9E3779B9))
+        out_cols = (
+            [kst]
+            + [kfo[:, w] for w in range(W)]  # graftlint: disable=trace-host-control
+            + [kfc[:, g] for g in range(G)]  # graftlint: disable=trace-host-control
+        )
+        for col in out_cols:  # graftlint: disable=trace-host-control
+            r1 = _mix32(r1 ^ col.astype(U32))
+            r2 = _mix32(r2 ^ col.astype(U32))
+        am = new_alive.astype(U32)
+        fp_ref[0] = (r1 * am).sum()
+        fp_ref[1] = (r2 * am).sum()
+        fp_ref[2] = am.sum()
+
+
+# ---------------------------------------------------------------------------
+# Wrappers (traced; call from inside jitted engines or eagerly)
+# ---------------------------------------------------------------------------
+
+
+def _pad_table(state, fok, fcr, alive):
+    n = state.shape[0]
+    n_pad = _pad_rows(n)
+    if n_pad != n:
+        d = n_pad - n
+        state = jnp.pad(state, (0, d))
+        fok = jnp.pad(fok, ((0, d), (0, 0)))
+        fcr = jnp.pad(fcr, ((0, d), (0, 0)))
+        alive = jnp.pad(alive, (0, d))
+    return state, fok, fcr.astype(I32), alive.astype(I32), n_pad
+
+
+def keep_mask(state, fok, fcr, alive, window: int = 4,
+              interpret: bool | None = None):
+    """The dedup stage alone (row hash + bucket partition + windowed
+    kills), as the standalone kernel — what ``dedup_round_probe`` times
+    and the differential suite compares bit-for-bit against
+    ``_keep_bucket``.  Returns (keep [n] bool in candidate order,
+    overflow [] bool)."""
+    n = state.shape[0]
+    assert keep_feasible(n), f"pallas keep-mask infeasible at {n} rows"
+    W, G = fok.shape[1], fcr.shape[1]
+    _ibits, bbits = hashing._bucket_bits(n)
+    st, fo, fc, al, n_pad = _pad_table(state, fok, fcr, alive)
+    if interpret is None:
+        interpret = interpret_default()
+    keep, ovf = pl.pallas_call(
+        functools.partial(_keep_kernel, int(window), bbits, W, G),
+        grid=(n_pad // TILE,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad,), I32),
+            jax.ShapeDtypeStruct((1,), I32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad,), U32),
+            pltpu.VMEM((n_pad,), U32),
+            pltpu.VMEM((n_pad,), I32),
+        ],
+        interpret=bool(interpret),
+    )(st, fo, fc, al)
+    return keep[:n] != 0, ovf[0] != 0
+
+
+def fused_frontier_update(
+    state, fok, fcr, alive, cost, capacity: int, window: int = 4,
+    n_parents: int | None = None, max_count: int | None = None,
+    interpret: bool | None = None,
+):
+    """Drop-in fused replacement for ``hashing.frontier_update_fast``
+    on feasible wide geometry (``fused_feasible``) — same signature
+    (``cost`` accepted and unused, same candidate-order truncation
+    argument), same returns (state', fok', fcr', alive', overflowed,
+    fp, child).
+
+    Output parity with the bucket backend (differential-gated): alive
+    rows are bit-identical in content AND position, and so are
+    ``overflowed`` and the fingerprint ``fp``.  Dead output rows are
+    ZEROS here (the reference gathers arbitrary row-0 copies into dead
+    slots); ``child`` is masked by alive' (the reference leaves garbage
+    on dead rows) — engines only consume ``alive' & child``.
+    """
+    n = state.shape[0]
+    assert fused_feasible(n, capacity, max_count), (
+        f"pallas fused update infeasible at n={n}, capacity={capacity}"
+    )
+    W, G = fok.shape[1], fcr.shape[1]
+    fcr_dtype = fcr.dtype
+    _ibits, bbits = hashing._bucket_bits(n)
+    C = int(capacity)
+    Cb = 2 * C
+    m = min(int(max_count), hashing.MXU_PRUNE_MAX_COUNT)
+    CC = _plane_cols(W, G)
+    st, fo, fc, al, n_pad = _pad_table(state, fok, fcr, alive)
+    if interpret is None:
+        interpret = interpret_default()
+    kst, kfo, kfc, alv, chd, flg, fp = pl.pallas_call(
+        functools.partial(
+            _fused_kernel, n, C, Cb, int(window), bbits, W, G, m,
+            -1 if n_parents is None else int(n_parents),
+        ),
+        grid=(n_pad // TILE,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((C,), I32),
+            jax.ShapeDtypeStruct((C, W), U32),
+            jax.ShapeDtypeStruct((C, G), I32),
+            jax.ShapeDtypeStruct((C,), I32),
+            jax.ShapeDtypeStruct((C,), I32),
+            jax.ShapeDtypeStruct((2,), I32),
+            jax.ShapeDtypeStruct((3,), U32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad,), U32),          # h1
+            pltpu.VMEM((n_pad,), U32),          # h2
+            pltpu.VMEM((n_pad,), I32),          # pre (bucket ranks)
+            pltpu.VMEM((n_pad,), I32),          # keep mask
+            pltpu.VMEM((Cb + TILE, CC), I32),   # 2C domination buffer
+            pltpu.VMEM((Cb,), I32),             # prune kills
+            pltpu.VMEM((C + TILE, CC), I32),    # compacted output
+            pltpu.SMEM((2,), I32),              # ragged-store cursors
+        ],
+        interpret=bool(interpret),
+    )(st, fo, fc, al)
+    return (
+        kst, kfo, kfc.astype(fcr_dtype), alv != 0, flg[0] != 0, fp, chd != 0
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("capacity", "window", "n_parents", "max_count",
+                     "interpret"),
+)
+def fused_update_jit(state, fok, fcr, alive, cost, capacity, window=4,
+                     n_parents=None, max_count=None, interpret=None):
+    """Jitted entry for eager callers (tests, probes): the engines trace
+    ``fused_frontier_update`` into their own runner programs instead."""
+    return fused_frontier_update(
+        state, fok, fcr, alive, cost, capacity, window=window,
+        n_parents=n_parents, max_count=max_count, interpret=interpret,
+    )
+
+
+def stage_occupancy(capacity: int, P: int, G: int, W: int | None = None,
+                    max_count: int | None = None) -> dict:
+    """Host-side tile/VMEM occupancy estimate for one fused-stage launch
+    at a rung's shape — the attrs ladder telemetry rows carry
+    (``pallas_tile``, ``pallas_vmem_bytes``, ...) and the chip-day flip
+    procedure reads next to the compete verdict.  Pure arithmetic, no
+    device work."""
+    W = (P + 31) // 32 if W is None else W
+    n = capacity * (1 + P + G)
+    n_pad = _pad_rows(n)
+    C = int(capacity)
+    Cb = 2 * C
+    CC = _plane_cols(W, G)
+    inputs = n_pad * (4 + 4 * W + 4 * G + 4)
+    scratch = (
+        n_pad * (4 + 4 + 4 + 4)            # h1, h2, pre, keep
+        + (Cb + TILE) * CC * 4             # domination buffer
+        + Cb * 4                           # prune kills
+        + (C + TILE) * CC * 4              # compacted output
+    )
+    outputs = C * (4 + 4 * W + 4 * G + 4 + 4) + 4 * 2 + 4 * 3
+    return {
+        "tile": TILE,
+        "candidates": int(n),
+        "candidates_padded": int(n_pad),
+        "vmem_bytes": int(inputs + scratch + outputs),
+        "prune_planes": (
+            min(int(max_count), hashing.MXU_PRUNE_MAX_COUNT)
+            if max_count is not None else None
+        ),
+        "interpret": interpret_default(),
+    }

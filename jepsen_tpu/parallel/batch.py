@@ -1135,6 +1135,31 @@ def batch_analysis(
             stage=si, engine=st_engine, capacity=batch_cap,
             lanes=len(group), dedup=dedup,
         )
+        if dedup == "pallas" and st_engine in ("async", "sync") and group:
+            # Fused-kernel rungs carry the kernel's tile/VMEM occupancy
+            # on their ladder.stage rows (estimate at the rung's widest
+            # pack shape — stage_occupancy is pure arithmetic), plus an
+            # honest interpret flag so chip rows stay separable.  A
+            # rung whose geometry statically routes AWAY from the
+            # kernel is counted: silent fallback would read as "the
+            # kernel ran" in exactly the stage rows built to decide
+            # the chip-day flip.
+            from jepsen_tpu.ops import wide_kernel as _wk
+
+            _pP = max(packs[k]["P"] for k in group)
+            _pG = max(packs[k]["G"] for k in group)
+            _occ = _wk.stage_occupancy(batch_cap, _pP, _pG,
+                                       max_count=_pP + 1)
+            _routed = _wk.fused_feasible(
+                _occ["candidates"], batch_cap, _pP + 1)
+            stage_attrs.update(
+                pallas_routed=_routed, pallas_tile=_occ["tile"],
+                pallas_vmem_bytes=_occ["vmem_bytes"],
+                pallas_interpret=_occ["interpret"],
+            )
+            if not _routed:
+                obs.counter("dedup.pallas_fallback",
+                            stage=si, capacity=batch_cap)
         # Measured-shape guard (round 5): the batched exact runner
         # faults the TPU worker on long-scan x wide-frontier shapes
         # (boundary table in wgl.exact_scan_safe).  Lanes past the

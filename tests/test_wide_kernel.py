@@ -1,0 +1,468 @@
+"""Interpret-mode differential suite for the fused Pallas wide-stage
+kernel (jepsen_tpu.ops.wide_kernel, ``dedup_backend="pallas"``).
+
+The kernel body EXECUTES here — Pallas interpret mode on the CPU
+backend runs the same traced program the chip would — and every
+contract is gated against the reference backends: bit-identical keep
+masks vs ``_keep_bucket``, bit-identical compacted frontiers /
+overflow flags / fingerprints vs the bucket fast update, identical
+survivor content sets vs sort, overflow-retention soundness,
+all-dead/all-alive masks, static fallback routing on infeasible
+geometry, and ladder-level verdict agreement.  Shapes reuse the
+suite-shared probe geometry (capacity 64/256 — tier-1 is near the
+870 s cap; no new compile geometries beyond the kernel's own)."""
+
+import functools
+import json
+import pathlib
+import random
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+import jax
+import jax.numpy as jnp
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import hashing as hx
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops import wide_kernel as wk
+from jepsen_tpu.parallel import batch_analysis
+from test_wgl_cpu import random_history
+
+
+@pytest.fixture(autouse=True)
+def _wide_floor(monkeypatch):
+    """Route the suite-shared (64/256) shapes to the kernel: the
+    production floor (1024) exists for chip perf routing, not
+    correctness, and tier-1 must execute the kernel body at shapes the
+    compile budget already pays for."""
+    monkeypatch.setenv(wk.PALLAS_MIN_CAPACITY_ENV, "64")
+
+
+def _content(state, fok, fcr, alive):
+    state, fok, fcr, alive = (np.asarray(a) for a in (state, fok, fcr, alive))
+    return {
+        (int(state[i]), tuple(int(x) for x in fok[i]),
+         tuple(int(x) for x in fcr[i]))
+        for i in np.flatnonzero(alive)
+    }
+
+
+#: jitted references at THE suite-shared shape (compiled once per run)
+_REF = {
+    b: jax.jit(functools.partial(
+        hx.frontier_update_fast, capacity=64, n_parents=64, max_count=8,
+        dedup_backend=b))
+    for b in ("sort", "bucket")
+}
+_KEEP = {
+    b: functools.partial(hx._dedup_stage_jit, window=4, dedup_backend=b)
+    for b in ("bucket", "pallas")
+}
+
+
+def _args(seed, capacity=64, P=4, G=3, W=1):
+    st, fo, fc, al = hx.probe_candidates(capacity, P, G, W, seed=seed)
+    return (jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al)), jnp.zeros(st.shape[0], jnp.int32)
+
+
+def _assert_fused_matches(pal, ref, tag, bit_exact=True):
+    ra, pa = np.asarray(ref[3]), np.asarray(pal[3])
+    if bit_exact:
+        assert (ra == pa).all(), (tag, "alive mask")
+        for k in range(3):
+            r, p = np.asarray(ref[k]), np.asarray(pal[k])
+            assert (r[ra] == p[ra]).all(), (tag, "column", k)
+        assert (np.asarray(ref[5]) == np.asarray(pal[5])).all(), (tag, "fp")
+        assert ((np.asarray(ref[6]) & ra) == (np.asarray(pal[6]) & pa)).all(), \
+            (tag, "child")
+    assert bool(ref[4]) == bool(pal[4]), (tag, "overflow")
+    assert _content(*ref[:4]) == _content(*pal[:4]), (tag, "content")
+
+
+# ---------------------------------------------------------------------------
+# Feasibility / routing gates
+# ---------------------------------------------------------------------------
+
+
+def test_feasibility_gates(monkeypatch):
+    assert wk.keep_feasible(512)
+    assert not wk.keep_feasible(64)          # below one 128-lane stride
+    assert wk.fused_feasible(512, 64, 8)
+    assert not wk.fused_feasible(512, 64, None)   # no MXU plane bound
+    assert not wk.fused_feasible(512, 48, 8)      # 2C not tile-aligned
+    assert not wk.fused_feasible(100, 64, 8)      # n < 2C and < stride
+    monkeypatch.delenv(wk.PALLAS_MIN_CAPACITY_ENV, raising=False)
+    assert wk.wide_min_capacity() == wk.PALLAS_MIN_CAPACITY
+    assert not wk.fused_feasible(2048, 256, 8)    # narrow rung at default
+    assert wk.fused_feasible(26624, 2048, 9)      # the cap-2048 rung
+    monkeypatch.setattr(hx, "BUCKET_MIN_BITS", 40)
+    assert not wk.keep_feasible(512)              # bucket bits gate shared
+
+
+def test_backend_roster_and_resolver(monkeypatch):
+    assert hx.DEDUP_BACKENDS == ("sort", "bucket", "pallas")
+    monkeypatch.setenv(hx.DEDUP_BACKEND_ENV, "pallas")
+    assert hx.resolve_dedup_backend() == "pallas"
+    assert hx.resolve_dedup_backend("bucket") == "bucket"  # explicit wins
+
+
+# ---------------------------------------------------------------------------
+# Randomized differential: >= 200 seeded rounds, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_randomized_differential_200_rounds():
+    """The acceptance differential: 200 seeded rounds at the shared
+    shape — keep mask bit-identical to _keep_bucket, fused update
+    bit-identical to the bucket fast update (alive rows, positions,
+    overflow, fingerprint, child), survivor content equal to sort."""
+    fused = 0
+    for seed in range(200):
+        args, cost = _args(seed)
+        kb = np.asarray(_KEEP["bucket"](*args))
+        kp = np.asarray(_KEEP["pallas"](*args))
+        assert (kb == kp).all(), (seed, np.flatnonzero(kb != kp)[:8])
+        pal = wk.fused_update_jit(*args, cost, 64, n_parents=64, max_count=8)
+        _assert_fused_matches(pal, _REF["bucket"](*args, cost), seed)
+        _assert_fused_matches(pal, _REF["sort"](*args, cost), seed,
+                              bit_exact=False)
+        fused += 1
+    assert fused == 200
+
+
+def test_duplicate_heavy_and_spill_pressure():
+    """Dup runs far beyond the window and survivor counts past the 2C
+    buffer: retention and the spill flag must match the reference
+    bit-for-bit (overflow NEVER drops a row on either path)."""
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        n = 1024
+        st = rng.integers(0, 8, n).astype(np.int32)
+        fo = rng.integers(0, 4, (n, 1)).astype(np.uint32)
+        fc = rng.integers(0, 3, (n, 2)).astype(np.int16)
+        src = rng.integers(0, n, (3 * n) // 4)
+        st[: len(src)] = st[src]
+        fo[: len(src)] = fo[src]
+        fc[: len(src)] = fc[src]
+        al = rng.random(n) < 0.9
+        args = (jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+                jnp.asarray(al))
+        cost = jnp.zeros(n, jnp.int32)
+        pal = wk.fused_update_jit(*args, cost, 64, n_parents=64, max_count=4)
+        ref = hx.frontier_update_fast(*args, cost, 64, n_parents=64,
+                                      max_count=4, dedup_backend="bucket")
+        _assert_fused_matches(pal, ref, trial)
+
+
+def test_extreme_value_ranges_through_byte_planes():
+    """Full-range values must survive the byte-plane matmul gathers
+    exactly: negative int32 states (bitcast path), full u32 fok lanes,
+    and fcr counts at the int16 gate."""
+    rng = np.random.default_rng(3)
+    n = 512
+    st = rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)
+    fo = rng.integers(0, 2**32, (n, 2), dtype=np.uint64).astype(np.uint32)
+    fc = rng.integers(0, 32767, (n, 3)).astype(np.int16)
+    st[:200] = st[200:400]
+    fo[:200] = fo[200:400]
+    fc[:200] = fc[200:400]
+    al = rng.random(n) < 0.8
+    args = (jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc), jnp.asarray(al))
+    cost = jnp.zeros(n, jnp.int32)
+    pal = wk.fused_update_jit(*args, cost, 64, n_parents=64, max_count=64)
+    ref = hx.frontier_update_fast(*args, cost, 64, n_parents=64,
+                                  max_count=64, dedup_backend="bucket")
+    _assert_fused_matches(pal, ref, "extreme")
+    # saturating prune planes: counts >= m-1 everywhere
+    fc2 = jnp.asarray(rng.integers(0, 200, (n, 3)).astype(np.int16))
+    pal = wk.fused_update_jit(args[0], args[1], fc2, args[3], cost, 64,
+                              n_parents=64, max_count=4)
+    ref = hx.frontier_update_fast(args[0], args[1], fc2, args[3], cost, 64,
+                                  n_parents=64, max_count=4,
+                                  dedup_backend="bucket")
+    _assert_fused_matches(pal, ref, "saturate")
+
+
+def test_all_dead_and_all_alive_masks():
+    args, cost = _args(11)
+    dead = jnp.zeros_like(args[3])
+    pal = wk.fused_update_jit(args[0], args[1], args[2], dead, cost, 64,
+                              n_parents=64, max_count=8)
+    assert not np.asarray(pal[3]).any()
+    assert not bool(pal[4])
+    assert (np.asarray(pal[5]) == 0).all()   # empty-set fingerprint
+    ref = hx.frontier_update_fast(args[0], args[1], args[2], dead, cost, 64,
+                                  n_parents=64, max_count=8,
+                                  dedup_backend="bucket")
+    assert (np.asarray(ref[5]) == np.asarray(pal[5])).all()
+    live = jnp.ones_like(args[3])
+    pal = wk.fused_update_jit(args[0], args[1], args[2], live, cost, 64,
+                              n_parents=64, max_count=8)
+    ref = hx.frontier_update_fast(args[0], args[1], args[2], live, cost, 64,
+                                  n_parents=64, max_count=8,
+                                  dedup_backend="bucket")
+    _assert_fused_matches(pal, ref, "all-alive")
+
+
+def test_keep_mask_kills_only_true_duplicates():
+    """No-drop soundness, directly on the kernel: every killed row has
+    an identical EARLIER surviving copy — a kill is always a duplicate
+    kill keeping the first copy in candidate order, never a distinct
+    config (the bucket contract, inherited bit-for-bit)."""
+    st, fo, fc, al = hx.probe_candidates(32, 4, 2, 1, seed=7)
+    keep, _ovf = wk.keep_mask(jnp.asarray(st), jnp.asarray(fo),
+                              jnp.asarray(fc), jnp.asarray(al), 4)
+    keep = np.asarray(keep)
+    rows = [(int(st[i]), tuple(fo[i]), tuple(fc[i])) for i in range(len(st))]
+    first = {}
+    for i in range(len(rows)):
+        if al[i]:
+            first.setdefault(rows[i], i)
+    for i in np.flatnonzero(al & ~keep):
+        j = first[rows[i]]
+        assert j < i and keep[j], f"killed row {i} lost its content"
+    for i in np.flatnonzero(keep):
+        assert first[rows[i]] == i, "survivor is not the first copy"
+
+
+# ---------------------------------------------------------------------------
+# Static fallback routing
+# ---------------------------------------------------------------------------
+
+
+def test_infeasible_geometry_routes_to_bucket_then_sort(monkeypatch):
+    """Below the wide floor / stride / bucket gates, "pallas" must be
+    bit-identical to the bucket route (then sort when bucket is also
+    infeasible) — the trace-time fallback ladder, rows never dropped."""
+    args, cost = _args(5)
+    monkeypatch.setenv(wk.PALLAS_MIN_CAPACITY_ENV, "4096")  # nothing is wide
+    via = hx.frontier_update_fast(*args, cost, 64, n_parents=64, max_count=8,
+                                  dedup_backend="pallas")
+    ref = hx.frontier_update_fast(*args, cost, 64, n_parents=64, max_count=8,
+                                  dedup_backend="bucket")
+    for x, y in zip(via, ref):
+        assert (np.asarray(x) == np.asarray(y)).all()
+    monkeypatch.setattr(hx, "BUCKET_MIN_BITS", 40)  # bucket infeasible too
+    via = hx.frontier_update_fast(*args, cost, 64, n_parents=64, max_count=8,
+                                  dedup_backend="pallas")
+    ref = hx.frontier_update_fast(*args, cost, 64, n_parents=64, max_count=8,
+                                  dedup_backend="sort")
+    for x, y in zip(via, ref):
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
+def test_exact_update_pallas_rides_bucket_partition():
+    """The exact engine (content-decided kills) under "pallas" keeps the
+    bucket stage-1 partition: identical survivor content set."""
+    st, fo, fc, al = hx.probe_candidates(48, 3, 2, 1, seed=5)
+    cost = jnp.asarray(np.asarray(fc).sum(axis=1, dtype=np.int32))
+    out = {}
+    for b in ("bucket", "pallas"):
+        kst, kfo, kfc, ka, ovf, _fp = hx.frontier_update(
+            jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+            jnp.asarray(al), cost, 48, dedup_backend=b,
+        )
+        out[b] = (_content(kst, kfo, kfc, ka), bool(ovf))
+    assert out["bucket"] == out["pallas"]
+
+
+# ---------------------------------------------------------------------------
+# Engine- and ladder-level verdict agreement
+# ---------------------------------------------------------------------------
+
+
+def test_async_engine_pallas_vs_oracle():
+    from jepsen_tpu.checker import wgl_cpu
+
+    rng = random.Random(321)
+    for trial in range(8):
+        hist = random_history(rng)
+        truth = wgl_cpu.brute_analysis(m.CASRegister(None), hist)["valid?"]
+        got = wgl.analysis_async(
+            m.CASRegister(None), hist, capacity=128, dedup_backend="pallas"
+        )["valid?"]
+        assert got in (truth, "unknown"), (trial, got, truth)
+
+
+def test_ladder_verdict_agreement_pallas_vs_sort():
+    """batch_analysis (greedy rung, async rungs, exact escalation,
+    confirmation) through pallas vs sort: bit-identical verdicts."""
+    rng = random.Random(45100)
+    model = m.CASRegister(None)
+    hists = []
+    for i in range(8):
+        if i % 2:
+            hist = valid_register_history(30, 4, seed=i, info_rate=0.2)
+            if i % 4 == 1:
+                hist = corrupt(hist, seed=i)
+        else:
+            hist = random_history(rng)
+        hists.append(h.index(hist))
+    kw = dict(capacity=(64, 256), cpu_fallback=False, exact_escalation=(64,))
+    verdicts = {}
+    for b in ("sort", "pallas"):
+        verdicts[b] = [
+            r["valid?"] for r in batch_analysis(model, hists,
+                                                dedup_backend=b, **kw)
+        ]
+    assert verdicts["sort"] == verdicts["pallas"]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: probe + occupancy attrs
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_rows_carry_pallas_occupancy_attrs(tmp_path):
+    """Fused-kernel rungs attach tile/VMEM occupancy + routing/interpret
+    attrs to their ladder.stage rows — the rows the chip-day flip
+    decision reads next to the compete ledger record."""
+    from jepsen_tpu import obs
+
+    # a corrupted history: the greedy walk can't resolve it, so the
+    # async rung actually launches (greedy rungs have no frontier and
+    # therefore no pallas attrs)
+    hists = [h.index(corrupt(valid_register_history(12, 3, seed=s), seed=s))
+             for s in (0, 1)]
+    with obs.recording(tmp_path, enabled=True) as rec:
+        batch_analysis(m.CASRegister(None), hists, capacity=(64,),
+                       cpu_fallback=False, exact_escalation=(),
+                       confirm_refutations=False, dedup_backend="pallas")
+    rows = [r for r in rec.summary["ladder"] if r.get("engine") == "async"]
+    assert rows, rec.summary["ladder"]
+    for r in rows:
+        assert r["dedup"] == "pallas"
+        assert r["pallas_tile"] == wk.TILE
+        assert r["pallas_vmem_bytes"] > 0
+        assert r["pallas_routed"] is True      # floor lowered by fixture
+        assert r["pallas_interpret"] is True   # CPU: honest tag
+
+
+def test_dedup_probe_includes_pallas_with_interpret_tag(tmp_path):
+    from jepsen_tpu import obs
+
+    with obs.recording(tmp_path, enabled=True) as rec:
+        times = hx.dedup_round_probe(32, 4, 2, rounds=2)
+    assert set(times) == {"sort", "bucket", "pallas"}
+    rows = rec.summary["dedup"]
+    by_backend = {r["backend"]: r for r in rows}
+    assert set(by_backend) == {"sort", "bucket", "pallas"}
+    assert by_backend["pallas"]["interpret"] is True  # CPU run: honest tag
+    assert "interpret" not in by_backend["sort"]
+
+
+def test_probe_skips_statically_infeasible_pallas():
+    # capacity 8, P=2, G=1 -> 32 candidates: below one 128-lane stride
+    times = hx.dedup_round_probe(8, 2, 1, rounds=1, emit=False)
+    assert "pallas" not in times and set(times) == {"sort", "bucket"}
+
+
+def test_stage_occupancy_fits_vmem():
+    occ = wk.stage_occupancy(2048, 8, 4, max_count=9)
+    assert occ["tile"] == wk.TILE == 128
+    assert occ["candidates"] == 2048 * 13
+    assert occ["vmem_bytes"] < 16 * 1024 * 1024  # the fusion premise
+    assert occ["prune_planes"] == 9
+    assert occ["interpret"] is True
+
+
+# ---------------------------------------------------------------------------
+# exact_scan_safe measured-grid override (tools/fault_sweep.py artifact)
+# ---------------------------------------------------------------------------
+
+
+def _grid(cells):
+    return {"version": 1, "kind": "exact-fault-grid", "cells": cells}
+
+
+def test_exact_grid_schema_validation():
+    ok = _grid([{"lanes": 1, "capacity": 64, "barriers": 128, "ok": True}])
+    assert wgl.validate_exact_grid(ok)[0]["capacity"] == 64
+    for bad in (
+        [],
+        {"version": 2, "kind": "exact-fault-grid", "cells": [{}]},
+        _grid([]),
+        _grid([{"lanes": 1, "capacity": 64, "ok": True}]),
+        _grid([{"lanes": 0, "capacity": 64, "barriers": 1, "ok": True}]),
+        _grid([{"lanes": 1, "capacity": 64, "barriers": 1, "ok": "yes"}]),
+        {"version": 1, "kind": "other", "cells": [1]},
+    ):
+        with pytest.raises(ValueError):
+            wgl.validate_exact_grid(bad)
+
+
+def test_exact_grid_override_routing(tmp_path, monkeypatch):
+    """Measured cells beat the product model in both directions; fault
+    wins over pass on contradictory data; uncovered queries fall back."""
+    path = tmp_path / "grid.json"
+    path.write_text(json.dumps(_grid([
+        {"lanes": 8, "capacity": 1024, "barriers": 4096, "ok": True},
+        {"lanes": 64, "capacity": 64, "barriers": 1024, "ok": False},
+    ])))
+    monkeypatch.setenv(wgl.EXACT_GRID_ENV, str(path))
+    # measured pass: the product model would refuse 8x1024x4096
+    assert wgl.exact_scan_safe(4096, 1024, lanes=8)
+    assert wgl.exact_scan_safe(2048, 512, lanes=4)   # pass-dominated
+    # measured fault: the product model would allow 64x64x1024
+    assert not wgl.exact_scan_safe(1024, 64, lanes=64)
+    assert not wgl.exact_scan_safe(2048, 128, lanes=64)  # fault-dominated
+    # uncovered: product model decides
+    assert not wgl.exact_scan_safe(8192, 32, lanes=1)
+    assert wgl.exact_scan_safe(128, 64, lanes=1)
+    # contradiction resolves conservatively (fault wins)
+    path2 = tmp_path / "contradictory.json"
+    path2.write_text(json.dumps(_grid([
+        {"lanes": 1, "capacity": 64, "barriers": 64, "ok": False},
+        {"lanes": 8, "capacity": 1024, "barriers": 4096, "ok": True},
+    ])))
+    monkeypatch.setenv(wgl.EXACT_GRID_ENV, str(path2))
+    assert not wgl.exact_scan_safe(128, 64, lanes=1)
+
+
+def test_exact_grid_invalid_file_falls_back(tmp_path, monkeypatch):
+    path = tmp_path / "bad.json"
+    path.write_text("{definitely not json")
+    monkeypatch.setenv(wgl.EXACT_GRID_ENV, str(path))
+    wgl._EXACT_GRID_WARNED.discard(str(path))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert wgl.exact_scan_safe(128, 64)          # product model
+        assert not wgl.exact_scan_safe(8192, 64)
+    assert any("product model" in str(x.message) for x in w)
+
+
+def test_fault_sweep_dry_run():
+    import fault_sweep
+
+    assert fault_sweep.dry_run() == 0
+
+
+def test_compete_default_is_three_way_with_interpret_stamp(tmp_path,
+                                                           monkeypatch):
+    """`perfwatch compete --axis dedup_backend` with no --values runs
+    sort vs bucket vs pallas and stamps the record's pallas execution
+    mode (interpret on CPU) so chip records stay separable."""
+    import perfwatch
+
+    from jepsen_tpu.obs import regress
+
+    times = {"sort": [0.5], "bucket": [0.3], "pallas": [0.4]}
+    monkeypatch.setattr(
+        regress, "_default_runner", lambda axis, **kw: (lambda v: times[v]),
+    )
+    led = tmp_path / "ledger.jsonl"
+    assert perfwatch.main(["compete", "--axis", "dedup_backend",
+                           "--ledger", str(led)]) == 0
+    (rec,) = regress.read_records(led)
+    assert rec["extra"]["values"] == ["sort", "bucket", "pallas"]
+    assert rec["extra"]["winner"] == "bucket"
+    assert rec["extra"]["pallas_interpret"] is True  # CPU: honest tag

@@ -1,0 +1,292 @@
+"""Multi-lane exact fault sweep: measure the batched-exact fault grid.
+
+The round-5 fault boundary (``ops/wgl.py:exact_scan_safe``) was
+measured on SINGLE-lane launches; the multi-lane guard is a lanes x
+capacity PRODUCT-MODEL inference with no multi-lane fault point
+confirming it — conservative by construction, and the cost is routing:
+mid-size batched-exact launches that may in fact be safe get re-routed
+to the chunked path (PERF.md round 6 "exact_scan_safe lane-count
+conservatism").  This tool runs the deferred measurement: a grid of
+(lanes x capacity x barriers) REAL batched-exact launches, each in its
+own subprocess (a genuine TPU-worker fault kills the child, never the
+sweep), recording pass/fault per cell into a JSON artifact whose
+schema ``ops/wgl.py:validate_exact_grid`` owns.  Point
+``JEPSEN_TPU_EXACT_GRID`` at the artifact and ``exact_scan_safe``
+routes by MEASURED cells first (fault-domination beats pass-domination
+beats the product model) — the chip-day win-back is one sweep plus one
+env var.
+
+  # the chip sweep (sized like the round-5 single-lane grid, x lanes):
+  python tools/fault_sweep.py --lanes 1,8,32 --capacity 512,1024,2048 \\
+      --barriers 2048,4096,8192 --out store/exact-grid.json
+
+  # CI/CPU: validate schema + routing without launching anything
+  python tools/fault_sweep.py --dry-run
+
+Each cell launches ``lanes`` copies of one ``barriers``-op valid
+register history through ``wgl.exact_batched_runner`` at ``capacity``
+(the exact kernel shape the guard protects).  Cell outcomes: ``ok``
+(clean exit), ``fault`` (crash/abort — the measurement), or a timeout
+(recorded as a fault, conservatively, with ``timeout: true``).  The
+artifact carries the machine fingerprint (obs.regress), so CPU-run
+grids can never masquerade as chip measurements when routing reads
+them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "tools"))
+
+
+def _build_cell_args(lanes: int, capacity: int, barriers: int):
+    """Pack a ``barriers``-op valid register history and stack ``lanes``
+    copies at the cell's geometry — exactly what a batched-exact ladder
+    stage would launch (parallel.batch._stack at the bucketed shapes,
+    batch axis padded like _launch_impl pads it)."""
+    from genhist import valid_register_history
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.parallel import batch as pbatch
+
+    hist = valid_register_history(int(barriers), 4, seed=1234)
+    packed = wgl.pack(m.CASRegister(None), hist)
+    B, P, G = pbatch.bucket_geometry(packed["B"], packed["P"], packed["G"])
+    stacked = pbatch._stack([packed] * int(lanes), B, P, G)
+    return stacked, B, P, G
+
+
+def run_cell(lanes: int, capacity: int, barriers: int, rounds: int = 8) -> int:
+    """Execute ONE grid cell in-process (the subprocess entry): build
+    the launch, run it to completion, exit 0.  A TPU-worker fault
+    kills this process — the parent records the cell as a fault."""
+    import jax.numpy as jnp  # noqa: F401 — initialize the backend here
+
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.parallel.batch import _ARG_ORDER
+
+    stacked, B, P, G = _build_cell_args(lanes, capacity, barriers)
+    W = (P + 31) // 32
+    runner = wgl.exact_batched_runner(
+        _step_of(stacked), int(capacity), int(rounds), P, G, W
+    )
+    args = [stacked[k] for k in _ARG_ORDER]
+    valid, _failed_at, _lossy, _peak = runner(*args)
+    valid.block_until_ready()
+    print(f"cell ok: lanes={lanes} capacity={capacity} barriers={barriers} "
+          f"valid={[bool(v) for v in valid][:4]}...")
+    return 0
+
+
+def _step_of(stacked) -> object:
+    """The packed step function is per-model, not per-lane: recover it
+    the way the ladder does (pack() attaches it)."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.models import tensor as tmodels
+
+    return tmodels.tensor_model_for(m.CASRegister(None)).step
+
+
+def _machine_fingerprint() -> dict:
+    try:
+        from jepsen_tpu.obs import regress
+
+        return regress.fingerprint()
+    except Exception:  # noqa: BLE001 — a grid without a fingerprint is
+        # still valid; routing never reads it (humans and PERF.md do)
+        return {}
+
+
+def sweep(lanes_list, caps, bars, out_path: Path, timeout_s: float,
+          rounds: int = 8) -> dict:
+    """Run the full grid, one subprocess per cell, and write the
+    artifact after EVERY cell (a crashed sweep loses nothing)."""
+    cells = []
+    grid = {
+        "version": 1,
+        "kind": "exact-fault-grid",
+        "ts": time.time(),
+        "fingerprint": _machine_fingerprint(),
+        "workload": {"model": "cas-register", "rounds": int(rounds)},
+        "cells": cells,
+    }
+    total = len(lanes_list) * len(caps) * len(bars)
+    i = 0
+    for lanes in lanes_list:
+        for cap in caps:
+            for B in bars:
+                i += 1
+                print(f"[{i}/{total}] lanes={lanes} capacity={cap} "
+                      f"barriers={B} ...", flush=True)
+                t0 = time.time()
+                cell = {"lanes": int(lanes), "capacity": int(cap),
+                        "barriers": int(B)}
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, str(Path(__file__).resolve()),
+                         "--run-cell", f"{lanes},{cap},{B}",
+                         "--rounds", str(rounds)],
+                        timeout=timeout_s, capture_output=True, text=True,
+                    )
+                    cell["ok"] = proc.returncode == 0
+                    if proc.returncode != 0:
+                        cell["exit_code"] = proc.returncode
+                        cell["stderr_tail"] = (proc.stderr or "")[-500:]
+                except subprocess.TimeoutExpired:
+                    # a hung worker is indistinguishable from a wedged
+                    # fault from the router's seat: conservative fault
+                    cell["ok"] = False
+                    cell["timeout"] = True
+                cell["seconds"] = round(time.time() - t0, 2)
+                cells.append(cell)
+                out_path.parent.mkdir(parents=True, exist_ok=True)
+                out_path.write_text(json.dumps(grid, indent=1),
+                                    encoding="utf-8")
+                print(f"    -> {'ok' if cell['ok'] else 'FAULT'} "
+                      f"({cell['seconds']}s)", flush=True)
+    print(f"grid written: {out_path} ({len(cells)} cells)")
+    return grid
+
+
+def dry_run() -> int:
+    """CPU validation of the artifact schema and the routing override,
+    launch-free: write a tiny grid with KNOWN verdicts, point
+    ``JEPSEN_TPU_EXACT_GRID`` at it, and assert ``exact_scan_safe``
+    honors measured cells over the product model (both directions)
+    plus falls back where the grid is silent."""
+    import tempfile
+
+    from jepsen_tpu.ops import wgl
+
+    grid = {
+        "version": 1,
+        "kind": "exact-fault-grid",
+        "fingerprint": _machine_fingerprint(),
+        "cells": [
+            # a measured PASS the product model would conservatively
+            # refuse (the exact win-back this tool exists for):
+            {"lanes": 8, "capacity": 1024, "barriers": 2048, "ok": True},
+            # a measured FAULT the product model would allow — on an
+            # axis combination INCOMPARABLE to the pass cell (monotone
+            # consistency: a fault below a pass would be noise):
+            {"lanes": 64, "capacity": 64, "barriers": 1024, "ok": False},
+        ],
+    }
+    wgl.validate_exact_grid(grid)  # schema self-check
+    for bad, defect in [
+        ({}, "object"),
+        ({"version": 2, "kind": "exact-fault-grid", "cells": [{}]}, "version"),
+        ({"version": 1, "kind": "exact-fault-grid", "cells": []}, "cells"),
+        ({"version": 1, "kind": "exact-fault-grid",
+          "cells": [{"lanes": 1, "capacity": 1, "barriers": 1, "ok": "y"}]},
+         "ok"),
+    ]:
+        try:
+            wgl.validate_exact_grid(bad)
+        except ValueError:
+            pass
+        else:
+            print(f"dry-run FAILED: invalid grid accepted ({defect})",
+                  file=sys.stderr)
+            return 1
+    with tempfile.TemporaryDirectory() as td:
+        path = Path(td) / "grid.json"
+        path.write_text(json.dumps(grid), encoding="utf-8")
+        old = os.environ.get(wgl.EXACT_GRID_ENV)
+        os.environ[wgl.EXACT_GRID_ENV] = str(path)
+        try:
+            checks = [
+                # measured pass dominates: product model says False
+                # (8 lanes x 1024 cap x 2048 B = 16M rows), grid says ok
+                (wgl.exact_scan_safe(2048, 1024, lanes=8), True,
+                 "measured pass honored"),
+                # dominated by the pass cell too (componentwise <=)
+                (wgl.exact_scan_safe(1024, 512, lanes=4), True,
+                 "pass-domination honored"),
+                # measured fault dominates a LARGER query the product
+                # model would have allowed (rows < 8M, B < 4096)
+                (wgl.exact_scan_safe(1024, 64, lanes=64), False,
+                 "measured fault honored"),
+                # uncovered query falls back to the product model
+                (wgl.exact_scan_safe(8192, 64, lanes=1), False,
+                 "product-model fallback (B >= 8192)"),
+                (wgl.exact_scan_safe(128, 64, lanes=1), True,
+                 "product-model fallback (small shape)"),
+            ]
+        finally:
+            if old is None:
+                os.environ.pop(wgl.EXACT_GRID_ENV, None)
+            else:
+                os.environ[wgl.EXACT_GRID_ENV] = old
+    rc = 0
+    for got, want, what in checks:
+        status = "ok" if got == want else "FAILED"
+        print(f"  {status}: {what} (got {got}, want {want})")
+        if got != want:
+            rc = 1
+    # an invalid file must warn-and-fall-back, never crash the router
+    with tempfile.TemporaryDirectory() as td:
+        path = Path(td) / "bad.json"
+        path.write_text("{not json", encoding="utf-8")
+        os.environ[wgl.EXACT_GRID_ENV] = str(path)
+        try:
+            import warnings as _w
+
+            with _w.catch_warnings():
+                _w.simplefilter("ignore")
+                ok = wgl.exact_scan_safe(128, 64) is True
+        finally:
+            os.environ.pop(wgl.EXACT_GRID_ENV, None)
+    print(f"  {'ok' if ok else 'FAILED'}: invalid grid file falls back "
+          "to the product model")
+    rc = rc or (0 if ok else 1)
+    print("dry-run " + ("OK" if rc == 0 else "FAILED"))
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--lanes", default="1,8,32",
+                    help="comma-separated lane counts (default 1,8,32)")
+    ap.add_argument("--capacity", default="512,1024,2048",
+                    help="comma-separated capacities (default 512,1024,2048)")
+    ap.add_argument("--barriers", default="2048,4096,8192",
+                    help="comma-separated barrier counts "
+                         "(default 2048,4096,8192)")
+    ap.add_argument("--out", default="store/exact-grid.json",
+                    help="grid artifact path (default store/exact-grid.json)")
+    ap.add_argument("--timeout-s", type=float, default=600.0,
+                    help="per-cell wall-clock bound; expiry records a "
+                         "conservative fault (default 600)")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="exact-engine closure rounds per barrier (default 8)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="validate schema + exact_scan_safe routing on "
+                         "CPU, no launches")
+    ap.add_argument("--run-cell", default=None, metavar="L,C,B",
+                    help="(internal) run one cell in-process and exit")
+    a = ap.parse_args(argv)
+    if a.run_cell:
+        lanes, cap, bars = (int(x) for x in a.run_cell.split(","))
+        return run_cell(lanes, cap, bars, rounds=a.rounds)
+    if a.dry_run:
+        return dry_run()
+    lanes_list = [int(x) for x in a.lanes.split(",") if x]
+    caps = [int(x) for x in a.capacity.split(",") if x]
+    bars = [int(x) for x in a.barriers.split(",") if x]
+    sweep(lanes_list, caps, bars, Path(a.out), a.timeout_s, rounds=a.rounds)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

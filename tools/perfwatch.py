@@ -27,7 +27,7 @@ Examples:
 
   python tools/perfwatch.py compare
   python tools/perfwatch.py gate --advisory
-  python tools/perfwatch.py compete --axis dedup_backend --values sort,bucket
+  python tools/perfwatch.py compete --axis dedup_backend   # sort,bucket,pallas
   echo '{"kind":"bench","metrics":{"ops_per_s":1557.9}}' | \\
       python tools/perfwatch.py append
 """
@@ -116,8 +116,15 @@ def _cmd_compare(a, *, gating: bool) -> int:
     return 0
 
 
+#: Default competitor roster per axis: the dedup competition is
+#: three-way since the pallas backend landed (round 11) — the chip-day
+#: flip reads ONE record that ranks all three.
+_AXIS_VALUES = {"dedup_backend": "sort,bucket,pallas"}
+
+
 def _cmd_compete(a) -> int:
-    values = [v for v in (a.values or "").split(",") if v]
+    values_csv = a.values or _AXIS_VALUES.get(a.axis, "")
+    values = [v for v in values_csv.split(",") if v]
     if len(set(values)) < 2:
         print("compete: --values needs at least two DISTINCT comma-"
               "separated axis values", file=sys.stderr)
@@ -203,8 +210,11 @@ def main(argv=None) -> int:
                    help="the competition axis; its value is applied via "
                         "JEPSEN_TPU_<AXIS> (e.g. dedup_backend -> "
                         "JEPSEN_TPU_DEDUP_BACKEND)")
-    p.add_argument("--values", default="sort,bucket",
-                   help="comma-separated axis values (default sort,bucket)")
+    p.add_argument("--values", default=None,
+                   help="comma-separated axis values (default: the axis' "
+                        "full backend roster — dedup_backend gets "
+                        "sort,bucket,pallas — else the caller must pass "
+                        "them)")
     p.add_argument("--repeats", type=int, default=3,
                    help="timed passes per value, after one warm pass "
                         "(default 3)")

@@ -1,16 +1,30 @@
-"""Per-round dedup timing: sort vs bucket backend, over candidate shapes.
+"""Per-round dedup timing: sort vs bucket vs pallas, over candidate shapes.
 
 Times JUST the dedup stage of the fast frontier update (row hash +
 partition + windowed kills + candidate-order keep mask — the part the
-two backends implement differently; see ops.hashing._dedup_stage), the
+backends implement differently; see ops.hashing._dedup_stage), the
 per-round floor PERF.md's "Honest limits" names, at a grid of ladder
 shapes including the acceptance shape [256, 2176].
 
-  python tools/profile_dedup.py [--rounds N] [--telemetry DIR]
+  python tools/profile_dedup.py [--rounds N] [--telemetry DIR] [--smoke]
+
+The ``pallas`` column is the fused wide-stage kernel's dedup phase
+(ops.wide_kernel.keep_mask — it hashes IN-KERNEL, so the timed window
+covers the same work).  On CPU the kernel runs under the Pallas
+INTERPRETER; the column header, every emitted ``dedup.round`` span and
+any ledger record derived from one then carry an honest
+``interpret: true`` tag — interpret-mode timings measure the jitted
+interpreter lowering, NOT Mosaic, and must never be read as (or
+compared against) chip numbers.  Shapes where the kernel is statically
+infeasible print ``-`` (the engines would have routed them away too).
 
 ``--telemetry DIR`` additionally records the probes as ``dedup.round``
 obs spans into DIR/telemetry.json{,l} (the artifact
 tools/trace_summarize.py renders).
+
+``--smoke`` (the docker/bin/test stage) runs a single quick probe at
+the first shape plus a three-way survivor-set differential assert —
+exit 1 on any backend disagreement, 0 otherwise.
 """
 
 from __future__ import annotations
@@ -23,22 +37,71 @@ sys.path.insert(0, str(ROOT))
 
 from jepsen_tpu import obs  # noqa: E402
 from jepsen_tpu.ops import hashing  # noqa: E402
+from jepsen_tpu.ops import wide_kernel  # noqa: E402
 
 #: (capacity, P, G) — candidates = capacity * (1 + P + G).  The first
 #: rows bracket the acceptance shape (2176-candidate dedup round, the
 #: [256, 1088x2] sort floor PERF.md's "Honest limits" names); the tail
-#: covers the ladder's wider rungs.
+#: covers the ladder's wider rungs (the cap-2048 rung is the fused
+#: kernel's target geometry).
 SHAPES = [
     (128, 12, 4),   # 2176 candidates exactly
     (256, 4, 3),    # 2048 candidates, the cap-256 rung's table
     (128, 8, 4),
     (512, 8, 4),
-    (2048, 8, 4),
+    (2048, 8, 4),   # the wide rung: 26624 candidates
 ]
+
+
+def _smoke() -> int:
+    """Quick three-way differential: identical survivor content sets
+    through frontier_update_fast under every backend at a suite-shared
+    shape (the pallas round is forced feasible via the routing floor
+    env), plus one probe so the dedup.round spans exist."""
+    import os
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    os.environ.setdefault(wide_kernel.PALLAS_MIN_CAPACITY_ENV, "64")
+
+    def content(state, fok, fcr, alive):
+        state, fok, fcr, alive = (
+            np.asarray(a) for a in (state, fok, fcr, alive))
+        return {
+            (int(state[i]), tuple(int(x) for x in fok[i]),
+             tuple(int(x) for x in fcr[i]))
+            for i in np.flatnonzero(alive)
+        }
+
+    rc = 0
+    for seed in range(3):
+        st, fo, fc, al = hashing.probe_candidates(64, 4, 3, 1, seed=seed)
+        cost = jnp.zeros(st.shape[0], jnp.int32)
+        outs = {}
+        for b in hashing.DEDUP_BACKENDS:
+            r = hashing.frontier_update_fast(
+                jnp.asarray(st), jnp.asarray(fo), jnp.asarray(fc),
+                jnp.asarray(al), cost, 64, n_parents=64, max_count=8,
+                dedup_backend=b,
+            )
+            outs[b] = (content(*r[:4]), bool(r[4]))
+        if len({(frozenset(c), o) for c, o in outs.values()}) != 1:
+            print(f"SMOKE FAILED at seed {seed}: backend survivor sets "
+                  f"disagree: { {b: (len(c), o) for b, (c, o) in outs.items()} }",
+                  file=sys.stderr)
+            rc = 1
+    times = hashing.dedup_round_probe(64, 4, 3, rounds=2, emit=False)
+    print("dedup smoke:", {b: f"{t * 1e6:.0f}us" for b, t in times.items()},
+          f"(pallas interpret={wide_kernel.interpret_default()})")
+    print("dedup three-way differential " + ("OK" if rc == 0 else "FAILED"))
+    return rc
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--smoke" in argv:
+        return _smoke()
     rounds = 20
     tele_dir = None
     if "--rounds" in argv:
@@ -60,12 +123,23 @@ def main(argv=None) -> int:
                 cap, p, g, (p + 31) // 32, rounds=rounds,
                 emit=tele_dir is not None,
             )
-            rows.append((cap, n, times["sort"], times["bucket"]))
+            rows.append((cap, n, times))
+    pallas_hdr = (
+        "pallas_us*" if wide_kernel.interpret_default() else "pallas_us"
+    )
     print(f"{'capacity':>9} {'candidates':>11} {'sort_us':>9} "
-          f"{'bucket_us':>10} {'speedup':>8}")
-    for cap, n, ts, tb in rows:
-        print(f"{cap:>9} {n:>11} {ts*1e6:>9.1f} {tb*1e6:>10.1f} "
-              f"{ts/tb:>7.2f}x")
+          f"{'bucket_us':>10} {pallas_hdr:>11} {'speedup':>8}")
+    for cap, n, times in rows:
+        ts, tb = times["sort"], times["bucket"]
+        tp = times.get("pallas")
+        pcol = f"{tp * 1e6:>11.1f}" if tp is not None else f"{'-':>11}"
+        best = min(t for t in (tb, tp) if t is not None)
+        print(f"{cap:>9} {n:>11} {ts * 1e6:>9.1f} {tb * 1e6:>10.1f} "
+              f"{pcol} {ts / best:>7.2f}x")
+    if wide_kernel.interpret_default():
+        print("\n* pallas column ran under the Pallas INTERPRETER (no TPU "
+              "backend) — a lowering-overhead measurement, not a chip "
+              "number; every recorded span carries interpret: true")
     if tele_dir is not None:
         print(f"\ntelemetry: {tele_dir}/telemetry.json "
               f"(render: python tools/trace_summarize.py {tele_dir})")

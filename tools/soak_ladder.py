@@ -3,17 +3,22 @@
 Random small histories in adversarial shapes (info-heavy, crash groups,
 cas, corruptions), checked in batches through the COMPLETE round-5
 ladder (greedy rung, carried frontiers, saturating prune, both
-confirmation modes, both DEDUP BACKENDS — the ``dedup_backend`` axis
-randomizes sort vs bucket per batch) and compared verdict-by-verdict
-against ``wgl_cpu.sweep_analysis``.  Any non-unknown disagreement is a
-soundness bug — print it and exit 1.
+confirmation modes, every DEDUP BACKEND — the ``dedup_backend`` axis
+randomizes sort vs bucket vs pallas per batch) and compared
+verdict-by-verdict against ``wgl_cpu.sweep_analysis``.  Any non-unknown
+disagreement is a soundness bug — print it and exit 1.
 
   python tools/soak_ladder.py [--minutes N] [--seed S] [--batches N]
-                              [--dedup-backend sort|bucket|both]
+                              [--dedup-backend sort|bucket|pallas|both|all]
 
 ``--batches`` runs a fixed batch count instead of a time budget (the
 differential-soak acceptance gate pins a count, not a duration);
-``--dedup-backend`` pins the dedup axis (default: both, randomized).
+``--dedup-backend`` pins the dedup axis (default: all, randomized;
+"both" keeps the PR-2 sort/bucket pair).  When the pallas axis is
+live, the wide-rung routing floor is lowered to the soak's capacities
+(JEPSEN_TPU_PALLAS_MIN_CAPACITY=64, unless already set) so the fused
+kernel actually executes — in interpret mode on CPU — instead of
+routing every narrow rung back to bucket.
 """
 
 from __future__ import annotations
@@ -68,7 +73,7 @@ def main() -> int:
     minutes = 20.0
     seed = 45100
     max_batches = None
-    dedup_axis = "both"
+    dedup_axis = "all"
     if "--minutes" in sys.argv:
         minutes = float(sys.argv[sys.argv.index("--minutes") + 1])
     if "--seed" in sys.argv:
@@ -77,7 +82,18 @@ def main() -> int:
         max_batches = int(sys.argv[sys.argv.index("--batches") + 1])
     if "--dedup-backend" in sys.argv:
         dedup_axis = sys.argv[sys.argv.index("--dedup-backend") + 1]
-        assert dedup_axis in ("sort", "bucket", "both"), dedup_axis
+        assert dedup_axis in ("sort", "bucket", "pallas", "both", "all"), \
+            dedup_axis
+    if dedup_axis in ("pallas", "all"):
+        # make the fused kernel actually run at the soak's capacities
+        # (interpret mode on CPU) instead of routing back to bucket
+        import os
+
+        os.environ.setdefault("JEPSEN_TPU_PALLAS_MIN_CAPACITY", "64")
+    axis_pool = {
+        "both": ["sort", "bucket"],
+        "all": ["sort", "bucket", "pallas"],
+    }.get(dedup_axis, [dedup_axis])
     rng = random.Random(seed)
     model = m.CASRegister(None)
     deadline = time.monotonic() + minutes * 60
@@ -102,7 +118,7 @@ def main() -> int:
                     hist = corrupt(hist, seed=rng.randrange(1 << 30))
             hists.append(hist)
         confirm = rng.choice([True, "device"])
-        dedup = dedup_axis if dedup_axis != "both" else rng.choice(["sort", "bucket"])
+        dedup = rng.choice(axis_pool)
         results = batch_analysis(
             model, hists, capacity=(rng.choice([16, 32, 64]), 256),
             cpu_fallback=False, exact_escalation=(),
