@@ -29,6 +29,7 @@ import numpy as np
 from jepsen_tpu import obs, store
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.checker import txn_graph as tg
+from jepsen_tpu.obs import provenance as _prov
 from jepsen_tpu.ops import closure as cl
 
 # ---------------------------------------------------------------------------
@@ -515,6 +516,30 @@ class _ElleChecker(Checker):
         except OSError:
             pass
 
+    def _prov_engine(self) -> dict:
+        """The engine/backend resolution an evidence bundle records:
+        which inference engine actually ran (the instance's pin, or the
+        env/default resolution) and the cycle-detection backend."""
+        eng = getattr(self, "engine", None)
+        if eng is None:
+            try:
+                eng = tg.resolve_engine(None)
+            except ValueError:
+                eng = None
+        return {
+            "engine": "elle", "graph_engine": eng,
+            "cycle_backend": getattr(self, "backend", None) or CYCLE_BACKEND,
+        }
+
+    def _emit_evidence(self, test, history, res, opts, *,
+                       workload: str, source: str = "check") -> None:
+        _prov.attach(
+            res, [{"event": "elle.check", "workload": workload}],
+            engine=self._prov_engine(),
+        )
+        _prov.emit(test, history, res, source=source,
+                   checker=f"elle-{workload}", opts=opts)
+
 
 class ListAppendChecker(_ElleChecker):
     """Native elle.list-append equivalent (tests/cycle/append.clj:11-22).
@@ -552,6 +577,7 @@ class ListAppendChecker(_ElleChecker):
         )
         res = check_graph(g, self.anomalies)
         self.write_artifacts(test, res, opts)
+        self._emit_evidence(test, history, res, opts, workload="list-append")
         return res
 
     def check_batch(self, test, histories, opts):
@@ -562,7 +588,11 @@ class ListAppendChecker(_ElleChecker):
         graphs = tg.list_append_graphs(
             histories, self.additional_graphs, engine=self.engine
         )
-        return check_graphs(graphs, self.anomalies)
+        outs = check_graphs(graphs, self.anomalies)
+        for hh, res in zip(histories, outs):
+            self._emit_evidence(test, hh, res, opts,
+                                workload="list-append", source="check_batch")
+        return outs
 
 
 class WRRegisterChecker(_ElleChecker):
@@ -601,6 +631,7 @@ class WRRegisterChecker(_ElleChecker):
     def check(self, test, history, opts):
         res = check_graph(self._graph(history), self.anomalies)
         self.write_artifacts(test, res, opts)
+        self._emit_evidence(test, history, res, opts, workload="wr-register")
         return res
 
     def check_batch(self, test, histories, opts):
@@ -610,7 +641,11 @@ class WRRegisterChecker(_ElleChecker):
             sequential_keys=self.sequential_keys,
             linearizable_keys=self.linearizable_keys, engine=self.engine,
         )
-        return check_graphs(graphs, self.anomalies)
+        outs = check_graphs(graphs, self.anomalies)
+        for hh, res in zip(histories, outs):
+            self._emit_evidence(test, hh, res, opts,
+                                workload="wr-register", source="check_batch")
+        return outs
 
 
 class CycleChecker(_ElleChecker):
@@ -658,6 +693,7 @@ class CycleChecker(_ElleChecker):
     def check(self, test, history, opts):
         res = self._check_one(history)
         self.write_artifacts(test, res, opts)
+        self._emit_evidence(test, history, res, opts, workload="cycle")
         return res
 
     def check_batch(self, test, histories, opts):
@@ -667,7 +703,11 @@ class CycleChecker(_ElleChecker):
         with obs.span(
             "elle.infer_batch", histories=len(histories), workload="cycle",
         ):
-            return [self._check_one(hh) for hh in histories]
+            outs = [self._check_one(hh) for hh in histories]
+        for hh, res in zip(histories, outs):
+            self._emit_evidence(test, hh, res, opts,
+                                workload="cycle", source="check_batch")
+        return outs
 
     def _check_one(self, history):
         nodes, relations, explainer = self.analyzer(history)

@@ -33,6 +33,7 @@ from typing import Mapping
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import Checker, UNKNOWN
 from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.obs import provenance as _prov
 
 
 def _resolve_model(model) -> m.Model:
@@ -53,9 +54,13 @@ class Linearizable(Checker):
 
     def _analyze(self, history, deadline=None):
         if self.algorithm == "wgl":
-            return wgl_cpu.dfs_analysis(self.model, history)
+            return _prov.attach(
+                wgl_cpu.dfs_analysis(self.model, history),
+                [{"event": "engine.dfs"}], engine={"engine": "wgl-dfs"})
         if self.algorithm == "sweep":
-            return wgl_cpu.sweep_analysis(self.model, history)
+            return _prov.attach(
+                wgl_cpu.sweep_analysis(self.model, history),
+                [{"event": "engine.sweep"}], engine={"engine": "wgl-sweep"})
         from jepsen_tpu.ops import wgl as wgl_tpu
 
         if self.algorithm == "tpu":
@@ -73,13 +78,21 @@ class Linearizable(Checker):
         is a separate knob, forwarded untouched), ``confirm-max-configs``
         bounds the refutation-confirmation sweep (same default as
         parallel.batch_analysis's confirm_max_configs)."""
+        path: list[dict] = []  # the decision-path trail (obs.provenance)
+
+        def _fin(res, engine_name):
+            """Attach the engine-fallback trail before a result leaves
+            the competition — the evidence bundle's decision path."""
+            return _prov.attach(res, path, engine={"engine": engine_name})
+
         if deadline is not None and deadline.expired():
             # the budget was spent before this key's check began (e.g. by
             # earlier keys of an independent checker): degrade attributably
-            return {
+            path.append({"event": "fault.deadline", "at": "pre-check"})
+            return _fin({
                 "valid?": UNKNOWN,
                 "cause": "deadline-exceeded: check budget exhausted",
-            }
+            }, "competition")
         ladder = self.kernel_opts.get("async-capacity", (256, 1024))
         if isinstance(ladder, int):
             ladder = (ladder,)
@@ -92,13 +105,20 @@ class Linearizable(Checker):
         if self.kernel_opts.get("greedy-first", True):
             g = wgl_tpu.greedy_analysis(self.model, history)
             if g["valid?"] is True:
-                return g
+                path.append({"event": "engine.greedy", "outcome": "valid"})
+                return _fin(g, "greedy")
             if "not tensorizable" in str(g.get("cause", "")):
-                return wgl_cpu.analysis(self.model, history)
+                path.append({"event": "engine.greedy",
+                             "outcome": "not-tensorizable"})
+                path.append({"event": "cpu-fallback", "engine": "dfs"})
+                return _fin(wgl_cpu.analysis(self.model, history), "wgl-dfs")
+            path.append({"event": "engine.greedy", "outcome": "stuck"})
         for cap in ladder:
             a = wgl_tpu.analysis_async(self.model, history, capacity=int(cap))
+            path.append({"event": "async.capacity", "capacity": int(cap),
+                         "outcome": _prov.verdict_str(a["valid?"])})
             if a["valid?"] is True:
-                return a
+                return _fin(a, "async")
             if a["valid?"] is False:
                 # fast-engine kills are hash-decided: confirm on the
                 # exact sweep, bounded to the failure prefix.  The bound
@@ -110,35 +130,44 @@ class Linearizable(Checker):
                 c = wgl_cpu.sweep_analysis(
                     self.model, history, max_configs=confirm_cap, stop_at_index=stop
                 )
+                path.append({"event": "confirm.sweep",
+                             "outcome": _prov.verdict_str(c["valid?"])})
                 if c["valid?"] is False:
-                    return {**a, "confirmed?": True}
+                    return _fin({**a, "confirmed?": True}, "async")
                 if c["valid?"] is True:
-                    return c  # hash-collision artifact: the sweep wins
+                    # hash-collision artifact: the sweep wins
+                    return _fin(c, "wgl-sweep")
                 break  # inconclusive: escalate to the oracles
             if "not tensorizable" in str(a.get("cause", "")):
                 # no tensor form: every device rung would fail the same
                 # way — the CPU oracle is the only engine
-                return wgl_cpu.analysis(self.model, history)
+                path.append({"event": "cpu-fallback", "engine": "dfs"})
+                return _fin(wgl_cpu.analysis(self.model, history), "wgl-dfs")
         if deadline is not None and deadline.expired():
             # the CPU DFS and the exact device ladder are the expensive
             # oracles; past the budget they degrade to an attributable
             # unknown instead of running unbounded
-            return {
+            path.append({"event": "fault.deadline", "at": "pre-oracle"})
+            return _fin({
                 "valid?": UNKNOWN,
                 "cause": "deadline-exceeded: check budget exhausted before "
                          "the exact oracles",
-            }
+            }, "competition")
         dfs = wgl_cpu.analysis(self.model, history)
+        path.append({"event": "engine.dfs",
+                     "outcome": _prov.verdict_str(dfs["valid?"])})
         if dfs["valid?"] != UNKNOWN:
-            return dfs
+            return _fin(dfs, "wgl-dfs")
         # the exact device engine: final refutations, quantified prefix;
         # uses its own (chunked) capacity ladder from kernel_opts
         opts = {k: v for k, v in self.kernel_opts.items()
                 if k not in ("async-capacity", "confirm-max-configs")}
+        path.append({"event": "route.chunked-exact"})
         a = wgl_tpu.analysis(self.model, history, deadline=deadline, **opts)
         if a["valid?"] == UNKNOWN and "not tensorizable" in str(a.get("cause", "")):
-            return dfs  # keep the DFS's informative unknown (budget + op)
-        return a
+            # keep the DFS's informative unknown (budget + op)
+            return _fin(dfs, "wgl-dfs")
+        return _fin(a, "chunked-exact")
 
     @staticmethod
     def _truncate(a: Mapping) -> dict:
@@ -155,6 +184,8 @@ class Linearizable(Checker):
         )
         if out.get("valid?") is False:
             self._render_failure(test, history, out, opts)
+        _prov.emit(test, history, out, source="check", model=self.model,
+                   checker="linearizable", opts=opts)
         return out
 
     @staticmethod
@@ -186,7 +217,12 @@ class Linearizable(Checker):
             # headless: no per-key linear.svg (they would all land on the
             # same path and overwrite each other; independent.checker
             # writes per-key artifacts itself)
-            return [self._truncate(self._analyze(hh)) for hh in histories]
+            outs = [self._truncate(self._analyze(hh)) for hh in histories]
+            for hh, out in zip(histories, outs):
+                _prov.emit(test, hh, out, source="check_batch",
+                           model=self.model, checker="linearizable",
+                           opts=opts)
+            return outs
         from jepsen_tpu.parallel import batch_analysis
 
         # kernel-opts is shaped for wgl.analysis; forward only the keys
@@ -210,7 +246,15 @@ class Linearizable(Checker):
             resume=bool(opts.get("resume?")),
             **batch_kw,
         )
-        return [self._truncate(r) for r in results]
+        outs = [self._truncate(r) for r in results]
+        # Rung admission can grow the result list past the input
+        # histories; emit bundles for the caller-supplied ones (joiner
+        # verdicts are the admission hook's to bundle — the serving
+        # layer does so per request).
+        for hh, out in zip(histories, outs):
+            _prov.emit(test, hh, out, source="check_batch",
+                       model=self.model, checker="linearizable", opts=opts)
+        return outs
 
 
 def linearizable(opts: Mapping) -> Checker:

@@ -255,7 +255,8 @@ def _dfs_analysis(model, history, max_visited, stats: dict) -> dict:
 
 
 def greedy_walk(model: m.Model, history: Sequence[dict],
-                max_steps: int | None = None) -> bool | None:
+                max_steps: int | None = None,
+                record: list | None = None) -> bool | None:
     """Speculative single-config greedy walk — the host-side counterpart
     of the ladder's rung-0 greedy kernel (one beam lane, returning-op
     first, no backtracking).  Returns ``True`` when the walk completes:
@@ -268,6 +269,11 @@ def greedy_walk(model: m.Model, history: Sequence[dict],
     This is the serving layer's interactive fast path: ~microseconds per
     small history, no kernel launch, so it cannot contend with a ladder
     mid-rung for the device (or, on the CPU backend, for host cores).
+
+    ``record``, when given, receives the fired *effective* ops in fire
+    order — on a ``True`` return it is the full linearization, the
+    constructive witness the provenance layer embeds in evidence
+    bundles (obs.provenance re-steps it during ``verify``).
     """
     events, eff_ops, crashed = prepare(model, history)
     barriers, group_ops = _barrier_snapshots(events, eff_ops, crashed)
@@ -296,6 +302,8 @@ def greedy_walk(model: m.Model, history: Sequence[dict],
             s2 = state.step(eff_ops[i])
             if not m.is_inconsistent(s2):
                 state, fok = s2, fok | {i}
+                if record is not None:
+                    record.append(eff_ops[i])
                 continue
             # Enabling move: the first consistent open ok op, else the
             # first available crashed group (same legality and order the
@@ -306,6 +314,8 @@ def greedy_walk(model: m.Model, history: Sequence[dict],
                 s2 = state.step(eff_ops[j])
                 if not m.is_inconsistent(s2):
                     state, fok = s2, fok | {j}
+                    if record is not None:
+                        record.append(eff_ops[j])
                     break
             else:
                 for g, open_count in open_crashed:
@@ -316,6 +326,8 @@ def greedy_walk(model: m.Model, history: Sequence[dict],
                     if not m.is_inconsistent(s2):
                         state = s2
                         fcr = fcr[:k] + (fcr[k] + 1,) + fcr[k + 1:]
+                        if record is not None:
+                            record.append(group_op_list[k])
                         break
                 else:
                     sp.set(completed=False, steps=steps)

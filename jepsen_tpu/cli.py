@@ -266,6 +266,7 @@ def _cmd_serve(opts) -> int:
             continuous=not opts.no_continuous,
             devices=opts.check_devices,
             verify_placement=opts.verify_placement,
+            evidence_dir=opts.evidence_dir,
             drain_dir=opts.drain_dir,
             journal_dir=opts.journal_dir,
             idempotency_dir=opts.idempotency_dir,
@@ -387,6 +388,11 @@ def run_cli(
                          help="disable rung-boundary admission into "
                               "running ladders (restores window-then-"
                               "launch batching, for A/B comparison)")
+    p_serve.add_argument("--evidence-dir", default=None,
+                         help="durably persist every served verdict's "
+                              "evidence bundle here (GET /evidence/<id> "
+                              "then survives restart; audit offline with "
+                              "tools/evidence.py verify|replay)")
     p_serve.add_argument("--drain-dir", default=None,
                          help="where shutdown checkpoints still-queued "
                               "requests (resume with "

@@ -132,6 +132,9 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
     #: device.buffer_bytes gauge's MAX — the gauges section keeps only
     #: the last write, which understates a run's true high-water mark).
     mem = {"device_bytes_peak": 0, "undecidable": 0}
+    #: verdict-provenance accumulators (the provenance.* counter family:
+    #: evidence bundles emitted per source/verdict + emission errors).
+    prov = {"bundles": 0, "emit_errors": 0, "by_source": {}, "by_verdict": {}}
     wall = 0.0
 
     def _fault_row(name: str) -> dict:
@@ -241,6 +244,16 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
             wall = max(wall, t)
             name = str(ev.get("name"))
             counters[name] = counters.get(name, 0) + (ev.get("n") or 1)
+            if name == "provenance.bundle":
+                n = ev.get("n") or 1
+                a = ev.get("attrs") or {}
+                prov["bundles"] += n
+                src = str(a.get("source") or "?")
+                prov["by_source"][src] = prov["by_source"].get(src, 0) + n
+                vd = str(a.get("verdict") or "?")
+                prov["by_verdict"][vd] = prov["by_verdict"].get(vd, 0) + n
+            elif name == "provenance.emit_error":
+                prov["emit_errors"] += ev.get("n") or 1
             if name.startswith("fault."):
                 f = _fault_row(name)
                 f["count"] += ev.get("n") or 1
@@ -360,6 +373,10 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
         "dedup": out_dedup,
         "elle": elle,
         "memory": memory,
+        "provenance": (
+            {k: v for k, v in prov.items() if v}
+            if prov["bundles"] or prov["emit_errors"] else {}
+        ),
         "faults": out_faults,
         "critpath": _critpath.critpath_rollup(events),
         "counters": counters,
@@ -478,6 +495,17 @@ def format_summary(summary: Mapping) -> str:
             "device_bytes_peak", "spill_rows", "spill_bytes", "spill_merges",
             "factorizations", "oom_spills", "undecidable") if k in mm]
         parts.append(_table(["memory", "value"], rows))
+    if summary.get("provenance"):
+        pv = summary["provenance"]
+        parts.append("\nverdict provenance (evidence bundles emitted):")
+        rows = [["bundles", pv.get("bundles", 0)]]
+        for src, n in sorted((pv.get("by_source") or {}).items()):
+            rows.append([f"bundles[{src}]", n])
+        for vd, n in sorted((pv.get("by_verdict") or {}).items()):
+            rows.append([f"verdict[{vd}]", n])
+        if pv.get("emit_errors"):
+            rows.append(["emit_errors", pv["emit_errors"]])
+        parts.append(_table(["provenance", "value"], rows))
     if summary.get("critpath", {}).get("spans"):
         cp = summary["critpath"]
         parts.append(

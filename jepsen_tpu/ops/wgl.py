@@ -61,6 +61,7 @@ from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu import obs
 from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.obs import provenance as _prov
 from jepsen_tpu.models import tensor as tmodels
 from jepsen_tpu.ops.hashing import (
     frontier_update,
@@ -1104,6 +1105,30 @@ def chunked_analysis(
     caps = [int(c) for c in capacities]
     b_rows = spill_mod.budget_rows(budget_mb, W, G, P)
 
+    # Decision-path trajectory (obs.provenance): a bounded trail of the
+    # escalations, spill levers, and fault events this scan actually
+    # took, attached to every return so the caller's evidence bundle
+    # records HOW the verdict was produced.
+    traj: list[dict] = []
+    _prov_engine = {
+        "engine": "chunked-fast" if fast else "chunked-exact",
+        "dedup_backend": dedup, "spill": spill_on,
+    }
+    _prov_cfg = {
+        "capacity": caps, "rounds": int(rounds),
+        "chunk_barriers": int(chunk_barriers), "fast": bool(fast),
+        "frontier_budget_mb": budget_mb,
+        "spill_launches": spill_launches, "factor_groups": bool(factor_groups),
+    }
+
+    def _pv(event: str, **attrs) -> None:
+        if len(traj) < _prov.MAX_PATH:
+            traj.append({"event": event, **attrs})
+
+    def _finish(res: dict) -> dict:
+        _prov.attach(res, traj, engine=_prov_engine, config=_prov_cfg)
+        return res
+
     def _usable(i: int) -> bool:
         """Rung i fits the device budget (rung 0 always runs — the
         documented floor: some capacity is needed to make progress)."""
@@ -1186,7 +1211,17 @@ def chunked_analysis(
             saved = None
         if saved is not None:
             if saved["result"] is not None:
-                return saved["result"]  # idempotent finished-run resume
+                # Idempotent finished-run resume: return the certified
+                # result verbatim.  Its provenance already records the
+                # decision path that PRODUCED the verdict; tagging the
+                # no-op restore onto it would make the resumed result
+                # (and its evidence digest) differ from the original.
+                obs.span_event(
+                    "fault.checkpoint.load", 0.0,
+                    barrier=int(saved["barrier"]), chunked=True,
+                    complete=True,
+                )
+                return saved["result"]
             st, fo, fc = saved["frontier"]
             f_state = np.asarray(st, np.int32)
             f_fok = np.asarray(fo, np.uint32)
@@ -1201,6 +1236,7 @@ def chunked_analysis(
                 "fault.checkpoint.load", 0.0, barrier=start_barrier,
                 rows=int(f_state.shape[0]), chunked=True,
             )
+            _pv("checkpoint.restored", barrier=start_barrier)
 
     def _save_ck(barrier: int, result: dict | None = None) -> str | None:
         """Persist the chunk cursor + carried (spilled) frontier; a save
@@ -1298,25 +1334,31 @@ def chunked_analysis(
                 res["cause"] = spill_mod.undecidable_cause(exhaust_rep)
         return res
 
+    # Every chunked verdict records at least the scan itself — a clean
+    # no-escalation pass must still be distinguishable, in the evidence
+    # bundle, from a run that never reached the chunked engine.
+    _pv("wgl.chunk.scan", barriers=int(B0), chunks=len(spans),
+        capacity=caps[idx], start_barrier=start_barrier)
     si = 0
     while si < len(spans):
         lo, hi = spans[si]
         if deadline is not None and deadline.expired():
             obs.counter("fault.deadline.trip")
             obs.event("fault.deadline", at="wgl-chunk", barrier=lo)
+            _pv("fault.deadline", at="wgl-chunk", barrier=lo)
             ck = _save_ck(lo)
             note = f"; resumable checkpoint: {ck}" if ck else ""
             stats = _stats(caps[idx])
             stats["verified-barriers"] = verified
             _emit("unknown", stats)
-            return _attach_report({
+            return _finish(_attach_report({
                 "valid?": "unknown",
                 "cause": (
                     "deadline-exceeded: check budget exhausted at barrier "
                     f"{lo}/{B0}{note}"
                 ),
                 "kernel": stats,
-            })
+            }))
         Bc = 1 << max(5, (hi - lo - 1).bit_length())
 
         def padc(a, fill=0):
@@ -1392,14 +1434,15 @@ def chunked_analysis(
                     cause = faults.describe(lf.cause)
                     obs.counter("fault.launch.degraded", what="wgl.chunk",
                                 capacity=F, lanes=1, error=cause)
+                    _pv("fault.launch-degraded", capacity=F, error=cause)
                     stats = _stats(F)
                     stats["verified-barriers"] = verified
                     _emit("unknown", stats)
-                    return _attach_report({
+                    return _finish(_attach_report({
                         "valid?": "unknown",
                         "cause": f"device launch failed: {cause}",
                         "kernel": stats,
-                    })
+                    }))
                 launches += 1
                 slice_outs.append(out)
             trunc = not spill_on and n_in > F
@@ -1419,6 +1462,7 @@ def chunked_analysis(
             if (any_lossy and nxt < len(caps) and caps[nxt] > caps[idx]
                     and _usable(nxt)):
                 obs.counter("wgl.chunk.escalations")
+                _pv("chunk.escalation", barrier=lo, to_capacity=caps[nxt])
                 ring.discard()
                 idx = nxt  # re-run THIS chunk wider, from the same frontier
                 width = None
@@ -1433,6 +1477,7 @@ def chunked_analysis(
                 # closure overflowing the budget rung remains, which is
                 # undecidable under this memory.
                 obs.counter("wgl.chunk.slice_narrowing")
+                _pv("chunk.slice-narrowing", barrier=lo)
                 ring.discard()
                 spill_spent += 1
                 width = max(width_floor, width // 2)
@@ -1449,6 +1494,7 @@ def chunked_analysis(
                                 max(1, (hi - lo + 1) // 2))
             spans[si:si + 1] = [(lo + a, lo + b) for a, b in rel]
             obs.counter("wgl.chunk.bisections")
+            _pv("chunk.bisection", barrier=lo)
             spill_spent += 1
             continue
         if spill_on and spill_spent >= spill_budget:
@@ -1456,6 +1502,7 @@ def chunked_analysis(
             # the pre-spill truncation mode; the report names the bound
             # that bit.
             spill_on = False
+            _pv("spill.budget-exhausted", barrier=lo)
             if exhaust_rep is None:
                 exhaust_rep = spill_mod.undecidability_report(
                     capacity=caps[idx], frontier_rows=n_in,
@@ -1517,18 +1564,21 @@ def chunked_analysis(
             # were witnessed
             stats["witnessed-barriers"] = gb
             if lossy_any:
+                _pv("chunk.lossy-death", barrier=gb)
                 _emit("unknown", stats)
-                return _attach_report({
+                return _finish(_attach_report({
                     "valid?": "unknown",
                     "cause": "frontier capacity or closure rounds exhausted",
                     "op": op,
                     "kernel": stats,
-                })
+                }))
+            _pv("chunk.refuted", barrier=gb,
+                provisional=bool(fast))
             res = {"valid?": False, "op": op, "kernel": stats}
             if fast:
                 res["provisional?"] = True  # hash-decided kills
             _emit(False, stats)
-            return res
+            return _finish(res)
         if not lossy_any:
             verified = hi
         if len(sliced) == 1:
@@ -1562,6 +1612,7 @@ def chunked_analysis(
                     reason="host-budget",
                 )
             obs.counter("wgl.frontier.truncations")
+            _pv("frontier.truncated", reason="host-budget", barrier=hi)
             f_state = f_state[:host_rows_max]
             f_fok = f_fok[:host_rows_max]
             f_fcr = f_fcr[:host_rows_max]
@@ -1576,7 +1627,7 @@ def chunked_analysis(
     stats["verified-barriers"] = verified
     stats["witnessed-barriers"] = B0  # the survivor IS the whole-history witness
     _emit(True, stats)
-    result = {"valid?": True, "kernel": stats}
+    result = _finish({"valid?": True, "kernel": stats})
     _save_ck(B0, result=result)
     return result
 

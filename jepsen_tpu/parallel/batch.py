@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jepsen_tpu import _confirm_worker, faults, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.obs import provenance as _prov
 from jepsen_tpu.ops import hashing, wgl
 from jepsen_tpu.store import checkpoint as _ckpt
 
@@ -603,8 +604,19 @@ def batch_analysis(
             if restored["complete"]:
                 # A finished run's checkpoint: hand back the saved
                 # verdicts (idempotent resume; no device work at all).
+                # Each verdict's provenance records the restore — a
+                # replayed/resumed answer is a different trust path
+                # than a fresh device run.
                 for i, r in restored["results"].items():
                     if 0 <= i < len(results):
+                        if isinstance(r, dict):
+                            _prov.attach(
+                                r,
+                                [{"event": "checkpoint.restored",
+                                  "complete": True, "stage": start_stage}],
+                                engine={"engine": engine,
+                                        "dedup_backend": dedup},
+                            )
                         results[i] = r
                 return [r if r is not None else {"valid?": "unknown"}
                         for r in results]
@@ -616,6 +628,51 @@ def batch_analysis(
         "confirm_refutations": confirm_refutations, "fingerprint": fp,
         "frontier_budget_mb": frontier_budget_mb,
     }
+
+    # ------------------------------------------------------------------
+    # Verdict provenance (obs.provenance): a bounded per-history
+    # decision-path trail, attached to every result before it leaves the
+    # ladder (both the early _notify demux and the final return), so the
+    # caller can emit an evidence bundle recording exactly which rungs,
+    # fallbacks, and fault events produced each verdict.
+    # ------------------------------------------------------------------
+    prov_cfg = {k: v for k, v in config.items() if k != "fingerprint"}
+    prov_engine: dict = {"engine": engine, "dedup_backend": dedup,
+                        "greedy_first": bool(greedy_first)}
+    if dedup == "pallas":
+        try:
+            from jepsen_tpu.ops import wide_kernel as _wkp
+
+            prov_engine["pallas_interpret"] = bool(_wkp.interpret_default())
+        except Exception:  # noqa: BLE001 — provenance must not lose ladders
+            pass
+    prov_paths: dict[int, list] = {}
+
+    def _pv(i: int, event: str, **attrs) -> None:
+        lst = prov_paths.setdefault(i, [])
+        if len(lst) < _prov.MAX_PATH:
+            lst.append({"event": event, **attrs})
+
+    def _pv_merge(i: int, sub: dict | None) -> None:
+        """Fold a nested engine's provenance (chunked_analysis) into
+        this history's trail: the ladder's events stay first, the inner
+        trajectory follows."""
+        if not sub:
+            return
+        eng = sub.get("engine")
+        if eng:
+            _pv(i, "engine.nested", **eng)
+        lst = prov_paths.setdefault(i, [])
+        for e in sub.get("path", ()):
+            if len(lst) >= _prov.MAX_PATH:
+                break
+            lst.append(dict(e))
+
+    def _attach_prov(i: int) -> None:
+        r = results[i]
+        if isinstance(r, dict):
+            _prov.attach(r, prov_paths.get(i, []), engine=prov_engine,
+                         config=prov_cfg)
 
     def _notify(i: int) -> None:
         """Early per-history demux for the rung-admission caller: hand a
@@ -629,11 +686,18 @@ def batch_analysis(
         r = results[i]
         if r is None or r.get("valid?") == "unknown":
             return
+        _attach_prov(i)
         try:
             admission.on_result(i, r)
         except Exception:  # noqa: BLE001 — a broken feeder must not
             # lose the ladder; the verdict still lands in the return list
             logger.exception("rung-admission on_result failed (history %d)", i)
+
+    if restored is not None:
+        # mid-run resume: every history's trail records that this run
+        # continued from a checkpoint rather than starting fresh
+        for _pi in range(len(histories)):
+            _pv(_pi, "checkpoint.restored", stage=start_stage)
 
     #: device ids every launch of this ladder runs on (lane-sharded
     #: over the mesh, or jax's default device) — the device-attribution
@@ -990,6 +1054,8 @@ def batch_analysis(
                     round(time.perf_counter() - t_submit, 6), history=i,
                 )
                 results[i] = _resolve_confirmation(dev_res, fut.result())
+            _pv(i, "confirm.resolved", mode="worker",
+                outcome=_prov.verdict_str(results[i].get("valid?")))
             _notify(i)
 
     def _poll_admission() -> None:
@@ -1029,6 +1095,7 @@ def batch_analysis(
             idxs.append(i)
             pending.append(k)
             rungs[k] = 0
+            _pv(i, "admission.joined", at_stage=min_rung)
             obs.counter("ladder.rung_admission", stage=min_rung)
 
     #: Continuous batching pins every rung launch to one fixed batch
@@ -1116,6 +1183,7 @@ def batch_analysis(
             for k in pending:
                 i = idxs[k]
                 prev = results[i]
+                _pv(i, "fault.deadline", at="ladder-stage", stage=si)
                 results[i] = {
                     "valid?": "unknown",
                     "cause": (
@@ -1184,11 +1252,15 @@ def batch_analysis(
                     safe.append(k)
                     continue
                 i = idxs[k]
-                results[i] = wgl.chunked_analysis(
+                _pv(i, "route.chunked-exact", stage=si, capacity=batch_cap)
+                r = wgl.chunked_analysis(
                     model, histories[i], packs[k], exact_ladder,
                     rounds=int(rounds), fast=False, dedup_backend=dedup,
                     deadline=deadline, frontier_budget_mb=frontier_budget_mb,
                 )
+                _pv_merge(i, r.pop("provenance", None)
+                          if isinstance(r, dict) else None)
+                results[i] = r
                 _notify(i)
             group = safe
             if not group:
@@ -1271,6 +1343,9 @@ def batch_analysis(
                         engine=st_engine, capacity=batch_cap,
                         lanes=len(part),
                     )
+                    for k in part:
+                        _pv(idxs[k], "fault.oom-spill-retry", stage=si,
+                            engine=st_engine, capacity=batch_cap)
                     _launch_ft(part, pad_to, retry=True, spilled=True)
                     return
                 if lf.kind == "oom" and len(part) > 1:
@@ -1281,6 +1356,9 @@ def batch_analysis(
                         engine=st_engine, capacity=batch_cap,
                         lanes_from=len(part), lanes_to=mid,
                     )
+                    for k in part:
+                        _pv(idxs[k], "fault.oom-halving", stage=si,
+                            engine=st_engine, capacity=batch_cap)
                     # Fault path: drop the fixed continuous-batching pad
                     # — replaying the halved part back up to the width
                     # that just OOM'd would re-probe the fault.
@@ -1292,6 +1370,9 @@ def batch_analysis(
                     "fault.launch.degraded", stage=si, engine=st_engine,
                     capacity=batch_cap, lanes=len(part), error=cause,
                 )
+                for k in part:
+                    _pv(idxs[k], "fault.launch-degraded", stage=si,
+                        engine=st_engine, capacity=batch_cap, error=cause)
                 degraded.extend((k, cause) for k in part)
                 return
             v, fat, lz, pk, snap = out
@@ -1375,6 +1456,8 @@ def batch_analysis(
             pending_lane = _stays_pending(valid_k, fat_k, lossy_k)
             if not pending_lane and fat_k < 0:
                 n_true += 1
+                _pv(i, "ladder.stage", stage=si, engine=st_engine,
+                    capacity=batch_cap, outcome="valid")
                 results[i] = {"valid?": True, "kernel": stats}
                 _notify(i)
             elif not pending_lane:
@@ -1382,6 +1465,11 @@ def batch_analysis(
                 op_pos = int(packs[k]["bar_opid"][int(fat_k)])
                 op = histories[i][op_pos]
                 res = {"valid?": False, "op": op, "kernel": stats}
+                _pv(i, "ladder.stage", stage=si, engine=st_engine,
+                    capacity=batch_cap, outcome="refuted",
+                    confirm=("none" if st_engine == "exact"
+                             or not confirm_refutations
+                             else str(confirm_refutations)))
                 if st_engine == "exact" or not confirm_refutations:
                     # content-decided kills (or the caller opted out):
                     # the refutation is final
@@ -1414,6 +1502,9 @@ def batch_analysis(
                     results[i] = res  # placeholder; resolved below
             else:
                 still.append(k)
+                _pv(i, "ladder.stage", stage=si, engine=st_engine,
+                    capacity=batch_cap, outcome="pending",
+                    lossy=bool(lossy_k))
                 results[i] = {
                     "valid?": "unknown",
                     "cause": "frontier capacity or closure rounds exhausted",
@@ -1454,6 +1545,7 @@ def batch_analysis(
             )
         for k in exhausted:
             i = idxs[k]
+            _pv(i, "ladder.exhausted")
             r = results[i]
             if r is not None and r.get("valid?") == "unknown" and r.get("cause"):
                 r["cause"] = f"{r['cause']}; {note}"
@@ -1472,11 +1564,13 @@ def batch_analysis(
         device_resolved.add(i)
         if exact_died:
             res["confirmed?"] = True
+            _pv(i, "confirm.device", outcome="refuted-final")
             results[i] = res
             _notify(i)
             return
         if deadline is not None and deadline.expired():
             deadline_tripped = True
+            _pv(i, "fault.deadline", at="device-confirm")
             results[i] = {
                 "valid?": "unknown",
                 "cause": ("device refutation; deadline-exceeded before "
@@ -1490,6 +1584,8 @@ def batch_analysis(
             stop_at_index=op_pos,
         )
         results[i] = _resolve_confirmation(res, cpu_res)
+        _pv(i, "confirm.resolved", mode="device-sweep",
+            outcome=_prov.verdict_str(results[i].get("valid?")))
         _notify(i)
 
     if device_confirms and deadline is not None and deadline.expired():
@@ -1512,6 +1608,7 @@ def batch_analysis(
         obs.counter("fault.deadline.trip")
         note = f"; resumable checkpoint: {ck}" if ck else ""
         for k, _fat, _cap, res in device_confirms:
+            _pv(idxs[k], "fault.deadline", at="device-confirm")
             results[idxs[k]] = {
                 "valid?": "unknown",
                 "cause": (
@@ -1556,6 +1653,7 @@ def batch_analysis(
                 # final refutation; a surviving or lossy chunked run is
                 # the collision/loss case, resolved like the batched
                 # launch below.
+                _pv(idxs[k], "confirm.chunked-exact", capacity=cap)
                 r = wgl.chunked_analysis(
                     model, histories[idxs[k]], p, [cap], rounds=int(rounds),
                     fast=False, dedup_backend=dedup, deadline=deadline,
@@ -1615,6 +1713,8 @@ def batch_analysis(
                 # frontier algorithm the kernel runs and degrades linearly.
                 n_fb += 1
                 results[i] = wgl_cpu.sweep_analysis(model, histories[i])
+                _pv(i, "cpu-fallback", engine="sweep",
+                    outcome=_prov.verdict_str(results[i].get("valid?")))
                 _notify(i)
         if n_fb:
             obs.span_event(
@@ -1629,6 +1729,7 @@ def batch_analysis(
         deterministically (model bug, malformed history), the re-run
         raises the SAME error and still degrades this history alone
         (advisor r4)."""
+        _pv(i, "confirm.degraded", error=type(e).__name__)
         if cpu_fallback and not (deadline is not None and deadline.expired()):
             try:
                 results[i] = wgl_cpu.sweep_analysis(
@@ -1676,6 +1777,7 @@ def batch_analysis(
                     confirm_degraded.add(i)
                     obs.counter("fault.deadline.trip")
                     obs.event("fault.deadline", at="confirm-drain", history=i)
+                    _pv(i, "fault.deadline", at="confirm-drain")
                     results[i] = {
                         "valid?": "unknown",
                         "cause": (
@@ -1700,6 +1802,7 @@ def batch_analysis(
                         # deterministic task failure).
                         resubmitted = True
                         obs.counter("fault.confirm.resubmit", history=i)
+                        _pv(i, "confirm.resubmit")
                         pool, fut = _submit_confirmation(
                             confirm_workers, model, list(histories[i]),
                             confirm_max_configs, op_pos,
@@ -1724,6 +1827,12 @@ def batch_analysis(
                 round(time.perf_counter() - t_submit, 6), history=i,
             )
             results[i] = _resolve_confirmation(dev_res, cpu_res)
+            # mode stays "worker" whether the future was harvested early
+            # or in this drain: harvest TIMING is scheduling noise, and
+            # digest parity compares decision paths across runs (the
+            # drain itself is on the ladder.confirm.drain span).
+            _pv(i, "confirm.resolved", mode="worker",
+                outcome=_prov.verdict_str(results[i].get("valid?")))
         _notify(i)
     if confirm_futs:
         obs.span_event(
@@ -1764,4 +1873,8 @@ def batch_analysis(
             len(stages),
             complete=not deadline_tripped and not confirm_degraded,
         )
-    return [r if r is not None else {"valid?": "unknown"} for r in results]
+    out = [r if r is not None else {"valid?": "unknown"} for r in results]
+    for i, r in enumerate(out):
+        _prov.attach(r, prov_paths.get(i, []), engine=prov_engine,
+                     config=prov_cfg)
+    return out

@@ -59,6 +59,7 @@ from typing import Mapping, Sequence
 from jepsen_tpu import faults, obs, store
 from jepsen_tpu import models as m
 from jepsen_tpu.obs import metrics
+from jepsen_tpu.obs import provenance as _prov
 from jepsen_tpu.serve import health as _health
 from jepsen_tpu.store import durable as _durable
 from jepsen_tpu.serve import slo as _slo
@@ -357,6 +358,7 @@ class CheckService:
         verify_placement: bool = False,
         warm_pool: bool = True,
         drain_dir: str | Path | None = None,
+        evidence_dir: str | Path | None = None,
         journal_dir: str | Path | None = None,
         idempotency_dir: str | Path | None = None,
         idempotency_ttl_s: float = 3600.0,
@@ -389,6 +391,16 @@ class CheckService:
         self.verify_placement = bool(verify_placement)
         self.warm_pool = warm_pool
         self.drain_dir = Path(drain_dir) if drain_dir is not None else None
+        #: durable evidence-bundle directory (None: in-memory ring only).
+        #: Every settled request's bundle is retrievable via
+        #: ``get_evidence(id)`` / GET /evidence/<id> either way.
+        self.evidence_dir = (Path(evidence_dir)
+                             if evidence_dir is not None else None)
+        self._evidence: dict[str, dict] = {}     # guarded-by: _lock [rw]
+        # Warm the host-fingerprint cache off the request path: the
+        # first evidence bundle would otherwise eat a cold ~10ms
+        # import inside a request's measured lifetime.
+        _prov.machine_fingerprint()
         self._check_opts = dict(check_opts)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -736,17 +748,19 @@ class CheckService:
                     obs.counter("serve.quarantine_hit", client=client)
                     obs.counter("serve.completed")
                 metrics.inc("serve.verdicts", verdict="unknown")
-                req.resolve(
-                    {
-                        "valid?": "unknown",
-                        "quarantined": True,
-                        "cause": (
-                            "quarantined history (repeat poison "
-                            f"offender): {q['cause']}"
-                        ),
-                    },
-                    status="quarantined",
-                )
+                qres = {
+                    "valid?": "unknown",
+                    "quarantined": True,
+                    "cause": (
+                        "quarantined history (repeat poison "
+                        f"offender): {q['cause']}"
+                    ),
+                }
+                self._bundle(req, qres, [{
+                    "event": "fault.quarantine-hit",
+                    "error": str(q["cause"]),
+                }])
+                req.resolve(qres, status="quarantined")
                 dt = time.monotonic() - req.t_submit
                 metrics.observe("serve.request_latency_seconds", dt)
                 return req.future
@@ -887,7 +901,10 @@ class CheckService:
             # Resolved OUTSIDE the lock: set_result runs done-callbacks
             # synchronously, and a callback re-entering the service
             # (submit/stats) must not deadlock on a held lock.
-            req.resolve({"valid?": True})
+            tres = {"valid?": True}
+            self._bundle(req, tres,
+                         [{"event": "serve.trivial", "barriers": 0}])
+            req.resolve(tres)
             with obs.attach(req.ctx):
                 obs.counter("serve.completed")
             metrics.inc("serve.verdicts", verdict="true")
@@ -1068,7 +1085,10 @@ class CheckService:
             with obs.attach(req.ctx):
                 obs.counter("serve.journal_replayed", client=req.client)
             if group is None:
-                req.resolve({"valid?": True})
+                tres = {"valid?": True}
+                self._bundle(req, tres,
+                             [{"event": "serve.trivial", "barriers": 0}])
+                req.resolve(tres)
                 self.journal.resolve(req.id)
             n += 1
         if n:
@@ -1172,30 +1192,31 @@ class CheckService:
     def _resolve_expired(self, expired: list[CheckRequest]) -> None:
         # Expired futures resolve outside the lock (done-callbacks may
         # re-enter the service); the shared batch is untouched.
-        t_now = time.monotonic()
         for r in expired:
             with obs.attach(r.ctx):
-                # the whole lifetime WAS queue wait — record it as an
-                # admission span so the offline decomposition
-                # (critpath.decompose_requests) attributes it the same
-                # way the live latency block does
-                obs.span_event(
-                    "serve.admission", t_now - r.t_submit,
-                    client=r.client, tier=r.tier, expired=True,
-                )
                 obs.counter("serve.expired", client=r.client, tier=r.tier)
             metrics.inc("serve.verdicts", verdict="unknown")
-            r.resolve(
-                {
-                    "valid?": "unknown",
-                    "cause": (
-                        "deadline-exceeded: request budget expired while "
-                        "queued (the shared batch is unaffected)"
-                    ),
-                },
-                status="expired",
-            )
+            xres = {
+                "valid?": "unknown",
+                "cause": (
+                    "deadline-exceeded: request budget expired while "
+                    "queued (the shared batch is unaffected)"
+                ),
+            }
+            self._bundle(r, xres,
+                         [{"event": "fault.deadline", "at": "queue"}])
+            r.resolve(xres, status="expired")
             with obs.attach(r.ctx):
+                # the whole lifetime WAS queue wait — record the
+                # admission span over the SAME interval the live
+                # latency block uses (t_done - t_submit, which includes
+                # the evidence-bundle build) so the offline
+                # decomposition (critpath.decompose_requests) and the
+                # live block agree that queue_s == total_s
+                obs.span_event(
+                    "serve.admission", r.t_done - r.t_submit,
+                    client=r.client, tier=r.tier, expired=True,
+                )
                 # the end-to-end span every settled request gets — an
                 # expired lifecycle must decompose offline too
                 obs.span_event(
@@ -1316,7 +1337,10 @@ class CheckService:
         t_end = time.monotonic()
         for r, res in zip(rs, results):
             r.t_launch_end = t_end
-            self._settle_member(r, res)
+            self._settle_member(
+                r, res,
+                extra_path=[{"event": "serve.graph-lane", "batched": True}],
+            )
 
     def _run_graph(self, r: CheckRequest) -> None:
         from jepsen_tpu import checker as _checker
@@ -1338,7 +1362,10 @@ class CheckService:
             self._totals["graphs"] += 1
         obs.counter("serve.graphs")
         r.t_launch_end = time.monotonic()
-        self._settle_member(r, res)
+        self._settle_member(
+            r, res,
+            extra_path=[{"event": "serve.graph-lane", "batched": False}],
+        )
 
     # -- interactive fast path ---------------------------------------------
 
@@ -1414,7 +1441,11 @@ class CheckService:
                 with self._cond:
                     if r in self._inflight:
                         self._inflight.remove(r)
-                self._settle_member(r, {"valid?": True, "fastpath": "greedy"})
+                self._settle_member(
+                    r, {"valid?": True, "fastpath": "greedy"},
+                    extra_path=[{"event": "serve.fastpath",
+                                 "engine": "host-greedy"}],
+                )
             else:
                 r.escalated = True
                 # the fast-path stamps are void — the batch tier will
@@ -1628,17 +1659,86 @@ class CheckService:
             len(healthy), len(failed),
         )
 
+    def _bundle(self, r: CheckRequest, res: dict,
+                extra_path: Sequence[Mapping] | None = None) -> None:
+        """Build + retain this request's evidence bundle
+        (``obs.provenance``) BEFORE its future resolves, so the verdict
+        the client reads already carries the ``evidence`` pointer.  The
+        bundle id IS the request id — GET /evidence/<id> and GET
+        /check/<id> share a key.  Bundles land in the in-memory ring
+        (bounded like the request registry) and, when ``evidence_dir``
+        is set, as durable envelopes on disk.  Never raises — evidence
+        is observability, not the verdict."""
+        try:
+            path = [{"event": "serve.request", "tier": r.tier,
+                     "client": r.client}]
+            if r.escalated:
+                path.append({"event": "serve.escalated"})
+            if extra_path:
+                path.extend(dict(e) for e in extra_path)
+            bundle = _prov.build_bundle(
+                history=list(r.history), result=res, source="serve",
+                model=r.model,
+                checker=(type(r.checker).__name__
+                         if r.checker is not None else None),
+                trace_id=r.trace_id, bundle_id=r.id, extra_path=path,
+            )
+            written = None
+            if self.evidence_dir is not None:
+                written = _prov.write_bundle(self.evidence_dir, bundle)
+            with self._lock:
+                self._evidence[r.id] = bundle
+                if len(self._evidence) > _KEEP_DONE:
+                    drop = list(self._evidence)[
+                        : len(self._evidence) - _KEEP_DONE]
+                    for k in drop:
+                        del self._evidence[k]
+            res["evidence"] = {"id": bundle["id"],
+                               "digest": bundle["digest"]}
+            if written is not None:
+                res["evidence"]["path"] = str(written)
+            else:
+                # write_bundle counts the persisted case; the
+                # in-memory-only emission counts here so the
+                # provenance.* rollup sees every served bundle.
+                obs.counter("provenance.bundle", source="serve",
+                            verdict=bundle["verdict"])
+        except Exception:  # noqa: BLE001 — see docstring
+            logger.exception("evidence bundle emission failed for %s",
+                             r.id)
+            obs.counter("provenance.emit_error", error="serve")
+
+    def get_evidence(self, request_id: str) -> dict | None:
+        """The evidence bundle behind GET /evidence/<id>: the in-memory
+        ring first, then the durable ``evidence_dir`` copy (a restart
+        empties the ring; the disk envelope survives)."""
+        with self._lock:
+            b = self._evidence.get(request_id)
+        if b is not None:
+            return b
+        if self.evidence_dir is not None:
+            p = self.evidence_dir / f"{request_id}.json"
+            if p.is_file():
+                try:
+                    return _prov.read_bundle(p)
+                except _durable.DurableError:
+                    return None
+        return None
+
     def _settle_member(self, r: CheckRequest, res: dict,
-                       status: str = "done") -> bool:
+                       status: str = "done",
+                       extra_path: Sequence[Mapping] | None = None) -> bool:
         """Resolve one request's future with its verdict (idempotent —
         the ladder's early demux and the final settle loop may both
         reach a member).  Annotates mid-flight deadline overrun and
-        emits the per-request telemetry."""
+        emits the per-request telemetry + evidence bundle."""
         if r.deadline is not None and r.deadline.expired():
             # Launched before the budget ran out: the verdict is
             # already paid for, so hand it over — annotated, so an
             # SLA-bound caller can still discount it.
             res = {**res, "deadline-overrun": True}
+        if not r.future.done():
+            self._bundle(r, res, extra_path)
         if not r.resolve(res, status=status):
             return False
         with obs.attach(r.ctx):
@@ -1805,16 +1905,18 @@ class CheckService:
                               self.breaker.state == "open")
             for r in unresolved:
                 metrics.inc("serve.verdicts", verdict="unknown")
-                r.resolve(
-                    {
-                        "valid?": "unknown",
-                        "cause": (
-                            "service batch failed: "
-                            f"{faults.describe(err)}"
-                        ),
-                    },
-                    status="error",
-                )
+                eres = {
+                    "valid?": "unknown",
+                    "cause": (
+                        "service batch failed: "
+                        f"{faults.describe(err)}"
+                    ),
+                }
+                self._bundle(r, eres, [{
+                    "event": "fault.batch-error",
+                    "error": faults.describe(err),
+                }])
+                r.resolve(eres, status="error")
                 self._journal_done(r)
             return
         self.breaker.record_success()
@@ -1898,6 +2000,8 @@ class CheckService:
                     ),
                 },
                 status="quarantined",
+                extra_path=[{"event": "fault.poison-bisect",
+                             "error": cause0}],
             )
         logger.warning(
             "poison bisection: %d member(s) quarantined, %d innocent "
@@ -1949,17 +2053,21 @@ class CheckService:
             # these members only, with both failures named
             for r in retry:
                 metrics.inc("serve.verdicts", verdict="unknown")
-                r.resolve(
-                    {
-                        "valid?": "unknown",
-                        "cause": (
-                            f"hung launch ({faults.describe(err)}); "
-                            "reduced-placement retry failed: "
-                            f"{faults.describe(e2)}"
-                        ),
-                    },
-                    status="error",
-                )
+                hres = {
+                    "valid?": "unknown",
+                    "cause": (
+                        f"hung launch ({faults.describe(err)}); "
+                        "reduced-placement retry failed: "
+                        f"{faults.describe(e2)}"
+                    ),
+                }
+                self._bundle(r, hres, [
+                    {"event": "fault.watchdog-trip",
+                     "error": faults.describe(err)},
+                    {"event": "fault.retry-failed",
+                     "error": faults.describe(e2)},
+                ])
+                r.resolve(hres, status="error")
                 self._journal_done(r)
             return
         for r, res in zip(retry, results):
@@ -2158,12 +2266,12 @@ class CheckService:
                 summary = self._drain(remaining)
             else:
                 for r in remaining:
-                    r.resolve(
-                        {"valid?": "unknown",
-                         "cause": "service shut down before this request "
-                                  "was checked"},
-                        status="drained",
-                    )
+                    dres = {"valid?": "unknown",
+                            "cause": "service shut down before this "
+                                     "request was checked"}
+                    self._bundle(r, dres, [{"event": "serve.drained",
+                                            "checkpoint": False}])
+                    r.resolve(dres, status="drained")
                     # Keep the journal entry under drain=False too?  No:
                     # the caller explicitly declined a resumable drain,
                     # so a restart re-running these would contradict the
@@ -2244,8 +2352,10 @@ class CheckService:
                 with obs.attach(r.ctx):
                     obs.counter("serve.drained", client=r.client)
                 metrics.inc("serve.verdicts", verdict="unknown")
-                r.resolve({"valid?": "unknown", "cause": cause},
-                          status="drained")
+                dres = {"valid?": "unknown", "cause": cause}
+                self._bundle(r, dres, [{"event": "serve.drained",
+                                        "checkpoint": sub is not None}])
+                r.resolve(dres, status="drained")
                 if sub is not None:
                     # the drain checkpoint supersedes the journal entry
                     # (resume_drained is the recovery path now); a

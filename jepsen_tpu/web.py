@@ -26,6 +26,10 @@ the check API:
                      (the estimate is computed per latency class)
   GET  /check/<id>   request status / result (includes the trace_id and
                      the per-request "latency" decomposition block)
+  GET  /evidence/<id>  the request's verdict-provenance evidence bundle
+                     (obs.provenance): decision path, engine resolution,
+                     witness, config + machine fingerprint — same id as
+                     /check/<id>; audit with tools/evidence.py
   GET  /queue        queue-status JSON incl. per-class queue depths and
                      retry-after EWMAs (the home page shows a panel)
   GET  /alerts       the live SLO burn-rate engine's alert document
@@ -533,6 +537,17 @@ def telemetry_html(run_dir: Path, rel: str | None = None) -> str:
             "trace-event export (one lane per request; load at "
             "ui.perfetto.dev)</p>"
         )
+    ev_dir = Path(run_dir) / "evidence"
+    if rel and ev_dir.is_dir():
+        n_ev = sum(1 for _ in ev_dir.glob("*.json"))
+        if n_ev:
+            href = "/files/" + html.escape(rel.strip("/")) + "/evidence/"
+            parts.append(
+                f"<p><a href='{href}'>evidence bundles</a> — {n_ev} "
+                "verdict provenance bundle(s): decision path, engine "
+                "resolution, and witness per verdict (audit with "
+                "<code>tools/evidence.py verify|replay</code>)</p>"
+            )
     if s.get("phases"):
         parts.append("<h3>phases</h3>")
         parts.append(_telemetry_table(
@@ -917,6 +932,22 @@ class Handler(BaseHTTPRequestHandler):
                         self._send_json(404, {"error": "unknown request id"})
                     else:
                         self._send_json(200, req.describe())
+            elif path.startswith("/evidence/"):
+                # The verdict's evidence bundle (obs.provenance): the
+                # full decision path + witness for one served request,
+                # keyed by the SAME id as GET /check/<id>.  Audit it
+                # offline with tools/evidence.py verify / replay.
+                if self.check_service is None:
+                    self._send_json(503, {"error": "no check service mounted"})
+                else:
+                    bundle = self.check_service.get_evidence(
+                        path[len("/evidence/"):])
+                    if bundle is None:
+                        self._send_json(
+                            404, {"error": "no evidence bundle for that "
+                                           "request id"})
+                    else:
+                        self._send_json(200, bundle)
             elif path.startswith("/files/"):
                 target = _safe_resolve(base, path[len("/files/"):])
                 if target is None or not target.exists():

@@ -1,0 +1,103 @@
+"""Audit verdict evidence bundles (jepsen_tpu.obs.provenance).
+
+Every checker verdict — one-shot ``check``/``check_batch``, the chunked
+exact engine, and each served request — emits a durable evidence bundle
+(``<run-dir>/evidence/<id>.json``, a ``store.durable`` envelope): the
+full decision path behind the verdict (engine/backend resolution, ladder
+trajectory, fault events), the witness or refutation payload, the config
++ machine fingerprint, and the stability-core digest.  This tool is the
+offline auditor over those bundles:
+
+  verify   structural audit: envelope CRC, required fields, digest
+           recomputation, embedded-history fingerprint, and witness
+           re-validation against the model (a claimed linearization is
+           re-stepped op by op; a claimed cycle must actually cycle).
+           A tampered envelope or forged witness FAILS with a
+           machine-readable report.
+
+  replay   re-run the embedded history pinned to the recorded engine /
+           backend / config and assert verdict identity.  A bundle
+           whose decision path records a deadline trip replays under a
+           zero budget so the degraded-unknown outcome is deterministic.
+
+Usage::
+
+  python tools/evidence.py verify <bundle.json | run-dir> [run-dir...]
+  python tools/evidence.py replay <bundle.json | run-dir> [run-dir...]
+
+A directory argument audits every ``*.json`` under its ``evidence/``
+subdirectory (or the directory itself when it IS an evidence dir).  The
+report is one JSON document on stdout — ``{"ok": bool, "bundles":
+[...]}`` — and the exit code is 0 only when every bundle passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jepsen_tpu.obs import provenance  # noqa: E402
+
+
+def _targets(args: list[str]) -> list[Path]:
+    """Expand file/dir arguments into individual bundle paths.  Corrupt
+    files are NOT filtered here — verify must see (and fail on) them."""
+    out: list[Path] = []
+    for a in args:
+        p = Path(a)
+        if p.is_dir():
+            ev = p / "evidence" if (p / "evidence").is_dir() else p
+            found = sorted(ev.glob("*.json"))
+            if not found:
+                print(f"warning: no evidence bundles under {ev}",
+                      file=sys.stderr)
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
+def run_verify(paths: list[Path]) -> dict:
+    bundles = []
+    for p in paths:
+        rep = provenance.verify_bundle(p)
+        bundles.append({"path": str(p), **rep})
+    return {"ok": all(b["ok"] for b in bundles) and bool(bundles),
+            "mode": "verify", "bundles": bundles}
+
+
+def run_replay(paths: list[Path]) -> dict:
+    bundles = []
+    for p in paths:
+        rep = provenance.replay_bundle(p)
+        bundles.append({"path": str(p), **rep})
+    return {"ok": all(b["ok"] for b in bundles) and bool(bundles),
+            "mode": "replay", "bundles": bundles}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="evidence.py",
+        description="verify / replay verdict evidence bundles",
+    )
+    ap.add_argument("mode", choices=("verify", "replay"))
+    ap.add_argument("paths", nargs="+",
+                    help="bundle file(s) and/or run director(ies)")
+    opts = ap.parse_args(argv)
+    paths = _targets(opts.paths)
+    if not paths:
+        print(json.dumps({"ok": False, "mode": opts.mode, "bundles": [],
+                          "error": "no bundles found"}, indent=2))
+        return 1
+    report = (run_verify(paths) if opts.mode == "verify"
+              else run_replay(paths))
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,26 +66,81 @@ def _build_cell_args(lanes: int, capacity: int, barriers: int):
     return stacked, B, P, G
 
 
-def run_cell(lanes: int, capacity: int, barriers: int, rounds: int = 8) -> int:
+def run_cell(lanes: int, capacity: int, barriers: int, rounds: int = 8,
+             telemetry_dir: str | None = None) -> int:
     """Execute ONE grid cell in-process (the subprocess entry): build
     the launch, run it to completion, exit 0.  A TPU-worker fault
-    kills this process — the parent records the cell as a fault."""
+    kills this process — the parent records the cell as a fault.
+    With ``telemetry_dir`` the cell records its span stream there
+    (obs.recording), so the parent can fold each cell's stage rollup
+    into the grid artifact."""
+    import contextlib
+
     import jax.numpy as jnp  # noqa: F401 — initialize the backend here
 
+    from jepsen_tpu import obs
     from jepsen_tpu.ops import wgl
     from jepsen_tpu.parallel.batch import _ARG_ORDER
 
-    stacked, B, P, G = _build_cell_args(lanes, capacity, barriers)
-    W = (P + 31) // 32
-    runner = wgl.exact_batched_runner(
-        _step_of(stacked), int(capacity), int(rounds), P, G, W
-    )
-    args = [stacked[k] for k in _ARG_ORDER]
-    valid, _failed_at, _lossy, _peak = runner(*args)
-    valid.block_until_ready()
+    rec = (obs.recording(telemetry_dir) if telemetry_dir
+           else contextlib.nullcontext())
+    with rec:
+        stacked, B, P, G = _build_cell_args(lanes, capacity, barriers)
+        W = (P + 31) // 32
+        runner = wgl.exact_batched_runner(
+            _step_of(stacked), int(capacity), int(rounds), P, G, W
+        )
+        args = [stacked[k] for k in _ARG_ORDER]
+        with obs.span("fault_sweep.cell", lanes=int(lanes),
+                      capacity=int(capacity), barriers=int(barriers)):
+            valid, _failed_at, _lossy, _peak = runner(*args)
+            valid.block_until_ready()
     print(f"cell ok: lanes={lanes} capacity={capacity} barriers={barriers} "
           f"valid={[bool(v) for v in valid][:4]}...")
     return 0
+
+
+def _cell_telemetry(cell: dict, cell_dir: Path) -> None:
+    """Fold a finished cell's recorded telemetry into its grid entry:
+    the raw ``telemetry.jsonl`` path (flight-analyzer input — the
+    sweep's JSON artifact indexes every child stream) and the per-cell
+    stage rollup (span name -> seconds, obs.regress.stage_rollup), so
+    a faulting cell's last recorded stage is visible WITHOUT replaying
+    the child.  Best-effort: a cell that died before its recorder
+    flushed simply carries no rollup."""
+    jsonl = cell_dir / "telemetry.jsonl"
+    if jsonl.is_file():
+        cell["telemetry"] = str(jsonl)
+    summary_p = cell_dir / "telemetry.json"
+    summary = None
+    if summary_p.is_file():
+        try:
+            summary = json.loads(summary_p.read_text())
+        except (OSError, ValueError):
+            summary = None
+    elif jsonl.is_file():
+        # the child faulted before Recorder.close() rolled the stream
+        # up — roll up whatever lines made it to disk
+        try:
+            from jepsen_tpu.obs.summary import summarize
+            from jepsen_tpu.obs.trace import read_jsonl_events
+
+            events, _skipped = read_jsonl_events(jsonl)
+            summary = summarize(events)
+        except Exception:  # noqa: BLE001 — telemetry stays best-effort
+            summary = None
+    if summary is not None:
+        try:
+            from jepsen_tpu.obs import regress
+
+            stages, metrics = regress.stage_rollup(summary)
+            cell["stages"] = {k: round(v, 6) for k, v in stages.items()}
+            if metrics:
+                cell["stage_metrics"] = {
+                    k: round(v, 6) for k, v in metrics.items()
+                }
+        except Exception:  # noqa: BLE001 — telemetry stays best-effort
+            pass
 
 
 def _step_of(stacked) -> object:
@@ -121,6 +176,7 @@ def sweep(lanes_list, caps, bars, out_path: Path, timeout_s: float,
         "cells": cells,
     }
     total = len(lanes_list) * len(caps) * len(bars)
+    tele_root = out_path.parent / (out_path.stem + "-telemetry")
     i = 0
     for lanes in lanes_list:
         for cap in caps:
@@ -131,11 +187,14 @@ def sweep(lanes_list, caps, bars, out_path: Path, timeout_s: float,
                 t0 = time.time()
                 cell = {"lanes": int(lanes), "capacity": int(cap),
                         "barriers": int(B)}
+                cell_dir = tele_root / f"l{lanes}-c{cap}-b{B}"
+                cell_dir.mkdir(parents=True, exist_ok=True)
                 try:
                     proc = subprocess.run(
                         [sys.executable, str(Path(__file__).resolve()),
                          "--run-cell", f"{lanes},{cap},{B}",
-                         "--rounds", str(rounds)],
+                         "--rounds", str(rounds),
+                         "--telemetry-dir", str(cell_dir)],
                         timeout=timeout_s, capture_output=True, text=True,
                     )
                     cell["ok"] = proc.returncode == 0
@@ -148,6 +207,9 @@ def sweep(lanes_list, caps, bars, out_path: Path, timeout_s: float,
                     cell["ok"] = False
                     cell["timeout"] = True
                 cell["seconds"] = round(time.time() - t0, 2)
+                # the child's span stream + stage rollup ride the cell:
+                # a fault's last recorded stage is in the artifact
+                _cell_telemetry(cell, cell_dir)
                 cells.append(cell)
                 out_path.parent.mkdir(parents=True, exist_ok=True)
                 out_path.write_text(json.dumps(grid, indent=1),
@@ -275,10 +337,13 @@ def main(argv=None) -> int:
                          "CPU, no launches")
     ap.add_argument("--run-cell", default=None, metavar="L,C,B",
                     help="(internal) run one cell in-process and exit")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="(internal) record the cell's span stream here")
     a = ap.parse_args(argv)
     if a.run_cell:
         lanes, cap, bars = (int(x) for x in a.run_cell.split(","))
-        return run_cell(lanes, cap, bars, rounds=a.rounds)
+        return run_cell(lanes, cap, bars, rounds=a.rounds,
+                        telemetry_dir=a.telemetry_dir)
     if a.dry_run:
         return dry_run()
     lanes_list = [int(x) for x in a.lanes.split(",") if x]

@@ -77,6 +77,84 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _evidence_parity(baseline_bundles: dict, served_bundles: dict,
+                     verdicts: list, baseline_verdicts: list):
+    """Served-vs-sequential evidence-digest parity over the sampled
+    requests: the same history through the same decision path must
+    produce the same stability-core digest whether it was checked by
+    the service or by a one-shot ``batch_analysis`` call.
+
+    Normalization before comparing: the serving layer's own admission
+    events (``serve.*``) are stripped from the served path (they have
+    no sequential counterpart), and the config section is zeroed (the
+    two arms legitimately run under different batch configs).  A path
+    that still differs is NOT a failure — the service may batch/route
+    differently — but the first diverging step is named for diagnosis.
+    The hard failure is same-path-different-digest: the decision trail
+    claims the runs were identical while the evidence core disagrees.
+
+    Returns ``(summary_dict, failures)`` where each failure message
+    names the diverging decision step."""
+    from jepsen_tpu.obs import provenance
+
+    def norm_path(bundle, *, served):
+        path = bundle.get("decision_path") or []
+        if served:
+            path = [e for e in path
+                    if not str(e.get("event", "")).startswith("serve.")]
+        return path
+
+    def core_digest(bundle, path):
+        b = dict(bundle)
+        b["decision_path"] = path
+        b["config"] = {}
+        return provenance.bundle_digest(b)
+
+    checked = same_path = matched = 0
+    diverged: list[dict] = []
+    failures: list[str] = []
+    for i in sorted(set(baseline_bundles) & set(served_bundles)):
+        if verdicts[i] != baseline_verdicts[i]:
+            continue  # verdict-parity / chaos logic owns flips
+        bb, sb = baseline_bundles[i], served_bundles[i]
+        bp = norm_path(bb, served=False)
+        sp = norm_path(sb, served=True)
+        checked += 1
+        b_ev = [str(e.get("event")) for e in bp]
+        s_ev = [str(e.get("event")) for e in sp]
+        if b_ev != s_ev:
+            k = next((j for j in range(min(len(b_ev), len(s_ev)))
+                      if b_ev[j] != s_ev[j]),
+                     min(len(b_ev), len(s_ev)))
+            diverged.append({
+                "request": i, "step": k,
+                "sequential": b_ev[k] if k < len(b_ev) else None,
+                "served": s_ev[k] if k < len(s_ev) else None,
+            })
+            continue
+        same_path += 1
+        bd, sd = core_digest(bb, bp), core_digest(sb, sp)
+        if bd == sd:
+            matched += 1
+            continue
+        sbp = provenance._strip(bp)
+        ssp = provenance._strip(sp)
+        k = next((j for j in range(len(sbp)) if sbp[j] != ssp[j]), None)
+        where = (
+            f"decision step {k} ({b_ev[k]}): sequential={sbp[k]} "
+            f"served={ssp[k]}" if k is not None
+            else "outside the decision path (engine/witness/cause)"
+        )
+        failures.append(
+            f"request {i}: same decision path but digest {bd[:12]} != "
+            f"{sd[:12]} — diverges at {where}")
+    summary = {"checked": checked, "same_path": same_path,
+               "digest_match": matched, "diverged_paths": len(diverged)}
+    if diverged:
+        summary["first_divergences"] = diverged[:4]
+    return summary, failures
+
+
 def _pct(xs: list[float], p: float) -> float:
     if not xs:
         return 0.0
@@ -298,6 +376,7 @@ def main(argv=None) -> int:
     from jepsen_tpu import faults, obs
     from jepsen_tpu import models as m
     from jepsen_tpu.obs import metrics as obs_metrics
+    from jepsen_tpu.obs import provenance
     from jepsen_tpu.parallel import batch_analysis
     from jepsen_tpu.serve import CheckService, QueueFull
 
@@ -388,6 +467,12 @@ def main(argv=None) -> int:
         out["geometry"] = geometry_acct
     rc = 0
     baseline_verdicts = None
+    # Evidence-digest parity sample: the LAST few requests — the served
+    # arm keeps its most recent bundles in the in-memory evidence ring,
+    # so sampling from the tail survives large runs.
+    prov_sample = set(range(max(0, a.requests - 32), a.requests))
+    baseline_bundles: dict[int, dict] = {}
+    served_bundles: dict[int, dict] = {}
 
     import contextlib
 
@@ -408,11 +493,18 @@ def main(argv=None) -> int:
             lat = []
             t0 = time.perf_counter()
             baseline_verdicts = []
-            for hh in hists:
+            for i, hh in enumerate(hists):
                 t1 = time.perf_counter()
                 r = batch_analysis(model, [hh], capacity=capacity)[0]
                 lat.append(time.perf_counter() - t1)
                 baseline_verdicts.append(r["valid?"])
+                if i in prov_sample:
+                    try:
+                        baseline_bundles[i] = provenance.build_bundle(
+                            history=hh, result=r, source="sequential",
+                            model=model)
+                    except Exception:  # noqa: BLE001 — parity is advisory
+                        pass
             wall = time.perf_counter() - t0
             out["sequential"] = {
                 "wall_s": round(wall, 3),
@@ -497,6 +589,7 @@ def main(argv=None) -> int:
 
                 verdicts: list = [None] * a.requests
                 causes: list = [None] * a.requests
+                evid: list = [None] * a.requests
                 lat: list = [0.0] * a.requests
                 done_at: list = [0.0] * a.requests
                 retries = [0]
@@ -535,6 +628,7 @@ def main(argv=None) -> int:
                             lat[i] = time.perf_counter() - t1
                             verdicts[i] = r["valid?"]
                             causes[i] = r.get("cause")
+                            evid[i] = (r.get("evidence") or {}).get("id")
                     else:
                         # open arrivals: stream this tenant's share
                         # (optionally on the timed --arrival schedule),
@@ -559,6 +653,7 @@ def main(argv=None) -> int:
                             lat[i] = (done_at[i] or time.perf_counter()) - t1
                             verdicts[i] = r["valid?"]
                             causes[i] = r.get("cause")
+                            evid[i] = (r.get("evidence") or {}).get("id")
 
                 t0 = time.perf_counter()
                 threads = [
@@ -792,6 +887,24 @@ def main(argv=None) -> int:
                               list(zip(baseline_verdicts, verdicts)),
                               file=sys.stderr)
                         rc = 1
+                # Evidence-digest parity: same history + same decision
+                # path must hash to the same stability-core digest in
+                # both arms.  The ring outlives shutdown, so late
+                # collection is safe.
+                for i in sorted(prov_sample):
+                    if evid[i]:
+                        b = svc.get_evidence(evid[i])
+                        if b:
+                            served_bundles[i] = b
+                ep, ep_fail = _evidence_parity(
+                    baseline_bundles, served_bundles,
+                    verdicts, baseline_verdicts)
+                out["evidence_parity"] = ep
+                for msg in ep_fail:
+                    print(f"EVIDENCE DIGEST MISMATCH: {msg}",
+                          file=sys.stderr)
+                    rc = 1
+                print(f"evidence:   {ep}")
                 out["speedup"] = round(
                     out["service"]["throughput_rps"]
                     / out["sequential"]["throughput_rps"], 2)

@@ -23,6 +23,15 @@ jsonl (span intervals), not the rolled-up .json:
                the perf ledger (obs.regress) timing the analysis pass
                itself, so ``perfwatch gate`` flags analyzer-cost creep
 
+Verdict-provenance mode (jepsen_tpu.obs.provenance):
+
+  --provenance  decision-path audit table over the run's evidence
+               bundles (``<run-dir>/evidence/*.json``): one row per
+               verdict — source, checker, verdict, engine/backend
+               resolution, decision-path length, fault-event count,
+               and the stability-core digest — followed by each
+               bundle's decision path as a compact arrow chain.
+
 Any combination composes with ``--json`` (one merged JSON object).
 
 ``--diff`` answers "what got slower between these two runs": both runs'
@@ -136,6 +145,59 @@ def diff_summaries(path_a: Path, path_b: Path, *, as_json: bool) -> int:
     return 0
 
 
+def provenance_table(path: Path, *, as_json: bool) -> int:
+    """The --provenance mode: decision-path audit table over a run's
+    evidence bundles — the offline twin of the web run page's evidence
+    listing.  Corrupt bundles are skipped with a warning (they are
+    already quarantined aside by the durable reader); auditing them is
+    ``tools/evidence.py verify``'s job."""
+    from jepsen_tpu.obs import provenance
+    from jepsen_tpu.obs.summary import _table
+
+    p = Path(path)
+    run_dir = p if p.is_dir() else p.parent
+    doc: list[dict] = []
+    rows: list[list] = []
+    for bp, b in provenance.iter_bundles(run_dir):
+        steps = [str(e.get("event") or "?")
+                 for e in (b.get("decision_path") or [])]
+        faults = sum(1 for s in steps if s.startswith("fault."))
+        eng = b.get("engine") or {}
+        eng_s = str(eng.get("engine") or "?")
+        for k in ("backend", "graph_engine", "cycle_backend"):
+            if eng.get(k):
+                eng_s += f"/{eng[k]}"
+        doc.append({
+            "id": b.get("id"), "source": b.get("source"),
+            "checker": b.get("checker"), "verdict": b.get("verdict"),
+            "engine": eng, "decision_path": steps, "faults": faults,
+            "digest": b.get("digest"), "path": str(bp),
+        })
+        rows.append([
+            str(b.get("id"))[:12], str(b.get("source")),
+            str(b.get("checker")), str(b.get("verdict")), eng_s,
+            len(steps), faults, str(b.get("digest"))[:12],
+        ])
+    if as_json:
+        print(json.dumps({"provenance": doc}, indent=1, default=str))
+        return 0
+    if not rows:
+        print(f"no evidence bundles under {run_dir}/evidence (run "
+              "predates verdict provenance, or nothing was checked?)")
+        return 1
+    print(f"verdict provenance: {len(rows)} evidence bundle(s) under "
+          f"{run_dir}/evidence")
+    print(_table(["bundle", "source", "checker", "verdict", "engine",
+                  "steps", "faults", "digest"], rows), end="")
+    print("\ndecision paths (first 8 steps; tools/evidence.py "
+          "verify|replay re-certifies any bundle):")
+    for d in doc:
+        steps = d["decision_path"]
+        tail = f" ..+{len(steps) - 8}" if len(steps) > 8 else ""
+        print(f"  {str(d['id'])[:12]}: " + " -> ".join(steps[:8]) + tail)
+    return 0
+
+
 def analyze(path: Path, *, requests: bool, critpath: bool, devices: bool,
             as_json: bool, perf_record: bool) -> int:
     """The flight-analyzer modes over one run's raw event stream."""
@@ -216,6 +278,11 @@ def main(argv=None) -> int:
                     help="append a kind:'critpath' perf-ledger record "
                          "timing the analysis pass (perfwatch gates "
                          "analyzer-cost creep)")
+    ap.add_argument("--provenance", action="store_true",
+                    help="decision-path audit table over the run's "
+                         "evidence bundles (evidence/*.json): engine "
+                         "resolution, fallbacks, fault events, digest "
+                         "per verdict")
     ap.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
                     default=None,
                     help="diff two runs' stage tables instead of "
@@ -235,6 +302,8 @@ def main(argv=None) -> int:
         if opts.diff:
             return diff_summaries(Path(opts.diff[0]), Path(opts.diff[1]),
                                   as_json=opts.json)
+        if opts.provenance:
+            return provenance_table(Path(opts.path), as_json=opts.json)
         if analyzer:
             return analyze(
                 Path(opts.path), requests=opts.requests,
