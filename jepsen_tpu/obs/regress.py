@@ -657,7 +657,39 @@ def _default_runner(axis: str, *, histories: int = 6, ops: int = 30,
     env_var = "JEPSEN_TPU_" + axis.upper()
     caps = tuple(capacity)
 
+    def _mesh_run(value: str) -> list[float]:
+        # mesh-size axis (round 12): the value is a DEVICE COUNT, not an
+        # env knob — the same pinned workload through the ladder with the
+        # batch lane-sharded over an n-device mesh and the fused-kernel
+        # backend (the mesh-spanning wide stage is what the axis
+        # measures).  Needs the devices to exist before jax init (the
+        # caller sets --xla_force_host_platform_device_count for the
+        # virtual dev loop).
+        import jax
+
+        from jepsen_tpu.parallel import batch as _batch
+
+        n_dev = int(value)
+        if n_dev > len(jax.devices()):
+            raise ValueError(
+                f"mesh_devices={n_dev} but only {len(jax.devices())} jax "
+                "devices are visible (set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N for "
+                "the virtual dev loop)"
+            )
+        mesh = _batch.make_mesh(n_dev) if n_dev > 1 else None
+        kw = dict(mesh=mesh, dedup_backend="pallas")
+        batch_analysis(model, hists, capacity=caps, **kw)  # warm
+        times = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            batch_analysis(model, hists, capacity=caps, **kw)
+            times.append(time.perf_counter() - t0)
+        return times
+
     def run(value: str) -> list[float]:
+        if axis == "mesh_devices":
+            return _mesh_run(value)
         old = os.environ.get(env_var)
         os.environ[env_var] = str(value)
         try:
@@ -719,12 +751,14 @@ def run_competition(axis: str, values: Sequence[str], *,
         "margin_pct": round(margin_pct, 2),
         "workload": wl or "default fixed-work ladder",
     }
-    if "pallas" in verdict["values"]:
+    if "pallas" in verdict["values"] or axis == "mesh_devices":
         # Honest separation of chip records from CPU-interpret ones: a
         # pallas competitor that ran under the Pallas interpreter must
         # never pass for a chip measurement when the flip decision
         # reads the ledger (the fingerprint separates machines; this
-        # separates execution modes on the SAME machine).
+        # separates execution modes on the SAME machine).  The
+        # mesh_devices axis always runs the pallas backend, so it gets
+        # the same stamp.
         try:
             from jepsen_tpu.ops import wide_kernel
 

@@ -86,6 +86,10 @@ _STAGE_KEYS = (
     # the rows the chip-day flip decision reads next to the compete
     # ledger record
     "pallas_routed", "pallas_tile", "pallas_vmem_bytes", "pallas_interpret",
+    # mesh-spanning rungs (round 12): device count + the VMEM budget the
+    # routing gate compared against + whether the mesh stage could lift
+    # a rung the single-device budget spilled
+    "mesh_devices", "pallas_vmem_budget_bytes", "pallas_mesh_feasible",
 )
 
 
@@ -188,13 +192,14 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
                     if k in attrs:
                         row[k] = attrs[k]
                 ladder.append(row)
-            elif name == "dedup.round":
+            elif name in ("dedup.round", "dedup.mesh_round"):
                 # per-round dedup timing probes (ops.hashing.
-                # dedup_round_probe): one table row per (backend, shape),
-                # averaging repeated probes
+                # dedup_round_probe / sharded.mesh_round_probe): one
+                # table row per (backend, shape, mesh width), averaging
+                # repeated probes
                 key = (
                     attrs.get("backend"), attrs.get("candidates"),
-                    attrs.get("capacity"),
+                    attrs.get("capacity"), attrs.get("mesh_devices"),
                 )
                 d = dedup.setdefault(key, {
                     "backend": attrs.get("backend"),
@@ -202,6 +207,8 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
                     "capacity": attrs.get("capacity"),
                     "probes": 0, "_total_us": 0.0,
                 })
+                if attrs.get("mesh_devices") is not None:
+                    d["mesh_devices"] = int(attrs["mesh_devices"])
                 d["probes"] += 1
                 d["_total_us"] += float(attrs.get("per_round_us") or dur * 1e6)
                 if "interpret" in attrs:

@@ -374,10 +374,14 @@ def frontier_update_fast(
         # pl.pallas_call on feasible wide geometry; otherwise the round
         # statically routes down the bucket -> sort ladder, exactly
         # like an infeasible bucket geometry.  Lazy import: wide_kernel
-        # imports this module for the shared hash folds.
+        # imports this module for the shared hash folds.  w/g engage
+        # the VMEM working-set gate: a shape past the budget routes to
+        # bucket here — the mesh path (wide_kernel.mesh_frontier_update,
+        # routed by the engines when a Placement spans >1 device) is
+        # what lifts that ceiling.
         from jepsen_tpu.ops import wide_kernel
 
-        if wide_kernel.fused_feasible(n, capacity, max_count):
+        if wide_kernel.fused_feasible(n, capacity, max_count, w=w, g=g):
             return wide_kernel.fused_frontier_update(
                 state, fok, fcr, alive, cost, capacity, window=window,
                 n_parents=n_parents, max_count=max_count,

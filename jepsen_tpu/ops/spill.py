@@ -500,6 +500,8 @@ def undecidability_report(
     spill_bytes: int = 0,
     factor_count: int = 0,
     device_buffer_bytes: int | None = None,
+    mesh_devices: int | None = None,
+    per_device_rows: int | None = None,
     reason: str = "closure-overflow",
 ) -> dict:
     """The machine-readable record of WHY fixed memory could not decide:
@@ -508,7 +510,13 @@ def undecidability_report(
     (how much was already moved to host), and the budget in force at
     exhaustion.  Attached by the caller to the final ``unknown`` result
     (``"undecidability"`` key + a json rendering inside ``cause``) —
-    the result either decides or says exactly why it could not."""
+    the result either decides or says exactly why it could not.
+
+    ``mesh_devices``/``per_device_rows``: set when the exhausted stage
+    was the MESH-spanning fused kernel, so the report cites the honest
+    mesh capacity (devices × per-device rows) rather than implying a
+    single chip was the ceiling — spill engages only after the whole
+    mesh's capacity exhausts."""
     rep = {
         "reason": str(reason),
         "capacity": int(capacity),
@@ -527,6 +535,11 @@ def undecidability_report(
         rep["budget_rows"] = int(budget_rows)
     if device_buffer_bytes is not None:
         rep["device_buffer_bytes"] = int(device_buffer_bytes)
+    if mesh_devices is not None:
+        rep["mesh_devices"] = int(mesh_devices)
+        if per_device_rows is not None:
+            rep["per_device_rows"] = int(per_device_rows)
+            rep["mesh_capacity_rows"] = int(mesh_devices) * int(per_device_rows)
     _count("undecidable_reports")
     obs.event(
         "frontier.undecidable", barrier=rep["barrier"],

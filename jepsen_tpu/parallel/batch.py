@@ -1216,18 +1216,34 @@ def batch_analysis(
 
             _pP = max(packs[k]["P"] for k in group)
             _pG = max(packs[k]["G"] for k in group)
+            _pW = (_pP + 31) // 32
             _occ = _wk.stage_occupancy(batch_cap, _pP, _pG,
                                        max_count=_pP + 1)
+            # routed = the full gate (geometry AND the per-launch VMEM
+            # working-set model) — a rung the budget spills off the
+            # kernel must read as fallback in the stage rows, exactly
+            # like a geometry miss
             _routed = _wk.fused_feasible(
-                _occ["candidates"], batch_cap, _pP + 1)
+                _occ["candidates"], batch_cap, _pP + 1, w=_pW, g=_pG)
+            _n_mesh = int(mesh.devices.size) if mesh is not None else 1
             stage_attrs.update(
                 pallas_routed=_routed, pallas_tile=_occ["tile"],
                 pallas_vmem_bytes=_occ["vmem_bytes"],
+                pallas_vmem_budget_bytes=_occ["vmem_budget_bytes"],
                 pallas_interpret=_occ["interpret"],
+                mesh_devices=_n_mesh,
             )
             if not _routed:
                 obs.counter("dedup.pallas_fallback",
                             stage=si, capacity=batch_cap)
+                if _n_mesh > 1:
+                    # the mesh-spanning stage can still lift this rung:
+                    # record whether its per-device VMEM model says so
+                    _mocc = _wk.mesh_occupancy(
+                        batch_cap, _pP, _pG, W=_pW,
+                        max_count=_pP + 1, devices=_n_mesh)
+                    stage_attrs.update(
+                        pallas_mesh_feasible=_mocc["feasible"])
         # Measured-shape guard (round 5): the batched exact runner
         # faults the TPU worker on long-scan x wide-frontier shapes
         # (boundary table in wgl.exact_scan_safe).  Lanes past the
@@ -1524,6 +1540,84 @@ def batch_analysis(
         _save_checkpoint(
             min(rungs[k] for k in pending) if pending else si + 1
         )
+
+    if (exhausted and dedup == "pallas" and mesh is not None
+            and mesh.devices.size > 1):
+        # Mesh rescue (round 12): before the ladder admits defeat, the
+        # exhausted lanes get ONE run of the mesh-SPANNING fused stage —
+        # the whole mesh as a single frontier at devices x the top rung
+        # (the per-device VMEM model is what makes that capacity
+        # feasible where a single chip spills).  True is a constructive
+        # witness and lands outright; a refutation is hash-decided like
+        # every fast-engine False and is confirmed by the bounded exact
+        # sweep before it is reported; an unknown keeps the mesh-capacity
+        # undecidability report as its cause.
+        from jepsen_tpu.parallel import sharded as _sharded
+
+        _n_mesh = int(mesh.devices.size)
+        top_cap = max(batch_caps + exact_caps)
+        rescue_cap = top_cap * _n_mesh
+        t_rescue = time.perf_counter()
+        still_exhausted = []
+        for k in exhausted:
+            i = idxs[k]
+            if deadline is not None and deadline.expired():
+                still_exhausted.append(k)
+                continue
+            _pv(i, "route.mesh-kernel", capacity=rescue_cap,
+                mesh_devices=_n_mesh)
+            r = _sharded.mesh_kernel_analysis(
+                model, histories[i], mesh, capacity=(rescue_cap,),
+                rounds=int(rounds),
+            )
+            if r["valid?"] is True:
+                results[i] = r
+                no_fallback.add(i)
+                _pv(i, "mesh-kernel.resolved", outcome="valid")
+                _notify(i)
+                continue
+            if r["valid?"] is False:
+                if not confirm_refutations:
+                    # unconfirmed fast-engine False: carries its honest
+                    # provisional? flag, same contract as the ladder
+                    results[i] = r
+                    no_fallback.add(i)
+                    _pv(i, "mesh-kernel.resolved",
+                        outcome="refuted-provisional")
+                    _notify(i)
+                    continue
+                fat = int(r.get("kernel", {}).get("failed-at", -1))
+                op_pos = (int(packs[k]["bar_opid"][fat])
+                          if fat >= 0 else None)
+                cpu_res = wgl_cpu.sweep_analysis(
+                    model, histories[i],
+                    max_configs=confirm_max_configs,
+                    stop_at_index=op_pos,
+                )
+                results[i] = _resolve_confirmation(r, cpu_res)
+                decided = results[i].get("valid?") != "unknown"
+                _pv(i, "mesh-kernel.resolved" if decided
+                    else "mesh-kernel.unconfirmed",
+                    outcome=_prov.verdict_str(results[i].get("valid?")))
+                if decided:
+                    no_fallback.add(i)
+                    _notify(i)
+                    continue
+                still_exhausted.append(k)
+                continue
+            # unknown even at mesh capacity: the mesh-capacity
+            # undecidability report becomes the attributable cause
+            if r.get("cause"):
+                results[i] = r
+            _pv(i, "mesh-kernel.exhausted")
+            still_exhausted.append(k)
+        obs.span_event(
+            "ladder.mesh_rescue", time.perf_counter() - t_rescue,
+            capacity=rescue_cap, mesh_devices=_n_mesh,
+            lanes=len(exhausted),
+            resolved=len(exhausted) - len(still_exhausted),
+        )
+        exhausted = still_exhausted
 
     if exhausted:
         # The lanes the whole ladder failed to resolve: close the

@@ -39,6 +39,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jepsen_tpu import _platform, obs
 from jepsen_tpu import models as m
 from jepsen_tpu.ops import wgl
+from jepsen_tpu.ops import wide_kernel
+from jepsen_tpu.ops import spill as spill_mod
 from jepsen_tpu.ops.hashing import frontier_update, hash_rows
 
 I32 = jnp.int32
@@ -269,15 +271,18 @@ def lane_shard(fn, mesh: Mesh, *, n_args: int, replicated: Sequence[int] = (),
 def forget_mesh(mesh: Mesh) -> int:
     """Evict every cached runner compiled for ``mesh`` (device-loss
     re-placement: a shrunk-away mesh's compiled wrappers pin references
-    to the lost devices and could never launch again anyway).  Returns
-    the number of cache entries dropped."""
-    dead = [k for k in _LANE_SHARDED if any(v is mesh for v in k)]
-    for k in dead:
-        del _LANE_SHARDED[k]
-    dead_r = [k for k in _SHARDED_RUNNERS if any(v is mesh for v in k)]
-    for k in dead_r:
-        del _SHARDED_RUNNERS[k]
-    return len(dead) + len(dead_r)
+    to the lost devices and could never launch again anyway) — the
+    lane-sharded wrappers, the sharded-frontier runners, AND the
+    mesh-kernel runners (engine + eager update).  Returns the number of
+    cache entries dropped."""
+    n = 0
+    for cache in (_LANE_SHARDED, _SHARDED_RUNNERS, _MESH_RUNNERS,
+                  _MESH_UPDATE_RUNNERS):
+        dead = [k for k in cache if any(v is mesh for v in k)]
+        for k in dead:
+            del cache[k]
+        n += len(dead)
+    return n
 
 
 def _sharded_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int):
@@ -367,4 +372,380 @@ def sharded_analysis(
             "op": op,
             "kernel": stats,
         }
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Mesh-kernel engine: the fused Pallas wide stage spanning the whole mesh
+# ---------------------------------------------------------------------------
+
+
+def _run_core_mesh(
+    axis,
+    D,
+    step,
+    Fl,
+    R,
+    P_,
+    G,
+    W,
+    window,
+    interp,
+    init_state,
+    bar_active,
+    bar_f,
+    bar_v1,
+    bar_v2,
+    bar_slot,
+    mov_f,
+    mov_v1,
+    mov_v2,
+    mov_open,
+    grp_f,
+    grp_v1,
+    grp_v2,
+    grp_open,
+    slot_lane,
+    slot_onehot,
+):
+    """Per-device body (under shard_map) of the MESH-KERNEL engine:
+    ``_run_core_sharded``'s scan skeleton with steps 2–4 (all_to_all
+    exchange + sort-based local update + fingerprint fixpoint) replaced
+    by ONE ``wide_kernel.mesh_frontier_update`` — remote-DMA routing and
+    the fused dedup/domination/compaction kernel, with the fast engine's
+    child-no-growth fixpoint (psum'd, so the while_loop agrees across
+    shards).  Fl = per-device frontier capacity."""
+    eye_g = jnp.eye(G, dtype=I32)
+    slot_mask = slot_onehot.sum(axis=1)
+
+    def expand_round(val):
+        state, fok, fcr, alive, r, changed, lossy, xs = val
+        (xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open) = xs
+        cat_state, cat_fok, cat_fcr, cat_alive, cost = wgl.expand_candidates(
+            step, eye_g, slot_lane, slot_mask, slot_onehot,
+            state, fok, fcr, alive,
+            xmov_f, xmov_v1, xmov_v2, xmov_open,
+            grp_f, grp_v1, grp_v2, xgrp_open,
+        )
+        state2, fok2, fcr2, alive2, ovf, _fp, child = (
+            wide_kernel.mesh_frontier_update(
+                axis, D, cat_state, cat_fok, cat_fcr, cat_alive, cost, Fl,
+                window=window, n_parents=Fl,
+                max_count=xmov_f.shape[-1] + 1, interpret=interp,
+            )
+        )
+        # ovf is already psum'd global; growth must be too, or shards
+        # would disagree on the while_loop predicate.
+        grew = jax.lax.psum((alive2 & child).any().astype(I32), axis) > 0
+        return (state2, fok2, fcr2, alive2, r + 1, grew, lossy | ovf, xs)
+
+    def round_cond(val):
+        _s, _fo, _fc, _a, r, changed, _l, _xs = val
+        return (r < R) & changed
+
+    def barrier(carry, xs):
+        state, fok, fcr, alive, failed_at, lossy, peak = carry
+        b_idx, active, xbar_slot, xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open = xs
+        done = (failed_at >= 0) | ~active
+
+        def process(_):
+            xs_inner = (xmov_f, xmov_v1, xmov_v2, xmov_open, xgrp_open)
+            s2, fo2, fc2, a2, _r, changed, lossy2, _ = jax.lax.while_loop(
+                round_cond,
+                expand_round,
+                (state, fok, fcr, alive, jnp.int32(0), jnp.bool_(True), lossy, xs_inner),
+            )
+            lossy3 = lossy2 | changed
+            lane = xbar_slot // 32
+            bitmask = (U32(1) << (xbar_slot % 32).astype(U32))
+            lane_vals = jnp.take(fo2, lane[None], axis=1)[:, 0]
+            a3 = a2 & ((lane_vals & bitmask) != 0)
+            clear = jnp.where(jnp.arange(W) == lane, bitmask, U32(0))
+            fo3 = fo2 & ~clear[None, :]
+            n_alive = jax.lax.psum(a3.sum(), axis)
+            dead = n_alive == 0
+            failed2 = jnp.where(dead, b_idx, failed_at)
+            peak2 = jnp.maximum(peak, n_alive)
+            return (s2, fo3, fc2, a3, failed2, lossy3, peak2)
+
+        def skip(_):
+            return (state, fok, fcr, alive, failed_at, lossy, peak)
+
+        return jax.lax.cond(done, skip, process, None), None
+
+    state0 = jnp.full((Fl,), init_state, I32)
+    fok0 = jnp.zeros((Fl, W), U32)
+    fcr0 = jnp.zeros((Fl, G), I32)
+    me = jax.lax.axis_index(axis)
+    alive0 = jnp.zeros((Fl,), bool).at[0].set(me == 0)
+    carry0 = (state0, fok0, fcr0, alive0, jnp.int32(-1), jnp.bool_(False), jnp.int32(1))
+    xs = (
+        jnp.arange(bar_f.shape[0], dtype=I32),
+        bar_active,
+        bar_slot,
+        mov_f,
+        mov_v1,
+        mov_v2,
+        mov_open,
+        grp_open,
+    )
+    (state, fok, fcr, alive, failed_at, lossy, peak), _ = jax.lax.scan(barrier, carry0, xs)
+    any_alive = jax.lax.psum(alive.any().astype(I32), axis) > 0
+    return any_alive, failed_at, lossy, peak
+
+
+#: (mesh, step, Fl, R, P, G, W, window, interpret) -> mesh-kernel runner.
+_MESH_RUNNERS: dict = {}
+
+#: (mesh, n, w, g, capacity, window, max_count, interpret, fcr dtype)
+#: -> eager global-table mesh update (tests/probes).
+_MESH_UPDATE_RUNNERS: dict = {}
+
+
+def _mesh_runner(mesh: Mesh, step, Fl: int, R: int, P_: int, G: int, W: int,
+                 window: int, interp: bool):
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+    key = (mesh, step, Fl, R, P_, G, W, window, interp)
+    if key not in _MESH_RUNNERS:
+        core = functools.partial(
+            _run_core_mesh, axis, D, step, Fl, R, P_, G, W, window, interp
+        )
+        fn = _platform.shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(P(),) * 16,
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+        _MESH_RUNNERS[key] = jax.jit(fn)
+    return _MESH_RUNNERS[key]
+
+
+def mesh_update(mesh: Mesh, state, fok, fcr, alive, cost, capacity: int, *,
+                window: int = 4, n_parents: int | None = None,
+                max_count: int | None = None,
+                interpret: bool | None = None):
+    """Eager global-table entry to the mesh-spanning fused stage (tests,
+    probes, differential suites): shard the [n] candidate table row-wise
+    across ``mesh``, run ``wide_kernel.mesh_frontier_update`` per shard,
+    and return the concatenated global outputs (state', fok', fcr',
+    alive', overflowed, fp, child).  ``capacity`` is GLOBAL (split
+    evenly).  Alive rows land in their class-hash owner's block, so
+    POSITIONS are not comparable to the single-device kernel; the
+    surviving content set, the child bits, ``overflowed`` and the
+    order-insensitive ``fp`` are — that is the cross-path differential
+    contract."""
+    D = int(mesh.devices.size)
+    axis = mesh.axis_names[0]
+    n = int(state.shape[0])
+    w, g = int(fok.shape[1]), int(fcr.shape[1])
+    cap_d = int(capacity) // D
+    if interpret is None:
+        interpret = wide_kernel.interpret_default()
+    mc = None if max_count is None else int(max_count)
+    key = (mesh, n, w, g, int(capacity), int(window), mc, bool(interpret),
+           str(jnp.asarray(fcr).dtype))
+    if key not in _MESH_UPDATE_RUNNERS:
+
+        def body(st, fo, fc, al, ch):
+            return wide_kernel.mesh_frontier_update(
+                axis, D, st, fo, fc, al, jnp.zeros_like(st), cap_d,
+                window=int(window), max_count=mc,
+                interpret=bool(interpret), child=ch != 0,
+            )
+
+        fn = _platform.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis),) * 5,
+            out_specs=(P(axis),) * 4 + (P(), P()) + (P(axis),),
+            check_vma=False,
+        )
+        _MESH_UPDATE_RUNNERS[key] = jax.jit(fn)
+    if n_parents is not None:
+        child = jnp.arange(n, dtype=I32) >= np.int32(int(n_parents))
+    else:
+        child = jnp.zeros((n,), bool)
+    return _MESH_UPDATE_RUNNERS[key](
+        jnp.asarray(state), jnp.asarray(fok), jnp.asarray(fcr),
+        jnp.asarray(alive), child.astype(I32),
+    )
+
+
+def mesh_round_probe(mesh: Mesh, capacity: int, P_: int, G: int, W: int = 1,
+                     rounds: int = 3, seed: int = 0, emit: bool = True) -> dict:
+    """Measure per-round mesh-stage time at a rung's GLOBAL candidate
+    shape — the mesh counterpart of ``hashing.dedup_round_probe``, one
+    ``dedup.mesh_round`` span (attrs: mesh_devices, candidates,
+    capacity, rounds, per_round_us, interpret — interpret-mode CPU
+    probes never pass for chip measurements).  Returns
+    ``{"mesh": seconds per round, "occupancy": mesh_occupancy dict}``;
+    an infeasible shape bumps the ``dedup.mesh_fallback`` counter and
+    returns without timing (the engines would have routed it away too)."""
+    from jepsen_tpu.ops import hashing as hx
+
+    D = int(mesh.devices.size)
+    occ = wide_kernel.mesh_occupancy(
+        int(capacity), P_, G, W=W, max_count=P_ + 1, devices=D
+    )
+    if not occ["feasible"]:
+        obs.counter("dedup.mesh_fallback", capacity=int(capacity),
+                    mesh_devices=D)
+        return {"mesh": None, "occupancy": occ}
+    state, fok, fcr, alive = hx.probe_candidates(int(capacity), P_, G, W, seed)
+    n = int(state.shape[0])
+    args = (jnp.asarray(state), jnp.asarray(fok), jnp.asarray(fcr),
+            jnp.asarray(alive), jnp.zeros((n,), I32))
+    out = mesh_update(mesh, *args, int(capacity), window=4,
+                      n_parents=int(capacity), max_count=P_ + 1)
+    jax.block_until_ready(out)  # compile outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(max(1, int(rounds))):
+        out = mesh_update(mesh, *args, int(capacity), window=4,
+                          n_parents=int(capacity), max_count=P_ + 1)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / max(1, int(rounds))
+    if emit:
+        obs.span_event(
+            "dedup.mesh_round", dt, backend="pallas", mesh_devices=D,
+            candidates=n, capacity=int(capacity), rounds=int(rounds),
+            per_round_us=round(dt * 1e6, 1), interpret=occ["interpret"],
+        )
+    return {"mesh": dt, "occupancy": occ}
+
+
+def _mesh_rung_geometry(cap: int, D: int, packed: dict) -> tuple[int, int, bool]:
+    """(Fl, max_count, feasible) for one ladder rung of ``cap`` total
+    rows on a ``D``-device mesh: Fl is the per-device frontier slice,
+    rounded up to the fused kernel's 64-row granule."""
+    Fl = max(8, (int(cap) + D - 1) // D)
+    Fl = ((Fl + 63) // 64) * 64
+    max_count = int(packed["mov"][0].shape[-1]) + 1
+    n_loc = Fl * (1 + int(packed["P"]) + int(packed["G"]))
+    feasible = wide_kernel.mesh_feasible(
+        D * n_loc, D * Fl, max_count, D,
+        w=int(packed["W"]), g=int(packed["G"]),
+    )
+    return Fl, max_count, feasible
+
+
+def mesh_kernel_analysis(
+    model: m.Model,
+    history: Sequence[dict],
+    mesh: Mesh,
+    capacity: int | Sequence[int] = (8192,),
+    rounds: int = 8,
+    window: int = 4,
+    max_groups: int = 64,
+    max_procs: int = 128,
+) -> dict:
+    """Decide ONE history with the mesh-spanning fused Pallas wide stage
+    — the whole frontier update (hash routing over remote DMA + fused
+    dedup/domination/compaction) as one kernel program across every
+    device of ``mesh``.  ``capacity`` is the TOTAL frontier size per
+    rung (split evenly; the per-device VMEM model is what makes rungs
+    beyond the single-chip ceiling feasible here).
+
+    Fast-path semantics: kills are hash-decided, so a False verdict is
+    marked ``provisional?`` exactly like ``wgl.analysis(fast=True)`` —
+    callers confirm refutations before reporting them.  True is a
+    constructive witness (always sound); an exhausted ladder returns an
+    ``unknown`` whose undecidability report cites the MESH capacity
+    (devices × per-device rows).
+
+    Static fallback: a mesh with <2 devices or an infeasible
+    geometry/VMEM shape routes to the single-device pallas ladder
+    (``wgl.analysis`` with ``dedup_backend="pallas"``, which itself
+    falls back to bucket/sort) — the device-loss path after
+    ``Placement.shrink_to`` lands here with verdicts unchanged."""
+    D = int(mesh.devices.size)
+    try:
+        packed = wgl.pack(model, history)
+    except wgl.NotTensorizable as e:
+        return {"valid?": "unknown", "cause": f"not tensorizable: {e}"}
+    if packed["B"] == 0:
+        return {"valid?": True}
+    if packed["G"] > max_groups:
+        return {"valid?": "unknown", "cause": f"{packed['G']} crashed-op groups exceeds {max_groups}"}
+    if packed["P"] > max_procs:
+        return {"valid?": "unknown", "cause": f"{packed['P']} process slots exceeds {max_procs}"}
+    packed = wgl.pad_packed(packed)
+
+    capacities = [capacity] if isinstance(capacity, int) else list(capacity)
+    interp = wide_kernel.interpret_default()
+    infeasible = D < 2 or any(
+        not _mesh_rung_geometry(cap, D, packed)[2] for cap in capacities
+    )
+    if infeasible:
+        obs.counter("dedup.mesh_fallback", mesh_devices=D,
+                    capacity=int(max(capacities)))
+        return wgl.analysis(
+            model, history, capacity=tuple(int(c) for c in capacities),
+            rounds=int(rounds), max_groups=max_groups, max_procs=max_procs,
+            fast=True, dedup_backend="pallas",
+        )
+
+    from jepsen_tpu.parallel.batch import mesh_device_ids
+
+    dev_ids = mesh_device_ids(mesh)
+    result = None
+    for cap in capacities:
+        Fl, _mc, _ok = _mesh_rung_geometry(cap, D, packed)
+        runner = _mesh_runner(
+            mesh, packed["step"], Fl, int(rounds), packed["P"], packed["G"],
+            packed["W"], int(window), bool(interp),
+        )
+        with obs.span("sharded.mesh_launch", devices=dev_ids, mesh_devices=D,
+                      capacity=Fl * D, per_device_capacity=Fl,
+                      interpret=bool(interp)):
+            valid, failed_at, lossy, peak = runner(
+                packed["init_state"],
+                packed["bar_active"],
+                *packed["bar"],
+                *packed["mov"],
+                *packed["grp"],
+                packed["grp_open"],
+                jnp.asarray(packed["slot_lane"]),
+                jnp.asarray(packed["slot_onehot"]),
+            )
+            jax.block_until_ready((valid, failed_at, lossy, peak))
+        valid = bool(valid)
+        failed_at = int(failed_at)
+        lossy = bool(lossy)
+        stats = {
+            "frontier-peak": int(peak),
+            "capacity": Fl * D,
+            "per-device-capacity": Fl,
+            "devices": D,
+            "mesh_devices": D,
+            "lossy?": lossy,
+            "interpret": bool(interp),
+            "failed-at": failed_at,
+        }
+        if failed_at < 0 and valid:
+            return {"valid?": True, "kernel": stats}
+        op = history[int(packed["bar_opid"][failed_at])] if failed_at >= 0 else None
+        if not lossy:
+            # hash-decided kills: provisional, same contract as the
+            # single-device fast path (callers confirm before reporting)
+            return {"valid?": False, "op": op, "kernel": stats,
+                    "provisional?": True}
+        result = {
+            "valid?": "unknown",
+            "op": op,
+            "kernel": stats,
+        }
+    rep = spill_mod.undecidability_report(
+        capacity=int(max(capacities)),
+        frontier_rows=stats["capacity"],
+        peak_frontier=stats["frontier-peak"],
+        barrier=failed_at if failed_at >= 0 else int(packed["B"]),
+        barriers_total=int(packed["B"]),
+        mesh_devices=D,
+        per_device_rows=stats["per-device-capacity"],
+        reason="mesh-capacity",
+    )
+    result["undecidability"] = rep
+    result["cause"] = spill_mod.undecidable_cause(rep)
     return result

@@ -15,6 +15,21 @@ launch must produce verdicts identical to single-device execution.
 ``__graft_entry__.dryrun_multichip`` asserts for the production
 ladder), runnable at service start (``verify_placement=True``) and in
 the test suite.
+
+Mesh-kernel routing (round 12): with ``dedup_backend="pallas"`` and a
+>1-device placement, a ladder that exhausts its capacity rungs rescues
+the unresolved lanes on the mesh-SPANNING fused wide stage
+(``parallel.sharded.mesh_kernel_analysis``) — candidate rows hash-route
+to their class-owner device over remote-DMA ring exchanges and the
+fused dedup/domination/compaction runs per shard, so the feasible
+frontier capacity scales linearly with mesh size.  The routing is
+static and honest: a 1-device placement (including one produced by
+``shrink_to`` after device loss) or an infeasible per-device VMEM shape
+falls back to the single-device pallas ladder (then bucket/sort) with
+verdicts unchanged — ``shrink_to`` evicts the dead mesh's compiled
+mesh-kernel runners along with the lane-shard kernels, so a mid-run
+loss drains at the rung boundary and re-routes instead of relaunching
+on a dead device.
 """
 
 from __future__ import annotations
@@ -110,8 +125,14 @@ class Placement:
     def shrink_to(self, devices: Sequence) -> None:
         """Re-place onto the surviving devices (device-loss recovery):
         rebuild the 1-D mesh over ``devices``, bump the generation so
-        running ladders drain, and evict the dead mesh's compiled
-        lane-shard kernels (they hold references to lost devices)."""
+        running ladders drain at their next rung boundary, and evict the
+        dead mesh's compiled kernels — the lane-shard runners AND the
+        mesh-spanning fused-stage runners (``sharded.forget_mesh``
+        clears both; they hold references to lost devices).  A carried
+        frontier resumes on the shrunk placement: if only one device
+        survives, the mesh-kernel path statically routes to the
+        single-device pallas ladder (then bucket/sort) with verdicts
+        unchanged."""
         import numpy as np
 
         from jepsen_tpu.parallel import sharded
@@ -134,6 +155,10 @@ class Placement:
         return {
             "devices": self.n_devices,
             "sharded": self.mesh is not None,
+            # the mesh-spanning fused stage engages only beyond one
+            # device — operators read this to know which dedup path a
+            # pallas ladder's rescue rung will take
+            "mesh_kernel": self.n_devices > 1,
             **({"lost_devices": len(self.lost),
                 "generation": self.generation} if self.generation else {}),
         }

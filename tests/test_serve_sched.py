@@ -237,7 +237,8 @@ def test_service_mesh_placement_end_to_end():
     got = [f.result(timeout=60) for f in futs]
     assert [r["valid?"] for r in got] == [r["valid?"] for r in direct]
     st = svc.stats()
-    assert st["placement"] == {"devices": 8, "sharded": True}
+    assert st["placement"] == {"devices": 8, "sharded": True,
+                               "mesh_kernel": True}
     assert svc._parity_checked
 
 

@@ -466,3 +466,345 @@ def test_compete_default_is_three_way_with_interpret_stamp(tmp_path,
     assert rec["extra"]["values"] == ["sort", "bucket", "pallas"]
     assert rec["extra"]["winner"] == "bucket"
     assert rec["extra"]["pallas_interpret"] is True  # CPU: honest tag
+
+
+# ---------------------------------------------------------------------------
+# Mesh-spanning wide stage (round 12): virtual 4-device mesh differentials
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.parallel import make_mesh  # noqa: E402
+from jepsen_tpu.parallel import sharded as sh  # noqa: E402
+
+MESH_D = 4
+MESH_CAP = 256          # global; 64 rows per device (suite-shared shape)
+MESH_P, MESH_G, MESH_W = 4, 3, 1
+MESH_N = MESH_CAP * (1 + MESH_P + MESH_G)
+
+
+@pytest.fixture(scope="module")
+def fmesh():
+    return make_mesh(MESH_D, axis="frontier")
+
+
+def _mesh_gen(seed, n=MESH_N, alive_p=0.6):
+    """Small-content-space candidate tables: unique contents stay well
+    under the 2*cap_d stage-1 buffer per shard, so non-overflow rounds
+    dominate and the differential is non-vacuous."""
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.integers(0, 5, n).astype(np.int32)),
+        jnp.asarray(rng.integers(0, 3, (n, MESH_W)).astype(np.uint32)),
+        jnp.asarray(rng.integers(0, 2, (n, MESH_G)).astype(np.int16)),
+        jnp.asarray(rng.random(n) < alive_p),
+    )
+
+
+def _content_child(state, fok, fcr, alive, child):
+    s, f, c, a, ch = (np.asarray(x) for x in (state, fok, fcr, alive, child))
+    return {
+        (int(s[i]), tuple(int(x) for x in f[i]),
+         tuple(int(x) for x in c[i]), bool(ch[i]))
+        for i in np.flatnonzero(a)
+    }
+
+
+def _mesh_fp0(fp):
+    """The psum'd fingerprint is replicated; out_specs P() may still hand
+    back one copy per shard — collapse to one and assert uniformity."""
+    fp = np.asarray(fp)
+    if fp.size > 3:
+        fp = fp.reshape(-1, 3)
+        assert (fp == fp[0]).all(), "psum'd fingerprint not uniform"
+        return fp[0]
+    return fp
+
+
+def test_mesh_exchange_roundtrip(fmesh):
+    """Remote-DMA ring exchange data integrity: slot s of device m's
+    received table came from device (m - s) % D, bit-for-bit."""
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu import _platform
+
+    D, rcap, nc = MESH_D, 8, 4
+
+    def body():
+        me = jax.lax.axis_index("frontier")
+        send = (me * D + jnp.arange(D, dtype=jnp.int32))[:, None, None]
+        send = jnp.broadcast_to(send, (D, rcap, nc)).astype(jnp.int32)
+        return wk.mesh_exchange("frontier", D, send, interpret=True)
+
+    fn = jax.jit(_platform.shard_map(
+        body, mesh=fmesh, in_specs=(), out_specs=P("frontier"),
+        check_vma=False,
+    ))
+    out = np.asarray(fn()).reshape(D, D, rcap, nc)
+    for m in range(D):
+        for s in range(D):
+            want = ((m - s) % D) * D + s
+            assert (out[m, s] == want).all(), (m, s)
+
+
+def test_mesh_differential_randomized(fmesh):
+    """Bit-identity of the surviving CONTENT set (incl. child bits),
+    order-insensitive fingerprint, and overflow flag vs the single-device
+    fused kernel at the same GLOBAL capacity.  Positions are shard-owned
+    on the mesh, so content/fingerprint is the cross-path contract."""
+    compared = 0
+    for seed in range(4):
+        args = _mesh_gen(seed)
+        cost = jnp.zeros(MESH_N, jnp.int32)
+        ref = wk.fused_update_jit(*args, cost, MESH_CAP, window=4,
+                                  n_parents=MESH_CAP,
+                                  max_count=MESH_P + 1, interpret=True)
+        got = sh.mesh_update(fmesh, *args, cost, MESH_CAP,
+                             n_parents=MESH_CAP, max_count=MESH_P + 1)
+        ovf_ref = bool(ref[4])
+        ovf_got = bool(np.asarray(got[4]).ravel()[0])
+        assert ovf_got == ovf_ref, seed
+        if ovf_ref:
+            continue  # both honest-lossy: contents may differ
+        compared += 1
+        assert (_content_child(got[0], got[1], got[2], got[3], got[6])
+                == _content_child(ref[0], ref[1], ref[2], ref[3], ref[6])), seed
+        assert np.array_equal(_mesh_fp0(got[5]), np.asarray(ref[5])), seed
+    assert compared >= 3  # the differential must not be vacuous
+
+
+def test_mesh_update_positions_deterministic(fmesh):
+    """Same inputs -> bit-identical outputs including POSITIONS: the
+    hash routing, rank scatter and parents-first partition are all
+    deterministic, so replay/audit reproducibility holds on the mesh."""
+    args = _mesh_gen(1)
+    cost = jnp.zeros(MESH_N, jnp.int32)
+    a = sh.mesh_update(fmesh, *args, cost, MESH_CAP,
+                       n_parents=MESH_CAP, max_count=MESH_P + 1)
+    b = sh.mesh_update(fmesh, *args, cost, MESH_CAP,
+                       n_parents=MESH_CAP, max_count=MESH_P + 1)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_mesh_ragged_and_all_to_one_routing(fmesh):
+    """Edge geometry: (a) ragged shard occupancy (all live rows in one
+    input shard) still matches the single-device content set; (b) every
+    row in one routing class overflows its owner's fixed receive slot ->
+    the HONEST spill flag, never silent loss."""
+    # (a) ragged: only the first quarter of the table is alive
+    st, fo, fc, al = _mesh_gen(2)
+    ragged = jnp.where(jnp.arange(MESH_N) < MESH_N // 4, al, False)
+    cost = jnp.zeros(MESH_N, jnp.int32)
+    ref = wk.fused_update_jit(st, fo, fc, ragged, cost, MESH_CAP, window=4,
+                              n_parents=MESH_CAP, max_count=MESH_P + 1,
+                              interpret=True)
+    got = sh.mesh_update(fmesh, st, fo, fc, ragged, cost, MESH_CAP,
+                         n_parents=MESH_CAP, max_count=MESH_P + 1)
+    assert not bool(ref[4])
+    assert not bool(np.asarray(got[4]).ravel()[0])
+    assert (_content_child(got[0], got[1], got[2], got[3], got[6])
+            == _content_child(ref[0], ref[1], ref[2], ref[3], ref[6]))
+    # (b) all-to-one: one (state, fok) class -> one owner device; the
+    # class's live rows exceed rcap (1.5x-headroom slot), so the round
+    # must raise the global overflow flag
+    n_loc = MESH_N // MESH_D
+    assert n_loc > wk.mesh_rcap(n_loc, MESH_D)
+    st1 = jnp.zeros(MESH_N, jnp.int32)
+    fo1 = jnp.zeros((MESH_N, MESH_W), jnp.uint32)
+    fc1 = jnp.asarray(
+        np.arange(MESH_N)[:, None].repeat(MESH_G, 1).astype(np.int16))
+    got1 = sh.mesh_update(fmesh, st1, fo1, fc1,
+                          jnp.ones(MESH_N, bool), cost, MESH_CAP,
+                          n_parents=MESH_CAP, max_count=MESH_P + 1)
+    assert bool(np.asarray(got1[4]).ravel()[0])  # honest overflow
+
+
+def test_mesh_feasibility_gates():
+    P_, G = 8, 4
+    W = (P_ + 31) // 32
+    mc = P_ + 1
+
+    def caps(c, d):
+        n = c * (1 + P_ + G)
+        return wk.mesh_feasible(n, c, mc, d, w=W, g=G)
+
+    assert not caps(2048, 1)                 # mesh needs >= 2 devices
+    assert not wk.mesh_feasible(13 * 100, 100, mc, 4, w=W, g=G)  # cap % D
+    # the round-12 scaling claim: per-device VMEM model lifts the
+    # feasible capacity linearly with mesh size
+    assert wk.fused_feasible(2048 * 13, 2048, mc, w=W, g=G)
+    assert not wk.fused_feasible(4096 * 13, 4096, mc, w=W, g=G)
+    assert caps(4096, 2)
+    assert caps(8192, 4)
+    assert not caps(16384, 4)
+    occ = wk.mesh_occupancy(8192, P_, G, W=W, max_count=mc, devices=4)
+    assert occ["feasible"] and occ["devices"] == 4
+    assert occ["per_device_capacity"] == 2048
+    assert occ["interpret"] is True
+    assert occ["local_vmem_bytes"] <= occ["vmem_budget_bytes"]
+    assert occ["exchange_vmem_bytes"] <= occ["vmem_budget_bytes"]
+
+
+def test_mesh_engine_verdict_differential(fmesh):
+    """mesh_kernel_analysis vs the CPU oracle on valid AND corrupted
+    histories; False verdicts carry the fast-path provisional? flag."""
+    from jepsen_tpu.checker import wgl_cpu
+
+    model = m.CASRegister(None)
+    for seed in range(2):
+        hist = valid_register_history(40, 4, seed=seed, info_rate=0.1)
+        r = sh.mesh_kernel_analysis(model, hist, fmesh, capacity=(64, 256))
+        assert r["valid?"] is True, r
+        assert r["kernel"]["mesh_devices"] == MESH_D
+        assert r["kernel"]["interpret"] is True
+    decided = 0
+    for seed in range(4):
+        hist = corrupt(valid_register_history(30, 3, seed=seed,
+                                              info_rate=0.1), seed=seed)
+        r = sh.mesh_kernel_analysis(model, hist, fmesh, capacity=(64, 256))
+        c = wgl_cpu.dfs_analysis(model, hist)
+        if r["valid?"] != "unknown":
+            assert r["valid?"] == c["valid?"], (seed, r, c)
+            if r["valid?"] is False:
+                assert r.get("provisional?") is True  # hash-decided kills
+            decided += 1
+    assert decided >= 3
+    assert sh.mesh_kernel_analysis(model, [], fmesh)["valid?"] is True
+
+
+def test_mesh_engine_single_device_fallback():
+    """A 1-device placement (the post-device-loss shape) statically
+    routes to the single-device pallas ladder with verdicts unchanged."""
+    model = m.CASRegister(None)
+    hist = valid_register_history(20, 3, seed=0, info_rate=0.1)
+    m1 = make_mesh(1, axis="frontier")
+    r = sh.mesh_kernel_analysis(model, hist, m1, capacity=(64,))
+    assert r["valid?"] is True
+
+
+def test_mesh_unknown_carries_mesh_capacity_report(fmesh):
+    """An exhausted mesh ladder cites the MESH capacity — devices x
+    per-device rows — in its machine-readable undecidability report."""
+    from jepsen_tpu.ops import spill as sp
+
+    model = m.CASRegister(None)
+    hist = corrupt(valid_register_history(40, 4, seed=5, info_rate=0.35),
+                   seed=5)
+    # rounds=1 starves closure: the frontier dies mid-expansion with the
+    # lossy flag up, so the (only) rung ends unknown deterministically
+    r = sh.mesh_kernel_analysis(model, hist, fmesh, capacity=(256,),
+                                rounds=1)
+    assert r["valid?"] == "unknown"
+    rep = r["undecidability"]
+    assert rep["mesh_devices"] == MESH_D
+    assert rep["per_device_rows"] * rep["mesh_devices"] \
+        == rep["mesh_capacity_rows"]
+    assert "mesh_capacity_rows" in r["cause"]
+    assert sp.undecidable_cause(rep) == r["cause"]
+
+
+def test_forget_mesh_evicts_mesh_kernel_runners(fmesh):
+    """Device loss: forget_mesh must drop the mesh-kernel compile caches
+    (they hold references to the dead mesh's devices) along with the
+    lane-shard runners."""
+    model = m.CASRegister(None)
+    hist = valid_register_history(20, 3, seed=1, info_rate=0.1)
+    sh.mesh_kernel_analysis(model, hist, fmesh, capacity=(64,))
+    args = _mesh_gen(0)
+    sh.mesh_update(fmesh, *args, jnp.zeros(MESH_N, jnp.int32), MESH_CAP,
+                   n_parents=MESH_CAP, max_count=MESH_P + 1)
+    stale = [k for c in (sh._MESH_RUNNERS, sh._MESH_UPDATE_RUNNERS)
+             for k in c if any(v is fmesh for v in k)]
+    assert stale, "expected compiled mesh-kernel runners in the caches"
+    sh.forget_mesh(fmesh)
+    left = [k for c in (sh._MESH_RUNNERS, sh._MESH_UPDATE_RUNNERS)
+            for k in c if any(v is fmesh for v in k)]
+    assert not left
+
+
+def test_mesh_rescue_in_batch_ladder(fmesh, tmp_path):
+    """An exhausted pallas ladder on a >1-device placement rescues its
+    unknowns on the mesh-spanning stage (provenance records the route;
+    the verdict carries mesh attrs)."""
+    from jepsen_tpu import obs
+
+    model = m.CASRegister(None)
+    hist = valid_register_history(60, 6, seed=3, info_rate=0.35)
+    with obs.recording(tmp_path, enabled=True) as rec:
+        (r,) = batch_analysis(model, [hist], capacity=(64,), mesh=fmesh,
+                              cpu_fallback=False, exact_escalation=(),
+                              confirm_refutations=False, greedy_first=False,
+                              dedup_backend="pallas")
+    assert r["valid?"] is True, r
+    assert r["kernel"]["mesh_devices"] == MESH_D
+    assert r["kernel"]["interpret"] is True
+    events = [e["event"] for e in r["provenance"]["path"]]
+    assert "route.mesh-kernel" in events
+    assert "mesh-kernel.resolved" in events
+    rows = [row for row in rec.summary["ladder"]
+            if row.get("engine") == "async"]
+    assert rows and all(row["mesh_devices"] == MESH_D for row in rows)
+
+
+def test_mesh_round_probe_emits_tagged_span(fmesh, tmp_path):
+    from jepsen_tpu import obs
+
+    with obs.recording(tmp_path, enabled=True) as rec:
+        out = sh.mesh_round_probe(fmesh, MESH_CAP, MESH_P, MESH_G,
+                                  W=MESH_W, rounds=1)
+    assert out["mesh"] is not None
+    rows = [r for r in rec.summary["dedup"]
+            if r.get("mesh_devices") == MESH_D]
+    assert rows and rows[0]["backend"] == "pallas"
+    assert rows[0]["interpret"] is True
+    # infeasible geometry: honest fallback counter, no timing
+    out2 = sh.mesh_round_probe(fmesh, 12, MESH_P, MESH_G, W=MESH_W)
+    assert out2["mesh"] is None and not out2["occupancy"]["feasible"]
+
+
+@pytest.mark.slow
+def test_mesh_cap8192_rung_acceptance(fmesh):
+    """The round-12 acceptance rung: capacity 8192 runs on the 4-device
+    virtual mesh (interpret mode) with a bit-identical surviving content
+    set and fingerprint vs the single-device kernel at the same global
+    capacity, across randomized tables."""
+    CAP = 8192
+    n = CAP * (1 + MESH_P + MESH_G)
+    assert wk.mesh_feasible(n, CAP, MESH_P + 1, MESH_D,
+                            w=MESH_W, g=MESH_G)
+    for seed in range(2):
+        rng = np.random.default_rng(seed)
+        st = jnp.asarray(rng.integers(0, 16, n).astype(np.int32))
+        fo = jnp.asarray(rng.integers(0, 4, (n, MESH_W)).astype(np.uint32))
+        fc = jnp.asarray(rng.integers(0, 2, (n, MESH_G)).astype(np.int16))
+        al = jnp.asarray(rng.random(n) < 0.5)
+        cost = jnp.zeros(n, jnp.int32)
+        ref = wk.fused_update_jit(st, fo, fc, al, cost, CAP, window=4,
+                                  n_parents=CAP, max_count=MESH_P + 1,
+                                  interpret=True)
+        got = sh.mesh_update(fmesh, st, fo, fc, al, cost, CAP,
+                             n_parents=CAP, max_count=MESH_P + 1)
+        assert not bool(ref[4]) and not bool(np.asarray(got[4]).ravel()[0])
+        assert (_content_child(got[0], got[1], got[2], got[3], got[6])
+                == _content_child(ref[0], ref[1], ref[2], ref[3], ref[6]))
+        assert np.array_equal(_mesh_fp0(got[5]), np.asarray(ref[5]))
+    # engine verdict at the acceptance capacity: the cap-8192 mesh rung
+    # vs the single-device HOST-SPILL path (the PR-8 bounded-memory
+    # reference) on the same history — the verdicts must agree, and the
+    # mesh stats must prove the mesh path (not a fallback) produced them
+    model = m.CASRegister(None)
+    hist = valid_register_history(40, 4, seed=0, info_rate=0.1)
+    rs = wgl.chunked_analysis(model, hist, wgl.pack(model, hist), [64],
+                              spill=True, spill_launches=8)
+    rm = sh.mesh_kernel_analysis(model, hist, fmesh, capacity=(CAP,))
+    assert rm["valid?"] == rs["valid?"] is True, (rm, rs)
+    assert rm["kernel"]["capacity"] == CAP
+    assert rm["kernel"]["mesh_devices"] == MESH_D
+    assert rm["kernel"]["per-device-capacity"] == CAP // MESH_D
+    # a packed geometry the per-device VMEM model can NOT hold at this
+    # width (info-heavy: G=13) must route back honestly, not error
+    heavy = valid_register_history(60, 6, seed=3, info_rate=0.35)
+    hp = wgl.pack(model, heavy)
+    assert not wk.mesh_feasible(
+        4 * (CAP // MESH_D) * (1 + int(hp["P"]) + int(hp["G"])), CAP,
+        int(hp["mov"][0].shape[-1]) + 1, MESH_D,
+        w=(int(hp["P"]) + 31) // 32, g=int(hp["G"]))

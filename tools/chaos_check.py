@@ -533,6 +533,45 @@ def serve_chaos(opts) -> int:
     check(verdicts(got_d2) == cv[4:8],
           "post-shrink verdict parity (parity probe re-ran)")
 
+    # ---- phase 3b: device loss under the fused-kernel backend
+    # The same shrink scenario with dedup_backend="pallas": the mesh
+    # rescue rung compiles mesh-SPANNING fused-stage runners against the
+    # 4-device placement, so a loss must (a) evict them with the mesh
+    # (sharded.forget_mesh) and (b) re-route the survivors' ladders
+    # through the single-device pallas path with verdicts unchanged.
+    print("phase 3b: device loss (pallas backend)")
+    os.environ["JEPSEN_TPU_PALLAS_MIN_CAPACITY"] = "8"
+    try:
+        svc_p = sv.CheckService(
+            devices=4, verify_placement=True, health_probe_every_s=0.0,
+            max_batch=8, warm_pool=False, batch_window_s=0,
+            dedup_backend="pallas", **LADDER,
+        )
+        futs_p = [svc_p.submit(h) for h in hists[:4]]
+        for _ in range(16):
+            if not svc_p.stats()["queue_depth"]:
+                break
+            svc_p.step()
+        got_p = [f.result(timeout=120) for f in futs_p]
+        check(verdicts(got_p) == cv[:4],
+              "4-device mesh verdict parity (pallas)")
+        check(svc_p.stats()["placement"].get("mesh_kernel") is True,
+              "placement advertises the mesh-kernel path")
+        with faults.inject_scope(dev_inj):
+            futs_p2 = [svc_p.submit(h) for h in hists[4:8]]
+            for _ in range(16):
+                if not svc_p.stats()["queue_depth"]:
+                    break
+                svc_p.step()
+        got_p2 = [f.result(timeout=120) for f in futs_p2]
+        stp = svc_p.stats()
+        check(stp["placement"]["devices"] == 3,
+              "placement shrunk to the 3 survivors (pallas)")
+        check(verdicts(got_p2) == cv[4:8],
+              "post-shrink verdict parity (pallas backend re-routed)")
+    finally:
+        del os.environ["JEPSEN_TPU_PALLAS_MIN_CAPACITY"]
+
     # ---- phase 4: real SIGKILL + journal replay
     print("phase 4: SIGKILL + journal replay")
     with tempfile.TemporaryDirectory(prefix="chaos-journal-") as jd:

@@ -118,8 +118,11 @@ def _cmd_compare(a, *, gating: bool) -> int:
 
 #: Default competitor roster per axis: the dedup competition is
 #: three-way since the pallas backend landed (round 11) — the chip-day
-#: flip reads ONE record that ranks all three.
-_AXIS_VALUES = {"dedup_backend": "sort,bucket,pallas"}
+#: flip reads ONE record that ranks all three.  mesh_devices (round 12)
+#: ranks mesh widths through the fused-kernel backend: the
+#: capacity-vs-devices scaling curve as one recorded head-to-head.
+_AXIS_VALUES = {"dedup_backend": "sort,bucket,pallas",
+                "mesh_devices": "1,2,4"}
 
 
 def _cmd_compete(a) -> int:
@@ -129,6 +132,22 @@ def _cmd_compete(a) -> int:
         print("compete: --values needs at least two DISTINCT comma-"
               "separated axis values", file=sys.stderr)
         return 2
+    if a.axis == "mesh_devices":
+        # the devices must exist before jax backend init; on a CPU host
+        # that means the virtual mesh (same dev loop the tests run on).
+        # regress imports jax lazily, so setting the flag here is early
+        # enough as long as nothing imported jax yet.
+        import os
+
+        if ("jax" not in sys.modules
+                and os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                and "--xla_force_host_platform_device_count"
+                not in os.environ.get("XLA_FLAGS", "")):
+            n_max = max(int(v) for v in values)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_max}"
+            ).strip()
     workload = {
         "histories": a.histories, "ops": a.ops, "procs": a.procs,
         "capacity": tuple(int(c) for c in a.capacity.split(",") if c),

@@ -7,6 +7,7 @@ per-round floor PERF.md's "Honest limits" names, at a grid of ladder
 shapes including the acceptance shape [256, 2176].
 
   python tools/profile_dedup.py [--rounds N] [--telemetry DIR] [--smoke]
+  python tools/profile_dedup.py --devices 1,2,4 [--ledger]
 
 The ``pallas`` column is the fused wide-stage kernel's dedup phase
 (ops.wide_kernel.keep_mask — it hashes IN-KERNEL, so the timed window
@@ -25,15 +26,41 @@ tools/trace_summarize.py renders).
 ``--smoke`` (the docker/bin/test stage) runs a single quick probe at
 the first shape plus a three-way survivor-set differential assert —
 exit 1 on any backend disagreement, 0 otherwise.
+
+``--devices 1,2,4`` switches to the MESH-SIZE axis (round 12): per
+device count, the max feasible fused-stage capacity under the
+per-device VMEM model (the mesh-spanning wide stage scales it linearly
+with mesh size) plus a measured per-round probe at a weak-scaled shape.
+On a CPU host the mesh is VIRTUAL
+(``--xla_force_host_platform_device_count``, set here before jax init)
+and every number carries the honest ``interpret: true`` tag.
+``--ledger`` additionally appends the curve as a fingerprinted
+``mesh_scaling`` perf-ledger record (obs.regress) — the
+capacity-vs-devices trajectory the chip-day flip reads next to the
+compete verdicts.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
+
+if "--devices" in sys.argv:
+    # The virtual mesh must exist before the jax backend initializes,
+    # and the jepsen_tpu imports below are what trigger it.
+    _nd = max(int(x) for x in
+              sys.argv[sys.argv.index("--devices") + 1].split(",") if x)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ("--xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(_nd, 1)}"
+        ).strip()
 
 from jepsen_tpu import obs  # noqa: E402
 from jepsen_tpu.ops import hashing  # noqa: E402
@@ -98,10 +125,102 @@ def _smoke() -> int:
     return rc
 
 
+#: per-device probe shape for the mesh-size axis: capacity 256 per
+#: device (P=8, G=4 — the ladder's wide-rung move shape), weak-scaled
+#: so every device count runs the same per-shard work.
+_SCALING_CAP_PER_DEV = 256
+_SCALING_P, _SCALING_G = 8, 4
+
+
+def _scaling(devices: list[int], rounds: int, ledger: bool) -> int:
+    """The capacity-vs-devices curve: per mesh width, the max feasible
+    fused-stage capacity (static per-device VMEM model — the claim the
+    mesh stage exists to make) and a measured per-round probe at a
+    weak-scaled shape (the honest part: interpret-tagged on CPU)."""
+    from jepsen_tpu.parallel import make_mesh, sharded
+
+    # the weak-scaled probe shape sits below the production routing
+    # floor; lower it like _smoke so the kernel actually runs
+    os.environ.setdefault(wide_kernel.PALLAS_MIN_CAPACITY_ENV, "64")
+    P_, G = _SCALING_P, _SCALING_G
+    W = (P_ + 31) // 32
+    interp = wide_kernel.interpret_default()
+    curve = []
+    for d in devices:
+        cap_max, c = 0, 64
+        while c <= (1 << 20):
+            n = c * (1 + P_ + G)
+            if d > 1:
+                ok = wide_kernel.mesh_feasible(n, c, P_ + 1, d, w=W, g=G)
+            else:
+                ok = wide_kernel.fused_feasible(n, c, P_ + 1, w=W, g=G)
+            if ok:
+                cap_max = c
+            c *= 2
+        probe_cap = _SCALING_CAP_PER_DEV * d
+        if d > 1:
+            mesh = make_mesh(d, axis="frontier")
+            probe = sharded.mesh_round_probe(
+                mesh, probe_cap, P_, G, W=W, rounds=rounds)
+            t = probe["mesh"]
+        else:
+            times = hashing.dedup_round_probe(
+                probe_cap, P_, G, W, rounds=rounds)
+            t = times.get("pallas")
+        curve.append({
+            "devices": d, "max_capacity_rows": cap_max,
+            "probe_capacity": probe_cap,
+            "per_round_us": (round(t * 1e6, 1) if t is not None else None),
+            "interpret": interp,
+        })
+    hdr_t = "per_round_us*" if interp else "per_round_us"
+    print(f"{'devices':>8} {'max_capacity':>13} {'probe_cap':>10} {hdr_t:>14}")
+    for row in curve:
+        t = row["per_round_us"]
+        print(f"{row['devices']:>8} {row['max_capacity_rows']:>13} "
+              f"{row['probe_capacity']:>10} "
+              f"{t if t is not None else '-':>14}")
+    if interp:
+        print("\n* interpret-mode (virtual mesh, no TPU backend): lowering "
+              "overhead, not chip numbers; tagged interpret: true in every "
+              "span and the ledger record")
+    if ledger:
+        from jepsen_tpu.obs import regress
+
+        metrics = {}
+        for row in curve:
+            d = row["devices"]
+            metrics[f"mesh_max_capacity_rows_{d}dev"] = float(
+                row["max_capacity_rows"])
+            if row["per_round_us"] is not None:
+                metrics[f"mesh_per_round_us_{d}dev"] = row["per_round_us"]
+        rec = regress.make_record(
+            "mesh_scaling", metrics,
+            axes={"mesh_devices": ",".join(str(d) for d in devices),
+                  "dedup_backend": "pallas"},
+            extra={"interpret": interp, "curve": curve,
+                   "shape": {"P": P_, "G": G,
+                             "cap_per_device": _SCALING_CAP_PER_DEV}},
+        )
+        path = regress.append_record(rec)
+        if path is not None:
+            print(f"\nmesh_scaling record appended to {path}")
+        else:
+            print("\n(ledger disabled; record not written)", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--smoke" in argv:
         return _smoke()
+    if "--devices" in argv:
+        devices = [int(x) for x in
+                   argv[argv.index("--devices") + 1].split(",") if x]
+        rounds = 3
+        if "--rounds" in argv:
+            rounds = int(argv[argv.index("--rounds") + 1])
+        return _scaling(devices, rounds, "--ledger" in argv)
     rounds = 20
     tele_dir = None
     if "--rounds" in argv:
