@@ -239,10 +239,15 @@ def _cmd_serve(opts) -> int:
     persistent check service (jepsen_tpu.serve): POST /check admits
     histories into the shared batching queue, bounded at --max-queue
     (beyond it: 429 + Retry-After), and Ctrl-C drains gracefully,
-    checkpointing still-queued work into --drain-dir."""
+    checkpointing still-queued work into --drain-dir.  With
+    ``--replicas N`` the check API instead fronts a fleet of N replica
+    services behind a geometry-affinity router (jepsen_tpu.serve.fleet)
+    sharing one idempotency map and quarantine registry under
+    --fleet-dir."""
     from jepsen_tpu import web
 
     svc = None
+    router = None
     if getattr(opts, "check", False):
         from jepsen_tpu.serve import CheckService
 
@@ -255,38 +260,102 @@ def _cmd_serve(opts) -> int:
             probe_s = 10.0 if opts.check_devices else None
         elif probe_s is not None and probe_s < 0:
             probe_s = None
-        svc = CheckService(
-            capacity=capacity,
-            slo_specs=opts.slo_file,
-            max_queue=opts.max_queue,
-            max_interactive_queue=opts.max_interactive_queue,
-            max_batch=opts.max_batch,
-            batch_window_s=opts.batch_window_ms / 1000.0,
-            interactive_max_b=opts.interactive_max_b,
-            continuous=not opts.no_continuous,
-            devices=opts.check_devices,
-            verify_placement=opts.verify_placement,
-            evidence_dir=opts.evidence_dir,
-            drain_dir=opts.drain_dir,
-            journal_dir=opts.journal_dir,
-            idempotency_dir=opts.idempotency_dir,
-            idempotency_ttl_s=opts.idempotency_ttl,
-            quarantine_ttl_s=opts.quarantine_ttl,
-            breaker_threshold=opts.breaker_threshold,
-            breaker_cooldown_s=opts.breaker_cooldown,
-            watchdog_factor=opts.launch_watchdog or None,
-            health_probe_every_s=probe_s,
-        ).start()
-        logger.info(
-            "check service up: max_queue=%d max_batch=%d capacity=%s "
-            "continuous=%s devices=%s interactive_max_b=%d journal=%s "
-            "breaker=%d watchdog=%s",
-            opts.max_queue, opts.max_batch, capacity,
-            not opts.no_continuous, opts.check_devices or 1,
-            opts.interactive_max_b, opts.journal_dir or "off",
-            opts.breaker_threshold,
-            f"{opts.launch_watchdog}x" if opts.launch_watchdog else "off",
-        )
+        replicas = max(1, int(getattr(opts, "replicas", 1) or 1))
+
+        def _mk_service(*, journal_dir, journal_shared, idempotency_dir,
+                        idempotency_shared, quarantine_dir, evidence_dir,
+                        drain_dir):
+            return CheckService(
+                capacity=capacity,
+                slo_specs=opts.slo_file,
+                max_queue=opts.max_queue,
+                max_interactive_queue=opts.max_interactive_queue,
+                max_batch=opts.max_batch,
+                batch_window_s=opts.batch_window_ms / 1000.0,
+                interactive_max_b=opts.interactive_max_b,
+                continuous=not opts.no_continuous,
+                devices=opts.check_devices,
+                verify_placement=opts.verify_placement,
+                evidence_dir=evidence_dir,
+                drain_dir=drain_dir,
+                journal_dir=journal_dir,
+                journal_shared=journal_shared,
+                idempotency_dir=idempotency_dir,
+                idempotency_shared=idempotency_shared,
+                quarantine_dir=quarantine_dir,
+                idempotency_ttl_s=opts.idempotency_ttl,
+                quarantine_ttl_s=opts.quarantine_ttl,
+                breaker_threshold=opts.breaker_threshold,
+                breaker_cooldown_s=opts.breaker_cooldown,
+                watchdog_factor=opts.launch_watchdog or None,
+                health_probe_every_s=probe_s,
+            ).start()
+
+        if replicas > 1:
+            from pathlib import Path
+
+            from jepsen_tpu.serve import fleet as _fleet
+
+            # Per-replica private dirs + fleet-shared durable state
+            # (idempotency map, quarantine registry) under one root:
+            # the shared pieces are what make fencing exactly-once and
+            # quarantine fleet-wide.
+            base = Path(opts.fleet_dir or
+                        (Path(opts.store_dir or "store") / "fleet"))
+
+            def _replica_dirs(name):
+                return dict(
+                    journal_dir=(Path(opts.journal_dir) / name
+                                 if opts.journal_dir
+                                 else base / "journal" / name),
+                    journal_shared=True,
+                    idempotency_dir=(opts.idempotency_dir
+                                     or base / "idempotency"),
+                    idempotency_shared=True,
+                    quarantine_dir=(opts.quarantine_dir
+                                    or base / "quarantine"),
+                    evidence_dir=(Path(opts.evidence_dir) / name
+                                  if opts.evidence_dir else None),
+                    drain_dir=(Path(opts.drain_dir) / name
+                               if opts.drain_dir
+                               else base / "drain" / name),
+                )
+
+            def _successor(name, old_svc):
+                return _mk_service(**_replica_dirs(name))
+
+            router = _fleet.FleetRouter(
+                probe_every_s=opts.fleet_probe_s or None,
+                successor_factory=_successor,
+            )
+            for i in range(replicas):
+                name = f"r{i}"
+                router.add_local(name, _mk_service(**_replica_dirs(name)))
+            router.start()
+            logger.info(
+                "fleet up: %d replicas, shared state under %s "
+                "(affinity routing + power-of-two spill; "
+                "POST /fleet/rollout cycles replicas)", replicas, base,
+            )
+        else:
+            svc = _mk_service(
+                journal_dir=opts.journal_dir, journal_shared=False,
+                idempotency_dir=opts.idempotency_dir,
+                idempotency_shared=False,
+                quarantine_dir=getattr(opts, "quarantine_dir", None),
+                evidence_dir=opts.evidence_dir, drain_dir=opts.drain_dir,
+            )
+            logger.info(
+                "check service up: max_queue=%d max_batch=%d capacity=%s "
+                "continuous=%s devices=%s interactive_max_b=%d journal=%s "
+                "breaker=%d watchdog=%s",
+                opts.max_queue, opts.max_batch, capacity,
+                not opts.no_continuous, opts.check_devices or 1,
+                opts.interactive_max_b, opts.journal_dir or "off",
+                opts.breaker_threshold,
+                f"{opts.launch_watchdog}x" if opts.launch_watchdog
+                else "off",
+            )
     profiler = None
     if getattr(opts, "profile_dir", None):
         from jepsen_tpu.obs.profiler import ProfilerHook
@@ -301,7 +370,45 @@ def _cmd_serve(opts) -> int:
         )
     web.serve(host=opts.host, port=opts.port, store_dir=opts.store_dir,
               check_service=svc, profiler=profiler,
-              max_request_mb=opts.max_request_mb)
+              max_request_mb=opts.max_request_mb, fleet=router)
+    return EXIT_VALID
+
+
+def _cmd_fleet(opts) -> int:
+    """``fleet``: operate a running fleet over its HTTP admin surface
+    — ``fleet status --url`` prints GET /fleet, ``fleet rollout --url``
+    drives the zero-downtime replica cycle (POST /fleet/rollout)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = opts.url.rstrip("/")
+    try:
+        if opts.fleet_command == "rollout":
+            body = {}
+            if opts.names:
+                body["names"] = [n for n in opts.names.split(",") if n]
+            req = urllib.request.Request(
+                url + "/fleet/rollout",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+        else:
+            req = urllib.request.Request(url + "/fleet")
+        with urllib.request.urlopen(req, timeout=opts.timeout) as resp:
+            doc = _json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            doc = _json.loads(e.read() or b"{}")
+        except ValueError:
+            doc = {"error": str(e)}
+        print(_json.dumps(doc, indent=2, default=str))
+        return EXIT_CRASH
+    except (urllib.error.URLError, OSError) as e:
+        print(_json.dumps({"error": str(e)}, indent=2))
+        return EXIT_CRASH
+    print(_json.dumps(doc, indent=2, default=str))
     return EXIT_VALID
 
 
@@ -464,6 +571,54 @@ def run_cli(
                          help="hard bound per profiler capture; every "
                               "start auto-stops after at most this long "
                               "(default 120)")
+    p_serve.add_argument("--replicas", type=int, default=1, metavar="N",
+                         help="front the check API with a fleet of N "
+                              "replica services behind the geometry-"
+                              "affinity router (jepsen_tpu.serve.fleet): "
+                              "replica death degrades capacity instead "
+                              "of taking the front door down, and POST "
+                              "/fleet/rollout cycles replicas with zero "
+                              "downtime (default 1: single service)")
+    p_serve.add_argument("--fleet-dir", default=None, metavar="PATH",
+                         help="root for fleet state: per-replica "
+                              "journal/drain dirs plus the FLEET-SHARED "
+                              "idempotency map and quarantine registry "
+                              "(advisory-file-locked; what makes "
+                              "failover exactly-once and quarantine "
+                              "fleet-wide).  Default <store-dir>/fleet")
+    p_serve.add_argument("--fleet-probe-s", type=float, default=2.0,
+                         metavar="SECONDS",
+                         help="fleet health-probe interval: readiness + "
+                              "forward-progress staleness per replica; "
+                              "repeated fatal failures fence the replica "
+                              "and resubmit its in-flight work "
+                              "(0 disables; default 2)")
+    p_serve.add_argument("--quarantine-dir", default=None, metavar="PATH",
+                         help="durable (and shareable) quarantine "
+                              "registry dir: poison fingerprints persist "
+                              "across restart and are refused by every "
+                              "process pointed at the same dir "
+                              "(default: in-memory only)")
+
+    p_fleet = sub.add_parser(
+        "fleet", help="operate a running fleet (status / rollout)")
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_command")
+    p_fstat = fleet_sub.add_parser(
+        "status", help="print GET /fleet: per-replica state + router "
+                       "totals")
+    p_froll = fleet_sub.add_parser(
+        "rollout", help="cycle replicas with zero downtime (drain -> "
+                        "successor with journal replay + resume_drained "
+                        "-> swap; no 5xx, no verdict loss)")
+    for p in (p_fstat, p_froll):
+        p.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="base URL of the serving process "
+                            "(default http://127.0.0.1:8080)")
+        p.add_argument("--timeout", type=float, default=600.0,
+                       help="HTTP timeout seconds (default 600)")
+    p_froll.add_argument("--names", default=None,
+                         help="comma-separated replica names to roll "
+                              "(default: every local replica)")
 
     try:
         opts = parser.parse_args(argv)
@@ -500,6 +655,11 @@ def run_cli(
             return _cmd_analyze(test_fn, opts)
         if opts.command == "serve":
             return _cmd_serve(opts)
+        if opts.command == "fleet":
+            if not getattr(opts, "fleet_command", None):
+                parser.parse_args(["fleet", "--help"])
+                return EXIT_USAGE
+            return _cmd_fleet(opts)
         parser.print_help()
         return EXIT_USAGE
     except KeyboardInterrupt:

@@ -52,7 +52,7 @@ _METRIC_METHODS = {"inc", "set_gauge", "observe"}
 #: the namespace the orphan check patrols in the doc surfaces.
 FAMILIES = {
     "serve", "fault", "frontier", "elle", "dedup", "ladder", "device",
-    "checker", "phase", "wgl", "sharded", "durable", "provenance",
+    "checker", "phase", "wgl", "sharded", "durable", "provenance", "fleet",
 }
 
 _TOKEN_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_.*-]*[A-Za-z0-9_*]")

@@ -19,6 +19,13 @@ later perf PRs report against.
                 "fastpath_resolved", "fastpath_escalated",
                 "submitted", "completed", "rejected", "expired", "drained"}
                                                         # serve.* events
+   "fleet":    {"routed", "spilled", "parked", "fenced", "resubmitted",
+                "rollouts", "replicas", "replicas_healthy",
+                "rollout": {"count", "total_s", "max_s"}}
+                               # fleet.* events (the front-door router,
+                               # jepsen_tpu.serve.fleet): placement +
+                               # spill volume, fence/resubmission churn,
+                               # and zero-downtime rollout spans
    "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
                  "resolved", "refuted", "unknowns_remaining",
                  "launches", "compile_launches", "compile_s",
@@ -70,6 +77,13 @@ from ``serve.batch`` spans, admission-wait and end-to-end request
 latency from ``serve.admission``/``serve.request`` span events, and the
 admission counters (submitted/completed/rejected/expired/drained).
 Empty dict when a run never touched the service.
+
+The fleet section aggregates the front-door router's ``fleet.*`` events
+(jepsen_tpu.serve.fleet): routing volume (``fleet.routed`` summed over
+replica labels) vs load-spill (``fleet.spilled``) and no-replica parking
+(``fleet.parked``), failure-containment churn (``fleet.fenced``,
+``fleet.resubmitted``), rollout counts/spans, and the last-seen replica
+census gauges.  Empty dict for single-service runs.
 """
 
 from __future__ import annotations
@@ -368,6 +382,18 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
                   "placement_replaced", "drain_error"):
         if f"serve.{cname}" in counters:
             serve[cname] = counters[f"serve.{cname}"]
+    fleet: dict = {}
+    for cname in ("routed", "spilled", "parked", "fenced", "resubmitted",
+                  "rollouts"):
+        if f"fleet.{cname}" in counters:
+            fleet[cname] = counters[f"fleet.{cname}"]
+    for gname in ("replicas", "replicas_healthy"):
+        if f"fleet.{gname}" in gauges:
+            fleet[gname] = gauges[f"fleet.{gname}"]
+    if "fleet.rollout" in spans:
+        ro = spans["fleet.rollout"]
+        fleet["rollout"] = {"count": ro["count"], "total_s": ro["total_s"],
+                            "max_s": ro["max_s"]}
     from jepsen_tpu.obs import critpath as _critpath
 
     out = {
@@ -376,6 +402,7 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
         "phases": phases,
         "checkers": out_checkers,
         "serve": serve,
+        "fleet": fleet,
         "ladder": ladder,
         "dedup": out_dedup,
         "elle": elle,
@@ -459,6 +486,16 @@ def format_summary(summary: Mapping) -> str:
             rows.append([f"request[{tier}] mean_s", lat["mean_s"]])
             rows.append([f"request[{tier}] max_s", lat["max_s"]])
         parts.append(_table(["serve", "value"], rows))
+    if summary.get("fleet"):
+        fle = summary["fleet"]
+        parts.append("\nfleet (front-door router):")
+        rows = [[k, fle[k]] for k in (
+            "routed", "spilled", "parked", "fenced", "resubmitted",
+            "rollouts", "replicas", "replicas_healthy") if k in fle]
+        if "rollout" in fle:
+            rows.append(["rollout total_s", fle["rollout"]["total_s"]])
+            rows.append(["rollout max_s", fle["rollout"]["max_s"]])
+        parts.append(_table(["fleet", "value"], rows))
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",
                    "resolved", "refuted", "unknowns", "launches",

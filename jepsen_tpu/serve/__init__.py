@@ -38,13 +38,22 @@ caps, cancel-and-retry on reduced placement), device-loss re-placement
 (mesh health probes, shrink to survivors + parity re-probe), and a
 crash-safe fsync'd admission journal replayed by ``start()``.
 
+Fleet federation is delegated to ``jepsen_tpu.serve.fleet`` (PR 18):
+a front-door ``FleetRouter`` over N replicas (in-process services or
+subprocess HTTP workers) with geometry-affinity routing +
+power-of-two-choices spill, health-probe fencing with exactly-once
+resubmission through the shared ``IdempotencyMap``, fleet-wide
+``SharedQuarantine``, and zero-downtime ``rollout()`` via
+drain/replay/``resume_drained``.
+
 Exposure: this Python API (``submit(history, ...) -> Future[verdict]``),
 the HTTP API mounted into ``jepsen_tpu.web`` (``POST /check``,
 ``GET /check/<id>``, ``GET /queue``, ``GET /healthz``, ``GET
-/readyz``), and ``jepsen-tpu serve --check``.
+/readyz``), and ``jepsen-tpu serve --check`` (``--replicas N`` mounts
+the fleet router).
 """
 
-from jepsen_tpu.serve import health, sched
+from jepsen_tpu.serve import fleet, health, sched
 from jepsen_tpu.serve.service import (
     MODELS,
     CheckFuture,
@@ -65,6 +74,7 @@ __all__ = [
     "QueueFull",
     "ServiceClosed",
     "ServiceUnavailable",
+    "fleet",
     "health",
     "model_by_name",
     "resume_drained",

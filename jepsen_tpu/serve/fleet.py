@@ -1,0 +1,1166 @@
+"""Fleet federation: a front-door router over N CheckService replicas.
+
+Every robustness primitive the service grew — poison quarantine,
+circuit breaker, admission journal, idempotency map, drain-to-
+checkpoint — protects exactly one process; a single SIGKILL still
+takes down the whole front door.  This module federates N replicas
+(in-process ``CheckService`` instances or subprocess HTTP workers,
+each with its own journal/evidence/drain dirs) behind one router so a
+replica death is a degraded-capacity event, not an outage:
+
+  * **Geometry-affinity routing** — a request hashes by its padded
+    batch geometry (``affinity_key``: the same ``wgl.pack`` +
+    ``bucket_geometry`` key the service groups batches by; graph work
+    by ``graph_batch_key``) onto a rendezvous (highest-random-weight)
+    ordering of the replicas.  Compile caches are the expensive
+    per-replica state, so requests route to the replica whose cache is
+    already warm for their bucket — the hash-bucketed locality idea
+    batched beam search uses on accelerators.  Rendezvous hashing
+    means fencing a replica moves only ITS keys.
+  * **Power-of-two-choices spill** — when the owner's queue depth
+    fraction or SLO burn rate (serve.slo) crosses a threshold, the
+    router compares the owner against the second rendezvous choice and
+    routes to the less-loaded of the two (``fleet.spilled``).
+  * **Failure containment** — ``probe()`` health-checks every replica
+    (readiness + forward-progress staleness: pending work with no
+    completed batches for ``stale_after_s`` reads as wedged); a dead
+    or wedged replica is FENCED and its in-flight requests are
+    resubmitted through the router under their history-scoped
+    idempotency keys.  The shared ``IdempotencyMap`` (``shared=True``,
+    per-key advisory file locks) makes that exactly-once: a request
+    the dying replica already settled answers from the map, one it
+    never finished rebinds to the new replica, and a zombie replica's
+    late verdict loses the ``settle`` req-id CAS instead of
+    overwriting the binding of record.
+  * **Fleet-wide blast-radius isolation** — replicas share one
+    ``SharedQuarantine`` dir: a history that poisoned a launch on
+    replica A is refused at admission on replica B on its first local
+    offense, with zero launches spent.
+  * **Zero-downtime rollout** — ``rollout()`` cycles replicas one at a
+    time: stop routing to the old one, drain it to checkpoint
+    (serve.service shutdown drain), start the successor (journal
+    replay via ``recover()``), finish the checkpointed work with
+    ``resume_drained`` and deliver those verdicts to the original
+    futures, then swap the successor in.  The front door never 5xxes:
+    requests arriving mid-swap route to the other replicas or park
+    until the successor is live.
+
+Telemetry (documented in README / doc/tutorial.md; the graftlint
+telemetry inventory enforces the list): counters ``fleet.routed``
+``fleet.spilled`` ``fleet.resubmitted`` ``fleet.fenced``
+``fleet.parked`` ``fleet.rollouts`` ``fleet.quarantine_hits``, gauges
+``fleet.replicas`` ``fleet.replicas_healthy``, span ``fleet.rollout``
+— surfaced on /metrics as ``jepsen_tpu_fleet_*``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import random
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from concurrent.futures import TimeoutError as _FutureTimeout
+from pathlib import Path
+from typing import Mapping, Sequence
+
+from jepsen_tpu import faults, obs, store
+from jepsen_tpu import models as m
+from jepsen_tpu.serve import health as _health
+from jepsen_tpu.serve.sched import admission as _sched_adm
+from jepsen_tpu.serve.service import (
+    CheckService,
+    QueueFull,
+    ServiceClosed,
+    ServiceUnavailable,
+    resume_drained,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "FleetFuture",
+    "FleetRouter",
+    "HttpReplica",
+    "LocalReplica",
+    "ReplicaDown",
+    "affinity_key",
+    "spawn_replica",
+]
+
+
+class ReplicaDown(Exception):
+    """A replica can't take or answer requests at the transport level
+    (process dead, socket refused, service closed) — fence-worthy, as
+    opposed to backpressure (QueueFull) or a breaker (503)."""
+
+    def __init__(self, replica: str, cause=None):
+        super().__init__(f"replica {replica!r} is down"
+                         + (f": {cause}" if cause else ""))
+        self.replica = replica
+        self.cause = cause
+
+
+def affinity_key(history, *, model=None, checker=None) -> str:
+    """The warm-cache routing key of one request: the SAME grouping
+    the service batches by (``CheckService._group_of``) rendered as a
+    stable string — model name + padded ``bucket_geometry`` for ladder
+    work, the column-shape ``graph_batch_key`` for graph checkers.
+    Two requests with equal keys share a compiled kernel, so they
+    belong on the same replica."""
+    if checker is not None:
+        return f"graph:{_sched_adm.graph_batch_key(checker)!r}"
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.parallel import batch
+
+    model = model if model is not None else m.CASRegister()
+    try:
+        p = wgl.pack(model, list(history))
+    except wgl.NotTensorizable:
+        return f"{model.name}:untensorizable"
+    if p["B"] == 0:
+        return f"{model.name}:trivial"
+    geom = batch.bucket_geometry(p["B"], p["P"], p["G"])
+    return f"{model.name}:{geom}"
+
+
+def _rendezvous(key: str, names: Sequence[str]) -> list[str]:
+    """Highest-random-weight ordering of ``names`` for ``key``: every
+    router instance agrees on the owner without coordination, and
+    removing a name reshuffles only that name's keys."""
+    return sorted(
+        names,
+        key=lambda n: hashlib.sha256(f"{key}|{n}".encode()).digest(),
+        reverse=True,
+    )
+
+
+class FleetFuture:
+    """The router-owned future a fleet submission resolves: survives
+    resubmission across replicas (the per-replica CheckFutures come
+    and go underneath).  ``id`` tracks the CURRENT replica request id
+    (preserved across journal replay; fresh after a rebind)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._lock = threading.Lock()
+        self._result = None
+        self._exc: BaseException | None = None
+        self._cbs: list = []
+        self.id: str | None = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def cancelled(self) -> bool:
+        return False
+
+    def _settle(self, result=None, exc: BaseException | None = None) -> bool:
+        """First write wins; returns whether THIS write won."""
+        with self._lock:
+            if self._ev.is_set():
+                return False
+            self._result, self._exc = result, exc
+            cbs, self._cbs = self._cbs, []
+            self._ev.set()
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — callbacks are best-effort
+                logger.exception("fleet future callback failed")
+        return True
+
+    def set_result(self, result) -> bool:
+        return self._settle(result=result)
+
+    def set_exception(self, exc: BaseException) -> bool:
+        return self._settle(exc=exc)
+
+    def result(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise _FutureTimeout()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def add_done_callback(self, fn) -> None:
+        with self._lock:
+            if not self._ev.is_set():
+                self._cbs.append(fn)
+                return
+        fn(self)
+
+
+class _Entry:
+    """One routed request: everything needed to resubmit it verbatim
+    (same history, same idempotency key) if its replica is fenced."""
+
+    __slots__ = (
+        "eid", "history", "model", "priority", "deadline", "client",
+        "trace_id", "class_", "checker", "idem_key", "affinity",
+        "future", "replica", "rep_id", "rep_ids", "resubmits",
+        "suspended",
+    )
+
+    def __init__(self, *, history, model, priority, deadline, client,
+                 trace_id, class_, checker, idem_key, affinity):
+        self.eid = uuid.uuid4().hex[:12]
+        self.history = history
+        self.model = model
+        self.priority = priority
+        self.deadline = deadline
+        self.client = client
+        self.trace_id = trace_id
+        self.class_ = class_
+        self.checker = checker
+        self.idem_key = idem_key
+        self.affinity = affinity
+        self.future = FleetFuture()
+        self.replica: str | None = None
+        self.rep_id: str | None = None
+        self.rep_ids: list[str] = []   # every id this entry ever held
+        self.resubmits = 0
+        self.suspended = False
+
+
+# ---------------------------------------------------------------------------
+# Replica transports
+# ---------------------------------------------------------------------------
+
+
+class LocalReplica:
+    """An in-process ``CheckService`` behind the router."""
+
+    kind = "local"
+
+    def __init__(self, name: str, svc: CheckService):
+        self.name = str(name)
+        self.svc = svc
+        self.router: "FleetRouter | None" = None
+        self._stats_cache: tuple[float, dict] | None = None
+
+    def submit(self, entry: _Entry) -> str:
+        try:
+            fut = self.svc.submit(
+                entry.history, model=entry.model, priority=entry.priority,
+                deadline=entry.deadline, client=entry.client,
+                trace_id=entry.trace_id, class_=entry.class_,
+                checker=entry.checker, idempotency_key=entry.idem_key,
+            )
+        except ServiceClosed as e:
+            raise ReplicaDown(self.name, e) from e
+        router, name = self.router, self.name
+
+        def _cb(f, entry=entry, name=name):
+            try:
+                res = f.result(timeout=0)
+            except BaseException as e:  # noqa: BLE001 — routed to the
+                # fleet future as-is below
+                router._on_error(entry, name, e)
+                return
+            router._on_result(entry, name, res)
+
+        fut.add_done_callback(_cb)
+        return str(fut.id)
+
+    def ready(self) -> tuple[bool, dict, bool]:
+        """(accepting-new-work, info, fatal).  fatal marks fence-worthy
+        states (closed); a breaker-open replica is unready but ALIVE —
+        fencing it would churn resubmissions for nothing."""
+        if self.svc._closed:
+            return False, {"reason": "closed"}, True
+        br = self.svc.breaker.describe()
+        if br.get("state") == "open":
+            return False, {"reason": "breaker open", "breaker": br}, False
+        return True, {"breaker": br}, False
+
+    def stats(self, max_age_s: float = 0.25) -> dict:
+        now = time.monotonic()
+        c = self._stats_cache
+        if c is not None and now - c[0] < max_age_s:
+            return c[1]
+        st = self.svc.stats()
+        self._stats_cache = (now, st)
+        return st
+
+    def burn(self) -> float:
+        """The worst fast-window burn fraction across SLOs (>=1.0
+        means a firing-level burn)."""
+        try:
+            rows = self.svc.slo.evaluate()
+        except Exception:  # noqa: BLE001 — routing hint only
+            return 0.0
+        worst = 0.0
+        for r in rows:
+            thr = float(r.get("burn_threshold") or 0) or 1.0
+            worst = max(worst, float(r.get("burn_fast") or 0.0) / thr)
+        return worst
+
+    def alerts(self) -> dict:
+        return self.svc.slo.alerts()
+
+    def get(self, rep_id: str) -> dict | None:
+        req = self.svc.get(rep_id)
+        return req.describe() if req is not None else None
+
+    def get_evidence(self, rep_id: str) -> dict | None:
+        return self.svc.get_evidence(rep_id)
+
+    def close(self, *, drain: bool = False) -> None:
+        with contextlib.suppress(Exception):
+            self.svc.shutdown(drain=drain)
+
+
+class HttpReplica:
+    """A subprocess/remote replica spoken to over the HTTP surface
+    (POST /check with ``wait: false``; completion via a GET
+    /check/<id> poller thread).  Graph-checker submissions aren't
+    expressible over the wire — the router keeps those on local
+    replicas."""
+
+    kind = "http"
+
+    def __init__(self, name: str, base_url: str, *, poll_s: float = 0.02,
+                 timeout_s: float = 10.0):
+        self.name = str(name)
+        self.base_url = str(base_url).rstrip("/")
+        self.poll_s = float(poll_s)
+        self.timeout_s = float(timeout_s)
+        self.router: "FleetRouter | None" = None
+        self._plock = threading.Lock()
+        self._pending: dict[str, _Entry] = {}    # guarded-by: _plock [rw]
+        self._poller: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._stats_cache: tuple[float, dict] | None = None
+        host, _, port = self.base_url.rpartition("//")[2].partition(":")
+        self._host, self._port = host, int(port or 80)
+
+    def _request(self, method: str, path: str, body=None) -> tuple[int, dict]:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout_s
+        )
+        try:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if data else {}
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                payload = json.loads(raw) if raw else {}
+            except ValueError:
+                payload = {}
+            return resp.status, payload
+        except OSError as e:
+            raise ReplicaDown(self.name, e) from e
+        finally:
+            with contextlib.suppress(Exception):
+                conn.close()
+
+    def submit(self, entry: _Entry) -> str:
+        if entry.checker is not None:
+            raise QueueFull(0, 0, 1.0, tier=entry.class_ or "batch")
+        payload: dict = {
+            "history": store._jsonable(list(entry.history)),
+            "client": entry.client,
+            "priority": entry.priority,
+            "wait": False,
+        }
+        if entry.model is not None:
+            payload["model"] = entry.model.name
+        if entry.class_ is not None:
+            payload["class"] = entry.class_
+        if entry.trace_id is not None:
+            payload["trace_id"] = entry.trace_id
+        if entry.idem_key is not None:
+            payload["idempotency_key"] = entry.idem_key
+        if entry.deadline is not None:
+            payload["deadline"] = entry.deadline.remaining()
+        status, data = self._request("POST", "/check", payload)
+        if status == 429:
+            raise QueueFull(
+                int(data.get("depth") or 0), int(data.get("limit") or 0),
+                float(data.get("retry_after_s") or 1.0),
+                tier=entry.class_ or "batch",
+            )
+        if status == 503:
+            raise ServiceUnavailable(float(data.get("retry_after_s") or 1.0))
+        if status not in (200, 202) or not data.get("id"):
+            raise ReplicaDown(self.name, f"POST /check -> {status}")
+        rep_id = str(data["id"])
+        if data.get("result") is not None:
+            self.router._on_result(entry, self.name, data["result"])
+            return rep_id
+        with self._plock:
+            self._pending[rep_id] = entry
+        self._ensure_poller()
+        return rep_id
+
+    def _ensure_poller(self) -> None:
+        if self._poller is not None and self._poller.is_alive():
+            return
+        self._stop.clear()
+        self._poller = threading.Thread(
+            target=self._poll_loop, name=f"fleet-poll-{self.name}",
+            daemon=True,
+        )
+        self._poller.start()
+
+    def _poll_loop(self) -> None:
+        misses: dict[str, int] = {}
+        while not self._stop.is_set():
+            with self._plock:
+                items = list(self._pending.items())
+            if not items:
+                # idle poller exits; the next submit restarts it
+                return
+            for rep_id, entry in items:
+                if self._stop.is_set():
+                    return
+                try:
+                    status, data = self._request("GET", f"/check/{rep_id}")
+                except ReplicaDown:
+                    router = self.router
+                    if router is not None:
+                        router.fence(self.name, reason="poll transport down")
+                    return
+                if status == 200 and data.get("result") is not None:
+                    with self._plock:
+                        self._pending.pop(rep_id, None)
+                    self.router._on_result(entry, self.name, data["result"])
+                elif status == 404:
+                    # the request evaporated (e.g. replica restarted
+                    # without its journal): after a grace of a few
+                    # polls, hand it back to the router to resubmit
+                    misses[rep_id] = misses.get(rep_id, 0) + 1
+                    if misses[rep_id] >= 5:
+                        with self._plock:
+                            self._pending.pop(rep_id, None)
+                        misses.pop(rep_id, None)
+                        self.router._on_gone(entry, self.name)
+            self._stop.wait(self.poll_s)
+
+    def drop_pending(self) -> list[_Entry]:
+        """Forget every in-flight poll target (the router fenced us);
+        returns the entries so the router can resubmit them."""
+        with self._plock:
+            out = list(self._pending.values())
+            self._pending.clear()
+        return out
+
+    def ready(self) -> tuple[bool, dict, bool]:
+        try:
+            status, data = self._request("GET", "/readyz")
+        except ReplicaDown as e:
+            return False, {"reason": str(e)}, True
+        if status == 200:
+            return True, data, False
+        fatal = "shutting down" in str(data.get("reason") or "")
+        return False, data, fatal
+
+    def stats(self, max_age_s: float = 0.25) -> dict:
+        now = time.monotonic()
+        c = self._stats_cache
+        if c is not None and now - c[0] < max_age_s:
+            return c[1]
+        status, data = self._request("GET", "/queue")
+        if status != 200:
+            raise ReplicaDown(self.name, f"GET /queue -> {status}")
+        self._stats_cache = (now, data)
+        return data
+
+    def burn(self) -> float:
+        try:
+            status, data = self._request("GET", "/alerts")
+        except ReplicaDown:
+            return 0.0
+        worst = 0.0
+        for r in data.get("slos") or []:
+            thr = float(r.get("burn_threshold") or 0) or 1.0
+            worst = max(worst, float(r.get("burn_fast") or 0.0) / thr)
+        return worst
+
+    def alerts(self) -> dict:
+        status, data = self._request("GET", "/alerts")
+        return data if status == 200 else {"error": status}
+
+    def get(self, rep_id: str) -> dict | None:
+        try:
+            status, data = self._request("GET", f"/check/{rep_id}")
+        except ReplicaDown:
+            return None
+        return data if status == 200 else None
+
+    def get_evidence(self, rep_id: str) -> dict | None:
+        try:
+            status, data = self._request("GET", f"/evidence/{rep_id}")
+        except ReplicaDown:
+            return None
+        return data if status == 200 else None
+
+    def close(self, *, drain: bool = False) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# The front-door router
+# ---------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """The front door over N replicas.  Duck-types enough of the
+    ``CheckService`` surface (``submit``/``stats``/``get``/
+    ``get_evidence``) that the web layer can mount it, while the
+    fleet-only verbs (``fence``/``probe``/``rollout``) manage replica
+    lifecycle.
+
+    ``spill_depth_frac``: owner queue-depth fraction above which the
+    power-of-two spill engages.  ``spill_burn``: owner SLO fast-burn
+    fraction (burn/threshold) with the same effect.  ``fence_after``:
+    consecutive failed probes before a fatal-unhealthy replica is
+    fenced.  ``stale_after_s``: pending work with no forward progress
+    for this long reads as wedged (launch-EWMA-scale staleness).
+    ``load_hint_age_s``: how stale a replica's cached queue-depth
+    snapshot may be when the spill comparison reads it — tighten it
+    (loadgen uses 0.02) when launch latency is on the order of the
+    default 0.25s cache, or the power-of-two choice compares last
+    epoch's depths and sheds into yesterday's short queue.
+    ``mint_keys``: mint a history-scoped idempotency key for keyless
+    submits (the default — it is what makes SIGKILL-mid-load
+    resubmission exactly-once even for clients that never heard of
+    idempotency keys); False skips the mint, trading the keyless
+    exactly-once guard for one less durable claim per request.
+    ``successor_factory(name, old_svc) -> CheckService`` powers
+    ``rollout()``."""
+
+    def __init__(self, *, spill_depth_frac: float = 0.5,
+                 spill_burn: float = 1.0, fence_after: int = 3,
+                 stale_after_s: float = 120.0,
+                 load_hint_age_s: float = 0.25,
+                 mint_keys: bool = True,
+                 probe_every_s: float | None = None,
+                 successor_factory=None):
+        self.spill_depth_frac = float(spill_depth_frac)
+        self.spill_burn = float(spill_burn)
+        self.load_hint_age_s = float(load_hint_age_s)
+        self.mint_keys = bool(mint_keys)
+        self.fence_after = int(fence_after)
+        self.stale_after_s = float(stale_after_s)
+        self.probe_every_s = probe_every_s
+        self.successor_factory = successor_factory
+        self._lock = threading.RLock()
+        self._replicas: dict[str, object] = {}   # guarded-by: _lock [rw]
+        self._fenced: set[str] = set()           # guarded-by: _lock [rw]
+        self._rolling: set[str] = set()          # guarded-by: _lock [rw]
+        self._unready: set[str] = set()          # guarded-by: _lock [rw]
+        self._entries: dict[str, _Entry] = {}    # guarded-by: _lock [rw]
+        self._parked: list[_Entry] = []          # guarded-by: _lock [rw]
+        self._probe_state: dict[str, dict] = {}  # guarded-by: _lock [rw]
+        self._totals = {                         # guarded-by: _lock [rw]
+            "routed": 0, "spilled": 0, "resubmitted": 0, "fenced": 0,
+            "parked": 0, "rollouts": 0, "completed": 0, "rejected": 0,
+            "errors": 0, "duplicate_settles": 0,
+        }
+        self._t_start = time.monotonic()
+        self._rng = random.Random(0x5EED)        # guarded-by: _lock [rw]
+        self._probe_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._closed = False
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def add_replica(self, replica) -> "FleetRouter":
+        with self._lock:
+            replica.router = self
+            self._replicas[replica.name] = replica
+            self._fenced.discard(replica.name)
+        self._gauge_health()
+        self._drain_parked()
+        return self
+
+    def add_local(self, name: str, svc: CheckService) -> "FleetRouter":
+        return self.add_replica(LocalReplica(name, svc))
+
+    def replicas(self) -> dict:
+        with self._lock:
+            return dict(self._replicas)
+
+    def start(self) -> "FleetRouter":
+        """Start the background health-probe loop (``probe_every_s``;
+        no-op when None — step-driven callers invoke ``probe()``
+        themselves)."""
+        if self.probe_every_s and self._probe_thread is None:
+            self._stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="fleet-probe", daemon=True
+            )
+            self._probe_thread.start()
+        self._gauge_health()
+        return self
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_every_s):
+            try:
+                self.probe()
+            except Exception:  # noqa: BLE001 — the probe loop must
+                # outlive any single replica's weird failure mode
+                logger.exception("fleet probe failed")
+
+    def shutdown(self, *, drain: bool = False) -> None:
+        self._closed = True
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10.0)
+            self._probe_thread = None
+        for rep in self.replicas().values():
+            rep.close(drain=drain)
+
+    # -- admission ------------------------------------------------------
+
+    def submit(self, history, *, model=None, priority: int = 0,
+               deadline=None, client: str = "anon",
+               trace_id: str | None = None, class_: str | None = None,
+               checker=None, idempotency_key: str | None = None
+               ) -> FleetFuture:
+        """Route one request to its affinity owner (spilling when the
+        owner is hot); returns a ``FleetFuture``.  Raises ``QueueFull``
+        re-quoted with the MIN retry-after across live replicas — a
+        full replica is not a full fleet — and ``ServiceUnavailable``
+        only when EVERY replica's breaker is open."""
+        if self._closed:
+            raise ServiceClosed("fleet router is shutting down")
+        if checker is None and model is None:
+            model = m.CASRegister()
+        key = affinity_key(history, model=model, checker=checker)
+        if idempotency_key is None and self.mint_keys:
+            # History-scoped by construction: the fingerprint prefix
+            # ties the key to THIS history (the map rejects fp-mismatch
+            # reuse), the suffix keeps logical requests distinct.
+            fp = (_health.history_fingerprint(history)
+                  if checker is None else "graph")
+            idempotency_key = f"fleet-{fp[:16]}-{uuid.uuid4().hex[:12]}"
+        entry = _Entry(
+            history=list(history), model=model, priority=int(priority),
+            deadline=faults.Deadline.coerce(deadline), client=str(client),
+            trace_id=trace_id, class_=class_, checker=checker,
+            idem_key=(None if idempotency_key is None
+                      else str(idempotency_key)),
+            affinity=key,
+        )
+        self._route(entry, raise_on_reject=True)
+        return entry.future
+
+    def _candidates(self, entry: _Entry) -> list[str]:
+        with self._lock:
+            alive = [n for n in self._replicas if n not in self._fenced]
+            unready = set(self._unready)
+            local = {n for n, r in self._replicas.items()
+                     if getattr(r, "kind", "") == "local"}
+        if entry.checker is not None:
+            alive = [n for n in alive if n in local]
+        order = _rendezvous(entry.affinity, alive)
+        # ready replicas first (rendezvous order), unready (alive but
+        # e.g. breaker-open) as last resorts so their 503 quotes still
+        # aggregate into the fleet-level answer
+        return ([n for n in order if n not in unready]
+                + [n for n in order if n in unready])
+
+    def _load_frac(self, name: str) -> float:
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            return 1.0
+        try:
+            st = rep.stats(max_age_s=self.load_hint_age_s)
+        except Exception:  # noqa: BLE001 — routing hint only
+            return 1.0
+        depth = int(st.get("queue_depth") or 0) + int(st.get("running") or 0)
+        return depth / max(1, int(st.get("max_queue") or 1))
+
+    def _route(self, entry: _Entry, *, raise_on_reject: bool) -> bool:
+        order = self._candidates(entry)
+        if not order:
+            with self._lock:
+                rolling = bool(self._rolling) or bool(self._replicas)
+            if rolling and not self._closed:
+                # every replica is mid-rollout/fenced but the fleet
+                # exists: park — the work flows when a replica returns
+                # (this is what keeps a rollout 5xx-free)
+                self._park(entry)
+                return False
+            raise ServiceUnavailable(1.0)
+        choice = order[0]
+        spilled = False
+        if len(order) > 1:
+            with self._lock:
+                rep0 = self._replicas.get(order[0])
+            owner_frac = self._load_frac(order[0])
+            owner_burn = rep0.burn() if rep0 is not None else 0.0
+            if (owner_frac >= self.spill_depth_frac
+                    or owner_burn >= self.spill_burn):
+                # canonical power-of-two-choices: the alternate is a
+                # RANDOM non-owner, not the rendezvous runner-up — a
+                # fixed runner-up starves every replica that is rank-3+
+                # for all hot keys (observed: one of three replicas
+                # pinned near-idle under a 5-key workload)
+                with self._lock:
+                    alt = self._rng.choice(order[1:])
+                if self._load_frac(alt) < owner_frac:
+                    choice, spilled = alt, True
+        quotes: list[float] = []
+        depths, limits = 0, 0
+        all_breaker = True
+        for name in [choice] + [n for n in order if n != choice]:
+            with self._lock:
+                rep = self._replicas.get(name)
+                if rep is None or name in self._fenced:
+                    continue
+                entry.suspended = False
+                entry.replica = name
+                self._entries[entry.eid] = entry
+            try:
+                rep_id = rep.submit(entry)
+            except QueueFull as e:
+                all_breaker = False
+                quotes.append(float(e.retry_after))
+                depths += int(getattr(e, "depth", 0) or 0)
+                limits += int(getattr(e, "limit", 0) or 0)
+                continue
+            except ServiceUnavailable as e:
+                quotes.append(float(e.retry_after))
+                continue
+            except ReplicaDown:
+                self.fence(name, reason="submit transport down")
+                continue
+            except BaseException:
+                with self._lock:
+                    self._entries.pop(entry.eid, None)
+                raise
+            entry.rep_id = rep_id
+            entry.rep_ids.append(rep_id)
+            entry.future.id = rep_id
+            with self._lock:
+                self._totals["routed"] += 1
+                if spilled and name == choice:
+                    self._totals["spilled"] += 1
+            obs.counter("fleet.routed", replica=name)
+            if spilled and name == choice:
+                obs.counter("fleet.spilled")
+            return True
+        with self._lock:
+            self._entries.pop(entry.eid, None)
+        if not raise_on_reject:
+            self._park(entry)
+            return False
+        with self._lock:
+            self._totals["rejected"] += 1
+        retry_after = min(quotes) if quotes else 1.0
+        if all_breaker and quotes:
+            # every live replica answered 503: the FLEET is unavailable
+            raise ServiceUnavailable(retry_after)
+        raise QueueFull(depths, limits or depths, retry_after,
+                        tier=entry.class_ or "batch")
+
+    def _park(self, entry: _Entry) -> None:
+        with self._lock:
+            self._parked.append(entry)
+            self._totals["parked"] += 1
+        obs.counter("fleet.parked")
+
+    def _drain_parked(self) -> None:
+        with self._lock:
+            parked, self._parked = self._parked, []
+        for e in parked:
+            if not e.future.done():
+                self._route(e, raise_on_reject=False)
+
+    # -- completion delivery -------------------------------------------
+
+    def _on_result(self, entry: _Entry, name: str, result) -> None:
+        with self._lock:
+            if entry.suspended or entry.replica != name:
+                return  # fenced/zombie source: the resubmission owns it
+            self._entries.pop(entry.eid, None)
+            self._totals["completed"] += 1
+        if not entry.future.set_result(result):
+            with self._lock:
+                self._totals["duplicate_settles"] += 1
+
+    def _on_error(self, entry: _Entry, name: str, exc: BaseException) -> None:
+        with self._lock:
+            if entry.suspended or entry.replica != name:
+                return
+            self._entries.pop(entry.eid, None)
+            self._totals["errors"] += 1
+        entry.future.set_exception(exc)
+
+    def _on_gone(self, entry: _Entry, name: str) -> None:
+        """The replica no longer knows the request (restart without a
+        journal, eviction): resubmit under the same idempotency key —
+        if it actually settled, the shared map answers."""
+        with self._lock:
+            if entry.suspended or entry.replica != name \
+                    or entry.future.done():
+                return
+        self._resubmit(entry)
+
+    # -- failure containment -------------------------------------------
+
+    def fence(self, name: str, *, resubmit: bool = True,
+              reason: str = "") -> list:
+        """Stop routing to ``name`` and (by default) resubmit its
+        in-flight requests through the router under their original
+        idempotency keys — the exactly-once handoff."""
+        with self._lock:
+            if name in self._fenced:
+                return []
+            self._fenced.add(name)
+            self._unready.discard(name)
+            self._totals["fenced"] += 1
+            victims = [e for e in self._entries.values()
+                       if e.replica == name and not e.future.done()]
+            for e in victims:
+                e.suspended = True
+            rep = self._replicas.get(name)
+        logger.warning("fencing replica %r%s (%d in-flight)", name,
+                       f": {reason}" if reason else "", len(victims))
+        obs.counter("fleet.fenced", replica=name)
+        if rep is not None and hasattr(rep, "drop_pending"):
+            rep.drop_pending()
+        self._gauge_health()
+        if resubmit:
+            for e in victims:
+                self._resubmit(e)
+        return victims
+
+    def unfence(self, name: str) -> None:
+        with self._lock:
+            self._fenced.discard(name)
+            ps = self._probe_state.get(name)
+            if ps is not None:
+                ps["fails"] = 0
+        self._gauge_health()
+        self._drain_parked()
+
+    def _resubmit(self, entry: _Entry) -> None:
+        if entry.future.done():
+            return
+        entry.resubmits += 1
+        with self._lock:
+            self._totals["resubmitted"] += 1
+        obs.counter("fleet.resubmitted")
+        entry.suspended = False
+        self._route(entry, raise_on_reject=False)
+
+    def probe(self) -> dict:
+        """One health pass over every replica: readiness plus forward-
+        progress staleness.  ``fence_after`` consecutive FATAL failures
+        fence a replica (and resubmit its work); non-fatal unreadiness
+        (breaker open) only demotes it in routing order."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        for name, rep in self.replicas().items():
+            with self._lock:
+                if name in self._fenced:
+                    out[name] = {"state": "fenced"}
+                    continue
+                ps = self._probe_state.setdefault(
+                    name, {"fails": 0, "prog": None, "t_prog": now}
+                )
+            ok, info, fatal = rep.ready()
+            if ok:
+                try:
+                    st = rep.stats()
+                except ReplicaDown as e:
+                    ok, info, fatal = False, {"reason": str(e)}, True
+                except Exception:  # noqa: BLE001 — stats is advisory
+                    st = None
+                else:
+                    # service totals are spread at the stats top level
+                    pending = (int(st.get("queue_depth") or 0)
+                               + int(st.get("running") or 0))
+                    prog = (st.get("completed"), st.get("batches"),
+                            st.get("graph_batches"))
+                    if prog != ps["prog"]:
+                        ps["prog"], ps["t_prog"] = prog, now
+                    elif pending and now - ps["t_prog"] > self.stale_after_s:
+                        ok, fatal = False, True
+                        info = {"reason": "stale: pending work, no "
+                                          "progress for "
+                                          f"{now - ps['t_prog']:.0f}s"}
+            with self._lock:
+                if ok:
+                    ps["fails"] = 0
+                    self._unready.discard(name)
+                else:
+                    ps["fails"] += 1
+                    self._unready.add(name)
+            if not ok and fatal and ps["fails"] >= self.fence_after:
+                self.fence(name, reason=str(info.get("reason") or "probe"))
+                out[name] = {"state": "fenced", "info": info}
+                continue
+            out[name] = {"state": "up" if ok else "unready", "info": info}
+        self._gauge_health()
+        self._drain_parked()
+        return out
+
+    def _gauge_health(self) -> None:
+        with self._lock:
+            total = len(self._replicas)
+            healthy = len([n for n in self._replicas
+                           if n not in self._fenced
+                           and n not in self._unready])
+        obs.gauge("fleet.replicas", total)
+        obs.gauge("fleet.replicas_healthy", healthy)
+
+    # -- zero-downtime rollout -----------------------------------------
+
+    def rollout(self, factory=None, names: Sequence[str] | None = None
+                ) -> dict:
+        """Cycle replicas one at a time with no 5xx and no verdict
+        loss: fence-for-rollout (new work routes elsewhere or parks),
+        drain the old service to checkpoint, build the successor
+        (``factory(name, old_svc) -> CheckService``; its ``recover()``
+        replays the shared journal dir), finish the drained work with
+        ``resume_drained`` and deliver those verdicts to the ORIGINAL
+        futures, then swap the successor in.  Only local replicas roll
+        (an HTTP worker's lifecycle belongs to its supervisor)."""
+        factory = factory or self.successor_factory
+        if factory is None:
+            raise ValueError("rollout requires a successor factory")
+        with self._lock:
+            targets = [n for n in (names or list(self._replicas))
+                       if getattr(self._replicas.get(n), "kind", "")
+                       == "local" and n not in self._fenced]
+        rolled, skipped = [], []
+        with obs.span("fleet.rollout", replicas=len(targets)):
+            for name in targets:
+                with self._lock:
+                    rep = self._replicas.get(name)
+                    if rep is None or name in self._fenced:
+                        skipped.append(name)
+                        continue
+                    self._fenced.add(name)
+                    self._rolling.add(name)
+                    victims = [e for e in self._entries.values()
+                               if e.replica == name and not e.future.done()]
+                    for e in victims:
+                        e.suspended = True
+                try:
+                    old_svc = rep.svc
+                    old_svc.shutdown(drain=True)
+                    succ = factory(name, old_svc)
+                    # journal replay: idempotent if the factory already
+                    # start()ed the successor
+                    succ.recover()
+                    results_by_id: dict[str, Mapping] = {}
+                    if old_svc.drain_dir is not None \
+                            and old_svc.drain_dir.is_dir():
+                        for g in resume_drained(
+                                old_svc.drain_dir,
+                                capacity=old_svc.capacity,
+                                **old_svc._check_opts):
+                            if "error" in g:
+                                logger.warning("rollout resume failed for "
+                                               "%s: %s", g.get("dir"),
+                                               g["error"])
+                                continue
+                            for rid, res in zip(g["ids"], g["results"]):
+                                results_by_id[str(rid)] = res
+                            # consumed: a later drain into the same dir
+                            # must not re-run this group's work
+                            shutil.rmtree(g["dir"], ignore_errors=True)
+                    with self._lock:
+                        self._replicas[name] = LocalReplica(name, succ)
+                        self._replicas[name].router = self
+                        self._fenced.discard(name)
+                        self._probe_state.pop(name, None)
+                finally:
+                    with self._lock:
+                        self._rolling.discard(name)
+                        self._fenced.discard(name)
+                # deliver: checkpointed verdicts to their original
+                # futures; anything else (journal-replayed or finished
+                # mid-drain) re-attaches through its idempotency key —
+                # affinity routes it back to the successor, where the
+                # replayed request or the settled map entry answers
+                for e in victims:
+                    if e.future.done():
+                        continue
+                    res = results_by_id.get(str(e.rep_id))
+                    if res is not None:
+                        with self._lock:
+                            self._entries.pop(e.eid, None)
+                            self._totals["completed"] += 1
+                        e.future.set_result(res)
+                    else:
+                        self._resubmit(e)
+                rolled.append(name)
+                with self._lock:
+                    self._totals["rollouts"] += 1
+                obs.counter("fleet.rollouts", replica=name)
+                self._gauge_health()
+                self._drain_parked()
+        return {"rolled": rolled, "skipped": skipped}
+
+    # -- observation ----------------------------------------------------
+
+    def get(self, request_id: str) -> dict | None:
+        """Router-wide request lookup: the entry table first (covers
+        every id a resubmitted request ever held), then each live
+        replica."""
+        rid = str(request_id)
+        with self._lock:
+            entry = next((e for e in self._entries.values()
+                          if rid in e.rep_ids), None)
+        if entry is not None and entry.replica is not None:
+            with self._lock:
+                rep = self._replicas.get(entry.replica)
+            if rep is not None:
+                with contextlib.suppress(Exception):
+                    got = rep.get(entry.rep_id)
+                    if got is not None:
+                        return got
+        for rep in self.replicas().values():
+            with contextlib.suppress(Exception):
+                got = rep.get(rid)
+                if got is not None:
+                    return got
+        return None
+
+    def get_evidence(self, request_id: str) -> dict | None:
+        rid = str(request_id)
+        for rep in self.replicas().values():
+            with contextlib.suppress(Exception):
+                got = rep.get_evidence(rid)
+                if got is not None:
+                    return got
+        return None
+
+    def ready(self) -> tuple[bool, dict]:
+        """Fleet readiness: ready while ANY replica can take work."""
+        with self._lock:
+            states = {
+                n: ("fenced" if n in self._fenced
+                    else "unready" if n in self._unready else "up")
+                for n in self._replicas
+            }
+        ok = any(s == "up" for s in states.values()) and not self._closed
+        return ok, {"replicas": states}
+
+    def alerts(self) -> dict:
+        per = {}
+        firing: list = []
+        for name, rep in self.replicas().items():
+            try:
+                a = rep.alerts()
+            except Exception as e:  # noqa: BLE001 — one replica's
+                # alert surface failing must not hide the others'
+                a = {"error": str(e)}
+            per[name] = a
+            for al in a.get("alerts") or []:
+                firing.append(dict(al, replica=name))
+        return {"alerts": firing, "replicas": per, "fleet": True}
+
+    def stats(self) -> dict:
+        per = {}
+        for name, rep in self.replicas().items():
+            row: dict = {"kind": rep.kind}
+            with self._lock:
+                row["state"] = ("fenced" if name in self._fenced
+                                else "unready" if name in self._unready
+                                else "up")
+            try:
+                row["stats"] = rep.stats()
+            except Exception as e:  # noqa: BLE001 — a dead replica
+                # still gets a stats row, with the error in it
+                row["error"] = str(e)
+            per[name] = row
+        with self._lock:
+            totals = dict(self._totals)
+            inflight = len(self._entries)
+            parked = len(self._parked)
+        return {
+            "fleet": True,
+            "replicas": per,
+            "totals": totals,
+            "inflight": inflight,
+            "parked": parked,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Subprocess workers
+# ---------------------------------------------------------------------------
+
+#: the subprocess replica program: one CheckService behind the real
+#: HTTP surface, options as a JSON literal.  READY line carries the
+#: bound port (callers pass 0 to let the OS pick).
+_WORKER_SRC = """\
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+opts = json.loads({opts!r})
+opts["capacity"] = tuple(opts.get("capacity") or (64, 256))
+from jepsen_tpu import web
+from jepsen_tpu.serve.service import CheckService
+svc = CheckService(**opts).start()
+srv = web.make_server("127.0.0.1", {port}, check_service=svc)
+print("FLEET-REPLICA-READY", srv.server_address[1], flush=True)
+srv.serve_forever()
+"""
+
+
+def spawn_replica(name: str, *, port: int = 0, opts: Mapping | None = None,
+                  ready_timeout_s: float = 180.0,
+                  env: Mapping | None = None) -> tuple:
+    """Start one subprocess worker replica (its own process, its own
+    jax runtime) and wait for its HTTP surface.  ``opts`` are
+    CheckService kwargs (JSON-encodable: capacity as a list, dirs as
+    strings — point ``idempotency_dir``/``quarantine_dir`` at the
+    fleet-shared stores with ``idempotency_shared=True``).  Returns
+    ``(Popen, base_url)``; kill the Popen to kill the replica."""
+    import os
+
+    src = _WORKER_SRC.format(opts=json.dumps(dict(opts or {})),
+                             port=int(port))
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    if env:
+        child_env.update({str(k): str(v) for k, v in env.items()})
+    proc = subprocess.Popen(
+        [sys.executable, "-c", src],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=child_env,
+        cwd=str(Path(__file__).resolve().parents[2]),
+    )
+    deadline = time.monotonic() + float(ready_timeout_s)
+    bound = None
+    for line in proc.stdout:  # type: ignore[union-attr]
+        if line.startswith("FLEET-REPLICA-READY"):
+            bound = int(line.split()[1])
+            break
+        if time.monotonic() > deadline or proc.poll() is not None:
+            break
+    if bound is None:
+        with contextlib.suppress(Exception):
+            proc.kill()
+        raise ReplicaDown(name, "worker never became ready")
+
+    # keep draining the child's stdout (request logs) so its pipe
+    # buffer never fills and wedges it
+    def _drain(p=proc):
+        with contextlib.suppress(Exception):
+            for _ in p.stdout:  # type: ignore[union-attr]
+                pass
+
+    threading.Thread(target=_drain, name=f"fleet-worker-log-{name}",
+                     daemon=True).start()
+    return proc, f"http://127.0.0.1:{bound}"

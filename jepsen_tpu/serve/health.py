@@ -58,21 +58,25 @@ import time
 from pathlib import Path
 from typing import Callable, Mapping, Sequence
 
-from jepsen_tpu import store
+from jepsen_tpu import obs, store
 from jepsen_tpu.store import checkpoint as _ckpt
 from jepsen_tpu.store import durable as _durable
 
 logger = logging.getLogger(__name__)
 
 #: durable-record kinds this layer persists (see store.durable): the
-#: admission journal's per-request entries and the idempotency map's
-#: per-key entries.  Both are envelope v1 with a legacy (pre-envelope,
-#: version 0) migration so a pre-durable journal replays unchanged.
+#: admission journal's per-request entries, the idempotency map's
+#: per-key entries, and the shared quarantine registry's per-
+#: fingerprint entries.  All are envelope v1; journal/idem carry a
+#: legacy (pre-envelope, version 0) migration so a pre-durable journal
+#: replays unchanged.
 KIND_JOURNAL = "admission-journal"
 KIND_IDEM = "idempotency-entry"
+KIND_QUAR = "quarantine-entry"
 
 _durable.register_kind(KIND_JOURNAL, 1)
 _durable.register_kind(KIND_IDEM, 1)
+_durable.register_kind(KIND_QUAR, 1)
 
 
 @_durable.register_migration(KIND_JOURNAL, 0)
@@ -156,6 +160,106 @@ class Quarantine:
                 "ttl_s": self.ttl_s,
                 "hits": sum(e["hits"] for e in self._entries.values()),
             }
+
+
+class SharedQuarantine(Quarantine):
+    """``Quarantine`` semantics over a shared fsync'd directory: the
+    fleet-wide poison registry.
+
+    One ``store.durable`` enveloped file per fingerprint.  ``add``
+    writes the entry (under the fingerprint's advisory file lock, see
+    ``store.durable.file_lock``) so EVERY replica pointed at the same
+    ``quarantine_dir`` refuses the history at admission — on its FIRST
+    local offense, not after poisoning its own shared launch too.
+    ``check`` consults the in-memory registry first; a miss costs one
+    ``stat`` on the fingerprint's path (O(1), no directory scan), and a
+    disk hit is adopted into memory, counted as the
+    ``fleet.quarantine_hits`` counter.
+
+    Expiry on disk is WALL clock (``expires`` epoch seconds — replicas
+    don't share a monotonic clock); the in-memory mirror keeps the
+    superclass's monotonic TTL.  Corrupt entries count on ``errors``
+    and read as absent — a broken registry file must degrade to
+    "launch and decide", never to refusing service."""
+
+    def __init__(self, ttl_s: float = 900.0, dir: str | Path | None = None):  # noqa: A002
+        super().__init__(ttl_s)
+        if dir is None:
+            raise ValueError("SharedQuarantine requires a directory; "
+                             "use Quarantine for in-memory-only")
+        self.dir = Path(dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.errors = 0
+        self.disk_hits = 0
+
+    def _fp_path(self, fp: str) -> Path:
+        # fingerprints are sha256 hex; 40 chars of it is filename-safe
+        # and collision-negligible (the payload keeps the full fp and
+        # check() verifies it before trusting the entry)
+        return self.dir / f"quar-{str(fp)[:40]}.json"
+
+    def add(self, fp: str, cause: str) -> None:
+        super().add(fp, cause)
+        now = time.time()
+        payload = {
+            "fp": str(fp), "cause": str(cause)[:300],
+            "added": now, "expires": now + self.ttl_s,
+        }
+        p = self._fp_path(fp)
+        try:
+            with _durable.file_lock(Path(str(p) + ".lock"), timeout_s=10.0):
+                _durable.write_record(p, KIND_QUAR, payload)
+        except Exception:  # noqa: BLE001 — registry persistence is a
+            # fleet-wide aid; THIS replica still quarantines in memory
+            self.errors += 1
+            logger.warning("shared quarantine write failed for %s",
+                           fp, exc_info=True)
+
+    def check(self, fp: str) -> dict | None:
+        e = super().check(fp)
+        if e is not None:
+            return e
+        p = self._fp_path(fp)
+        if not p.exists():
+            return None
+        try:
+            with _durable.file_lock(Path(str(p) + ".lock"), timeout_s=10.0):
+                rr = _durable.read_verified(p, KIND_QUAR)
+        except _durable.DurableError:
+            self.errors += 1
+            return None
+        except Exception:  # noqa: BLE001 — lock timeout / IO error
+            self.errors += 1
+            logger.warning("shared quarantine read failed for %s",
+                           fp, exc_info=True)
+            return None
+        d = rr.payload
+        if not isinstance(d, dict) or d.get("fp") != str(fp):
+            self.errors += 1
+            return None
+        if float(d.get("expires") or 0) <= time.time():
+            with contextlib.suppress(OSError):
+                p.unlink()
+            return None
+        entry = {
+            "cause": str(d.get("cause") or "")[:300],
+            # adopted with a FULL local TTL — same refresh-on-hit
+            # semantics a locally-added entry gets
+            "expires": time.monotonic() + self.ttl_s,
+            "hits": 1,
+            "added": float(d.get("added") or time.time()),
+        }
+        with self._lock:
+            self._entries[str(fp)] = entry
+        self.disk_hits += 1
+        obs.counter("fleet.quarantine_hits")
+        return dict(entry)
+
+    def describe(self) -> dict:
+        out = super().describe()
+        out.update(shared=True, dir=str(self.dir),
+                   disk_hits=self.disk_hits, errors=self.errors)
+        return out
 
 
 def bisect_launch_budget(n: int) -> int:
@@ -412,15 +516,32 @@ class AdmissionJournal:
     ``depth()`` is a CACHED counter (maintained at record/resolve,
     reconciled against the directory at ``replay()``) — it used to
     re-glob the journal dir on every stats call, which made ``GET
-    /queue`` an O(queue-depth) directory walk."""
+    /queue`` an O(queue-depth) directory walk.
 
-    def __init__(self, journal_dir: str | Path):
+    ``shared=True`` serializes record/resolve/replay across PROCESSES
+    under a directory-level advisory lock (``journal.lock``,
+    ``store.durable.file_lock``): a journal dir handed between fleet
+    replicas (rollout successor replaying while the predecessor's last
+    resolves land) can't interleave a replay with a half-applied
+    mutation.  Off by default — a journal dir owned by exactly one
+    process pays no extra syscalls."""
+
+    def __init__(self, journal_dir: str | Path, *, shared: bool = False):
         self.dir = Path(journal_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
+        self.shared = bool(shared)
         self.errors = 0
         self.corrupt_reports: list[dict] = []    # guarded-by: _lock [rw]
         self._lock = threading.Lock()
         self._depth = self._glob_depth()         # guarded-by: _lock [rw]
+
+    @contextlib.contextmanager
+    def _dir_lock(self):
+        if not self.shared:
+            yield
+            return
+        with _durable.file_lock(self.dir / "journal.lock", timeout_s=30.0):
+            yield
 
     def _glob_depth(self) -> int:
         try:
@@ -445,8 +566,9 @@ class AdmissionJournal:
         if idempotency_key is not None:
             entry["idempotency_key"] = str(idempotency_key)
         try:
-            existed = self._path(req_id).exists()
-            _durable.write_record(self._path(req_id), KIND_JOURNAL, entry)
+            with self._dir_lock():
+                existed = self._path(req_id).exists()
+                _durable.write_record(self._path(req_id), KIND_JOURNAL, entry)
             if not existed:
                 with self._lock:
                     self._depth += 1
@@ -459,7 +581,8 @@ class AdmissionJournal:
 
     def resolve(self, req_id: str) -> None:
         try:
-            self._path(req_id).unlink()
+            with self._dir_lock():
+                self._path(req_id).unlink()
         except FileNotFoundError:
             return  # already resolved (or never journaled): depth unchanged
         except OSError:
@@ -481,7 +604,9 @@ class AdmissionJournal:
         actually on disk afterwards (quarantined files leave the
         glob)."""
         out = []
-        for p in sorted(self.dir.glob("req-*.json")):
+        with self._dir_lock():
+            entries = sorted(self.dir.glob("req-*.json"))
+        for p in entries:
             try:
                 rr = _durable.read_verified(p, KIND_JOURNAL)
                 out.append(rr.payload)
@@ -522,11 +647,27 @@ class IdempotencyMap:
     still attaches to the journal-replayed in-flight request (same id)
     or gets the previously settled result.  Corrupt entries are
     quarantined aside and counted (``errors``); persistence failures
-    never fail a submit."""
+    never fail a submit.
+
+    ``shared=True`` makes the dir a FLEET-wide map: claim / rebind /
+    settle / release become read-modify-writes of the key's entry file
+    under a per-key advisory ``fcntl`` lock (a ``.lock`` sidecar,
+    ``store.durable.file_lock``), with the on-disk entry as the source
+    of truth.  Without it, two PROCESSES pointed at the same dir can
+    both claim a key — the in-process ``_lock`` only arbitrates
+    threads — and a router resubmitting a fenced replica's in-flight
+    work through its idempotency keys could double-run a check.  With
+    it, a cross-process duplicate claim loses atomically: the loser
+    reads the winner's live entry and attaches (or, if the winner died
+    unsettled, rebinds under the same lock).  ``settle`` takes an
+    optional ``req_id`` CAS so a fenced-but-still-running zombie
+    replica whose request was rebound elsewhere can never overwrite
+    the binding's verdict of record."""
 
     def __init__(self, dir: str | Path | None = None,  # noqa: A002
-                 ttl_s: float = 3600.0):
+                 ttl_s: float = 3600.0, *, shared: bool = False):
         self.dir = Path(dir) if dir is not None else None
+        self.shared = bool(shared) and self.dir is not None
         self.ttl_s = float(ttl_s)
         self.errors = 0
         self._lock = threading.Lock()
@@ -551,6 +692,63 @@ class IdempotencyMap:
         digest = _hashlib.sha256(key.encode()).hexdigest()[:24]
         return self.dir / f"idem-{digest}.json"
 
+    # -- shared (cross-process) mode helpers ---------------------------
+
+    def _key_lock(self, key: str):
+        # sidecar lock file, never unlinked (see durable.file_lock) —
+        # and never matched by replay()'s "idem-*.json" glob
+        return _durable.file_lock(
+            Path(str(self._path(key)) + ".lock"), timeout_s=30.0
+        )
+
+    # holds: the key's file lock
+    def _read_disk_locked(self, key: str) -> dict | None:
+        """The live on-disk entry for ``key``, or None.  An expired
+        file is deleted here (safe: we hold its lock); a corrupt one
+        reads as absent and counts on ``errors`` (read_verified has
+        already quarantined it aside)."""
+        p = self._path(key)
+        if not p.exists():
+            return None
+        try:
+            rr = _durable.read_verified(p, KIND_IDEM)
+        except _durable.DurableError:
+            self.errors += 1
+            return None
+        e = rr.payload
+        if not isinstance(e, dict) or "key" not in e:
+            self.errors += 1
+            return None
+        if time.time() - float(e.get("ts") or 0) > self.ttl_s:
+            with contextlib.suppress(OSError):
+                p.unlink()
+            return None
+        return {
+            "key": str(e["key"]), "req_id": str(e.get("req_id") or ""),
+            "ts": float(e.get("ts") or time.time()),
+            "result": e.get("result"), "fp": e.get("fp"),
+        }
+
+    # holds: the key's file lock
+    def _write_disk_locked(self, key: str, snapshot: dict) -> None:
+        try:
+            _durable.write_record(self._path(key), KIND_IDEM, snapshot)
+        except Exception:  # noqa: BLE001 — same contract as _persist
+            self.errors += 1
+            logger.warning("idempotency entry write failed for key %r",
+                           key, exc_info=True)
+
+    # holds: the key's file lock
+    def _sync_memory_locked(self, key: str, disk: dict | None) -> None:
+        """Make the in-memory mirror agree with the disk truth just
+        read under the lock (another process may have moved the key)."""
+        with self._lock:
+            if disk is None:
+                self._entries.pop(key, None)
+            else:
+                self._entries[key] = dict(disk)
+            self._seq += 1
+
     # holds: _lock
     def _purge_locked(self) -> list[str]:
         """Drop expired entries from memory; returns the expired keys
@@ -572,8 +770,26 @@ class IdempotencyMap:
         an expired ts and deletes it, or an unsettled binding to a
         request that never ran, which the rebind-after-grace path runs
         fresh.  What must NOT leak is ``_written``: popping the key
-        here is what keeps the seq map bounded by live entries."""
+        here is what keeps the seq map bounded by live entries.
+
+        Shared mode re-checks the DISK ts under the key's file lock
+        before unlinking: this replica's memory expiring a key says
+        nothing about a sibling replica having refreshed it since."""
         if self.dir is None or not keys:
+            return
+        if self.shared:
+            for k in keys:
+                try:
+                    with self._key_lock(k):
+                        # reads the disk entry; an expired one is
+                        # unlinked inside, a live (refreshed-elsewhere)
+                        # one is left alone
+                        self._read_disk_locked(k)
+                except Exception:  # noqa: BLE001 — lock timeout/IO
+                    self.errors += 1
+            with self._io_lock:
+                for k in keys:
+                    self._written.pop(k, None)
             return
         with self._io_lock:
             for k in keys:
@@ -613,6 +829,29 @@ class IdempotencyMap:
         key collision would hand one caller another history's
         verdict."""
         key = str(key)
+        if self.shared:
+            # Cross-process atomicity: the entry FILE is the claim
+            # token.  Under the key's advisory lock, read disk truth —
+            # a live entry (ours from an earlier claim, or a sibling
+            # replica's) loses the claim; absence binds us, and the
+            # write lands BEFORE the lock releases, so no second
+            # process can observe the gap two in-process claims never
+            # had.
+            with self._lock:
+                dead = self._purge_locked()
+            with self._key_lock(key):
+                disk = self._read_disk_locked(key)
+                if disk is not None:
+                    self._sync_memory_locked(key, disk)
+                    claimed = None
+                else:
+                    e = {"key": key, "req_id": str(req_id),
+                         "ts": time.time(), "result": None, "fp": fp}
+                    self._sync_memory_locked(key, e)
+                    self._write_disk_locked(key, dict(e))
+                    claimed = dict(e)
+            self._unlink_keys(dead)
+            return None if claimed is not None else dict(disk)
         with self._lock:
             dead = self._purge_locked()
             e = self._entries.get(key)
@@ -633,9 +872,22 @@ class IdempotencyMap:
 
     def rebind(self, key: str, old_req_id: str, new_req_id: str) -> bool:
         """CAS a STALE entry (its request evaporated — e.g. evicted
-        before settling) onto a new request id.  False when the entry
-        changed underneath (someone else rebound or settled it)."""
+        before settling, or bound by a replica that died) onto a new
+        request id.  False when the entry changed underneath (someone
+        else rebound or settled it)."""
         key = str(key)
+        if self.shared:
+            with self._key_lock(key):
+                disk = self._read_disk_locked(key)
+                if disk is None or disk["req_id"] != str(old_req_id) \
+                        or disk["result"] is not None:
+                    self._sync_memory_locked(key, disk)
+                    return False
+                disk["req_id"] = str(new_req_id)
+                disk["ts"] = time.time()
+                self._sync_memory_locked(key, disk)
+                self._write_disk_locked(key, dict(disk))
+            return True
         with self._lock:
             e = self._entries.get(key)
             if e is None or e["req_id"] != str(old_req_id) \
@@ -648,14 +900,33 @@ class IdempotencyMap:
         self._persist(key, seq, snapshot)
         return True
 
-    def settle(self, key: str, result: Mapping | None) -> None:
+    def settle(self, key: str, result: Mapping | None,
+               req_id: str | None = None) -> None:
         """Record the settled verdict against ``key`` (refreshes the
         TTL: a settled entry answers duplicates for a full window after
-        the verdict, not after the submit)."""
+        the verdict, not after the submit).  With ``req_id``, settle
+        only if the key is still bound to THAT request — the fence/
+        rebind race guard: a zombie replica finishing a request whose
+        key the router already rebound elsewhere must discard its
+        verdict, not publish it over the binding of record."""
         key = str(key)
+        if self.shared:
+            with self._key_lock(key):
+                disk = self._read_disk_locked(key)
+                if disk is None or (req_id is not None
+                                    and disk["req_id"] != str(req_id)):
+                    self._sync_memory_locked(key, disk)
+                    return
+                disk["result"] = store._jsonable(dict(result)) \
+                    if result is not None else None
+                disk["ts"] = time.time()
+                self._sync_memory_locked(key, disk)
+                self._write_disk_locked(key, dict(disk))
+            return
         with self._lock:
             e = self._entries.get(key)
-            if e is None:
+            if e is None or (req_id is not None
+                             and e["req_id"] != str(req_id)):
                 return
             e["result"] = store._jsonable(dict(result)) \
                 if result is not None else None
@@ -669,6 +940,21 @@ class IdempotencyMap:
         admission) so the client's retry isn't answered with a request
         that never existed."""
         key = str(key)
+        if self.shared:
+            with self._key_lock(key):
+                disk = self._read_disk_locked(key)
+                if disk is None or disk["req_id"] != str(req_id) \
+                        or disk["result"] is not None:
+                    self._sync_memory_locked(key, disk)
+                    return
+                self._sync_memory_locked(key, None)
+                try:
+                    self._path(key).unlink(missing_ok=True)
+                except OSError:
+                    self.errors += 1
+            with self._io_lock:
+                self._written.pop(key, None)
+            return
         with self._lock:
             e = self._entries.get(key)
             if e is None or e["req_id"] != str(req_id) \
@@ -740,6 +1026,7 @@ class IdempotencyMap:
                 "ttl_s": self.ttl_s,
                 "errors": self.errors,
                 "journaled": self.dir is not None,
+                "shared": self.shared,
             }
         self._unlink_keys(dead)
         return out

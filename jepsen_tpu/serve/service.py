@@ -360,8 +360,11 @@ class CheckService:
         drain_dir: str | Path | None = None,
         evidence_dir: str | Path | None = None,
         journal_dir: str | Path | None = None,
+        journal_shared: bool = False,
         idempotency_dir: str | Path | None = None,
+        idempotency_shared: bool = False,
         idempotency_ttl_s: float = 3600.0,
+        quarantine_dir: str | Path | None = None,
         quarantine_ttl_s: float = 900.0,
         poison_bisect: bool = True,
         breaker_threshold: int = 5,
@@ -434,7 +437,16 @@ class CheckService:
             "idempotent_hits": 0,
         }
         # -- the self-healing layer (serve.health) ----------------------
-        self.quarantine = _health.Quarantine(ttl_s=quarantine_ttl_s)
+        #: with ``quarantine_dir``, the registry is the FLEET-wide
+        #: durable store (serve.health.SharedQuarantine): a history
+        #: poisoned by any replica sharing the dir is refused at
+        #: admission here on its first local offense.
+        self.quarantine = (
+            _health.SharedQuarantine(ttl_s=quarantine_ttl_s,
+                                     dir=quarantine_dir)
+            if quarantine_dir is not None
+            else _health.Quarantine(ttl_s=quarantine_ttl_s)
+        )
         self.poison_bisect = bool(poison_bisect)
         self.breaker = _health.CircuitBreaker(
             threshold=breaker_threshold, cooldown_s=breaker_cooldown_s
@@ -447,14 +459,17 @@ class CheckService:
             if watchdog_factor else None
         )
         self.journal = (
-            _health.AdmissionJournal(journal_dir)
+            _health.AdmissionJournal(journal_dir, shared=journal_shared)
             if journal_dir is not None else None
         )
         #: the idempotent-resubmission registry: in-memory always (a
         #: duplicate within one process dedups regardless), journaled
-        #: when ``idempotency_dir`` is set so it survives SIGKILL.
+        #: when ``idempotency_dir`` is set so it survives SIGKILL, and
+        #: cross-process atomic when ``idempotency_shared`` marks the
+        #: dir as fleet-shared (per-key advisory file locks).
         self.idempotency = _health.IdempotencyMap(
-            idempotency_dir, ttl_s=idempotency_ttl_s
+            idempotency_dir, ttl_s=idempotency_ttl_s,
+            shared=idempotency_shared,
         )
         #: keys with a submit currently mid-_admit (claim taken, request
         #: not yet in _requests): count per key — the live signal that
@@ -678,7 +693,11 @@ class CheckService:
         def _done(f):
             try:
                 if not f.cancelled() and req.status == "done":
-                    self.idempotency.settle(key, req.result)
+                    # req_id-CAS'd: if a fleet router rebound this key
+                    # to another replica's request after fencing us,
+                    # our late verdict is discarded, not published
+                    self.idempotency.settle(key, req.result,
+                                            req_id=req.id)
                 else:
                     self.idempotency.release(key, req.id)
             except Exception:  # noqa: BLE001 — bookkeeping must not
@@ -981,11 +1000,20 @@ class CheckService:
         # with a concurrently-draining sibling, so its sweep keeps the
         # age gate.
         if self.journal is not None:
-            _durable.sweep_tmp(self.journal.dir, min_age_s=0.0,
-                               what="serve.journal")
+            _durable.sweep_tmp(
+                self.journal.dir,
+                min_age_s=60.0 if self.journal.shared else 0.0,
+                what="serve.journal")
         if self.idempotency.dir is not None:
-            _durable.sweep_tmp(self.idempotency.dir, min_age_s=0.0,
-                               what="serve.idempotency")
+            # a SHARED dir has live sibling writers — keep the age gate
+            # so their in-flight tmp files survive this start
+            _durable.sweep_tmp(
+                self.idempotency.dir,
+                min_age_s=60.0 if self.idempotency.shared else 0.0,
+                what="serve.idempotency")
+        qdir = getattr(self.quarantine, "dir", None)
+        if qdir is not None:
+            _durable.sweep_tmp(qdir, what="serve.quarantine")
         if self.drain_dir is not None and self.drain_dir.is_dir():
             _durable.sweep_tmp(self.drain_dir, what="serve.drain")
             for sub in self.drain_dir.iterdir():

@@ -396,6 +396,63 @@ def check_line(record: Mapping) -> tuple[bool, bool]:
 
 
 # ---------------------------------------------------------------------------
+# Cross-process advisory locking
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def file_lock(path, *, timeout_s: float | None = None):
+    """Exclusive ``fcntl.flock`` advisory lock on ``path`` (created if
+    missing) for the duration of the scope.
+
+    This is the cross-PROCESS companion to the in-process locks the
+    durable-dir owners (idempotency map, shared quarantine) already
+    hold: two processes sharing one ``--idempotency-dir`` serialize
+    their read-modify-write of a key's entry file here, which is what
+    makes a claim atomic across a fleet instead of within one service.
+
+    The lock file is a SIDECAR (never the record file itself —
+    ``write_record`` replaces records via rename, and a lock taken on a
+    renamed-away inode excludes nobody) and is never unlinked: deleting
+    a lock file another process is blocked on would hand a third
+    process a fresh inode and break mutual exclusion.  One empty
+    sidecar per key is the rent.
+
+    ``timeout_s`` bounds the wait (LOCK_NB + backoff); ``TimeoutError``
+    after it.  None blocks indefinitely.  On platforms without fcntl
+    (not a supported deployment target) the scope degrades to the
+    caller's in-process locking."""
+    import fcntl  # POSIX-only; imported here so module import never fails
+
+    path = Path(path)
+    fd = os.open(str(path), os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        if timeout_s is None:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        else:
+            import time as _time
+
+            deadline = _time.monotonic() + float(timeout_s)
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    break
+                except OSError:
+                    if _time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"file_lock timed out after {timeout_s}s: {path}"
+                        ) from None
+                    _time.sleep(0.005)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            import fcntl as _fcntl
+
+            _fcntl.flock(fd, _fcntl.LOCK_UN)
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
 # Orphaned-tmp sweep
 # ---------------------------------------------------------------------------
 

@@ -37,6 +37,22 @@ the check API:
                      per-objective fast/slow-window burn table (the
                      home page shows a panel)
 
+When a ``jepsen_tpu.serve.fleet.FleetRouter`` is mounted instead
+(``make_server(..., fleet=router)`` / ``jepsen-tpu serve --check
+--replicas N``) the SAME check API fronts the whole replica fleet:
+submissions route by geometry affinity, 429 re-quotes Retry-After as
+the MIN across live replicas, 503 means every replica's breaker is
+open, /readyz is 200 while ANY replica can take work, and two admin
+endpoints appear:
+
+  GET  /fleet          fleet status: per-replica state/stats + router
+                       totals (routed/spilled/fenced/resubmitted/
+                       rollouts/parked)
+  POST /fleet/rollout  zero-downtime rollout: cycle local replicas
+                       through drain → successor (journal replay +
+                       resume_drained) → swap; body may name specific
+                       replicas ({"names": [...]})
+
 Oversized ``POST /check`` bodies are rejected 413 BEFORE the JSON parse
 (``make_server(..., max_request_mb=)`` / ``serve --max-request-mb``) so
 one hostile payload can't balloon the process ahead of admission
@@ -632,6 +648,11 @@ def telemetry_html(run_dir: Path, rel: str | None = None) -> str:
 class Handler(BaseHTTPRequestHandler):
     store_dir = None
     check_service = None  # a jepsen_tpu.serve.CheckService, or None
+    #: a jepsen_tpu.serve.fleet.FleetRouter, or None.  When mounted it
+    #: fronts /check, /queue, /alerts, /readyz and the /fleet admin
+    #: surface; 429s re-quote Retry-After as the MIN across live
+    #: replicas and 503 means EVERY replica's breaker is open.
+    fleet = None
     profiler = None  # a jepsen_tpu.obs.profiler.ProfilerHook, or None
     #: request-body bound for POST /check, enforced on Content-Length
     #: BEFORE the body is read or parsed (413 beyond it).
@@ -667,10 +688,13 @@ class Handler(BaseHTTPRequestHandler):
             if path in ("/profile/start", "/profile/stop"):
                 self._handle_profile(path)
                 return
+            if path == "/fleet/rollout":
+                self._handle_rollout()
+                return
             if path != "/check":
                 self._send(404, b"not found")
                 return
-            svc = self.check_service
+            svc = self.fleet or self.check_service
             if svc is None:
                 self._send_json(
                     503, {"error": "no check service mounted "
@@ -770,7 +794,10 @@ class Handler(BaseHTTPRequestHandler):
                 self._send_json(503, {"error": "service shutting down"})
                 return
             req = svc.get(fut.id)
-            tid = req.trace_id if req is not None else None
+            # the fleet router's get() returns the describe() document
+            # directly; the single service returns the request object
+            tid = (req.get("trace_id") if isinstance(req, dict)
+                   else req.trace_id if req is not None else None)
             if body.get("wait"):
                 import concurrent.futures
 
@@ -826,6 +853,30 @@ class Handler(BaseHTTPRequestHandler):
             doc = self.profiler.stop()
         self._send_json(409 if doc.get("error") else 200, doc)
 
+    def _handle_rollout(self) -> None:
+        """POST /fleet/rollout — cycle the fleet's local replicas with
+        zero downtime (serve.fleet.FleetRouter.rollout): drain each to
+        checkpoint, start its successor (journal replay +
+        resume_drained), swap, no 5xx, no verdict loss.  Body may name
+        specific replicas: {"names": ["r0"]}."""
+        if self.fleet is None:
+            self._send_json(503, {"error": "no fleet mounted "
+                                           "(start with serve --replicas N)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            self._send_json(400, {"error": "bad JSON body"})
+            return
+        names = body.get("names")
+        try:
+            doc = self.fleet.rollout(names=names)
+        except ValueError as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        self._send_json(200, doc)
+
     def do_GET(self):  # noqa: N802 - stdlib API
         try:
             path = unquote(self.path.split("?")[0])
@@ -853,6 +904,13 @@ class Handler(BaseHTTPRequestHandler):
                 )
             elif path == "/readyz":
                 # Readiness: mounted + admitting + breaker not open.
+                # With a fleet mounted, ready while ANY replica can
+                # take work — one replica's breaker is not an outage.
+                if self.fleet is not None:
+                    ok, info = self.fleet.ready()
+                    self._send_json(
+                        200 if ok else 503, {"ready": ok, **info})
+                    return
                 svc = self.check_service
                 if svc is None:
                     self._send_json(
@@ -909,38 +967,56 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/perf":
                 self._send(200, perf_html(self.store_dir).encode())
             elif path == "/queue":
-                if self.check_service is None:
+                front = self.fleet or self.check_service
+                if front is None:
                     self._send_json(503, {"error": "no check service mounted"})
                 else:
-                    self._send_json(200, self.check_service.stats())
+                    self._send_json(200, front.stats())
+            elif path == "/fleet":
+                # Fleet status: per-replica state/stats + router totals
+                # (routed/spilled/fenced/resubmitted/rollouts/parked).
+                if self.fleet is None:
+                    self._send_json(
+                        503, {"error": "no fleet mounted "
+                                       "(start with serve --replicas N)"})
+                else:
+                    self._send_json(200, self.fleet.stats())
             elif path == "/alerts":
                 # The live SLO burn-rate engine's alert document:
                 # currently-firing alerts plus the full per-SLO burn
                 # table (fast/slow windows) — loadgen's acceptance
-                # gates and operators' pagers both read this.
+                # gates and operators' pagers both read this.  A fleet
+                # answers the merged per-replica document.
+                if self.fleet is not None:
+                    self._send_json(200, self.fleet.alerts())
+                    return
                 svc = self.check_service
                 if svc is None or getattr(svc, "slo", None) is None:
                     self._send_json(503, {"error": "no check service mounted"})
                 else:
                     self._send_json(200, svc.slo.alerts())
             elif path.startswith("/check/"):
-                if self.check_service is None:
+                front = self.fleet or self.check_service
+                if front is None:
                     self._send_json(503, {"error": "no check service mounted"})
                 else:
-                    req = self.check_service.get(path[len("/check/"):])
+                    req = front.get(path[len("/check/"):])
                     if req is None:
                         self._send_json(404, {"error": "unknown request id"})
                     else:
-                        self._send_json(200, req.describe())
+                        self._send_json(
+                            200,
+                            req if isinstance(req, dict) else req.describe())
             elif path.startswith("/evidence/"):
                 # The verdict's evidence bundle (obs.provenance): the
                 # full decision path + witness for one served request,
                 # keyed by the SAME id as GET /check/<id>.  Audit it
                 # offline with tools/evidence.py verify / replay.
-                if self.check_service is None:
+                front = self.fleet or self.check_service
+                if front is None:
                     self._send_json(503, {"error": "no check service mounted"})
                 else:
-                    bundle = self.check_service.get_evidence(
+                    bundle = front.get_evidence(
                         path[len("/evidence/"):])
                     if bundle is None:
                         self._send_json(
@@ -1004,14 +1080,15 @@ class Handler(BaseHTTPRequestHandler):
 
 def make_server(host="0.0.0.0", port=8080, store_dir=None,
                 check_service=None, profiler=None,
-                max_request_mb: float = 32.0) -> ThreadingHTTPServer:
+                max_request_mb: float = 32.0,
+                fleet=None) -> ThreadingHTTPServer:
     # A mounted web server IS a serving process: turn the live metrics
     # registry on so /metrics (and the home panel) have data to show.
     obs_metrics.enable_mirror()
     handler = type(
         "BoundHandler", (Handler,),
         {"store_dir": store_dir, "check_service": check_service,
-         "profiler": profiler,
+         "fleet": fleet, "profiler": profiler,
          "max_request_bytes": int(max_request_mb * 1024 * 1024),
          "t_start": time.monotonic()},
     )
@@ -1019,13 +1096,15 @@ def make_server(host="0.0.0.0", port=8080, store_dir=None,
 
 
 def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None,
-          profiler=None, max_request_mb: float = 32.0):
+          profiler=None, max_request_mb: float = 32.0, fleet=None):
     """Blocking server (web.clj:385-390).  With a ``check_service`` the
     check API mounts and shutdown drains it (checkpointing queued work);
+    with a ``fleet`` (serve.fleet.FleetRouter) the check API fronts the
+    whole replica fleet instead (+ GET /fleet, POST /fleet/rollout);
     with a ``profiler`` (obs.profiler.ProfilerHook) the /profile
     endpoints drive bounded device captures."""
     srv = make_server(host, port, store_dir, check_service, profiler,
-                      max_request_mb=max_request_mb)
+                      max_request_mb=max_request_mb, fleet=fleet)
     logger.info("serving store on http://%s:%d", host, port)
     try:
         srv.serve_forever()
@@ -1033,7 +1112,9 @@ def serve(host="0.0.0.0", port=8080, store_dir=None, check_service=None,
         srv.server_close()
         if profiler is not None:
             profiler.stop()
-        if check_service is not None:
+        if fleet is not None:
+            fleet.shutdown(drain=True)
+        elif check_service is not None:
             check_service.shutdown(drain=True)
 
 

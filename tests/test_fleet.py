@@ -1,0 +1,400 @@
+"""Fleet federation tests (jepsen_tpu.serve.fleet): affinity routing,
+power-of-two spill, fence + idempotent resubmission, fleet-wide
+quarantine, zero-downtime rollout, and the Retry-After aggregation
+contract.
+
+Kernel shapes are shared with tests/test_serve.py — (30, 3) and
+(30, 12) register histories at capacity (64, 256) — so every launch
+re-hits runner caches the suite already paid to compile (tier-1 budget
+is tight).  Router-level tests drive UNSTARTED services through
+``svc.step()`` so routing decisions are deterministic; the live
+multi-replica SIGKILL round is slow-marked."""
+
+import pathlib
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu import serve as sv
+from jepsen_tpu.parallel import batch_analysis
+from jepsen_tpu.serve import fleet as fl
+from jepsen_tpu.serve import health as hl
+
+#: the suite-shared ladder (same shapes as test_serve.py).
+KW = dict(capacity=(64, 256), warm_pool=False)
+
+
+def mixed_histories(n=6, ops=30, procs=3):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(ops, procs, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+def step_all(router, rounds=4):
+    """Step every local replica until nothing is queued anywhere."""
+    for _ in range(rounds):
+        for rep in router.replicas().values():
+            while rep.svc.stats()["queue_depth"] > 0:
+                rep.svc.step()
+
+
+# ---------------------------------------------------------------------------
+# Affinity keys and rendezvous placement
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_geometry_stability():
+    """Same padded geometry -> same key (batchable together anywhere);
+    different geometry -> different key; and rendezvous order is a pure
+    function of (key, names) with single-failure locality: removing one
+    replica moves ONLY the keys it owned."""
+    a1 = fl.affinity_key(valid_register_history(30, 3, seed=1, info_rate=0.1))
+    a2 = fl.affinity_key(valid_register_history(30, 3, seed=99, info_rate=0.1))
+    wide = fl.affinity_key(valid_register_history(30, 12, seed=1, info_rate=0.1))
+    assert a1 == a2
+    assert a1 != wide
+    names = ["r0", "r1", "r2"]
+    keys = [f"{a1}#{i}" for i in range(24)]
+    owners = {k: fl._rendezvous(k, names)[0] for k in keys}
+    assert {fl._rendezvous(k, names)[0] for k in keys} == set(
+        owners.values()
+    )  # deterministic
+    dead = "r1"
+    survivors = [n for n in names if n != dead]
+    for k in keys:
+        if owners[k] != dead:
+            # a key NOT owned by the dead replica keeps its owner
+            assert fl._rendezvous(k, survivors)[0] == owners[k]
+
+
+def test_trivial_and_graph_affinity_buckets():
+    assert fl.affinity_key([]).endswith(":trivial")
+    assert fl.affinity_key([], model=m.FIFOQueue()).startswith("fifo")
+
+
+# ---------------------------------------------------------------------------
+# Routing: owner first, spill under load
+# ---------------------------------------------------------------------------
+
+
+def test_router_routes_to_owner_with_verdict_parity():
+    hists = mixed_histories(4)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    router = fl.FleetRouter()
+    router.add_local("r0", sv.CheckService(**KW))
+    router.add_local("r1", sv.CheckService(**KW))
+    owner = fl._rendezvous(fl.affinity_key(hists[0]), ["r0", "r1"])[0]
+    futs = [router.submit(hh, client="t") for hh in hists]
+    # all four share one affinity key -> all on the rendezvous owner
+    assert router.replicas()[owner].svc.stats()["queue_depth"] == 4
+    step_all(router)
+    assert [f.result(timeout=30)["valid?"] for f in futs] == [
+        d["valid?"] for d in direct
+    ]
+    st = router.stats()
+    assert st["totals"]["routed"] == 4
+    assert st["totals"]["completed"] == 4
+    assert st["totals"]["duplicate_settles"] == 0
+    assert st["inflight"] == 0
+    router.shutdown()
+
+
+def test_spill_sheds_to_lighter_replica_on_depth():
+    """With the spill threshold at zero and fresh load hints, a loaded
+    owner sheds to the lighter alternate (power-of-two choices)."""
+    hists = [valid_register_history(30, 3, seed=i, info_rate=0.1)
+             for i in range(6)]
+    router = fl.FleetRouter(spill_depth_frac=0.0, load_hint_age_s=0.0)
+    router.add_local("r0", sv.CheckService(**KW))
+    router.add_local("r1", sv.CheckService(**KW))
+    for hh in hists:
+        router.submit(hh, client="t")
+    depths = {n: rep.svc.stats()["queue_depth"]
+              for n, rep in router.replicas().items()}
+    # first lands on the owner; once the owner is deeper, spill engages
+    assert router.stats()["totals"]["spilled"] > 0
+    assert min(depths.values()) > 0, f"one replica never fed: {depths}"
+    step_all(router)
+    router.shutdown()
+
+
+def test_spill_on_burn_threshold():
+    """spill_burn=0 treats any owner burn as hot — the SLO-burn arm of
+    the spill condition routes to the lighter alternate without waiting
+    for queue depth."""
+    hists = [valid_register_history(30, 3, seed=i, info_rate=0.1)
+             for i in range(4)]
+    router = fl.FleetRouter(spill_burn=0.0, load_hint_age_s=0.0)
+    router.add_local("r0", sv.CheckService(**KW))
+    router.add_local("r1", sv.CheckService(**KW))
+    for hh in hists:
+        router.submit(hh, client="t")
+    assert router.stats()["totals"]["spilled"] > 0
+    step_all(router)
+    router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fencing + idempotent resubmission
+# ---------------------------------------------------------------------------
+
+
+def test_fence_resubmits_with_identical_verdicts(tmp_path):
+    """Fencing a replica mid-flight moves its queued work to the
+    survivor; every future settles exactly once with verdicts identical
+    to a direct check, and the zombie's late results are dropped."""
+    hists = mixed_histories(4)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    router = fl.FleetRouter()
+    svc_a = sv.CheckService(idempotency_dir=tmp_path / "idem",
+                            idempotency_shared=True, **KW)
+    svc_b = sv.CheckService(idempotency_dir=tmp_path / "idem",
+                            idempotency_shared=True, **KW)
+    router.add_local("r0", svc_a)
+    router.add_local("r1", svc_b)
+    owner = fl._rendezvous(fl.affinity_key(hists[0]), ["r0", "r1"])[0]
+    victim = router.replicas()[owner]
+    survivor = "r1" if owner == "r0" else "r0"
+    futs = [router.submit(hh, client="t", idempotency_key=f"k-{i}")
+            for i, hh in enumerate(hists)]
+    assert victim.svc.stats()["queue_depth"] == 4
+    router.fence(owner, reason="test")
+    st = router.stats()
+    assert st["totals"]["fenced"] == 1
+    assert st["totals"]["resubmitted"] == 4
+    assert router.replicas()[survivor].svc.stats()["queue_depth"] == 4
+    step_all(router)
+    assert [f.result(timeout=30)["valid?"] for f in futs] == [
+        d["valid?"] for d in direct
+    ]
+    # the fenced replica finishing its copy later must be a no-op
+    while victim.svc.stats()["queue_depth"] > 0:
+        victim.svc.step()
+    assert router.stats()["totals"]["duplicate_settles"] == 0
+    router.unfence(owner)
+    router.shutdown()
+
+
+def test_shared_idempotency_single_winner_across_instances(tmp_path):
+    """Two IdempotencyMap instances over one shared dir (two replicas
+    of one fleet): exactly one claim wins per key."""
+    m1 = hl.IdempotencyMap(dir=tmp_path / "idem", shared=True)
+    m2 = hl.IdempotencyMap(dir=tmp_path / "idem", shared=True)
+    assert m1.claim("key-1", "req-a", fp="fp-1") is None  # ours
+    other = m2.claim("key-1", "req-b", fp="fp-1")
+    assert other is not None and other["req_id"] == "req-a"
+    m1.settle("key-1", {"valid?": True}, req_id="req-a")
+    settled = m2.claim("key-1", "req-c", fp="fp-1")
+    assert settled["result"]["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_quarantine_first_offense_everywhere(tmp_path):
+    """A history poisoned on replica A is refused by replica B on its
+    FIRST submission there — the shared registry spends zero launches
+    fleet-wide on known poison."""
+    hist = valid_register_history(30, 3, seed=5, info_rate=0.1)
+    fp = hl.history_fingerprint(hist)
+    svc_a = sv.CheckService(quarantine_dir=tmp_path / "quar", **KW)
+    svc_b = sv.CheckService(quarantine_dir=tmp_path / "quar", **KW)
+    svc_a.quarantine.add(fp, "poison: test")
+    b_batches = svc_b.stats()["batches"]
+    fut = svc_b.submit(hist, client="t")
+    res = fut.result(timeout=10)
+    assert res["valid?"] == "unknown"
+    assert "quarantine" in str(res.get("cause", "")).lower()
+    assert svc_b.stats()["quarantined"] == 1
+    assert svc_b.stats()["batches"] == b_batches  # zero launches
+    svc_a.shutdown(drain=False)
+    svc_b.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Zero-downtime rollout
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_drains_and_delivers_identical_verdicts(tmp_path):
+    """rollout(): queued work on the old replica is drained to a
+    checkpoint, finished by the resume machinery, and delivered to the
+    ORIGINAL futures; the successor serves the next wave."""
+    hists = mixed_histories(4)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+
+    def mk(name):
+        return sv.CheckService(drain_dir=tmp_path / f"drain-{name}", **KW)
+
+    router = fl.FleetRouter(successor_factory=lambda name, old: mk(name))
+    router.add_local("r0", mk("r0"))
+    old_svc = router.replicas()["r0"].svc
+    futs = [router.submit(hh, client="t") for hh in hists]
+    out = router.rollout()
+    assert out["rolled"] == ["r0"]
+    assert [f.result(timeout=30)["valid?"] for f in futs] == [
+        d["valid?"] for d in direct
+    ]
+    succ = router.replicas()["r0"].svc
+    assert succ is not old_svc
+    # the successor serves the next wave normally
+    f2 = router.submit(hists[0], client="t")
+    while succ.stats()["queue_depth"] > 0:
+        succ.step()
+    assert f2.result(timeout=30)["valid?"] == direct[0]["valid?"]
+    assert router.stats()["totals"]["rollouts"] == 1
+    router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After aggregation (a full replica is not a full fleet)
+# ---------------------------------------------------------------------------
+
+
+def _stub_replica(name, exc):
+    class _Stub:
+        kind = "local"
+
+        def __init__(self):
+            self.name = name
+            self.router = None
+
+        def submit(self, entry):
+            raise exc
+
+        def ready(self):
+            return True, {}, False
+
+        def stats(self, max_age_s=0.25):
+            return {"queue_depth": 0, "running": 0, "max_queue": 1}
+
+        def burn(self):
+            return 0.0
+
+        def close(self, *, drain=False):
+            pass
+
+    return _Stub()
+
+
+def test_queuefull_requotes_min_retry_after_across_replicas():
+    router = fl.FleetRouter()
+    router.add_replica(_stub_replica("r0", sv.QueueFull(3, 4, 2.5)))
+    router.add_replica(_stub_replica("r1", sv.QueueFull(1, 4, 0.5)))
+    hist = valid_register_history(30, 3, seed=0, info_rate=0.1)
+    with pytest.raises(sv.QueueFull) as ei:
+        router.submit(hist, client="t")
+    # MIN quote (the soonest any replica frees a slot), summed depth
+    assert ei.value.retry_after == 0.5
+    assert ei.value.depth == 4 and ei.value.limit == 8
+    router.shutdown()
+
+
+def test_503_only_when_every_replica_breaker_open():
+    router = fl.FleetRouter()
+    router.add_replica(_stub_replica("r0", sv.ServiceUnavailable(7.0)))
+    router.add_replica(_stub_replica("r1", sv.ServiceUnavailable(5.0)))
+    hist = valid_register_history(30, 3, seed=0, info_rate=0.1)
+    with pytest.raises(sv.ServiceUnavailable) as ei:
+        router.submit(hist, client="t")
+    assert ei.value.retry_after == 5.0
+    router.shutdown()
+
+
+def test_mixed_breaker_and_queuefull_is_429_not_503():
+    """One breaker-open replica + one full queue: the fleet answer is
+    backpressure (429 + retry), NOT unavailable — some replica is
+    alive."""
+    router = fl.FleetRouter()
+    router.add_replica(_stub_replica("r0", sv.ServiceUnavailable(9.0)))
+    router.add_replica(_stub_replica("r1", sv.QueueFull(2, 2, 1.5)))
+    hist = valid_register_history(30, 3, seed=0, info_rate=0.1)
+    with pytest.raises(sv.QueueFull) as ei:
+        router.submit(hist, client="t")
+    assert ei.value.retry_after == 1.5
+    router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Live fleet under SIGKILL (slow: real subprocess replica)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_live_fleet_sigkill_zero_lost_zero_double(tmp_path):
+    hists = mixed_histories(6)
+    direct = batch_analysis(m.CASRegister(None), hists, capacity=(64, 256))
+    shared = dict(idempotency_dir=tmp_path / "idem",
+                  idempotency_shared=True,
+                  quarantine_dir=tmp_path / "quar")
+    key = fl.affinity_key(hists[0])
+    wname = next(nm for nm in (f"w{i}" for i in range(64))
+                 if fl._rendezvous(key, [nm, "r0", "r1"])[0] == nm)
+    router = fl.FleetRouter(fence_after=1)
+    router.add_local("r0", sv.CheckService(**shared, **KW).start())
+    router.add_local("r1", sv.CheckService(**shared, **KW).start())
+    opts = dict(capacity=[64, 256], warm_pool=False,
+                idempotency_dir=str(tmp_path / "idem"),
+                idempotency_shared=True,
+                quarantine_dir=str(tmp_path / "quar"))
+    proc, url = fl.spawn_replica(wname, opts=opts)
+    router.add_replica(fl.HttpReplica(wname, url))
+    try:
+        futs = [router.submit(hh, client="t", idempotency_key=f"sk-{i}")
+                for i, hh in enumerate(hists)]
+        time.sleep(0.2)
+        proc.send_signal(signal.SIGKILL)
+        got = [f.result(timeout=120)["valid?"] for f in futs]
+        assert got == [d["valid?"] for d in direct]
+        st = router.stats()["totals"]
+        assert st["fenced"] >= 1
+        assert st["duplicate_settles"] == 0
+        assert st["completed"] == 6
+    finally:
+        proc.kill()
+        router.shutdown()
+
+
+def test_router_ready_aggregates_and_http_mount(tmp_path):
+    """The web layer mounts the router: /readyz is fleet-ready while
+    any replica lives, GET /fleet reports per-replica state."""
+    import json
+    import urllib.request
+
+    from jepsen_tpu import web
+
+    router = fl.FleetRouter()
+    router.add_local("r0", sv.CheckService(**KW))
+    ok, info = router.ready()
+    assert ok and info["replicas"] == {"r0": "up"}
+    srv = web.make_server("127.0.0.1", 0, fleet=router)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["fleet"] is True
+        assert doc["replicas"]["r0"]["state"] == "up"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10) as r:
+            rd = json.loads(r.read())
+        assert rd["ready"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        router.shutdown()

@@ -611,6 +611,145 @@ def serve_chaos(opts) -> int:
     return failures
 
 
+def fleet_chaos(opts) -> int:
+    """The fleet-federation gate (serve.fleet) in three phases, all
+    diffed against a clean single-service baseline: (1) a THREE-replica
+    fleet (one subprocess HTTP worker named to WIN rendezvous for the
+    workload's affinity key, two in-process replicas) takes the whole
+    workload, the worker is SIGKILLed mid-load — the router must fence
+    it and resubmit its in-flight requests through the shared
+    idempotency map with ZERO lost requests, ZERO double-settles, and
+    baseline verdicts; (2) fleet-wide quarantine — a history poisoned
+    on replica A must be refused at admission on replica B on its FIRST
+    local offense with zero launches spent; (3) a zero-downtime rollout
+    cycle under live HTTP load — no 5xx responses, every verdict
+    identical to the undisturbed run.  Returns the failure count."""
+    from jepsen_tpu import web
+    from jepsen_tpu.serve import fleet as fl
+    from jepsen_tpu.serve import health, service as sv
+
+    failures = 0
+
+    def check(ok: bool, what: str):
+        nonlocal failures
+        print(f"  {'ok  ' if ok else 'FAIL'} {what}"
+              + ("" if ok else " <<<"),
+              file=sys.stderr if not ok else sys.stdout)
+        if not ok:
+            failures += 1
+
+    n = max(5, opts.histories)
+    hists = build_histories(n, opts.ops, opts.procs)
+    model = m.CASRegister(None)
+    clean = pb.batch_analysis(model, hists, **LADDER)
+    cv = verdicts(clean)
+    print(f"fleet-chaos clean verdicts: {cv}")
+
+    base = Path(tempfile.mkdtemp(prefix="chaos-fleet-"))
+    shared = dict(idempotency_dir=str(base / "idem"),
+                  idempotency_shared=True,
+                  quarantine_dir=str(base / "quar"))
+
+    def mk(name):
+        return sv.CheckService(
+            warm_pool=False, journal_dir=base / f"journal-{name}",
+            journal_shared=True, drain_dir=base / f"drain-{name}",
+            **shared, **LADDER,
+        ).start()
+
+    # ---- phase F1: SIGKILL the loaded worker mid-flight
+    print("phase F1: 3 replicas, SIGKILL the rendezvous owner mid-load")
+    key = fl.affinity_key(hists[0])
+    wname = next(nm for nm in (f"w{i}" for i in range(64))
+                 if fl._rendezvous(key, [nm, "r1", "r2"])[0] == nm)
+    proc, url = fl.spawn_replica(wname, opts=dict(
+        capacity=list(LADDER["capacity"]), warm_pool=False,
+        cpu_fallback=False, exact_escalation=[],
+        confirm_refutations=False,
+        journal_dir=str(base / f"journal-{wname}"), journal_shared=True,
+        **shared))
+    router = fl.FleetRouter(fence_after=1)
+    router.add_replica(fl.HttpReplica(wname, url))
+    router.add_local("r1", mk("r1")).add_local("r2", mk("r2")).start()
+    futs = [router.submit(h, client="chaos") for h in hists]
+    time.sleep(0.2)
+    proc.send_signal(signal.SIGKILL)
+    got = [f.result(timeout=300) for f in futs]
+    tot = router.stats()["totals"]
+    check(verdicts(got) == cv,
+          f"zero lost requests, verdicts == baseline after SIGKILL "
+          f"(fenced={tot['fenced']} resubmitted={tot['resubmitted']})")
+    check(tot["duplicate_settles"] == 0,
+          "zero double-served requests (idempotent resubmission)")
+    check(tot["completed"] == n, f"all {n} completed through the router")
+
+    # ---- phase F2: fleet-wide quarantine, first offense
+    print("phase F2: fleet-wide quarantine (poisoned on A, refused at B)")
+    ra = router.replicas()["r1"].svc
+    rb = router.replicas()["r2"].svc
+    fp = health.history_fingerprint(hists[0])
+    ra.quarantine.add(fp, "chaos: poison isolated on r1")
+    batches_before = rb.stats()["batches"]
+    fq = rb.submit(hists[0], client="chaos-poison")
+    rq = fq.result(timeout=60)
+    check(bool(rq.get("quarantined")),
+          "replica B refused the history replica A poisoned")
+    check(rb.stats()["batches"] == batches_before,
+          "zero launches spent on the fleet-quarantined history")
+
+    # ---- phase F3: rollout cycle under live HTTP load, no 5xx
+    print("phase F3: zero-downtime rollout under live HTTP load")
+    router.successor_factory = lambda name, old: mk(f"{name}v2")
+    srv = web.make_server("127.0.0.1", 0, fleet=router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    statuses: list[int] = []
+    results: dict[int, object] = {}
+    lock = threading.Lock()
+
+    def tenant(w: int):
+        import http.client
+        for i in range(w, n, 2):
+            body = json.dumps({"history": hists[i], "wait": True,
+                               "client": f"roll-{w}"})
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=300)
+            try:
+                conn.request("POST", "/check", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                doc = json.loads(resp.read() or b"{}")
+                with lock:
+                    statuses.append(resp.status)
+                    if resp.status == 200:
+                        results[i] = doc["result"]["valid?"]
+            finally:
+                conn.close()
+
+    ths = [threading.Thread(target=tenant, args=(w,)) for w in range(2)]
+    for t in ths:
+        t.start()
+    time.sleep(0.1)
+    rolled = router.rollout()
+    for t in ths:
+        t.join(timeout=600)
+    check(not any(s >= 500 for s in statuses),
+          f"no 5xx during the rollout (statuses: {sorted(set(statuses))})")
+    check(len(rolled["rolled"]) >= 2,
+          f"rollout cycled the local replicas ({rolled})")
+    # history 0 was quarantined fleet-wide in F2: its verdict is the
+    # refusal ("unknown"), proving the shared quarantine SURVIVES the
+    # rollout (successors read the same durable dir); every other
+    # verdict must match the undisturbed run exactly
+    check(results.get(0) == "unknown",
+          "the F2-quarantined history is still refused post-rollout")
+    check(all(results.get(i) == cv[i] for i in range(1, n)),
+          "every verdict under rollout identical to the undisturbed run")
+    srv.shutdown()
+    router.shutdown()
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--histories", type=int, default=16)
@@ -638,6 +777,14 @@ def main(argv=None) -> int:
                          "unknowns) plus a kill -9 MID-SPILL with chunk "
                          "checkpointing — the resumed verdict must equal "
                          "the uninterrupted one")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-federation gate instead "
+                         "(serve.fleet): 3 replicas with one SIGKILLed "
+                         "mid-load (zero lost, zero double-served, "
+                         "baseline verdicts), fleet-wide quarantine "
+                         "first-offense refusal, and a zero-downtime "
+                         "rollout cycle under live HTTP load with no "
+                         "5xx and identical verdicts")
     ap.add_argument("--crashpoint", action="store_true",
                     help="run the crash-consistency audit instead "
                          "(tools/crashpoint.py): the (surface x "
@@ -665,6 +812,15 @@ def main(argv=None) -> int:
         print(json.dumps({
             "metric": "chaos_spill",
             "histories": max(2, opts.histories // 2),
+            "failures": failures,
+        }))
+        return 0 if failures == 0 else 1
+
+    if opts.fleet:
+        failures = fleet_chaos(opts)
+        print(json.dumps({
+            "metric": "chaos_fleet",
+            "histories": max(5, opts.histories),
             "failures": failures,
         }))
         return 0 if failures == 0 else 1

@@ -568,6 +568,75 @@ def idempotency_cell(hists, baseline) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Cross-process double-claim (fleet shared-dir cell)
+# ---------------------------------------------------------------------------
+
+_RACE_CHILD_SRC = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from jepsen_tpu.serve.health import IdempotencyMap
+imap = IdempotencyMap({idir!r}, shared=True)
+# spin-barrier on the go file so both processes hit claim() together
+deadline = time.monotonic() + 30
+while not os.path.exists({gofile!r}):
+    if time.monotonic() > deadline:
+        sys.exit("go file never appeared")
+    time.sleep(0.0005)
+wins = []
+for i in range({rounds}):
+    prior = imap.claim(f"race-key-{{i}}", f"req-{{os.getpid()}}-{{i}}",
+                       fp=f"fp-{{i}}")
+    wins.append(prior is None)
+print("WINS", json.dumps(wins), flush=True)
+"""
+
+
+def shared_claim_race_cell() -> None:
+    """Two PROCESSES pointed at one shared ``--idempotency-dir`` race
+    ``claim()`` on the same keys: the advisory per-key file locks must
+    yield exactly ONE winner per key (this is what makes fleet failover
+    exactly-once — before the locks, claim-before-admit was only
+    guarded in-process and both replicas could run the check)."""
+
+    def _run():
+        idir = tempfile.mkdtemp(prefix="cp-idem-race-")
+        gofile = os.path.join(idir, "..", "cp-race-go-%d" % os.getpid())
+        rounds = 16
+        src = _RACE_CHILD_SRC.format(
+            repo=str(REPO), idir=idir, gofile=gofile, rounds=rounds)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        kids = [
+            subprocess.Popen(
+                [sys.executable, "-c", src], stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env,
+                cwd=str(REPO),
+            )
+            for _ in range(2)
+        ]
+        import time as _t
+        _t.sleep(0.5)  # let both children reach the spin-barrier
+        Path(gofile).touch()
+        outs = []
+        for p in kids:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, f"racer exited {p.returncode}: {out}"
+            outs.append(out)
+        wins = []
+        for out in outs:
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("WINS "))
+            wins.append(json.loads(line[len("WINS "):]))
+        os.unlink(gofile)
+        for i in range(rounds):
+            winners = int(wins[0][i]) + int(wins[1][i])
+            assert winners == 1, (
+                f"key {i}: {winners} winners — cross-process double-claim"
+                if winners > 1 else f"key {i}: no winner — claim lost")
+
+    cell("idempotency", "race", "cross-process double-claim", _run)
+
+
+# ---------------------------------------------------------------------------
 # Main
 # ---------------------------------------------------------------------------
 
@@ -600,6 +669,9 @@ def run(surfaces, *, smoke: bool, real_sigkill: bool) -> int:
     if "idempotency" in surfaces and real_sigkill:
         print("surface: idempotent resubmission (SIGKILL round trip)")
         idempotency_cell(hists, baseline)
+    if "idempotency" in surfaces:
+        print("surface: idempotency shared-dir claim race")
+        shared_claim_race_cell()
     failed = [r for r in RESULTS if not r["ok"]]
     print(f"crashpoint matrix: {len(RESULTS) - len(failed)}/{len(RESULTS)} "
           "cells green")

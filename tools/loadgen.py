@@ -272,6 +272,318 @@ class MetricsScraper:
             self._thread.join(timeout=5)
 
 
+#: the fleet round's geometry mix: small (ops, procs) pairs spanning
+#: several padded (B, P, G) compile buckets so affinity routing has
+#: DISTINCT keys to spread over the replicas (one uniform geometry
+#: would hash the whole workload onto a single owner and measure
+#: nothing but spill).  Deliberately small histories: post-warm launch
+#: compute must stay well under the injected launch latency, or the
+#: round measures 1-core compute serialization instead of the overlap
+#: of device waits (large-ops histories blow up frontier compute at
+#: unlucky seeds).  Rendezvous ownership over a 3-replica fleet is
+#: lumpy at this key count — the power-of-two spill is what levels it,
+#: which is the point: the round measures routing + spill, not a
+#: hand-balanced assignment.
+FLEET_GEOMETRY = [(20, 3), (20, 6), (40, 6), (40, 12),
+                  (60, 12), (60, 24), (30, 5), (50, 10)]
+
+
+def fleet_round(a) -> int:
+    """``--replicas N``: the fleet-federation round (serve.fleet).
+
+    Two sub-rounds, one shared workload drawn from ``FLEET_GEOMETRY``:
+    (A) throughput — the SAME workload through one service, then
+    through an N-replica fleet behind the affinity router, both under
+    identical injected launch latency (``--inject-latency-ms``, default
+    250 here) modeling device-bound launches: on a 1-core host the
+    replicas overlap device WAITS, not python — exactly the resource a
+    fleet multiplies; gate: fleet/single > ``--fleet-min-speedup`` with
+    verdict parity, plus the per-replica occupancy breakdown; (B)
+    failover — a subprocess worker replica joins, takes its rendezvous
+    share, and is SIGKILLed mid-load: every request must settle exactly
+    once (router ``duplicate_settles`` == 0, scraped
+    ``jepsen_tpu_fleet_resubmitted_total`` == the router's own count,
+    idempotent hits bounded by resubmissions) with verdicts identical
+    to sub-round A.  Exit 1 on any gate; a passing round appends a
+    fingerprinted ``kind:"fleet"`` perf-ledger record."""
+    import contextlib
+    import signal
+    import tempfile
+
+    from genhist import valid_register_history
+
+    from jepsen_tpu import faults, web
+    from jepsen_tpu.obs import metrics as obs_metrics
+    from jepsen_tpu.obs import regress
+    from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.serve import fleet as fl
+
+    obs_metrics.enable_mirror()
+    capacity = tuple(int(c) for c in a.capacity.split(",") if c)
+    inject_s = (a.inject_latency_ms or 200.0) / 1000.0
+    # scale the offered load to the fleet: N replicas need ~6 in-flight
+    # each to stay fed (a closed loop sized for one service leaves
+    # replicas idle and measures starvation), and enough requests that
+    # the drain tail is a small fraction of the run
+    n = max(a.requests, 20 * a.replicas)
+    conc = max(a.concurrency, 6 * a.replicas)
+    # all-VALID histories: a corrupted history pays the refutation
+    # ladder (~1-2s of real, GIL-serialized compute vs ~2ms for a valid
+    # one), which measures the checker's escalation policy, not the
+    # fleet's routing — chaos_check --fleet owns corrupt-verdict parity
+    hists = []
+    for i in range(n):
+        ops, procs = FLEET_GEOMETRY[i % len(FLEET_GEOMETRY)]
+        hists.append(valid_register_history(ops, procs, seed=a.seed + i,
+                                            info_rate=a.info_rate))
+    keys = {fl.affinity_key(h) for h in hists}
+    print(f"fleet round: {n} requests over {len(keys)} affinity keys, "
+          f"{a.replicas} replicas, concurrency {conc}, "
+          f"{inject_s * 1000:.0f}ms/lane injected launch latency "
+          "(both arms)")
+
+    base = Path(tempfile.mkdtemp(prefix="loadgen-fleet-"))
+    svc_opts = dict(
+        # max_batch pinned to the padded-batch floor (8): every launch
+        # then runs at the SAME n_pad per bucket, so the sequential
+        # warm pass covers every shape the measured pass can hit — an
+        # uncapped batch drifts across power-of-two n_pad buckets and
+        # pays ~1s mid-measurement recompiles in whichever arm happens
+        # to form the unwarmed size
+        capacity=capacity, max_batch=8, max_queue=a.max_queue,
+        batch_window_s=a.batch_window_ms / 1000.0,
+        # one-shot batches in BOTH arms: the continuous engine re-fires
+        # the launch hook per ladder rung with the full lane count, so
+        # under injected per-lane latency it multiplies the modeled
+        # device time by a joiner-dependent factor — noise that swamps
+        # the arm comparison this round exists to make
+        continuous=False, warm_pool=False,
+        confirm_refutations=False, exact_escalation=(),
+    )
+
+    def mk(name):
+        # shared idempotency only — no admission journal: the round's
+        # failover guarantee rides on claims + resubmission, and every
+        # journal append is an fsync added to BOTH arms' request path
+        return CheckService(
+            idempotency_dir=base / "idem", idempotency_shared=True,
+            quarantine_dir=base / "quar", **svc_opts,
+        ).start()
+
+    def sleeper(info, attempt, _s=inject_s):
+        # per-LANE, not per-launch: device time grows with batch rows,
+        # so queueing everything on one box must not amortize the
+        # modeled launch away (a fixed per-launch sleep would reward
+        # the single service for batching and measure that, not the
+        # fleet's overlap of device waits)
+        if str(info.get("what", "")).startswith("serve.batch"):
+            time.sleep(_s * max(1, int(info.get("lanes") or 1)))
+
+    def drive(submit):
+        """Closed-loop measured pass; returns (wall_s, verdicts)."""
+        verdicts: list = [None] * n
+        idx_lock = threading.Lock()
+        next_idx = [0]
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next_idx[0]
+                    if i >= n:
+                        return
+                    next_idx[0] += 1
+                verdicts[i] = submit(i).result(timeout=600)["valid?"]
+
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=worker) for _ in range(conc)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return time.perf_counter() - t0, verdicts
+
+    rc = 0
+    out: dict = {"requests": n, "replicas": a.replicas,
+                 "affinity_keys": len(keys),
+                 "inject_latency_ms": inject_s * 1000.0}
+
+    # ---- sub-round A1: single service under injected launch latency
+    solo = mk("solo")
+    for h in hists:  # sequential warm: singleton batches at n_pad=8,
+        # the exact shape every measured launch runs at (jit cache is
+        # process-global, so this warm covers the fleet arm too)
+        solo.submit(h, client="warm").result(timeout=600)
+    with faults.inject_scope(sleeper):
+        wall_1, single_verdicts = drive(
+            lambda i: solo.submit(hists[i], client="loadgen"))
+    solo.shutdown(drain=False)
+    out["single"] = {"wall_s": round(wall_1, 3),
+                     "throughput_rps": round(n / wall_1, 2)}
+    print(f"single:     {out['single']}")
+
+    # ---- sub-round A2: the N-replica fleet, same workload + latency.
+    # spill_depth_frac=0 keeps the power-of-two comparison always on:
+    # the owner still wins warm-cache ties, but a backlogged owner
+    # sheds to its second choice — the load-balancing half of the
+    # routing story (the in-process replicas share one jit cache, so a
+    # spilled request never pays a fresh compile mid-measurement).
+    # mint_keys=False: sub-round A measures routing, not durable-claim
+    # fsyncs (the solo arm pays none either); sub-round B passes
+    # explicit per-request keys, which is what its exactly-once
+    # accounting rides on
+    router = fl.FleetRouter(spill_depth_frac=0.0, load_hint_age_s=0.02,
+                            mint_keys=False,
+                            successor_factory=lambda nm, old: mk(nm))
+    for i in range(a.replicas):
+        router.add_local(f"r{i}", mk(f"r{i}"))
+    router.start()
+    srv = web.make_server("127.0.0.1", 0, fleet=router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    scraper = MetricsScraper(srv.server_address[1])
+    try:
+        for f in [router.submit(h, client="warm") for h in hists]:
+            f.result(timeout=600)
+        with faults.inject_scope(sleeper):
+            wall_f, fleet_verdicts = drive(
+                lambda i: router.submit(hists[i], client="loadgen"))
+        st = router.stats()
+        speedup = round((n / wall_f) / (n / wall_1), 2)
+        # per-replica occupancy breakdown: who served what, how full
+        per = {}
+        for name, row in st["replicas"].items():
+            s = row.get("stats") or {}
+            per[name] = {
+                "completed": s.get("completed"),
+                "batches": s.get("batches"),
+                "avg_occupancy": s.get("avg_occupancy"),
+            }
+        out["fleet"] = {
+            "wall_s": round(wall_f, 3),
+            "throughput_rps": round(n / wall_f, 2),
+            "speedup": speedup,
+            "routed": st["totals"]["routed"],
+            "spilled": st["totals"]["spilled"],
+            "per_replica": per,
+        }
+        print(f"fleet:      {out['fleet']}")
+        if fleet_verdicts != single_verdicts:
+            print("FLEET PARITY MISMATCH:",
+                  list(zip(single_verdicts, fleet_verdicts)),
+                  file=sys.stderr)
+            rc = 1
+        if speedup <= a.fleet_min_speedup:
+            print(f"FLEET SPEEDUP BELOW GATE: {speedup}x <= "
+                  f"{a.fleet_min_speedup}x", file=sys.stderr)
+            rc = 1
+
+        # ---- sub-round B: SIGKILL a worker replica mid-load
+        print("failover:   spawning a subprocess worker replica")
+        scrape_0 = scraper.scrape()
+        wname = next(nm for nm in (f"w{i}" for i in range(64))
+                     if any(fl._rendezvous(
+                         k, [nm] + [f"r{i}" for i in range(a.replicas)]
+                     )[0] == nm for k in keys))
+        wopts = dict(svc_opts, capacity=list(capacity),
+                     exact_escalation=[],
+                     journal_dir=str(base / f"journal-{wname}"),
+                     idempotency_dir=str(base / "idem"),
+                     idempotency_shared=True,
+                     quarantine_dir=str(base / "quar"))
+        proc, url = fl.spawn_replica(wname, opts=wopts)
+        router.add_replica(fl.HttpReplica(wname, url))
+        resolved = [0]
+        res_lock = threading.Lock()
+
+        def stamp(fut):
+            with res_lock:
+                resolved[0] += 1
+
+        futs = []
+        for i, h in enumerate(hists):
+            f = router.submit(h, client="failover",
+                              idempotency_key=f"lg-failover-{i}")
+            f.add_done_callback(stamp)
+            futs.append(f)
+        time.sleep(0.3)
+        proc.send_signal(signal.SIGKILL)
+        failover_verdicts = [f.result(timeout=600)["valid?"]
+                             for f in futs]
+        tot = router.stats()["totals"]
+        scrape_1 = scraper.scrape()
+
+        def psum(parsed, name):
+            # labeled series parse as 'name{labels}'; sum the family
+            return sum(v for k, v in parsed.items()
+                       if k == name or k.startswith(name + "{"))
+
+        resub_scraped = (
+            psum(scrape_1, "jepsen_tpu_fleet_resubmitted_total")
+            - psum(scrape_0, "jepsen_tpu_fleet_resubmitted_total"))
+        hits_delta = (
+            psum(scrape_1, "jepsen_tpu_serve_idempotent_hits_total")
+            - psum(scrape_0, "jepsen_tpu_serve_idempotent_hits_total"))
+        resub_router = tot["resubmitted"] - st["totals"]["resubmitted"]
+        out["failover"] = {
+            "fenced": tot["fenced"],
+            "resubmitted": resub_router,
+            "resubmitted_scraped": resub_scraped,
+            "idempotent_hits": hits_delta,
+            "duplicate_settles": tot["duplicate_settles"],
+            "resolved": resolved[0],
+        }
+        print(f"failover:   {out['failover']}")
+        if failover_verdicts != single_verdicts:
+            print("FAILOVER PARITY MISMATCH: a SIGKILLed replica "
+                  "changed verdicts", file=sys.stderr)
+            rc = 1
+        if resolved[0] != n:
+            print(f"LOST REQUESTS: {n - resolved[0]} futures never "
+                  "resolved", file=sys.stderr)
+            rc = 1
+        if tot["duplicate_settles"] != 0:
+            print(f"DOUBLE-SERVE: {tot['duplicate_settles']} requests "
+                  "settled twice", file=sys.stderr)
+            rc = 1
+        if resub_scraped != resub_router:
+            print(f"RESUBMISSION ACCOUNTING MISMATCH: scraped "
+                  f"{resub_scraped} != router {resub_router}",
+                  file=sys.stderr)
+            rc = 1
+        if hits_delta > resub_router:
+            print(f"IDEMPOTENT-HIT OVERCOUNT: {hits_delta} hits > "
+                  f"{resub_router} resubmissions — a duplicate "
+                  "attached more than once", file=sys.stderr)
+            rc = 1
+    finally:
+        with contextlib.suppress(Exception):
+            proc.kill()
+        scraper.stop()
+        srv.shutdown()
+        srv.server_close()
+        router.shutdown()
+
+    if rc == 0:
+        try:
+            metrics = {
+                "fleet_rps": out["fleet"]["throughput_rps"],
+                "single_rps": out["single"]["throughput_rps"],
+                "fleet_speedup": out["fleet"]["speedup"],
+                "resubmitted": float(out["failover"]["resubmitted"]),
+                "duplicate_settles":
+                    float(out["failover"]["duplicate_settles"]),
+            }
+            axes = {"replicas": str(a.replicas),
+                    "inject_latency_ms": str(inject_s * 1000.0)}
+            regress.append_record(
+                regress.make_record("fleet", metrics, axes=axes))
+        except Exception as e:  # noqa: BLE001 — never fail the run here
+            print(f"warning: perf-ledger append failed: {e}",
+                  file=sys.stderr)
+
+    print(json.dumps({"loadgen": out}))
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=32)
@@ -353,6 +665,16 @@ def main(argv=None) -> int:
     ap.add_argument("--assert-no-alerts", action="store_true",
                     help="exit 1 if ANY SLO alert is firing after the "
                          "load (the clean-run acceptance gate)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run the FLEET round instead: the same workload "
+                         "through one service, then through this many "
+                         "local replicas behind the affinity router "
+                         "(serve.fleet), plus a SIGKILL-failover pass "
+                         "with exactly-once accounting")
+    ap.add_argument("--fleet-min-speedup", type=float, default=2.5,
+                    help="fleet round: exit 1 unless fleet throughput "
+                         "exceeds single-service throughput by this "
+                         "factor (default 2.5)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the conftest dance) — "
@@ -371,6 +693,9 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if a.replicas and a.replicas > 1:
+        return fleet_round(a)
 
     from genhist import corrupt, valid_register_history
     from jepsen_tpu import faults, obs
