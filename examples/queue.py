@@ -57,6 +57,24 @@ class QueueDB(LocalProcessDB):
         return ["--durable"] if self.durable else []
 
 
+def _await_connect(test, node) -> socket.socket:
+    import time
+
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", node_port(test, node)), timeout=5
+            )
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+    s.settimeout(5)
+    return s
+
+
 class QueueClient(client.Client):
     """Line-protocol queue client.  Raising from invoke becomes :info
     (indeterminate) via the interpreter — an enqueue cut off by a kill
@@ -67,28 +85,22 @@ class QueueClient(client.Client):
     def __init__(self, sock=None):
         self.sock = sock
         self.rfile = None
+        self.node = None
 
     def open(self, test, node):
         # Await the endpoint: a freshly restarted node needs a beat to
         # listen, and the total-queue checker cannot account a crashed
         # drain — connects retry so drains always land on a live server.
-        import time
-
-        deadline = time.monotonic() + 10
-        while True:
-            try:
-                s = socket.create_connection(
-                    ("127.0.0.1", node_port(test, node)), timeout=5
-                )
-                break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.1)
-        s.settimeout(5)
+        s = _await_connect(test, node)
         c = type(self)(s)  # subclass-friendly: variants survive reopen
+        c.node = node
         c.rfile = s.makefile("r")
         return c
+
+    def _reopen(self, test):
+        self.close(test)
+        self.sock = _await_connect(test, self.node)
+        self.rfile = self.sock.makefile("r")
 
     def _round(self, line: str) -> str:
         self.sock.sendall((line + "\n").encode())
@@ -111,7 +123,21 @@ class QueueClient(client.Client):
                 return {**op, "type": "fail"}  # empty: definitely nothing taken
             return {**op, "type": "ok", "value": int(reply.split()[1])}
         if f == "drain":
-            reply = self._round("DRAIN")
+            # The drain phase runs after the heal with the nemesis
+            # stopped, so a connection error here means THIS socket went
+            # stale when a phase-1 kill took its server (the time-limit
+            # cut never issued another op to reopen it) — the request
+            # cannot have reached a live journal, so reconnecting and
+            # retrying is sound, and keeps the crashed-drain shape the
+            # total-queue checker refuses out of healed-cluster runs.
+            for attempt in range(3):
+                try:
+                    reply = self._round("DRAIN")
+                    break
+                except (ConnectionError, OSError):
+                    if attempt == 2:
+                        raise
+                    self._reopen(test)
             body = reply[3:].strip()
             vs = [int(x) for x in body.split(",")] if body else []
             return {**op, "type": "ok", "value": vs}

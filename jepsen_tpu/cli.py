@@ -99,6 +99,16 @@ def add_test_opts(p: argparse.ArgumentParser):
                         "appends to (sets JEPSEN_TPU_PERF_LEDGER; "
                         "default store/perf-ledger.jsonl; 'off' "
                         "disables)")
+    p.add_argument("--stream", action="store_true",
+                   help="live streaming check: tee the interpreter's op "
+                        "log into an incremental checker "
+                        "(checker.streaming) and report a "
+                        "linearizability violation WHILE the test runs; "
+                        "the post-hoc analysis stays authoritative")
+    p.add_argument("--stream-every", type=int, default=None, metavar="N",
+                   help="ops per live-streaming epoch (default 32; each "
+                        "epoch re-packs the current prefix, so smaller "
+                        "epochs detect sooner but cost more host work)")
     p.add_argument("--check-deadline", type=float, default=None,
                    metavar="SECONDS",
                    help="wall-clock budget for the checker phase: on "
@@ -138,6 +148,10 @@ def options_to_test_opts(opts: argparse.Namespace) -> dict:
         out["store-dir"] = opts.store_dir
     if getattr(opts, "check_deadline", None) is not None:
         out["check-deadline"] = opts.check_deadline
+    if getattr(opts, "stream", False):
+        out["stream?"] = True
+    if getattr(opts, "stream_every", None) is not None:
+        out["stream-every"] = opts.stream_every
     return out
 
 
@@ -151,14 +165,18 @@ def _exit_code(result: Mapping) -> int:
 
 
 def _apply_telemetry_opt(test: Mapping, opts) -> dict:
-    """Pin the CLI's telemetry choice onto the built test map — harness
-    test_fns copy options selectively, so the flag is applied after the
-    map is built, on every command path.  Tri-state: an unset flag leaves
-    the map alone so obs.enabled_for falls through to the
-    JEPSEN_TPU_TELEMETRY env var (default on for run/analyze)."""
+    """Pin the CLI's run-mode choices onto the built test map — harness
+    test_fns copy options selectively, so these flags are applied after
+    the map is built, on every command path.  Telemetry is tri-state: an
+    unset flag leaves the map alone so obs.enabled_for falls through to
+    the JEPSEN_TPU_TELEMETRY env var (default on for run/analyze)."""
     t = dict(test)
     if getattr(opts, "telemetry", None) is not None:
         t["telemetry?"] = opts.telemetry
+    if getattr(opts, "stream", False):
+        t["stream?"] = True
+    if getattr(opts, "stream_every", None) is not None:
+        t["stream-every"] = opts.stream_every
     return t
 
 
@@ -264,10 +282,12 @@ def _cmd_serve(opts) -> int:
 
         def _mk_service(*, journal_dir, journal_shared, idempotency_dir,
                         idempotency_shared, quarantine_dir, evidence_dir,
-                        drain_dir):
+                        drain_dir, stream_dir=None):
             return CheckService(
                 capacity=capacity,
                 slo_specs=opts.slo_file,
+                max_streams=opts.max_streams,
+                stream_dir=stream_dir,
                 max_queue=opts.max_queue,
                 max_interactive_queue=opts.max_interactive_queue,
                 max_batch=opts.max_batch,
@@ -319,6 +339,10 @@ def _cmd_serve(opts) -> int:
                     drain_dir=(Path(opts.drain_dir) / name
                                if opts.drain_dir
                                else base / "drain" / name),
+                    # streams are replica-sticky; their checkpoints are
+                    # per-replica private state, never fleet-shared
+                    stream_dir=(Path(opts.stream_dir) / name
+                                if opts.stream_dir else None),
                 )
 
             def _successor(name, old_svc):
@@ -344,6 +368,7 @@ def _cmd_serve(opts) -> int:
                 idempotency_shared=False,
                 quarantine_dir=getattr(opts, "quarantine_dir", None),
                 evidence_dir=opts.evidence_dir, drain_dir=opts.drain_dir,
+                stream_dir=opts.stream_dir,
             )
             logger.info(
                 "check service up: max_queue=%d max_batch=%d capacity=%s "
@@ -495,6 +520,17 @@ def run_cli(
                          help="disable rung-boundary admission into "
                               "running ladders (restores window-then-"
                               "launch batching, for A/B comparison)")
+    p_serve.add_argument("--max-streams", type=int, default=8,
+                         help="bound on concurrently OPEN op-streams "
+                              "(POST /stream; beyond it: 429 + a "
+                              "Retry-After quoted from the stream "
+                              "lane's own session-duration EWMA)")
+    p_serve.add_argument("--stream-dir", default=None,
+                         help="per-stream durable checkpoint root: a "
+                              "SIGKILL'd stream re-opened with "
+                              "resume=true continues mid-history with "
+                              "identical verdicts (default: streams "
+                              "are memory-only)")
     p_serve.add_argument("--evidence-dir", default=None,
                          help="durably persist every served verdict's "
                               "evidence bundle here (GET /evidence/<id> "

@@ -133,6 +133,107 @@ def run_case(test: Mapping) -> list[dict]:
                 logger.exception("nemesis teardown failed")
 
 
+class _LiveStream:
+    """``test["stream?"]``: tee the interpreter's op log into a running
+    ``checker.streaming.StreamingChecker`` so a linearizability
+    violation is reported WHILE the test runs, not minutes later when
+    ``analyze`` gets the stored history (ISSUE 19: check latency
+    measured from the offending op, not from end-of-run).
+
+    The live verdict is advisory — ``analyze`` still runs the test's
+    checker post-hoc and its results stay authoritative — but on the
+    same history the streaming verdict is identical by construction
+    (the differential suite pins that).  Ops buffer and feed in
+    ``test["stream-every"]``-op epochs (default 32: each epoch re-packs
+    the current prefix, so per-op feeding would be quadratic host
+    work)."""
+
+    def __init__(self, test: Mapping, model):
+        from jepsen_tpu.checker.streaming import StreamingChecker
+
+        self.every = max(1, int(test.get("stream-every") or 32))
+        self.checker = StreamingChecker(
+            model,
+            capacity=tuple(test.get("stream-capacity") or (64, 256)),
+        )
+        self._buf: list[dict] = []
+        self._announced = False
+
+    def sink(self, op: Mapping) -> None:
+        """The interpreter's ``op-sink`` callable (history order)."""
+        self._buf.append(dict(op))
+        if len(self._buf) >= self.every:
+            self._flush()
+
+    def _flush(self) -> None:
+        buf, self._buf = self._buf, []
+        if buf:
+            self.checker.feed(buf)
+        self._announce()
+
+    def _announce(self) -> None:
+        if not self.checker.terminal or self._announced:
+            return
+        self._announced = True
+        res = self.checker.result or {}
+        det = self.checker.detection or {}
+        if res.get("valid?") is False:
+            logger.warning(
+                "STREAMING: linearizability violation detected while the "
+                "test runs — op position %s, %s ops seen (analysis will "
+                "confirm post-hoc)", det.get("op-position"), det.get("ops"),
+            )
+        else:
+            logger.info("streaming verdict: valid?=%s", res.get("valid?"))
+
+    def finish(self) -> dict:
+        """End of run: flush, finalize, return the stream's status doc
+        (recorded as ``test["streaming"]``)."""
+        self._flush()
+        self.checker.finalize()
+        self._announce()
+        return self.checker.status()
+
+
+def _stream_model(test: Mapping):
+    """The model a live stream checks against: ``test["model"]`` or the
+    test checker's ``.model`` (the Linearizable checker carries one)."""
+    model = test.get("model")
+    if model is None:
+        model = getattr(test.get("checker"), "model", None)
+    if model is None:
+        # a composed checker hides its linearizable child's model
+        children = getattr(test.get("checker"), "checker_map", None) or {}
+        for child in children.values():
+            model = getattr(child, "model", None)
+            if model is not None:
+                break
+    if isinstance(model, str):
+        from jepsen_tpu import models
+
+        model = models.model(model)
+    return model
+
+
+def _live_stream(test: Mapping) -> "_LiveStream | None":
+    """Build the live streaming monitor when ``test["stream?"]`` asks
+    for one.  Never raises — a broken monitor must not cost the run."""
+    if not test.get("stream?"):
+        return None
+    model = _stream_model(test)
+    if model is None:
+        logger.warning(
+            "stream? is set but the test names no model (set "
+            "test['model'] or use a checker with .model); "
+            "live streaming disabled")
+        return None
+    try:
+        return _LiveStream(test, model)
+    except Exception:  # noqa: BLE001 — monitor, not the run
+        logger.exception("couldn't start live streaming; disabled")
+        return None
+
+
 def analyze(test: Mapping, *, capture: bool = True) -> dict:
     """Index the history, run the checker, store the results — the TPU
     insertion point (core.clj:221-237, SURVEY.md §3.3).
@@ -247,11 +348,19 @@ def _run_test_captured(test: dict) -> dict:
                     control.on_nodes(test, os_.setup)
                 if database is not None:
                     jdb.cycle_db(test)
+            live = _live_stream(test)
             with relative_time(), obs.span("phase.run-case") as sp:
-                history = run_case(test)
+                # the sink rides a COPY so the callable never lands in
+                # the persisted test map
+                history = run_case(
+                    test if live is None
+                    else {**test, "op-sink": live.sink})
                 sp.set(ops=len(history))
             test = dict(test)
             test["history"] = history
+            if live is not None:
+                with obs.span("phase.stream-finalize"):
+                    test["streaming"] = live.finish()
             with obs.span("phase.save-history"):
                 store.save_1(test)
         finally:

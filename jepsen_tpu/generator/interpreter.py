@@ -171,6 +171,22 @@ def run(test: Mapping) -> list[dict]:
     g = gen.validate(gen.friendly_exceptions(gen.to_gen(test.get("generator"))))
     nodes = client_nodes(test)
     completions: queue.Queue = queue.Queue()
+    # ``test["op-sink"]``: a callable tee'd every op that lands in the
+    # history, in history order (core.py's live streaming mode feeds it
+    # into a checker.streaming.StreamingChecker).  A monitor must never
+    # be able to kill the run it watches, so sink errors are logged and
+    # the sink is dropped for the rest of the run.
+    sink = test.get("op-sink")
+
+    def tee(op):
+        nonlocal sink
+        if sink is None:
+            return
+        try:
+            sink(op)
+        except Exception:  # noqa: BLE001 — see comment above
+            logger.exception("op-sink failed; disabling for this run")
+            sink = None
 
     workers: dict[Any, tuple[queue.Queue, threading.Thread]] = {}
     for thread_id in sorted(ctx.all_threads(), key=gen._thread_sort_key):
@@ -199,6 +215,7 @@ def run(test: Mapping) -> list[dict]:
             ctx = ctx.free_thread(thread_id)
         if goes_in_history(comp):
             history.append(comp)
+            tee(comp)
             g = g.update(test, ctx, comp)
         if (
             comp.get("type") == "info"
@@ -255,6 +272,7 @@ def run(test: Mapping) -> list[dict]:
             ctx = ctx.busy_thread(thread_id)
             if goes_in_history(op):
                 history.append(op)
+                tee(op)
                 g = g2.update(test, ctx, op)
             else:
                 g = g2

@@ -53,6 +53,7 @@ _METRIC_METHODS = {"inc", "set_gauge", "observe"}
 FAMILIES = {
     "serve", "fault", "frontier", "elle", "dedup", "ladder", "device",
     "checker", "phase", "wgl", "sharded", "durable", "provenance", "fleet",
+    "stream",
 }
 
 _TOKEN_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_.*-]*[A-Za-z0-9_*]")

@@ -1632,6 +1632,201 @@ def chunked_analysis(
     return result
 
 
+def scan_barrier_range(
+    packed: dict,
+    frontier: tuple,
+    lo: int,
+    hi: int,
+    *,
+    capacities: Sequence[int],
+    rounds: int = 8,
+    chunk_barriers: int = 512,
+    cap_idx: int = 0,
+    lossy: bool = False,
+    fast: bool = False,
+    dedup_backend: str | None = None,
+    spill: bool = False,
+    on_event=None,
+) -> dict:
+    """Advance a carried frontier through barriers ``[lo, hi)`` of an
+    already-padded pack — chunked_analysis's scan loop factored out so
+    an INCREMENTAL caller (checker.streaming's per-epoch advance) can
+    extend a running scan range by range instead of owning the whole
+    history up front.
+
+    ``packed`` must be ``pad_packed`` output with ``B`` kept at the true
+    barrier count (the chunked-path convention) so ``lo``/``hi`` index
+    real barriers; ``frontier`` is the carried ``(state, fok, fcr)``
+    host arrays in the pack's padded ``(W, G)`` shapes.  Chunk cuts, the
+    ``Bc`` padding rule, the capacity-escalation ladder, the dedup
+    backend resolution, and the launch retry policy are all
+    chunked_analysis's own — an epoch advance compiles no kernel
+    geometry the post-hoc chunked path wouldn't.
+
+    Returns a dict::
+
+        frontier        surviving (state, fok, fcr), alive rows compacted
+        failed_barrier  GLOBAL barrier index the frontier died at
+                        (None = survived to ``hi``)
+        cap_idx, lossy  adapted ladder position / latched loss flag —
+                        thread them back into the next call
+        launches, peak  accounting deltas for the caller's stats
+        error           launch-failure cause string (scan aborted; the
+                        caller degrades this range to unknown) or None
+
+    Soundness is chunked_analysis's: death with ``lossy`` False refutes
+    at ``failed_barrier`` exactly (content-decided kills when ``fast``
+    is False); once any loss has latched, a death only means "unknown".
+    ``spill`` slices an overflowing ENTRY frontier through the same
+    kernel in ≤capacity-row slices and merges the survivors exactly
+    (scan linearity — refutation then requires EVERY slice to die);
+    without it overflow truncates and latches ``lossy``.
+
+    ``on_event`` (optional callable ``(event, **attrs)``) receives the
+    escalation/truncation events the chunked path would log to its
+    decision-path trajectory, so the caller can record them under its
+    own provenance prefix.
+    """
+    from jepsen_tpu.ops import spill as spill_mod
+
+    dedup = resolve_dedup_backend(dedup_backend)
+    caps = [int(c) for c in capacities]
+    P, G, W = packed["P"], packed["G"], packed["W"]
+    quiet = packed["bar_quiet"]
+    bar_f, bar_v1, bar_v2, bar_slot = packed["bar"]
+    mov_f, mov_v1, mov_v2, mov_open = packed["mov"]
+    slot_lane = jnp.asarray(packed["slot_lane"])
+    slot_onehot = jnp.asarray(packed["slot_onehot"])
+    grp_args = tuple(jnp.asarray(a) for a in packed["grp"])
+
+    f_state = np.asarray(frontier[0], np.int32)
+    f_fok = np.asarray(frontier[1], np.uint32)
+    f_fcr = np.asarray(frontier[2], np.int16)
+    idx = min(max(int(cap_idx), 0), len(caps) - 1)
+    lossy_any = bool(lossy)
+    launches = 0
+    peak_g = 0
+
+    def _ev(event: str, **attrs) -> None:
+        if on_event is not None:
+            on_event(event, **attrs)
+
+    def _out(failed=None, error=None):
+        return {
+            "frontier": (f_state, f_fok, f_fcr),
+            "failed_barrier": failed, "cap_idx": idx, "lossy": lossy_any,
+            "launches": launches, "peak": peak_g, "error": error,
+        }
+
+    if hi <= lo:
+        return _out()
+    spans = [
+        (lo + a, lo + b)
+        for a, b in _chunk_bounds(quiet[lo:hi], hi - lo, int(chunk_barriers))
+    ]
+    for clo, chi in spans:
+        Bc = 1 << max(5, (chi - clo - 1).bit_length())
+
+        def padc(a, fill=0):
+            out = np.full((Bc,) + a.shape[1:], fill, a.dtype)
+            out[: chi - clo] = a[clo:chi]
+            return out
+
+        c_args = tuple(
+            jnp.asarray(padc(a, fill))
+            for a, fill in [
+                (packed["bar_active"], False),
+                (bar_f, 0), (bar_v1, 0), (bar_v2, 0), (bar_slot, 0),
+                (mov_f, 0), (mov_v1, 0), (mov_v2, 0), (mov_open, False),
+            ]
+        )
+        c_grp_open = jnp.asarray(padc(packed["grp_open"]))
+        n_in = f_state.shape[0]
+        while (idx + 1 < len(caps) and caps[idx] < n_in
+               and caps[idx + 1] > caps[idx]):
+            idx += 1
+        while True:
+            F = caps[idx]
+            cuts = list(range(0, n_in, F)) if spill and n_in > F else [0]
+            slice_outs = []
+            for a in cuts:
+                b = min(a + F, n_in)
+                k = max(1, b - a)  # the initial 1-row frontier case
+                st0 = np.zeros(F, np.int32)
+                fo0 = np.zeros((F, W), np.uint32)
+                fc0 = np.zeros((F, G), np.int16)
+                al0 = np.zeros(F, bool)
+                st0[:k] = f_state[a:a + k]
+                fo0[:k] = f_fok[a:a + k]
+                fc0[:k] = f_fcr[a:a + k]
+                al0[: b - a] = True
+                try:
+                    o = faults.call_with_retry(
+                        lambda: _scan_chunk(
+                            packed["step"], F, int(rounds), P, G, W, fast,
+                            jnp.asarray(st0), jnp.asarray(fo0),
+                            jnp.asarray(fc0), jnp.asarray(al0), *c_args,
+                            *grp_args, c_grp_open,
+                            slot_lane, slot_onehot, dedup=dedup,
+                        ),
+                        dict(what="wgl.chunk",
+                             engine="fast" if fast else "exact",
+                             capacity=F, lanes=1),
+                    )
+                except faults.LaunchFailure as lf:
+                    cause = faults.describe(lf.cause)
+                    obs.counter("fault.launch.degraded", what="wgl.chunk",
+                                capacity=F, lanes=1, error=cause)
+                    _ev("launch-degraded", capacity=F, error=cause)
+                    return _out(error=cause)
+                launches += 1
+                slice_outs.append(o)
+            trunc = not spill and n_in > F
+            sliced = []
+            any_lossy = trunc
+            peak_total = 0
+            for s, fo, fc, al, failed_at, sl, peak in slice_outs:
+                failed_at, sl, peak = int(failed_at), bool(sl), int(peak)
+                any_lossy |= sl
+                peak_total += peak
+                sliced.append((s, fo, fc, al, failed_at))
+            peak_g = max(peak_g, peak_total)
+            nxt = idx + 1
+            if any_lossy and nxt < len(caps) and caps[nxt] > caps[idx]:
+                obs.counter("wgl.chunk.escalations")
+                _ev("escalation", barrier=clo, to_capacity=caps[nxt])
+                idx = nxt  # re-run THIS chunk wider, from the same frontier
+                continue
+            break
+        lossy_any |= any_lossy
+        if trunc:
+            obs.counter("wgl.frontier.truncations")
+            _ev("truncated", barrier=clo)
+        all_failed = all(f >= 0 for (_s, _fo, _fc, _al, f) in sliced)
+        if all_failed:
+            gb = clo + max(f for (_s, _fo, _fc, _al, f) in sliced)
+            return _out(failed=gb)
+        if len(sliced) == 1:
+            s, fo, fc, al, _f = sliced[0]
+            sel = np.flatnonzero(np.asarray(al))
+            f_state = np.asarray(s)[sel]
+            f_fok = np.asarray(fo)[sel]
+            f_fcr = np.asarray(fc)[sel]
+        else:
+            ring = spill_mod.HostRing(W, G)
+            for s, fo, fc, al, f in sliced:
+                if f < 0:  # dead slices contribute no rows
+                    ring.push(s, fo, fc, al)
+            popped = ring.pop_all()
+            f_state, f_fok, f_fcr, _mstats = spill_mod.merge_frontiers(
+                [popped] if popped is not None else [])
+        rows = int(f_state.shape[0])
+        if (idx > 0 and peak_total * 4 <= caps[idx - 1]
+                and rows <= caps[idx - 1]):
+            idx -= 1
+    return _out()
+
+
 def analysis(
     model: m.Model,
     history: Sequence[dict],

@@ -51,6 +51,14 @@ the HTTP API mounted into ``jepsen_tpu.web`` (``POST /check``,
 ``GET /check/<id>``, ``GET /queue``, ``GET /healthz``, ``GET
 /readyz``), and ``jepsen-tpu serve --check`` (``--replicas N`` mounts
 the fleet router).
+
+Streaming (``checker.streaming``, PR 19): beside the request queues the
+service runs a bounded lane of OPEN op-streams — ``stream_open`` /
+``stream_feed`` / ``stream_close`` (HTTP ``POST /stream`` and friends)
+feed an incremental checker epoch by epoch and surface
+verdict-on-violation while the test still runs; per-stream durable
+checkpoints under ``stream_dir`` make a SIGKILL'd stream resumable with
+identical verdicts.
 """
 
 from jepsen_tpu.serve import fleet, health, sched
@@ -62,6 +70,7 @@ from jepsen_tpu.serve.service import (
     QueueFull,
     ServiceClosed,
     ServiceUnavailable,
+    StreamSession,
     model_by_name,
     resume_drained,
 )
@@ -74,6 +83,7 @@ __all__ = [
     "QueueFull",
     "ServiceClosed",
     "ServiceUnavailable",
+    "StreamSession",
     "fleet",
     "health",
     "model_by_name",

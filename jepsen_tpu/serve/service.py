@@ -300,6 +300,38 @@ class CheckRequest:
         return True
 
 
+class StreamSession:
+    """One open op-stream's serving record (the ``/stream/<id>``
+    surface).  The wrapped ``checker.streaming.StreamingChecker`` is NOT
+    thread-safe, so every feed/finalize runs under the session's own
+    lock — never the service lock, which would stall admission behind a
+    device launch."""
+
+    __slots__ = ("id", "client", "checker", "trace_id", "lock",
+                 "t_open", "t_last", "t_close", "closed", "evidence_done")
+
+    def __init__(self, *, checker, client: str, trace_id: str | None):
+        self.id = checker.stream_id
+        self.client = client
+        self.checker = checker
+        self.trace_id = trace_id or obs.new_trace_id()
+        self.lock = threading.Lock()
+        self.t_open = time.monotonic()
+        self.t_last = self.t_open
+        self.t_close: float | None = None
+        self.closed = False
+        self.evidence_done = False
+
+    def describe(self) -> dict:
+        """The status document behind GET /stream/<id>."""
+        out = self.checker.status()
+        out["client"] = self.client
+        out["trace_id"] = self.trace_id
+        out["closed?"] = self.closed
+        out["age_s"] = round(time.monotonic() - self.t_open, 3)
+        return out
+
+
 class CheckService:
     """A persistent multi-tenant check service over ``batch_analysis``.
 
@@ -321,6 +353,15 @@ class CheckService:
     verdict disagreement.  ``drain_dir`` is where shutdown checkpoints
     still-queued work (None: drained requests resolve unknown without a
     checkpoint).
+
+    The STREAMING lane (``checker.streaming``; HTTP ``POST /stream``)
+    runs beside the request queues: up to ``max_streams`` open
+    op-streams, each an incremental checker with carried frontier state,
+    fed in epochs via ``stream_feed`` and emitting verdict-on-violation
+    before the stream ends.  ``stream_dir`` roots per-stream durable
+    checkpoints so a SIGKILL'd stream resumes mid-history with identical
+    verdicts.  A rejected open raises ``QueueFull(tier="stream")``
+    quoted from the stream lane's own session-duration EWMA.
 
     ``start()`` spawns the scheduler thread (and pre-forks the
     confirmation worker pool, so the first confirmed-unknown request
@@ -357,6 +398,8 @@ class CheckService:
         continuous: bool = True,
         verify_placement: bool = False,
         warm_pool: bool = True,
+        max_streams: int = 8,
+        stream_dir: str | Path | None = None,
         drain_dir: str | Path | None = None,
         evidence_dir: str | Path | None = None,
         journal_dir: str | Path | None = None,
@@ -393,6 +436,24 @@ class CheckService:
         self.continuous = bool(continuous)
         self.verify_placement = bool(verify_placement)
         self.warm_pool = warm_pool
+        # -- the streaming lane (checker.streaming) ----------------------
+        #: concurrent open op-streams admitted before POST /stream gets a
+        #: 429.  Streams hold carried device state for their whole
+        #: lifetime, so the lane is bounded separately from the request
+        #: queues — and its Retry-After is quoted from STREAM-session
+        #: wall clocks, never the batch ladder's cycle EWMA (the PR 6
+        #: per-class rule, applied to the new lane).
+        self.max_streams = int(max_streams)
+        #: per-stream checkpoint root (None: streams are memory-only and
+        #: a SIGKILL loses them; with a dir, POST /stream resume=true
+        #: reconstructs a killed stream mid-history).
+        self.stream_dir = Path(stream_dir) if stream_dir is not None else None
+        self._streams: dict[str, StreamSession] = {}  # guarded-by: _lock [rw]
+        #: stream-session duration EWMA (seconds), folded on every close
+        #: — the stream lane's own retry-after basis.  Seeded at a
+        #: plausible short-session wall so the first rejection quotes
+        #: something sane rather than a batch-tier number.
+        self._stream_ewma_s = 5.0                # guarded-by: _lock
         self.drain_dir = Path(drain_dir) if drain_dir is not None else None
         #: durable evidence-bundle directory (None: in-memory ring only).
         #: Every settled request's bundle is retrievable via
@@ -435,6 +496,8 @@ class CheckService:
             "watchdog_trips": 0, "journal_replayed": 0,
             "devices_replaced": 0, "breaker_rejected": 0, "drain_errors": 0,
             "idempotent_hits": 0,
+            "streams_opened": 0, "streams_closed": 0,
+            "streams_rejected": 0, "streams_resumed": 0,
         }
         # -- the self-healing layer (serve.health) ----------------------
         #: with ``quarantine_dir``, the registry is the FLEET-wide
@@ -1753,6 +1816,223 @@ class CheckService:
                     return None
         return None
 
+    # ------------------------------------------------------------------
+    # Streaming sessions (checker.streaming — POST /stream)
+    # ------------------------------------------------------------------
+
+    def _stream_retry_after(self) -> float:  # holds: _lock
+        """Stream-lane Retry-After quote: active sessions over lane
+        width times the STREAM-session duration EWMA — the same shape
+        as ``AdmissionQueues.retry_after`` but fed exclusively from
+        stream wall clocks, never the batch ladder's cycle EWMA.
+        Caller holds ``_lock``."""
+        active = sum(1 for s in self._streams.values() if not s.closed)
+        waves = max(1.0, active / max(1, self.max_streams))
+        return round(max(0.02, waves * self._stream_ewma_s), 3)
+
+    def _stream_opts(self) -> dict:
+        """Scan parameters a stream shares with the service's ladder
+        config (dedup backend, spill, closure depth) — a stream compiles
+        no kernel geometry the batch path wouldn't."""
+        keep = ("dedup_backend", "spill", "fast", "rounds",
+                "chunk_barriers", "max_groups", "max_procs")
+        return {k: self._check_opts[k] for k in keep
+                if k in self._check_opts}
+
+    def stream_open(self, *, model=None, stream_id: str | None = None,
+                    resume: bool = False, client: str = "http",
+                    trace_id: str | None = None) -> dict:
+        """Open (or re-open) an incremental checking stream.
+
+        Admission is bounded by ``max_streams``; beyond it raises
+        ``QueueFull(tier="stream")`` quoted from the stream lane's own
+        duration EWMA.  ``resume=True`` with a ``stream_dir`` checkpoint
+        reconstructs a SIGKILL'd stream mid-history (the feeder then
+        continues from the returned ``ops`` count).  Re-opening an id
+        that is already active is idempotent and returns its status.
+
+        Streams are replica-sticky (carried frontier state): the fleet
+        router does not front this surface.  Shutdown leaves open
+        streams un-finalized on purpose — finalizing would classify
+        still-pending invokes as crashed and CHANGE the eventual
+        verdict; the per-feed checkpoint is the durable state."""
+        from jepsen_tpu.checker import streaming as _streaming
+        from jepsen_tpu.store import checkpoint as _ckpt
+
+        if model is None or isinstance(model, str):
+            model = model_by_name(model or "cas-register")
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is shutting down")
+            live = self._streams.get(stream_id) if stream_id else None
+            if live is not None and not live.closed:
+                return live.describe()
+            active = sum(1 for s in self._streams.values() if not s.closed)
+            if active >= self.max_streams:
+                self._totals["streams_rejected"] += 1
+                retry = self._stream_retry_after()
+                obs.counter("stream.rejected")
+                raise QueueFull(active, self.max_streams, retry,
+                                tier="stream")
+        sid = stream_id or uuid.uuid4().hex[:16]
+        ckdir = (self.stream_dir / sid
+                 if self.stream_dir is not None else None)
+        sc = None
+        if resume and ckdir is not None and _ckpt.stream_exists(ckdir):
+            try:
+                sc = _streaming.StreamingChecker.resume(ckdir, model)
+                with self._lock:
+                    self._totals["streams_resumed"] += 1
+            except _ckpt.CheckpointError as e:
+                logger.warning("unreadable stream checkpoint in %s (%s); "
+                               "opening fresh", ckdir, e)
+                obs.counter("fault.checkpoint.mismatch",
+                            reason="unreadable")
+        if sc is None:
+            sc = _streaming.StreamingChecker(
+                model, capacity=self.capacity, checkpoint_dir=ckdir,
+                stream_id=sid, **self._stream_opts(),
+            )
+        sess = StreamSession(checker=sc, client=client, trace_id=trace_id)
+        with self._lock:
+            # lost an open race for the same id: first one wins
+            live = self._streams.get(sess.id)
+            if live is not None and not live.closed:
+                return live.describe()
+            self._streams[sess.id] = sess
+            self._totals["streams_opened"] += 1
+            active = sum(1 for s in self._streams.values() if not s.closed)
+        obs.counter("stream.opened", resumed=str(sc.ops_consumed > 0))
+        metrics.set_gauge("stream.active", active)
+        return sess.describe()
+
+    def _stream_get(self, stream_id: str) -> StreamSession:
+        with self._lock:
+            sess = self._streams.get(stream_id)
+        if sess is None:
+            raise KeyError(f"unknown stream {stream_id!r}")
+        return sess
+
+    def stream_feed(self, stream_id: str, ops, seq: int | None = None) -> dict:
+        """Feed one epoch of ops into a stream; returns its status doc
+        (a verdict-on-violation shows up here the moment the frontier
+        dies).  ``seq`` is the count of ops the CLIENT believes it has
+        already delivered before this chunk: overlap with the stream's
+        consumed count is dropped (idempotent re-feeds after a
+        kill/resume), a gap is refused — silently skipping unseen ops
+        would corrupt the verdict."""
+        sess = self._stream_get(stream_id)
+        ops = [dict(o) for o in ops]
+        with sess.lock:
+            if sess.closed:
+                raise ValueError(f"stream {stream_id!r} is closed")
+            if seq is not None:
+                have = sess.checker.ops_consumed
+                if seq > have:
+                    raise ValueError(
+                        f"sequence gap: stream has {have} ops, chunk "
+                        f"starts at {seq}")
+                if seq < have:
+                    ops = ops[have - seq:]
+            sess.t_last = time.monotonic()
+            with obs.attach(obs.capture(trace=sess.trace_id)):
+                status = sess.checker.feed(ops)
+            if sess.checker.terminal:
+                self._stream_bundle(sess, status)
+        return status
+
+    def stream_close(self, stream_id: str) -> dict:
+        """End of stream: finalize (still-pending invokes classify as
+        crashed, exactly the post-hoc treatment), emit the evidence
+        bundle, fold the session wall into the stream lane's EWMA, and
+        return ``{"result": ..., **status}``.  Idempotent."""
+        sess = self._stream_get(stream_id)
+        with sess.lock:
+            if not sess.closed:
+                with obs.attach(obs.capture(trace=sess.trace_id)):
+                    result = sess.checker.finalize()
+                sess.closed = True
+                sess.t_close = time.monotonic()
+                wall = sess.t_close - sess.t_open
+                status = sess.checker.status()
+                self._stream_bundle(sess, status)
+                with self._lock:
+                    self._totals["streams_closed"] += 1
+                    self._stream_ewma_s += _sched_adm._EWMA_ALPHA * (
+                        wall - self._stream_ewma_s)
+                    active = sum(1 for s in self._streams.values()
+                                 if not s.closed)
+                    self._prune_streams()
+                obs.counter("stream.closed",
+                            verdict=str(result.get("valid?")).lower())
+                obs.span_event("stream.session", wall, stream=sess.id,
+                               verdict=str(result.get("valid?")),
+                               ops=sess.checker.ops_consumed)
+                metrics.set_gauge("stream.active", active)
+            else:
+                result = sess.checker.result
+                status = sess.checker.status()
+        out = dict(sess.describe())
+        out["result"] = result
+        if "evidence" in status:
+            out["evidence"] = status["evidence"]
+        else:
+            # the bundle may have been emitted at the MID-STREAM verdict
+            # (feed time) — the pointer then lives in the evidence ring
+            with self._lock:
+                bundle = self._evidence.get(sess.id)
+            if bundle is not None:
+                out["evidence"] = {"id": bundle["id"],
+                                   "digest": bundle["digest"]}
+        return out
+
+    def stream_status(self, stream_id: str) -> dict:
+        """The status doc behind GET /stream/<id> (404s via KeyError)."""
+        return self._stream_get(stream_id).describe()
+
+    def _stream_bundle(self, sess: StreamSession, status: dict) -> None:
+        """Evidence for a terminal stream, emitted ONCE — at the
+        mid-stream verdict when one fires, else at close.  Lands in the
+        same ring + ``evidence_dir`` as request bundles (GET
+        /evidence/<stream-id>).  Never raises; caller holds the session
+        lock."""
+        if sess.evidence_done or not sess.checker.terminal:
+            return
+        sess.evidence_done = True
+        try:
+            bundle = sess.checker.evidence(trace_id=sess.trace_id)
+            if bundle is None:
+                return
+            written = None
+            if self.evidence_dir is not None:
+                written = _prov.write_bundle(self.evidence_dir, bundle)
+            with self._lock:
+                self._evidence[sess.id] = bundle
+                if len(self._evidence) > _KEEP_DONE:
+                    drop = list(self._evidence)[
+                        : len(self._evidence) - _KEEP_DONE]
+                    for k in drop:
+                        del self._evidence[k]
+            status["evidence"] = {"id": bundle["id"],
+                                  "digest": bundle["digest"]}
+            if written is not None:
+                status["evidence"]["path"] = str(written)
+            else:
+                obs.counter("provenance.bundle", source="stream",
+                            verdict=bundle["verdict"])
+        except Exception:  # noqa: BLE001 — observability, not the verdict
+            logger.exception("stream evidence emission failed for %s",
+                             sess.id)
+            obs.counter("provenance.emit_error", error="stream")
+
+    def _prune_streams(self) -> None:  # holds: _lock
+        """Bound the closed-session registry (caller holds ``_lock``);
+        active sessions are bounded by admission and never pruned."""
+        done = [sid for sid, s in self._streams.items() if s.closed]
+        if len(done) > _KEEP_DONE:
+            for sid in done[: len(done) - _KEEP_DONE]:
+                del self._streams[sid]
+
     def _settle_member(self, r: CheckRequest, res: dict,
                        status: str = "done",
                        extra_path: Sequence[Mapping] | None = None) -> bool:
@@ -2193,6 +2473,17 @@ class CheckService:
                 "rung_slot_s": round(self._rung_slot_sum, 6),
                 "retry_after_hint_s": self._adm.retry_after(
                     "batch", self.max_batch),
+                # -- streaming lane (checker.streaming) -----------------
+                # its retry-after hint comes from the stream-session
+                # duration EWMA, NOT the batch ladder's cycle EWMA (the
+                # per-class quoting rule extends to the new lane).
+                "streams": {
+                    "active": sum(1 for s in self._streams.values()
+                                  if not s.closed),
+                    "max_streams": self.max_streams,
+                    "ewma_s": round(self._stream_ewma_s, 4),
+                    "retry_after_hint_s": self._stream_retry_after(),
+                },
                 "uptime_s": round(time.monotonic() - self._t_start, 3),
                 # -- self-healing layer (serve.health) ------------------
                 "breaker": self.breaker.describe(),

@@ -71,11 +71,24 @@ CHUNK_NPZ = "chunk-checkpoint.npz"
 
 CHUNK_VERSION = 2
 
+#: per-stream incremental checkpoint pair (checker.streaming): the op
+#: stream consumed so far, the settled-scan cursor, and the carried
+#: frontier between epochs.  Unlike the chunk pair the OPS THEMSELVES
+#: ride the json — a streaming resume has no stored history to re-read,
+#: the checkpoint IS the source of truth for what was fed, so the
+#: feeder only needs the consumed-op count to continue.
+STREAM_JSON = "stream-checkpoint.json"
+STREAM_NPZ = "stream-checkpoint.npz"
+
+STREAM_VERSION = 1
+
 KIND_LADDER = "ladder-checkpoint"
 KIND_CHUNK = "chunk-checkpoint"
+KIND_STREAM = "stream-checkpoint"
 
 _durable.register_kind(KIND_LADDER, VERSION)
 _durable.register_kind(KIND_CHUNK, CHUNK_VERSION)
+_durable.register_kind(KIND_STREAM, STREAM_VERSION)
 
 
 @_durable.register_migration(KIND_LADDER, 1)
@@ -276,6 +289,110 @@ def save_chunked(
     return chunk_json_path(d)
 
 
+def stream_json_path(d) -> Path:
+    return Path(d) / STREAM_JSON
+
+
+def stream_exists(d) -> bool:
+    return stream_json_path(d).exists()
+
+
+def save_stream(
+    d,
+    *,
+    config: Mapping,
+    ops: Sequence[Mapping],
+    advanced: int,
+    cap_idx: int,
+    frontier: tuple,
+    group_keys: Sequence[Sequence[int]],
+    lossy: bool,
+    verified: int,
+    launches: int,
+    epochs: int,
+    result: Mapping | None = None,
+) -> Path:
+    """Persist one stream epoch boundary (checker.streaming).  ``ops``
+    is the FULL op stream consumed so far (the resume source of truth);
+    ``advanced`` is the settled-barrier cursor the carried ``frontier``
+    (state, fok, fcr) sits at; ``group_keys`` are the (f_code, v1, v2)
+    triples naming the frontier's fcr columns, so a resume can remap
+    them onto the re-packed vocabulary.  ``config`` must carry the scan
+    parameters verdict identity depends on (model name, capacity
+    ladder, rounds, chunk size, dedup backend, fast flag).  ``result``
+    marks a TERMINAL stream (verdict already emitted): resuming it
+    returns the saved verdict without device work.  npz before json,
+    atomically, same torn-write reasoning as the chunk pair."""
+    d = Path(d)
+    d.mkdir(parents=True, exist_ok=True)
+    st, fo, fc = frontier
+    buf = io.BytesIO()
+    np.savez(buf, st=np.asarray(st), fo=np.asarray(fo), fc=np.asarray(fc))
+    data = buf.getvalue()
+    _store._atomic_write(d / STREAM_NPZ, data)
+    doc = {
+        "config": config,
+        "ops": [dict(o) for o in ops],
+        "advanced": int(advanced),
+        "cap_idx": int(cap_idx),
+        "group_keys": [[int(x) for x in k] for k in group_keys],
+        "lossy": bool(lossy),
+        "verified": int(verified),
+        "launches": int(launches),
+        "epochs": int(epochs),
+        "result": result,
+    }
+    _durable.write_record(
+        stream_json_path(d), KIND_STREAM, _store._jsonable(doc),
+        files={STREAM_NPZ: _durable.digest_bytes(data)},
+    )
+    return stream_json_path(d)
+
+
+def load_stream(d) -> dict:
+    """Load a stream checkpoint; raises CheckpointError (with the
+    durable layer's ``.report`` when applicable) on a missing, torn,
+    corrupt, or unmigratable pair.  Corrupt pairs are quarantined aside
+    by the durable layer before the raise."""
+    p = stream_json_path(d)
+    try:
+        rr = _durable.read_verified(p, KIND_STREAM)
+    except _durable.DurableError as e:
+        raise CheckpointError(str(e), e.report) from e
+    doc = rr.payload
+    npz = Path(d) / STREAM_NPZ
+    if not npz.exists():
+        raise CheckpointError(
+            f"{p} references missing {STREAM_NPZ}",
+            {"artifact": KIND_STREAM, "path": str(npz),
+             "reason": "missing-sibling"})
+    try:
+        with np.load(npz) as a:
+            frontier = (a["st"], a["fo"], a["fc"])
+    except (OSError, ValueError, KeyError) as e:
+        q = _durable.quarantine_file(npz, reason="npz-unreadable",
+                                     kind=KIND_STREAM)
+        raise CheckpointError(
+            f"unreadable {npz}: {e}",
+            {"artifact": KIND_STREAM, "path": str(npz),
+             "reason": "npz-unreadable", "quarantined_to": q}) from e
+    return {
+        "config": doc.get("config") or {},
+        "ops": list(doc.get("ops") or ()),
+        "advanced": int(doc.get("advanced") or 0),
+        "cap_idx": int(doc.get("cap_idx") or 0),
+        "group_keys": [tuple(int(x) for x in k)
+                       for k in (doc.get("group_keys") or ())],
+        "lossy": bool(doc.get("lossy")),
+        "verified": int(doc.get("verified") or 0),
+        "launches": int(doc.get("launches") or 0),
+        "epochs": int(doc.get("epochs") or 0),
+        "result": doc.get("result"),
+        "frontier": frontier,
+        "path": str(p),
+    }
+
+
 def _quarantine_pair(d, names, kind: str, reason: str) -> list[str]:
     out = []
     for name in names:
@@ -299,6 +416,12 @@ def quarantine(d, *, reason: str = "stale") -> list[str]:
 def quarantine_chunked(d, *, reason: str = "stale") -> list[str]:
     """``quarantine`` for the chunked-scan checkpoint pair."""
     return _quarantine_pair(d, (CHUNK_JSON, CHUNK_NPZ), KIND_CHUNK, reason)
+
+
+def quarantine_stream(d, *, reason: str = "stale") -> list[str]:
+    """``quarantine`` for the per-stream checkpoint pair."""
+    return _quarantine_pair(d, (STREAM_JSON, STREAM_NPZ), KIND_STREAM,
+                            reason)
 
 
 def load_chunked(d) -> dict:

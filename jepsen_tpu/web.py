@@ -32,6 +32,32 @@ the check API:
                      /check/<id>; audit with tools/evidence.py
   GET  /queue        queue-status JSON incl. per-class queue depths and
                      retry-after EWMAs (the home page shows a panel)
+  POST /stream       open an incremental checking stream
+                     (checker.streaming).  Body is NDJSON: a header
+                     line ({"model": ..., "stream_id": ..., "resume":
+                     bool, "client", "trace_id"}), then zero or more op
+                     lines, then an optional {"end": true} trailer —
+                     one POST can open, feed, and close a whole
+                     replayed history.  A single JSON object with an
+                     inline "ops" list works too.  Returns the stream
+                     status doc: "valid?" goes False/True the MOMENT a
+                     verdict exists (verdict-on-violation), honest
+                     "unknown" before that.  429 + Retry-After when
+                     the stream lane is full — quoted from the stream
+                     lane's own session-duration EWMA, never the batch
+                     ladder's
+  POST /stream/<id>  feed one epoch of ops (NDJSON op lines with an
+                     optional leading {"seq": N} offset line, or JSON
+                     {"ops": [...], "seq": N}).  "seq" = ops the client
+                     already delivered: overlap is dropped (idempotent
+                     re-feed after kill/resume), a gap is refused 409
+  POST /stream/<id>/close   end of stream: finalize (pending invokes
+                     classify as crashed, exactly post-hoc), emit the
+                     evidence bundle, return the final result
+  GET  /stream/<id>  stream status (ops consumed, settled barriers,
+                     verdict + detection metadata once terminal).
+                     Streams are replica-sticky (carried device state):
+                     the fleet router does NOT front this surface
   GET  /alerts       the live SLO burn-rate engine's alert document
                      (jepsen_tpu.serve.slo): firing alerts + the
                      per-objective fast/slow-window burn table (the
@@ -511,6 +537,48 @@ def _serve_mod():
     return serve
 
 
+def _parse_stream_body(raw: bytes) -> tuple[dict, list, bool, int | None]:
+    """Parse a ``POST /stream`` body into ``(header, ops, end, seq)``.
+
+    The body is NDJSON — one JSON object per line.  Lines carrying a
+    ``type``/``process`` key are history ops; ``{"end": true}`` marks
+    end-of-stream; anything else is a header/control line whose keys
+    merge into the header (``ops`` may inline an op list, ``seq`` sets
+    the idempotent feed offset).  A single JSON document like
+    ``{"model": ..., "ops": [...], "end": true}`` is therefore parsed
+    by the same rules.  Raises ``ValueError`` on malformed input."""
+    header: dict = {}
+    ops: list = []
+    end = False
+    seq: int | None = None
+    for ln in raw.decode("utf-8", "replace").splitlines():
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad NDJSON line: {e}") from None
+        if not isinstance(obj, dict):
+            raise ValueError("each NDJSON line must be a JSON object")
+        if "type" in obj or "process" in obj:
+            ops.append(obj)
+            continue
+        obj = dict(obj)
+        inline = obj.pop("ops", None)
+        if inline is not None:
+            if not isinstance(inline, list):
+                raise ValueError("ops must be a list of op maps")
+            ops.extend(dict(o) for o in inline)
+        if obj.pop("end", False):
+            end = True
+        s = obj.pop("seq", None)
+        if s is not None:
+            seq = int(s)
+        header.update(obj)
+    return header, ops, end, seq
+
+
 def _safe_resolve(base: Path, rel: str) -> Path | None:
     """Path-traversal guard (web.clj:328-333)."""
     target = (base / rel).resolve()
@@ -691,6 +759,9 @@ class Handler(BaseHTTPRequestHandler):
             if path == "/fleet/rollout":
                 self._handle_rollout()
                 return
+            if path == "/stream" or path.startswith("/stream/"):
+                self._handle_stream(path)
+                return
             if path != "/check":
                 self._send(404, b"not found")
                 return
@@ -832,6 +903,105 @@ class Handler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001 - pragma: no cover
             logger.exception("web POST handler error")
             self._send_json(500, {"error": "internal error"})
+
+    def _read_body(self) -> bytes | None:
+        """Bounded request-body read (the POST /check Content-Length
+        rules: 400 on a bad length, 413 + connection close beyond
+        ``max_request_bytes`` BEFORE any parse).  Replies itself and
+        returns None when the body was refused."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self._send_json(400, {"error": "bad Content-Length"})
+            return None
+        if length < 0:
+            self._send_json(400, {"error": "bad Content-Length"})
+            return None
+        if length > self.max_request_bytes:
+            obs_metrics.inc("serve.oversized_rejected")
+            self._send_json(
+                413,
+                {"error": "request body too large",
+                 "bytes": length, "limit": self.max_request_bytes},
+                headers={"Connection": "close"},
+            )
+            self.close_connection = True
+            return None
+        return self.rfile.read(length)
+
+    def _handle_stream(self, path: str) -> None:
+        """POST /stream[/<id>[/close]] — the streaming lane (NDJSON op
+        ingestion into ``CheckService.stream_*``; protocol in the
+        module docstring).  Streams are replica-sticky (each holds a
+        carried frontier), so this surface always talks to the LOCAL
+        check service, never the fleet router."""
+        svc = self.check_service
+        if svc is None:
+            self._send_json(
+                503, {"error": "no check service mounted (start with "
+                               "serve --check; streams are replica-"
+                               "sticky and never fleet-routed)"})
+            return
+        raw = self._read_body()
+        if raw is None:
+            return
+        try:
+            header, ops, end, seq = _parse_stream_body(raw)
+        except ValueError as e:
+            self._send_json(400, {"error": f"bad stream body: {e}"})
+            return
+        serve = _serve_mod()
+        try:
+            if path == "/stream":
+                try:
+                    status = svc.stream_open(
+                        model=header.get("model"),
+                        stream_id=header.get("stream_id"),
+                        resume=bool(header.get("resume")),
+                        client=str(header.get("client") or "http"),
+                        trace_id=header.get("trace_id"),
+                    )
+                except (KeyError, ValueError) as e:
+                    # unknown model / malformed header — client input
+                    self._send_json(400, {"error": f"bad stream: {e}"})
+                    return
+                sid = status["stream-id"]
+                if ops:
+                    status = svc.stream_feed(sid, ops, seq=seq)
+                if end:
+                    status = svc.stream_close(sid)
+                status.setdefault("href", f"/stream/{sid}")
+                self._send_json(200, status)
+                return
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 3 and parts[2] == "close":
+                self._send_json(200, svc.stream_close(parts[1]))
+                return
+            if len(parts) != 2:
+                self._send(404, b"not found")
+                return
+            status = svc.stream_feed(parts[1], ops, seq=seq)
+            if end:
+                status = svc.stream_close(parts[1])
+            self._send_json(200, status)
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+        except ValueError as e:
+            # closed stream / sequence gap: the stream exists but the
+            # feed conflicts with its state
+            self._send_json(409, {"error": str(e)})
+        except serve.QueueFull as e:
+            # Stream-lane backpressure: same 429 contract as /check,
+            # but the quote comes from the STREAM lane's session EWMA.
+            self._send_json(
+                429,
+                {"error": "stream lane full", "depth": e.depth,
+                 "limit": e.limit, "retry_after_s": e.retry_after,
+                 "tier": e.tier},
+                headers={"Retry-After": max(1, math.ceil(e.retry_after))},
+            )
+        except serve.ServiceClosed:
+            self._send_json(503, {"error": "service shutting down"})
 
     def _handle_profile(self, path: str) -> None:
         """POST /profile/start|stop — the bounded jax.profiler capture
@@ -1007,6 +1177,18 @@ class Handler(BaseHTTPRequestHandler):
                         self._send_json(
                             200,
                             req if isinstance(req, dict) else req.describe())
+            elif path.startswith("/stream/"):
+                # Replica-sticky: streams hold carried frontier state,
+                # so status always reads the LOCAL service (no fleet).
+                svc = self.check_service
+                if svc is None:
+                    self._send_json(503, {"error": "no check service mounted"})
+                else:
+                    try:
+                        self._send_json(
+                            200, svc.stream_status(path[len("/stream/"):]))
+                    except KeyError:
+                        self._send_json(404, {"error": "unknown stream id"})
             elif path.startswith("/evidence/"):
                 # The verdict's evidence bundle (obs.provenance): the
                 # full decision path + witness for one served request,

@@ -344,7 +344,10 @@ def test_tier1_budget_appends_ledger_record(tmp_path, capsys):
     led = tmp_path / "ledger.jsonl"
     log = ("12.34s call     tests/test_slowest.py::test_big\n"
            "2.00s call     tests/test_quick.py::test_small\n"
-           "= 1 passed in 799.10s (0:13:19) =\n")
+           # the gate refuses a log its REQUIRED_FILES never ran in
+           + "".join(f"1.00s call     {f}::test_x\n"
+                     for f in budget.REQUIRED_FILES)
+           + "= 1 passed in 799.10s (0:13:19) =\n")
     lp = tmp_path / "tier1.log"
     lp.write_text(log)
     assert budget.main([str(lp), "--ledger", str(led)]) == 0
@@ -372,13 +375,41 @@ def test_tier1_stage_table_sums_call_setup_rows(tmp_path):
     led = tmp_path / "ledger.jsonl"
     log = ("12.34s call     tests/test_big.py::test_kernel\n"
            "9.50s setup    tests/test_big.py::test_kernel\n"
-           "= 1 passed in 500.00s =\n")
+           + "".join(f"1.00s call     {f}::test_x\n"
+                     for f in budget.REQUIRED_FILES)
+           + "= 1 passed in 500.00s =\n")
     lp = tmp_path / "tier1.log"
     lp.write_text(log)
     assert budget.main([str(lp), "--ledger", str(led)]) == 0
     rec = regress.read_records(led)[0]
     assert rec["stages"]["tests/test_big.py::test_kernel"] == pytest.approx(
         21.84)
+
+
+def test_tier1_budget_structural_guards(tmp_path):
+    """The two structural guards that ride the budget gate: a log that
+    never ran a REQUIRED_FILES member fails loud (collection errors are
+    non-fatal in tier-1, so a broken import would otherwise silently
+    shrink the suite), and the audited files' compile geometries must
+    already be shared with the rest of the suite."""
+    import check_tier1_budget as budget
+
+    lp = tmp_path / "tier1.log"
+    lp.write_text("= 1 passed in 100.00s =\n")
+    assert budget.main([str(lp), "--ledger", "off"]) == 1
+
+    # the live repo must be geometry-clean (test_streaming pins only
+    # suite-shared capacity tuples)
+    tests_dir = Path(__file__).resolve().parent
+    assert budget.geometry_audit(tests_dir) == []
+
+    # a synthetic offender is named
+    d = tmp_path / "tests"
+    d.mkdir()
+    (d / "test_streaming.py").write_text("CAP = (7, 777)\n")
+    (d / "test_other.py").write_text("kw = dict(capacity=(64, 256))\n")
+    problems = budget.geometry_audit(d)
+    assert len(problems) == 1 and "(7, 777)" in problems[0]
 
 
 def test_bench_append_ledger_helper(tmp_path, monkeypatch):

@@ -1,0 +1,358 @@
+"""Streaming online checking tests (jepsen_tpu.checker.streaming + the
+serve/web stream lane): the differential contract against post-hoc
+``batch_analysis`` (identical verdicts AND identical evidence digests
+after stripping stream-admission events), mid-stream verdict-on-violation
+with the terminal latch, SIGKILL-mid-stream resume identity, the
+stream-lane admission/backpressure contract, the HTTP NDJSON endpoints,
+and the live interpreter tee (``test["stream?"]``).
+
+Kernel shapes are shared with tests/test_serve.py and
+tests/test_parallel.py — (30, 3) register histories at capacity
+(64, 256) — so every launch here re-hits runner caches the suite
+already paid to compile (tier-1 budget is tight; see
+tools/check_tier1_budget.py, which fails loud on new geometries)."""
+
+import json
+import pathlib
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import models as m
+from jepsen_tpu import serve as sv
+from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.checker.streaming import (
+    StreamingChecker,
+    parity_digest,
+    stream_check,
+)
+from jepsen_tpu.obs import provenance
+from jepsen_tpu.parallel import batch_analysis
+from jepsen_tpu.store import checkpoint as ckpt
+
+#: the suite-shared geometry (same shapes as test_serve/test_parallel).
+CAP = (64, 256)
+KW = dict(capacity=CAP, warm_pool=False)
+
+
+def mixed_histories(n=6):
+    hists = []
+    for i in range(n):
+        hist = valid_register_history(30, 3, seed=i, info_rate=0.1)
+        if i % 3 == 2:
+            hist = corrupt(hist, seed=i)
+        hists.append(hist)
+    return hists
+
+
+def bad_history(seed=2):
+    """A corrupted (30, 3) history — seed 2 carries a seeded violation
+    the post-hoc ladder refutes, so the stream must too."""
+    return corrupt(valid_register_history(30, 3, seed=seed, info_rate=0.1),
+                   seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Differential: streaming vs post-hoc — verdicts AND evidence digests
+# ---------------------------------------------------------------------------
+
+
+def test_differential_verdict_and_digest_parity():
+    """The load-bearing ISSUE-19 contract: replaying a stored history
+    through the streaming engine produces verdicts bit-identical to
+    ``batch_analysis`` — same valid?, same witness op — and the
+    evidence bundles digest identically once the stream-admission
+    events (the only legitimate divergence) are stripped."""
+    model = m.CASRegister(None)
+    hists = mixed_histories(6)
+    post = batch_analysis(model, hists, capacity=CAP)
+    for i, hist in enumerate(hists):
+        res, sc = stream_check(model, hist, feed_ops=8, capacity=CAP)
+        want = (post[i].get("valid?"), (post[i].get("op") or {}).get("index"))
+        got = (res.get("valid?"), (res.get("op") or {}).get("index"))
+        assert got == want, f"history {i}: stream {got} != post-hoc {want}"
+        bs = sc.evidence()
+        bp = provenance.build_bundle(
+            history=hist, result=post[i], source="posthoc", model=model,
+            checker="linearizable")
+        assert bs is not None
+        assert parity_digest(bs) == parity_digest(bp), (
+            f"history {i}: evidence digest mismatch")
+
+
+def test_midstream_detection_and_terminal_latch():
+    """A violation latches the verdict the moment its barrier settles —
+    BEFORE the stream ends — with detection metadata; ops fed after the
+    latch extend the recorded history but never the verdict, and
+    ``finalize`` is an idempotent no-op on a terminal stream."""
+    hist = bad_history()
+    sc = StreamingChecker(m.CASRegister(None), capacity=CAP)
+    assert sc.status()["valid?"] == UNKNOWN  # honest unknown-so-far
+    detected_at = None
+    for j in range(0, len(hist), 8):
+        sc.feed(hist[j:j + 8])
+        if sc.terminal:
+            detected_at = sc.ops_consumed
+            break
+    assert detected_at is not None and detected_at < len(hist), (
+        "verdict should fire mid-stream, not at end-of-run")
+    st = sc.status()
+    assert st["terminal?"] is True and st["valid?"] is False
+    det = sc.detection
+    assert det is not None and det["ops"] <= detected_at
+    verdict = dict(sc.result)
+    # terminal latch: late ops are recorded, the verdict never moves
+    sc.feed(hist[detected_at:])
+    assert sc.status()["ops"] == len(hist)
+    assert sc.result == verdict
+    assert sc.finalize() == verdict
+    assert sc.finalize() == verdict  # idempotent
+
+
+def test_valid_stream_survives_to_finalize():
+    """A clean stream stays unknown throughout and only a finalize —
+    which classifies still-pending invokes exactly like the post-hoc
+    path — produces the constructive valid verdict."""
+    hist = valid_register_history(30, 3, seed=0, info_rate=0.1)
+    sc = StreamingChecker(m.CASRegister(None), capacity=CAP)
+    for j in range(0, len(hist), 8):
+        st = sc.feed(hist[j:j + 8])
+        assert st["valid?"] == UNKNOWN and not sc.terminal
+    assert sc.finalize()["valid?"] is True
+    assert sc.status()["terminal?"] is True
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-stream: checkpoint resume identity
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_resume_verdict_identity(tmp_path):
+    """Kill a stream mid-history (drop the object; the per-feed
+    checkpoint is all that survives), resume, re-feed — verdict and
+    parity digest identical to the uninterrupted run."""
+    model = m.CASRegister(None)
+    hist = bad_history()
+    ref, ref_sc = stream_check(model, hist, feed_ops=8, capacity=CAP)
+
+    d = tmp_path / "stream-ck"
+    sc = StreamingChecker(model, capacity=CAP, checkpoint_dir=d)
+    sc.feed(hist[:15])
+    consumed = sc.ops_consumed
+    del sc  # SIGKILL stand-in: nothing in-process survives
+    assert ckpt.stream_exists(d)
+
+    res, sc2 = stream_check(model, hist, feed_ops=8, capacity=CAP,
+                            checkpoint_dir=d, resume=True)
+    assert sc2.ops_consumed >= consumed  # picked up, didn't restart
+    assert (res.get("valid?"), (res.get("op") or {}).get("index")) == (
+        ref.get("valid?"), (ref.get("op") or {}).get("index"))
+    assert parity_digest(sc2.evidence()) == parity_digest(ref_sc.evidence())
+
+
+def test_resume_refuses_model_mismatch(tmp_path):
+    """Resuming a stream against a different model could only produce
+    wrong verdicts — that's a CheckpointError, not a silent fresh start
+    at the StreamingChecker layer."""
+    d = tmp_path / "stream-ck"
+    sc = StreamingChecker(m.CASRegister(None), capacity=CAP,
+                          checkpoint_dir=d)
+    sc.feed(valid_register_history(30, 3, seed=1, info_rate=0.1)[:10])
+    with pytest.raises(ckpt.CheckpointError):
+        StreamingChecker.resume(d, m.FIFOQueue())
+
+
+# ---------------------------------------------------------------------------
+# The service stream lane: admission, seq idempotency, stats
+# ---------------------------------------------------------------------------
+
+
+def test_service_stream_lane(tmp_path):
+    """CheckService's streaming lane end-to-end: open/feed/close with a
+    mid-stream verdict and an evidence pointer, idempotent re-feeds and
+    refused gaps via ``seq``, QueueFull(tier="stream") quoted from the
+    stream lane's own EWMA, and the stats()["streams"] block."""
+    hist = bad_history()
+    svc = sv.CheckService(max_streams=1, stream_dir=str(tmp_path), **KW)
+    doc = svc.stream_open(model="cas-register", stream_id="s1",
+                          client="pytest")
+    assert doc["stream-id"] == "s1" and doc["valid?"] == UNKNOWN
+    # re-opening an active id is idempotent, but the lane is FULL for
+    # any other stream — rejected with the stream-tier Retry-After
+    assert svc.stream_open(stream_id="s1")["stream-id"] == "s1"
+    with pytest.raises(sv.QueueFull) as ei:
+        svc.stream_open(stream_id="s2")
+    assert ei.value.tier == "stream" and ei.value.retry_after > 0
+
+    st = svc.stream_feed("s1", hist[:10], seq=0)
+    assert st["ops"] == 10
+    # duplicate delivery (kill/resume replay): overlap dropped
+    st = svc.stream_feed("s1", hist[:10], seq=0)
+    assert st["ops"] == 10
+    # a sequence gap would silently skip unseen ops — refused
+    with pytest.raises(ValueError):
+        svc.stream_feed("s1", hist[20:], seq=20)
+    st = svc.stream_feed("s1", hist[10:], seq=10)
+    assert st["ops"] == len(hist)
+
+    stats = svc.stats()["streams"]
+    assert stats["active"] == 1 and stats["max_streams"] == 1
+    assert stats["retry_after_hint_s"] > 0
+
+    out = svc.stream_close("s1")
+    assert out["result"]["valid?"] is False
+    assert out["evidence"]["digest"]  # bundle landed in the ring
+    assert svc.stats()["streams"]["active"] == 0
+    # feeding a closed stream is a state conflict, not a crash
+    with pytest.raises(ValueError):
+        svc.stream_feed("s1", hist[:2])
+    svc.shutdown(drain=False)
+
+
+def test_service_stream_kill_resume(tmp_path):
+    """The serving-layer half of the SIGKILL contract: a second service
+    instance over the same ``stream_dir`` resumes the stream at its
+    checkpointed op count and finishes with the uninterrupted verdict."""
+    hist = bad_history()
+    ref, _ = stream_check(m.CASRegister(None), hist, feed_ops=8,
+                          capacity=CAP)
+    svc1 = sv.CheckService(stream_dir=str(tmp_path), **KW)
+    svc1.stream_open(model="cas-register", stream_id="sk")
+    svc1.stream_feed("sk", hist[:15], seq=0)
+    svc1.shutdown(drain=False)  # open streams are NOT finalized
+
+    svc2 = sv.CheckService(stream_dir=str(tmp_path), **KW)
+    doc = svc2.stream_open(model="cas-register", stream_id="sk",
+                           resume=True)
+    assert doc["ops"] == 15  # resumed exactly at the kill point
+    # the client re-sends from its own offset; seq makes it idempotent
+    svc2.stream_feed("sk", hist, seq=0)
+    out = svc2.stream_close("sk")
+    assert (out["result"].get("valid?"),
+            (out["result"].get("op") or {}).get("index")) == (
+        ref.get("valid?"), (ref.get("op") or {}).get("index"))
+    svc2.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP: POST /stream NDJSON ingestion
+# ---------------------------------------------------------------------------
+
+
+def test_http_stream_endpoints(tmp_path):
+    """The NDJSON protocol over a real HTTP round-trip: one-shot
+    open+feed+close, the incremental open → feed → status → close flow,
+    409 on a sequence gap, 404 on an unknown id, and 429 + Retry-After
+    quoted from the stream lane when it's full."""
+    from jepsen_tpu import web
+
+    hist = bad_history()
+    svc = sv.CheckService(max_streams=1, **KW)
+    srv = web.make_server("127.0.0.1", 0, str(tmp_path), check_service=svc)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(path, lines):
+        body = "\n".join(json.dumps(ln) for ln in lines).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body,
+            headers={"Content-Type": "application/x-ndjson"})
+        return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+    try:
+        # one-shot: header + ops + end in a single body
+        doc = post("/stream", [{"model": "cas-register"}, *hist,
+                               {"end": True}])
+        assert doc["terminal?"] is True
+        assert doc["result"]["valid?"] is False
+        assert doc["evidence"]["digest"]
+        # incremental flow with seq idempotency
+        doc = post("/stream", [{"model": "cas-register",
+                                "stream_id": "h1"}])
+        assert doc["valid?"] == UNKNOWN and "href" in doc
+        post("/stream/h1", [{"seq": 0}, *hist[:10]])
+        got = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stream/h1", timeout=10).read())
+        assert got["ops"] == 10
+        # the lane (width 1) is held by h1 -> 429 with the stream quote
+        try:
+            post("/stream", [{"stream_id": "h2"}])
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            body = json.loads(e.read())
+            assert body["tier"] == "stream"
+            assert int(e.headers["Retry-After"]) >= 1
+        # sequence gap -> 409 conflict
+        try:
+            post("/stream/h1", [{"seq": 25}, *hist[25:]])
+            raise AssertionError("expected 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        # deliver the tail with a valid seq, then close; {"end": true}
+        # on a feed body is equivalent to a separate /close call
+        doc = post("/stream/h1", [{"seq": 10, "end": True}, *hist[10:]])
+        assert doc["result"]["valid?"] is False
+        # unknown stream id -> 404; unknown model -> 400
+        for path, lines, code in (
+                ("/stream/nope", [*hist[:2]], 404),
+                ("/stream", [{"model": "not-a-model"}], 400)):
+            try:
+                post(path, lines)
+                raise AssertionError(f"expected {code}")
+            except urllib.error.HTTPError as e:
+                assert e.code == code
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        svc.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Live mode: the interpreter tee
+# ---------------------------------------------------------------------------
+
+
+def test_live_interpreter_stream_parity(tmp_path):
+    """``test["stream?"]`` tees the interpreter's op log into a live
+    StreamingChecker; the advisory streaming verdict agrees with the
+    authoritative post-hoc analyze on the same run."""
+    import random
+
+    from jepsen_tpu import checker as c
+    from jepsen_tpu import core, generator as gen, testkit
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    rng = random.Random(7)
+
+    def one():
+        if rng.random() < 0.5:
+            return {"f": "read"}
+        return {"f": "write", "value": rng.randint(0, 4)}
+
+    t = testkit.noop_test(
+        name="stream-live",
+        concurrency=3,
+        client=testkit.atom_client(),
+        generator=gen.clients(gen.limit(30, gen.repeat(one))),
+        checker=c.compose({
+            "linear": linearizable(
+                {"model": m.CASRegister(None), "algorithm": "wgl"}),
+        }),
+    )
+    t["store-dir"] = str(tmp_path / "store")
+    t["model"] = m.CASRegister(None)
+    t["stream?"] = True
+    t["stream-every"] = 8
+    t["stream-capacity"] = CAP
+    completed = core.run_test(t)
+    live = completed["streaming"]
+    assert live["terminal?"] is True
+    assert live["valid?"] == completed["results"]["valid?"] is True
+    assert live["ops"] == len(completed["history"])

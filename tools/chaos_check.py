@@ -31,6 +31,16 @@ with journal replay (a restarted service finishes the lost queue with
 identical verdicts), and a ``/metrics`` scrape that must agree with
 the harness's own request accounting.
 
+``--stream`` runs the streaming-checker gate: a differential pass
+(per-history ``stream_check`` verdicts, witnesses, and evidence
+digests must be bit-identical to ``batch_analysis``, and every
+refuted history must be detected MID-stream, before its last op) plus
+one real SIGKILL mid-stream — the child feeds a live CheckService
+stream lane and kills itself after a per-stream checkpoint write; a
+fresh service resumes the checkpoint, the client re-sends the whole
+history (``seq`` drops the overlap), and the close verdict must equal
+the uninterrupted run's.
+
 ``--crashpoint`` runs the durable-state crash-consistency audit
 (tools/crashpoint.py): the (surface x crash-step x corruption-mode)
 matrix over every durable surface, plus the SIGKILL
@@ -42,6 +52,7 @@ Usage:
   python tools/chaos_check.py --runs 5 --seed 7
   python tools/chaos_check.py --serve          # chaos-under-load gate
   python tools/chaos_check.py --serve --smoke  # its docker-entrypoint size
+  python tools/chaos_check.py --stream --smoke # streaming gate, small
   python tools/chaos_check.py --crashpoint --smoke   # crashpoint audit
 """
 
@@ -319,6 +330,142 @@ def spill_gate(opts) -> int:
             check(resumed["valid?"] == uninterrupted["valid?"],
                   f"resumed verdict {resumed['valid?']} identical to "
                   f"uninterrupted {uninterrupted['valid?']}")
+    return failures
+
+
+#: the child half of the streaming SIGKILL cycle: a CheckService stream
+#: fed epoch by epoch with per-feed checkpointing, SIGKILL'd after the
+#: KILL_AFTER-th stream-checkpoint write (mid-history, carried frontier
+#: on disk).
+_STREAM_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tools!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import chaos_check
+from jepsen_tpu.store import checkpoint as ckpt
+orig = ckpt.save_stream
+state = {{"n": 0}}
+def killing_save(*a, **kw):
+    out = orig(*a, **kw)
+    state["n"] += 1
+    if state["n"] >= {kill_after}:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return out
+ckpt.save_stream = killing_save
+from jepsen_tpu import serve as sv
+hist = chaos_check.build_histories({n}, {ops}, {procs})[{idx}]
+svc = sv.CheckService(warm_pool=False, stream_dir={stream_dir!r},
+                      **chaos_check.LADDER)
+svc.stream_open(model="cas-register", stream_id="chaos")
+at = 0
+while at < len(hist):
+    svc.stream_feed("chaos", hist[at:at + {epoch}], seq=at)
+    at += {epoch}
+svc.stream_close("chaos")
+print("CHILD-FINISHED-WITHOUT-KILL")
+"""
+
+
+def stream_chaos(opts) -> int:
+    """The streaming-lane gate (checker.streaming + the serve stream
+    lane) in two phases:
+
+    (1) REPLAYED-STREAM DIFFERENTIAL: every pinned history streamed in
+    epochs must reproduce the post-hoc ``batch_analysis`` verdict AND
+    witness op, with evidence digests identical after
+    ``parity_digest`` strips the admission events; corrupted histories
+    must additionally latch their refutation MID-stream (detection
+    metadata present, before full consumption).
+    (2) SIGKILL MID-STREAM: a child feeds the same ops through a
+    CheckService stream with per-feed checkpointing and SIGKILLs
+    itself after the --kill-after-th stream-checkpoint write; a fresh
+    service over the same --stream-dir must resume AT the checkpointed
+    op count (not zero), accept the client's idempotent full re-send
+    (seq offsets), and close with the uninterrupted verdict.  Returns
+    the failure count."""
+    from jepsen_tpu.checker import streaming as _streaming
+    from jepsen_tpu.obs import provenance
+    from jepsen_tpu.serve import service as svmod
+
+    failures = 0
+
+    def check(ok: bool, what: str):
+        nonlocal failures
+        print(f"  {'ok  ' if ok else 'FAIL'} {what}"
+              + ("" if ok else " <<<"),
+              file=sys.stderr if not ok else sys.stdout)
+        if not ok:
+            failures += 1
+
+    model = m.CASRegister(None)
+    n = max(3, opts.histories)
+    epoch = 8
+    hists = build_histories(n, opts.ops, opts.procs)
+    post = pb.batch_analysis(model, hists, **LADDER)
+    print(f"stream gate: differential over {n} histories "
+          f"(verdicts {verdicts(post)})")
+    for i, hist in enumerate(hists):
+        res, sc = _streaming.stream_check(
+            model, hist, feed_ops=epoch, capacity=LADDER["capacity"])
+        check((res.get("valid?"), (res.get("op") or {}).get("index"))
+              == (post[i].get("valid?"), (post[i].get("op") or {}).get("index")),
+              f"history {i}: stream verdict == post-hoc "
+              f"({res.get('valid?')})")
+        bs = sc.evidence()
+        bp = provenance.build_bundle(
+            history=hist, result=post[i], source="posthoc", model=model,
+            checker="linearizable")
+        check(bs is not None and _streaming.parity_digest(bs)
+              == _streaming.parity_digest(bp),
+              f"history {i}: evidence digest parity")
+        if post[i].get("valid?") is False:
+            det = sc.detection
+            check(det is not None and det.get("ops", len(hist)) < len(hist),
+                  f"history {i}: refutation latched MID-stream "
+                  f"(at {det and det.get('ops')}/{len(hist)} ops)")
+
+    if not opts.skip_sigkill:
+        print("stream gate: SIGKILL mid-stream + resume")
+        idx = 2  # build_histories corrupts every i % 3 == 2
+        hist = hists[idx]
+        ref, _ = _streaming.stream_check(
+            model, hist, feed_ops=epoch, capacity=LADDER["capacity"])
+        with tempfile.TemporaryDirectory(prefix="chaos-stream-") as d:
+            src = _STREAM_CHILD_SRC.format(
+                repo=str(REPO), tools=str(REPO / "tools"),
+                kill_after=max(1, opts.kill_after), n=n, ops=opts.ops,
+                procs=opts.procs, idx=idx, epoch=epoch, stream_dir=d,
+            )
+            p = subprocess.run(
+                [sys.executable, "-c", src], capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO),
+                timeout=600,
+            )
+            check(p.returncode == -signal.SIGKILL,
+                  f"child died by SIGKILL mid-stream (rc={p.returncode})")
+            svc = svmod.CheckService(warm_pool=False, stream_dir=d,
+                                     **LADDER)
+            doc = svc.stream_open(model="cas-register", stream_id="chaos",
+                                  resume=True)
+            resumed_at = doc["ops"]
+            check(0 < resumed_at <= len(hist),
+                  f"stream resumed at the checkpointed op count "
+                  f"({resumed_at}/{len(hist)}, not from zero)")
+            check(svc.stats()["streams_resumed"] == 1,
+                  "the service accounted the resume")
+            # the client re-sends everything; seq drops the overlap
+            at = 0
+            while at < len(hist):
+                svc.stream_feed("chaos", hist[at:at + epoch], seq=at)
+                at += epoch
+            out = svc.stream_close("chaos")
+            check((out["result"].get("valid?"),
+                   (out["result"].get("op") or {}).get("index"))
+                  == (ref.get("valid?"), (ref.get("op") or {}).get("index")),
+                  f"resumed verdict identical to uninterrupted "
+                  f"({out['result'].get('valid?')})")
+            svc.shutdown(drain=False)
     return failures
 
 
@@ -785,6 +932,17 @@ def main(argv=None) -> int:
                          "first-offense refusal, and a zero-downtime "
                          "rollout cycle under live HTTP load with no "
                          "5xx and identical verdicts")
+    ap.add_argument("--stream", action="store_true",
+                    help="run the streaming-checker gate instead: a "
+                         "differential pass (stream_check verdicts, "
+                         "witnesses, and evidence digests must be "
+                         "bit-identical to batch_analysis, with "
+                         "mid-stream detection on every refuted "
+                         "history) plus one real SIGKILL mid-stream — "
+                         "a fresh service resumes the per-stream "
+                         "checkpoint, the client re-sends everything "
+                         "(seq drops the overlap), and the final "
+                         "verdict must equal the uninterrupted one")
     ap.add_argument("--crashpoint", action="store_true",
                     help="run the crash-consistency audit instead "
                          "(tools/crashpoint.py): the (surface x "
@@ -812,6 +970,15 @@ def main(argv=None) -> int:
         print(json.dumps({
             "metric": "chaos_spill",
             "histories": max(2, opts.histories // 2),
+            "failures": failures,
+        }))
+        return 0 if failures == 0 else 1
+
+    if opts.stream:
+        failures = stream_chaos(opts)
+        print(json.dumps({
+            "metric": "chaos_stream",
+            "histories": max(3, opts.histories),
             "failures": failures,
         }))
         return 0 if failures == 0 else 1

@@ -17,11 +17,27 @@ top entries in the failure message so the offender is named in the CI
 output.  ``--seconds`` bypasses log parsing for drivers that timed the
 suite themselves.  Budget: ``--budget`` > ``JEPSEN_TPU_TIER1_BUDGET_S``
 env > 850 (headroom under the 870 s cap).
+
+Two structural guards ride along with the wall-clock check:
+
+  * REQUIRED FILES — tier-1 runs with
+    ``--continue-on-collection-errors``, so a syntax error in a new
+    test file silently shrinks the suite instead of failing it.  Every
+    file in ``REQUIRED_FILES`` must appear in the parsed log (so its
+    tests ran and its durations land in the report) or the gate fails.
+  * GEOMETRY AUDIT — each distinct ``capacity=(...)`` tuple is a rung
+    compile; the suite stays under budget by SHARING compile
+    geometries across files (conftest's 8-device mesh + the common
+    ``(64, 256)`` service shape).  The files in ``GEOMETRY_AUDITED``
+    are AST-scanned for capacity literals; any tuple no OTHER tier-1
+    test file uses is a fresh compile cache entry the whole suite pays
+    for, and the gate fails loudly naming it.
 """
 
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import os
 import re
@@ -31,6 +47,14 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 DEFAULT_BUDGET_S = 850.0
+
+#: files whose tests MUST have run (collection errors are non-fatal in
+#: tier-1, so a broken import would otherwise vanish silently).
+REQUIRED_FILES = ("tests/test_streaming.py",)
+
+#: new test files whose compile geometries must already be paid for by
+#: the rest of the suite (see the geometry audit in the docstring).
+GEOMETRY_AUDITED = ("tests/test_streaming.py",)
 
 #: pytest's terminal summary: "= 123 passed, 2 skipped in 812.34s (0:13:32) ="
 _SUMMARY_RE = re.compile(r"\bin (\d+(?:\.\d+)?)s(?: \(\d+:\d+(?::\d+)?\))?\s*=*\s*$")
@@ -57,6 +81,69 @@ def parse_log(text: str) -> tuple[float | None, list[tuple[float, str]]]:
     ]
     durations.sort(reverse=True)
     return seconds, durations
+
+
+def capacity_literals(path: Path) -> set[tuple[int, ...]]:
+    """Every compile geometry a test file pins statically: int-tuple
+    values of ``capacity=`` keywords, ``CAP``-named module constants,
+    and ``"capacity"``/``"stream-capacity"`` dict entries."""
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    except (OSError, SyntaxError):
+        return set()
+
+    def tup(node: ast.expr) -> tuple[int, ...] | None:
+        if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in node.elts):
+            return tuple(e.value for e in node.elts)
+        return None
+
+    out: set[tuple[int, ...]] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "capacity" and (t := tup(kw.value)):
+                    out.add(t)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and "CAP" in tgt.id.upper() \
+                        and (t := tup(node.value)):
+                    out.add(t)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                        and "capacity" in k.value and (t := tup(v)):
+                    out.add(t)
+    return out
+
+
+def geometry_audit(tests_dir: Path) -> list[str]:
+    """Problem strings for every audited file that pins a compile
+    geometry no other tier-1 test file uses (a fresh rung compile the
+    suite's budget was not paying for)."""
+    audited = {tests_dir.parent / f for f in GEOMETRY_AUDITED}
+    shared: set[tuple[int, ...]] = set()
+    for p in sorted(tests_dir.glob("test_*.py")):
+        if p not in audited:
+            shared |= capacity_literals(p)
+    problems = []
+    for p in sorted(audited):
+        if not p.exists():
+            continue  # REQUIRED_FILES covers absence via the run log
+        for cap in sorted(capacity_literals(p) - shared):
+            problems.append(
+                f"{p.relative_to(tests_dir.parent)} pins capacity "
+                f"{cap}, which no other tier-1 test file compiles — "
+                "use a suite-shared geometry (e.g. (64, 256)) or move "
+                "the test behind the slow marker")
+    return problems
+
+
+def missing_required(text: str) -> list[str]:
+    """REQUIRED_FILES that never appear in the suite log (collection
+    error or deletion — either way their tests silently didn't run)."""
+    return [f for f in REQUIRED_FILES if f not in text]
 
 
 def append_ledger(seconds: float, budget: float,
@@ -121,6 +208,7 @@ def main(argv=None) -> int:
                                       DEFAULT_BUDGET_S))
 
     durations: list[tuple[float, str]] = []
+    structural: list[str] = []
     if a.seconds is not None:
         seconds = a.seconds
     else:
@@ -138,17 +226,26 @@ def main(argv=None) -> int:
                 print("check_tier1_budget: no pytest summary line found "
                       f"in {a.log!r} (did the suite crash?)", file=sys.stderr)
             return 2
+        structural += [
+            f"required test file {f} appears nowhere in the suite log "
+            "(collection error? its tests did not run)"
+            for f in missing_required(text)
+        ]
+
+    structural += geometry_audit(
+        Path(__file__).resolve().parent.parent / "tests")
 
     append_ledger(seconds, budget, durations, a.ledger)
 
     if a.json:
-        ok = seconds <= budget
+        ok = seconds <= budget and not structural
         print(json.dumps({
             "metric": "tier1_budget",
             "ok": ok,
             "seconds": round(seconds, 2),
             "budget_s": budget,
             "headroom_s": round(budget - seconds, 2),
+            "structural": structural,
             "slowest": [
                 {"seconds": secs, "test": test}
                 for secs, test in durations[:10]
@@ -156,7 +253,12 @@ def main(argv=None) -> int:
         }))
         return 0 if ok else 1
 
+    for p in structural:
+        print(f"tier-1 STRUCTURAL: {p}", file=sys.stderr)
+
     if seconds <= budget:
+        if structural:
+            return 1
         print(f"tier-1 budget OK: {seconds:.1f}s <= {budget:.0f}s "
               f"({budget - seconds:.1f}s headroom)")
         return 0

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """Crash-consistency audit for every durable surface.
 
-The checker's own durable state (ladder + chunk checkpoints, the
-admission journal, drain dirs, the perf ledger, the idempotency map)
+The checker's own durable state (ladder + chunk + per-stream
+checkpoints, the admission journal, drain dirs, the perf ledger, the
+idempotency map)
 must survive exactly the fault classes this repo exists to inject.
 This tool enumerates the (surface x crash-step x corruption-mode)
 matrix and drives each surface's CONSUMER through every cell, asserting
@@ -316,6 +317,70 @@ def chunk_cells(*, smoke: bool) -> None:
             assert r["valid?"] == base, f"{r['valid?']} != {base}"
 
         cell("chunk", "corruption", mode, _run)
+
+
+# ---------------------------------------------------------------------------
+# Surface: stream checkpoint (checker.streaming)
+# ---------------------------------------------------------------------------
+
+
+def stream_cells(*, smoke: bool) -> None:
+    """The per-stream checkpoint pair (STREAM_JSON/STREAM_NPZ, written
+    every feed): crash-steps must resume to the uninterrupted verdict;
+    corruption must quarantine and stream FRESH to that same verdict —
+    a poisoned carried frontier must never decide anything."""
+    from jepsen_tpu.checker import streaming as _streaming
+
+    hist = corrupt(valid_register_history(30, 3, seed=7300, info_rate=0.35),
+                   seed=2)
+    model = m.CASRegister(None)
+    cap = LADDER["capacity"]
+    base = _streaming.stream_check(model, hist, feed_ops=8,
+                                   capacity=cap)[0]["valid?"]
+
+    def crashed_mid_stream(step: str) -> Path:
+        """Feed with checkpointing until the injected CrashPoint kills
+        the stream at its 2nd checkpoint write."""
+        d = Path(tempfile.mkdtemp(prefix=f"cp-stream-{step}-"))
+        with faults.inject_scope(
+                crash_injector(step, ckpt.STREAM_JSON, nth=2)):
+            try:
+                _streaming.stream_check(model, hist, feed_ops=8,
+                                        capacity=cap, checkpoint_dir=d)
+                raise AssertionError("crash injector never fired")
+            except faults.CrashPoint:
+                pass
+        return d
+
+    steps = ("post-rename",) if smoke else STEPS
+    for step in steps:
+        def _run(step=step):
+            d = crashed_mid_stream(step)
+            r, _ = _streaming.stream_check(model, hist, feed_ops=8,
+                                           capacity=cap, checkpoint_dir=d,
+                                           resume=True)
+            assert r["valid?"] == base, f"{r['valid?']} != {base}"
+
+        cell("stream", "crash-step", step, _run)
+
+    modes = ("bitflip",) if smoke else ("truncate", "bitflip", "junk",
+                                        "missing-sibling")
+    for mode in modes:
+        def _run(mode=mode):
+            d = crashed_mid_stream("post-rename")
+            if mode == "missing-sibling":
+                (d / ckpt.STREAM_NPZ).unlink()
+            else:
+                corrupt_file(d / ckpt.STREAM_JSON, mode)
+            r, _ = _streaming.stream_check(model, hist, feed_ops=8,
+                                           capacity=cap, checkpoint_dir=d,
+                                           resume=True)
+            assert r["valid?"] == base, f"{r['valid?']} != {base}"
+            if mode != "missing-sibling":
+                assert list(d.glob("*.corrupt-*")), (
+                    "corrupt stream checkpoint was not quarantined")
+
+        cell("stream", "corruption", mode, _run)
 
 
 # ---------------------------------------------------------------------------
@@ -657,6 +722,9 @@ def run(surfaces, *, smoke: bool, real_sigkill: bool) -> int:
     if "chunk" in surfaces:
         print("surface: chunk/spill checkpoint")
         chunk_cells(smoke=smoke)
+    if "stream" in surfaces:
+        print("surface: stream checkpoint")
+        stream_cells(smoke=smoke)
     if "journal" in surfaces:
         print("surface: admission journal")
         journal_cells(hists, baseline, smoke=smoke)
@@ -681,7 +749,7 @@ def run(surfaces, *, smoke: bool, real_sigkill: bool) -> int:
     return 1 if failed else 0
 
 
-ALL_SURFACES = ("ladder", "chunk", "journal", "drain", "ledger",
+ALL_SURFACES = ("ladder", "chunk", "stream", "journal", "drain", "ledger",
                 "idempotency")
 
 

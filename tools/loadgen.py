@@ -584,6 +584,144 @@ def fleet_round(a) -> int:
     return rc
 
 
+def stream_round(a) -> int:
+    """``--stream``: the open-arrival streaming round (checker.streaming).
+
+    Replays stored histories as op STREAMS at ``--rate`` ops/s (epochs
+    of ``--stream-epoch`` ops) through a ``StreamingChecker``, with
+    every ``--corrupt-every``-th history corrupted so some streams
+    carry a seeded violation.  For each refuted stream it measures
+    VIOLATION-DETECTION latency — wall clock from stream start to the
+    mid-stream verdict — against the end-of-run comparator: full
+    arrival time plus the measured post-hoc ``batch_analysis`` wall
+    (what a post-hoc pipeline would report).  This is the number ISSUE
+    19 changes: check latency from the offending op, not from
+    end-of-run.
+
+    Gates (exit 1): streaming verdicts identical to post-hoc on every
+    history; evidence digests identical after stripping
+    admission/decision-path events (``streaming.parity_digest``); mean
+    detection latency strictly below mean end-of-run latency on the
+    refuted streams.  A passing round appends a fingerprinted
+    ``kind:"stream"`` perf-ledger record."""
+    from genhist import corrupt, valid_register_history
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import streaming as _streaming
+    from jepsen_tpu.obs import provenance, regress
+    from jepsen_tpu.parallel import batch_analysis
+
+    capacity = tuple(int(c) for c in a.capacity.split(",") if c)
+    model = m.CASRegister(None)
+    epoch = max(1, a.stream_epoch)
+    rate = max(1.0, a.rate)
+    n = a.requests
+    hists, bad = [], []
+    for i in range(n):
+        h = valid_register_history(a.ops, a.procs, seed=a.seed + i,
+                                   info_rate=a.info_rate)
+        is_bad = bool(a.corrupt_every) and (
+            i % a.corrupt_every == a.corrupt_every - 1)
+        if is_bad:
+            h = corrupt(h, seed=a.seed + i)
+        hists.append(h)
+        bad.append(is_bad)
+    print(f"stream round: {n} histories ({sum(bad)} corrupted), "
+          f"{a.ops} ops @ {rate:.0f} ops/s, epoch {epoch}")
+
+    # Post-hoc arm first: the measured per-history check wall is the
+    # end-of-run comparator's second term, and running it first warms
+    # the chunk kernel so the streaming arm's detection latency isn't
+    # 90% first-compile.
+    post, post_wall = [], []
+    for h in hists:
+        t1 = time.perf_counter()
+        res = batch_analysis(model, [h], capacity=capacity,
+                             confirm_refutations=False)[0]
+        post_wall.append(time.perf_counter() - t1)
+        post.append(res)
+
+    rc = 0
+    det_lat, end_lat, stream_wall = [], [], []
+    for i, h in enumerate(hists):
+        sc = _streaming.StreamingChecker(model, capacity=capacity)
+        t0 = time.perf_counter()
+        detected = None
+        for j in range(0, len(h), epoch):
+            # pace the replay: this epoch's ops "arrive" at j/rate
+            due = t0 + j / rate
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            sc.feed(h[j:j + epoch])
+            if detected is None and sc.terminal:
+                detected = time.perf_counter() - t0
+        res = sc.finalize()
+        stream_wall.append(time.perf_counter() - t0)
+        # what a post-hoc pipeline reports the violation at: the whole
+        # stream has to arrive, then the stored history gets checked
+        end_of_run = len(h) / rate + post_wall[i]
+        if bad[i]:
+            det_lat.append(detected if detected is not None
+                           else stream_wall[-1])
+            end_lat.append(end_of_run)
+        want = (post[i].get("valid?"),
+                (post[i].get("op") or {}).get("index"))
+        got = (res.get("valid?"), (res.get("op") or {}).get("index"))
+        if got != want:
+            print(f"VERDICT PARITY MISMATCH at history {i}: "
+                  f"stream {got} != post-hoc {want}", file=sys.stderr)
+            rc = 1
+            continue
+        bs = sc.evidence()
+        bp = provenance.build_bundle(
+            history=h, result=post[i], source="posthoc", model=model,
+            checker="linearizable")
+        if (bs is None or _streaming.parity_digest(bs)
+                != _streaming.parity_digest(bp)):
+            print(f"EVIDENCE DIGEST MISMATCH at history {i}",
+                  file=sys.stderr)
+            rc = 1
+
+    out = {
+        "streams": n, "corrupted": sum(bad),
+        "rate_ops_s": rate, "epoch_ops": epoch,
+        "detect_latency_s": round(_pct(det_lat, 50), 4) if det_lat else None,
+        "end_of_run_latency_s": (round(_pct(end_lat, 50), 4)
+                                 if end_lat else None),
+        "stream_wall_s": round(_pct(stream_wall, 50), 4),
+        "posthoc_wall_s": round(_pct(post_wall, 50), 4),
+    }
+    if det_lat:
+        mean_det = sum(det_lat) / len(det_lat)
+        mean_end = sum(end_lat) / len(end_lat)
+        out["detection_speedup"] = round(mean_end / max(mean_det, 1e-9), 2)
+        if mean_det >= mean_end:
+            print(f"DETECTION NOT EARLY: streaming detected at "
+                  f"{mean_det:.3f}s mean, end-of-run would report at "
+                  f"{mean_end:.3f}s", file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        try:
+            metrics = {
+                "detect_latency_s": (sum(det_lat) / len(det_lat)
+                                     if det_lat else 0.0),
+                "end_of_run_latency_s": (sum(end_lat) / len(end_lat)
+                                         if end_lat else 0.0),
+                "detection_speedup": out.get("detection_speedup") or 0.0,
+                "stream_wall_s": sum(stream_wall) / len(stream_wall),
+            }
+            axes = {"rate": str(rate), "ops": str(a.ops),
+                    "epoch": str(epoch)}
+            regress.append_record(
+                regress.make_record("stream", metrics, axes=axes))
+        except Exception as e:  # noqa: BLE001 — never fail the run here
+            print(f"warning: perf-ledger append failed: {e}",
+                  file=sys.stderr)
+    print(json.dumps({"loadgen": {"stream": out}}))
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=32)
@@ -675,6 +813,17 @@ def main(argv=None) -> int:
                     help="fleet round: exit 1 unless fleet throughput "
                          "exceeds single-service throughput by this "
                          "factor (default 2.5)")
+    ap.add_argument("--stream", action="store_true",
+                    help="run the STREAMING round instead: replay "
+                         "stored histories as open-arrival op streams "
+                         "through checker.streaming at --rate ops/s, "
+                         "measuring violation-detection latency vs the "
+                         "end-of-run comparator, with verdict + "
+                         "evidence-digest parity gates against "
+                         "post-hoc batch_analysis")
+    ap.add_argument("--stream-epoch", type=int, default=8,
+                    help="ops per streaming feed epoch (smaller epochs "
+                         "detect sooner, pay more re-pack host work)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the conftest dance) — "
@@ -694,6 +843,8 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
 
+    if a.stream:
+        return stream_round(a)
     if a.replicas and a.replicas > 1:
         return fleet_round(a)
 
