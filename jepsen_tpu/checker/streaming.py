@@ -88,6 +88,7 @@ from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu import obs
 from jepsen_tpu.checker import UNKNOWN
+from jepsen_tpu.obs import metrics as _metrics
 from jepsen_tpu.obs import provenance as _prov
 from jepsen_tpu.ops import wgl
 from jepsen_tpu.ops.hashing import resolve_dedup_backend
@@ -219,6 +220,7 @@ class StreamingChecker:
         self._launches = 0
         self._peak = 1
         self._epochs = 0
+        self._rescans = 0
         self._result: dict | None = None
         self._detect: dict | None = None
         self._traj: list[dict] = []
@@ -242,6 +244,25 @@ class StreamingChecker:
     def ops_consumed(self) -> int:
         """Ops accepted so far — a resuming feeder continues from here."""
         return len(self._history)
+
+    @property
+    def epochs(self) -> int:
+        """Feed epochs processed so far."""
+        return self._epochs
+
+    @property
+    def rescans(self) -> int:
+        """Full from-barrier-0 rescans forced by a settlement-invariant
+        violation (``stream.rescan``)."""
+        return self._rescans
+
+    @property
+    def frontier_rows(self) -> int:
+        """Rows in the carried frontier right now (0 before the first
+        settled barrier)."""
+        if self._frontier is None:
+            return 0
+        return int(self._frontier[0].shape[0])
 
     @property
     def detection(self) -> dict | None:
@@ -390,6 +411,11 @@ class StreamingChecker:
             "seconds": time.perf_counter() - self._t0,
             "epoch_seconds": time.perf_counter() - self._t_epoch,
         }
+        # Detect latency = wall from the offending epoch's ARRIVAL, not
+        # from stream open — the quantity a streaming deployment cares
+        # about ("how long after the bad op landed did we know?").
+        _metrics.observe("serve.stream_detect_latency_seconds",
+                         self._detect["epoch_seconds"])
         self._terminal(res, barrier=gb)
 
     def _advance(self, final: bool) -> None:
@@ -478,6 +504,7 @@ class StreamingChecker:
                 # Settlement invariant violated (should be unreachable):
                 # rescan from barrier 0 — latency, never a wrong verdict.
                 obs.counter("stream.rescan", stream=self.stream_id)
+                self._rescans += 1
                 self._pv("stream.rescan", barrier=self._advanced)
                 logger.warning(
                     "stream %s: dropped crashed-group column was nonzero; "

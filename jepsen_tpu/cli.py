@@ -399,9 +399,34 @@ def _cmd_serve(opts) -> int:
     return EXIT_VALID
 
 
+def _fleet_status_lines(doc) -> str:
+    """Compact per-replica observability summary under the GET /fleet
+    JSON: where each replica's metrics endpoint and recorder stream
+    live, plus its recorder t0 epoch — the inputs an operator feeds
+    ``tools/trace_export.py`` to merge the fleet timeline."""
+    lines = ["", "replicas:"]
+    for name, row in sorted((doc.get("replicas") or {}).items()):
+        parts = [f"  {name}: {row.get('kind', '?')}/{row.get('state', '?')}"]
+        if row.get("metrics_url"):
+            parts.append(f"metrics={row['metrics_url']}")
+        tele = row.get("telemetry") or {}
+        if tele.get("jsonl"):
+            shared = " (shared with router)" if tele.get("shared") else ""
+            parts.append(f"telemetry={tele['jsonl']}{shared}")
+        if tele.get("t0") is not None:
+            parts.append(f"t0={tele['t0']}")
+        lines.append("  ".join(parts))
+    rt = doc.get("router_telemetry")
+    if rt:
+        lines.append(
+            f"  router: telemetry={rt.get('jsonl')}  t0={rt.get('t0')}")
+    return "\n".join(lines)
+
+
 def _cmd_fleet(opts) -> int:
     """``fleet``: operate a running fleet over its HTTP admin surface
-    — ``fleet status --url`` prints GET /fleet, ``fleet rollout --url``
+    — ``fleet status --url`` prints GET /fleet (plus a compact
+    per-replica endpoint/recorder summary), ``fleet rollout --url``
     drives the zero-downtime replica cycle (POST /fleet/rollout)."""
     import json as _json
     import urllib.error
@@ -434,6 +459,8 @@ def _cmd_fleet(opts) -> int:
         print(_json.dumps({"error": str(e)}, indent=2))
         return EXIT_CRASH
     print(_json.dumps(doc, indent=2, default=str))
+    if opts.fleet_command != "rollout" and doc.get("replicas"):
+        print(_fleet_status_lines(doc))
     return EXIT_VALID
 
 

@@ -233,6 +233,11 @@ class Recorder:
         with self._lock:
             self.events.append(ev)
             self._fh.write(line + "\n")
+            # per-line flush: subprocess replicas never close their
+            # recorder (they die by signal), and the fleet timeline
+            # merger reads the N jsonl streams LIVE — a block-buffered
+            # stream would trail reality by up to one stdio buffer
+            self._fh.flush()
 
     def close(self) -> dict:
         with self._lock:

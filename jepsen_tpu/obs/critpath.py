@@ -95,12 +95,21 @@ def extract_spans(events: Iterable[Mapping]) -> list["Span"]:
 
 
 def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
-    """``{trace_id: {queue_s, pack_s, launch_s, confirm_s, other_s,
-    total_s, tier, verdict, launch_span}}`` for every request whose
-    end-to-end ``serve.request`` span landed in the stream.
+    """``{trace_id: {route_s, queue_s, pack_s, launch_s, confirm_s,
+    other_s, total_s, tier, verdict, launch_span}}`` for every request
+    whose end-to-end ``serve.request`` span landed in the stream.
 
     Stage algebra (every request's stages SUM to its ``total_s``):
 
+      * ``route_s``   — the router hop (fleet deployments only): the
+        ``fleet.route`` span stamped with this trace starts at router
+        admission; the replica-side ``serve.request`` span starts at
+        replica accept.  With the two recorder streams clock-aligned
+        (obs.trace.align_streams), the gap between those starts IS the
+        hop cost, and ``total_s`` grows by exactly it — reconciling
+        router-side and replica-side stamps instead of trusting either
+        alone.  0 when no route span carries the trace (single-process
+        runs, or the router stream wasn't merged in).
       * ``queue_s``   — the ``serve.admission`` span: submit → picked
         into a wave/batch (the class-queue wait; a rung joiner's
         admission ends at its join boundary).
@@ -118,6 +127,7 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
     spans = extract_spans(events)
     requests: dict[str, Span] = {}
     admissions: dict[str, Span] = {}
+    routes: dict[str, Span] = {}
     #: trace id -> the launch spans stamped with it (one indexing pass:
     #: the per-request loop must not scan every launch's member list —
     #: long recordings carry thousands of both).
@@ -127,6 +137,11 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
             requests[s.trace] = s
         elif s.name == "serve.admission" and isinstance(s.trace, str):
             admissions[s.trace] = s
+        elif s.name == "fleet.route" and isinstance(s.trace, str):
+            # earliest route attempt wins: resubmission re-routes open
+            # later and must not shrink the measured hop
+            if s.trace not in routes or s.t < routes[s.trace].t:
+                routes[s.trace] = s
         elif s.name in LAUNCH_SPANS:
             members = s.trace if s.trace is not None else ()
             if isinstance(members, str):
@@ -141,6 +156,15 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
     for tid, req in requests.items():
         total = req.dur
         t_sub, t_done = req.t, req.end
+        # router hop: route span start (router admission) → request
+        # span start (replica accept).  Only a route that genuinely
+        # precedes the request counts — a negative gap is residual
+        # clock skew the alignment already reported, not a stage.
+        route = 0.0
+        rt = routes.get(tid)
+        if rt is not None and rt.t <= t_sub + _EPS:
+            route = max(0.0, t_sub - rt.t)
+            total += route
         adm = admissions.get(tid)
         queue = min(total, adm.dur) if adm is not None else 0.0
         t_picked = t_sub + queue
@@ -158,7 +182,7 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
             pack = max(0.0, min(ride.t, t_done) - t_picked)
             launch = max(0.0, l_end - l_start)
             confirm = max(0.0, t_done - max(ride.end, t_picked))
-        other = total - (queue + pack + launch + confirm)
+        other = total - (route + queue + pack + launch + confirm)
         if other < 0:
             # float rounding (event "t"/"dur" are rounded to µs): fold
             # the deficit back into the launch residence so the stages
@@ -166,6 +190,7 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
             launch = max(0.0, launch + other)
             other = 0.0
         row = {
+            "route_s": round(route, 6),
             "queue_s": round(queue, 6),
             "pack_s": round(pack, 6),
             "launch_s": round(launch, 6),
@@ -189,7 +214,8 @@ def decompose_requests(events: Iterable[Mapping]) -> dict[str, dict]:
 #: lifecycle measurements (serve.request covers submit→resolve and
 #: would swallow the execution spans it merely re-measures — the
 #: decomposition is their consumer, not the path).
-_PATH_EXCLUDE = {"serve.request", "serve.admission"}
+_PATH_EXCLUDE = {"serve.request", "serve.admission", "fleet.route",
+                 "fleet.resubmit", "fleet.spill"}
 
 
 def _build_forest(spans: list[Span]) -> list[Span]:
@@ -487,15 +513,15 @@ def format_requests(decomp: Mapping[str, Mapping]) -> str:
     if not decomp:
         return "(no serve.request spans in this stream)\n"
     rows = [
-        [tid, d.get("tier") or "", d["queue_s"], d["pack_s"], d["launch_s"],
-         d["confirm_s"], d["other_s"], d["total_s"],
-         d.get("verdict") or ""]
+        [tid, d.get("tier") or "", d.get("route_s", 0.0), d["queue_s"],
+         d["pack_s"], d["launch_s"], d["confirm_s"], d["other_s"],
+         d["total_s"], d.get("verdict") or ""]
         for tid, d in sorted(decomp.items(),
                              key=lambda kv: -kv[1]["total_s"])
     ]
     return _fmt_table(
-        ["trace", "tier", "queue_s", "pack_s", "launch_s", "confirm_s",
-         "other_s", "total_s", "verdict"], rows) + "\n"
+        ["trace", "tier", "route_s", "queue_s", "pack_s", "launch_s",
+         "confirm_s", "other_s", "total_s", "verdict"], rows) + "\n"
 
 
 def format_critpath(cp: Mapping) -> str:

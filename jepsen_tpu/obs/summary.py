@@ -26,6 +26,14 @@ later perf PRs report against.
                                # jepsen_tpu.serve.fleet): placement +
                                # spill volume, fence/resubmission churn,
                                # and zero-downtime rollout spans
+   "streams":  {"opened", "closed", "rejected", "ops", "rescans",
+                "epochs": {"count", "total_s", "max_s"},
+                "session": {"count", "total_s", "max_s"},
+                "verdicts": {verdict: count}}
+                               # stream.* events (checker.streaming +
+                               # the serving layer's stream sessions):
+                               # online-checking volume, epoch scan
+                               # time, and mid-stream verdict census
    "ladder":   [{"stage", "engine", "capacity", "lanes", "seconds",
                  "resolved", "refuted", "unknowns_remaining",
                  "launches", "compile_launches", "compile_s",
@@ -153,6 +161,8 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
     #: verdict-provenance accumulators (the provenance.* counter family:
     #: evidence bundles emitted per source/verdict + emission errors).
     prov = {"bundles": 0, "emit_errors": 0, "by_source": {}, "by_verdict": {}}
+    #: streaming-verdict census (stream.verdict span events).
+    stream_verdicts: dict[str, int] = {}
     wall = 0.0
 
     def _fault_row(name: str) -> dict:
@@ -242,6 +252,9 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
                         float(attrs["continuous_occupancy"]) * rungs
                     )
                 serve_cont["joined"] += int(attrs.get("joined") or 0)
+            elif name == "stream.verdict":
+                v = str(attrs.get("verdict") or "?")
+                stream_verdicts[v] = stream_verdicts.get(v, 0) + 1
             elif name in serve_lat:
                 sl = serve_lat[name]
                 sl["count"] += 1
@@ -394,6 +407,23 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
         ro = spans["fleet.rollout"]
         fleet["rollout"] = {"count": ro["count"], "total_s": ro["total_s"],
                             "max_s": ro["max_s"]}
+    streams: dict = {}
+    for cname, out_key in (("stream.opened", "opened"),
+                           ("stream.closed", "closed"),
+                           ("stream.rejected", "rejected"),
+                           ("stream.ops", "ops"),
+                           ("stream.rescan", "rescans")):
+        if cname in counters:
+            streams[out_key] = counters[cname]
+    for sname, out_key in (("stream.epoch", "epochs"),
+                           ("stream.session", "session")):
+        if sname in spans:
+            sp = spans[sname]
+            streams[out_key] = {"count": sp["count"],
+                                "total_s": sp["total_s"],
+                                "max_s": sp["max_s"]}
+    if stream_verdicts:
+        streams["verdicts"] = dict(sorted(stream_verdicts.items()))
     from jepsen_tpu.obs import critpath as _critpath
 
     out = {
@@ -403,6 +433,7 @@ def summarize(events: Iterable[Mapping], *, skipped_lines: int = 0) -> dict:
         "checkers": out_checkers,
         "serve": serve,
         "fleet": fleet,
+        "streams": streams,
         "ladder": ladder,
         "dedup": out_dedup,
         "elle": elle,
@@ -496,6 +527,19 @@ def format_summary(summary: Mapping) -> str:
             rows.append(["rollout total_s", fle["rollout"]["total_s"]])
             rows.append(["rollout max_s", fle["rollout"]["max_s"]])
         parts.append(_table(["fleet", "value"], rows))
+    if summary.get("streams"):
+        st = summary["streams"]
+        parts.append("\nstreams (online checking):")
+        rows = [[k, st[k]] for k in (
+            "opened", "closed", "rejected", "ops", "rescans") if k in st]
+        for key, label in (("epochs", "epoch"), ("session", "session")):
+            if key in st:
+                rows.append([f"{label} count", st[key]["count"]])
+                rows.append([f"{label} total_s", st[key]["total_s"]])
+                rows.append([f"{label} max_s", st[key]["max_s"]])
+        for vd, n in (st.get("verdicts") or {}).items():
+            rows.append([f"verdict[{vd}]", n])
+        parts.append(_table(["stream", "value"], rows))
     if summary.get("ladder"):
         headers = ["stage", "engine", "capacity", "lanes", "seconds",
                    "resolved", "refuted", "unknowns", "launches",

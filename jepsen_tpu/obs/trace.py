@@ -42,7 +42,8 @@ from typing import Iterable, Mapping
 
 from jepsen_tpu.obs.critpath import span_devices as _span_devices
 
-__all__ = ["read_jsonl_events", "to_trace_events"]
+__all__ = ["align_streams", "merge_aligned_events", "read_jsonl_events",
+           "to_trace_events"]
 
 #: gauges worth a Perfetto counter track (point samples over time).
 _COUNTER_GAUGES = {
@@ -64,6 +65,8 @@ _COUNTER_TID = 1
 _DEVICE_TID_BASE = 1000
 #: request lanes start here (arrival order).
 _REQUEST_TID_BASE = 2000
+#: stream lanes (one per live stream session id) start here.
+_STREAM_TID_BASE = 3000
 
 #: span names eligible for per-device rendering (device-attributed
 #: launches; ladder.stage stays on the ladder lane — its launches
@@ -107,6 +110,127 @@ def read_jsonl_events(path: Path | str) -> tuple[list[dict], int]:
     return events, skipped
 
 
+def _stream_meta(events: Iterable[Mapping]) -> dict:
+    return next((e for e in events if e.get("type") == "meta"), {})
+
+
+#: router-side spans whose start must precede the replica-side request
+#: span under the same trace — the ordering invariant clock alignment
+#: is supposed to restore (align_streams measures its violations as
+#: residual skew).
+_ROUTER_REQUEST_SPANS = ("fleet.route", "fleet.resubmit")
+_REPLICA_REQUEST_SPANS = ("serve.request", "serve.admission")
+
+
+def align_streams(streams: Iterable) -> tuple[list[dict], dict]:
+    """Clock-align N recorder streams onto one common epoch.
+
+    Each recorder's event ``t`` fields are monotonic offsets from ITS
+    OWN open; the ``meta`` header's ``t0`` epoch (obs.Recorder) is what
+    makes them comparable: epoch time = t0 + t.  This rebases every
+    stream onto the EARLIEST t0 (offset = t0_i - min t0) — the fix for
+    the old single-recorder assumption where merging streams with
+    differing ``t0`` silently interleaved unrelated clocks.
+
+    ``streams``: iterable of ``(label, events)`` or ``(label, events,
+    skipped)``.  Returns ``(aligned, info)``:
+
+      * ``aligned`` — one dict per stream: ``label``, ``meta``,
+        ``offset_s`` (seconds added to every event ``t``), ``skipped``,
+        and ``events`` (rebased COPIES; the input is not mutated).
+      * ``info`` — ``t0`` (the common epoch), ``offsets`` per label,
+        ``missing_t0`` (labels aligned at offset 0 because their meta
+        header carried no epoch), ``cross_process_traces`` (trace ids
+        whose events landed in more than one stream — the hop-spanning
+        requests), and ``residual_skew_s``: the largest POST-ALIGNMENT
+        causality violation between a router-side ``fleet.route``/
+        ``fleet.resubmit`` span and the same trace's replica-side
+        ``serve.request`` start (0.0 when the epochs agree; wall clocks
+        are not monotonic across hosts, so the residue is reported, not
+        hidden).
+    """
+    rows: list[dict] = []
+    for s in streams:
+        label, events = s[0], list(s[1])
+        skipped = int(s[2]) if len(s) > 2 else 0
+        meta = _stream_meta(events)
+        t0 = meta.get("t0", meta.get("wall-clock"))
+        rows.append({"label": str(label), "meta": meta, "skipped": skipped,
+                     "t0": float(t0) if t0 is not None else None,
+                     "raw": events})
+    known = [r["t0"] for r in rows if r["t0"] is not None]
+    ref = min(known) if known else 0.0
+    missing = [r["label"] for r in rows if r["t0"] is None]
+
+    aligned: list[dict] = []
+    trace_streams: dict[str, set[int]] = {}
+    route_starts: dict[str, float] = {}   # trace -> earliest router span t
+    request_starts: dict[str, float] = {}  # trace -> earliest replica span t
+    for i, r in enumerate(rows):
+        off = (r["t0"] - ref) if r["t0"] is not None else 0.0
+        events = []
+        for ev in r["raw"]:
+            if "t" in ev:
+                ev = {**ev, "t": round(float(ev["t"] or 0.0) + off, 6)}
+            events.append(ev)
+            tr = ev.get("trace")
+            if isinstance(tr, str):
+                trace_streams.setdefault(tr, set()).add(i)
+                if ev.get("type") == "span":
+                    name, t = str(ev.get("name")), float(ev.get("t") or 0.0)
+                    if name in _ROUTER_REQUEST_SPANS:
+                        route_starts[tr] = min(
+                            route_starts.get(tr, t), t)
+                    elif name in _REPLICA_REQUEST_SPANS:
+                        request_starts[tr] = min(
+                            request_starts.get(tr, t), t)
+        aligned.append({"label": r["label"], "meta": r["meta"],
+                        "offset_s": round(off, 6), "skipped": r["skipped"],
+                        "events": events})
+
+    skew = 0.0
+    pairs = 0
+    for tr, t_route in route_starts.items():
+        t_req = request_starts.get(tr)
+        if t_req is None or len(trace_streams.get(tr, ())) < 2:
+            continue
+        pairs += 1
+        # the route span opens before the replica accepts; a replica
+        # span that reads as STARTING EARLIER is clock skew
+        skew = max(skew, t_route - t_req)
+    info = {
+        "t0": ref if known else None,
+        "offsets": {a["label"]: a["offset_s"] for a in aligned},
+        "missing_t0": missing,
+        "cross_process_traces": sorted(
+            tr for tr, ss in trace_streams.items() if len(ss) > 1),
+        "residual_skew_s": round(max(0.0, skew), 6),
+        "skew_pairs": pairs,
+    }
+    return aligned, info
+
+
+def merge_aligned_events(aligned: Iterable[Mapping]) -> list[dict]:
+    """One time-ordered event list from ``align_streams`` output — what
+    the summarizer and the per-request decomposition consume.  Only the
+    reference stream's ``meta`` header survives (a merged stream has
+    exactly one epoch; N meta rows would re-introduce the ambiguity the
+    alignment just removed)."""
+    aligned = list(aligned)
+    merged: list[dict] = []
+    kept_meta = False
+    for a in sorted(aligned, key=lambda a: a.get("offset_s") or 0.0):
+        for ev in a["events"]:
+            if ev.get("type") == "meta":
+                if kept_meta:
+                    continue
+                kept_meta = True
+            merged.append(ev)
+    merged.sort(key=lambda ev: (ev.get("type") != "meta",
+                                float(ev.get("t") or 0.0)))
+    return merged
+
+
 def _us(t) -> float:
     return round(float(t or 0.0) * 1e6, 1)
 
@@ -134,6 +258,25 @@ def to_trace_events(events: Iterable[Mapping], *,
     ]
     lanes: dict[str, int] = {}
     device_lanes: dict[int, int] = {}
+    stream_lanes: dict[str, int] = {}
+
+    def stream_lane(sid: str) -> int:
+        """One lane per live stream session: the ``stream.*`` spans
+        (epoch advances, verdict latches, session wall) render as a
+        per-stream timeline instead of riding the session's request
+        lane."""
+        tid = stream_lanes.get(sid)
+        if tid is None:
+            tid = stream_lanes[sid] = _STREAM_TID_BASE + len(stream_lanes)
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": f"stream {sid}"},
+            })
+            out.append({
+                "ph": "M", "name": "thread_sort_index", "pid": pid,
+                "tid": tid, "args": {"sort_index": -500 + len(stream_lanes)},
+            })
+        return tid
 
     def lane_of(trace) -> int:
         """tid for one request's lane; shared (list) traces and
@@ -175,9 +318,13 @@ def to_trace_events(events: Iterable[Mapping], *,
                 args["parent"] = ev["parent"]
             if ev.get("err"):
                 args["err"] = ev["err"]
+            sid = args.get("stream")
+            tid = (stream_lane(str(sid))
+                   if name.startswith("stream.") and sid is not None
+                   else lane_of(tr))
             row = {
                 "ph": "X", "name": name, "pid": pid,
-                "tid": lane_of(tr), "ts": _us(ev.get("t")),
+                "tid": tid, "ts": _us(ev.get("t")),
                 "dur": max(1.0, _us(ev.get("dur"))), "args": args,
             }
             devs = (_span_devices(ev)
@@ -201,12 +348,17 @@ def to_trace_events(events: Iterable[Mapping], *,
                     "ts": _us(ev.get("t")), "args": {"value": v},
                 })
         elif et == "event":
+            name = str(ev.get("name"))
             args = dict(ev.get("attrs") or {})
             if tr is not None:
                 args["trace"] = tr
+            sid = args.get("stream")
+            tid = (stream_lane(str(sid))
+                   if name.startswith("stream.") and sid is not None
+                   else lane_of(tr))
             out.append({
-                "ph": "i", "name": str(ev.get("name")), "pid": pid,
-                "tid": lane_of(tr), "ts": _us(ev.get("t")), "s": "t",
+                "ph": "i", "name": name, "pid": pid,
+                "tid": tid, "ts": _us(ev.get("t")), "s": "t",
                 "args": args,
             })
         # counters are cumulative noise at trace zoom; the summary has them
@@ -219,6 +371,7 @@ def to_trace_events(events: Iterable[Mapping], *,
             "pid": meta.get("pid"),
             "requests": len(lanes),
             "devices": len(device_lanes),
+            "streams": len(stream_lanes),
             "skipped_lines": int(skipped_lines),
         },
     }

@@ -49,8 +49,17 @@ Telemetry (documented in README / doc/tutorial.md; the graftlint
 telemetry inventory enforces the list): counters ``fleet.routed``
 ``fleet.spilled`` ``fleet.resubmitted`` ``fleet.fenced``
 ``fleet.parked`` ``fleet.rollouts`` ``fleet.quarantine_hits``, gauges
-``fleet.replicas`` ``fleet.replicas_healthy``, span ``fleet.rollout``
-— surfaced on /metrics as ``jepsen_tpu_fleet_*``.
+``fleet.replicas`` ``fleet.replicas_healthy``, spans ``fleet.rollout``
+plus the per-request routing spans ``fleet.route`` ``fleet.spill``
+``fleet.fence`` ``fleet.resubmit`` — the routing spans are stamped
+with the request's trace id (the router MINTS the id at the front
+door), so a merged multi-recorder timeline
+(``obs.fleetview.merge_trace_events``) links a request's router hop to
+its replica-side ``serve.request`` span.  Surfaced on /metrics as
+``jepsen_tpu_fleet_*``; with a fleet mounted, ``GET /metrics``
+additionally federates live replica scrapes (``replica=`` labels +
+``jepsen_tpu_fleet_*`` rollups — obs.fleetview) and ``GET /alerts``
+carries fleet-level SLO burn aggregated across replicas.
 """
 
 from __future__ import annotations
@@ -205,7 +214,7 @@ class _Entry:
         "eid", "history", "model", "priority", "deadline", "client",
         "trace_id", "class_", "checker", "idem_key", "affinity",
         "future", "replica", "rep_id", "rep_ids", "resubmits",
-        "suspended",
+        "suspended", "route_s",
     )
 
     def __init__(self, *, history, model, priority, deadline, client,
@@ -227,6 +236,10 @@ class _Entry:
         self.rep_ids: list[str] = []   # every id this entry ever held
         self.resubmits = 0
         self.suspended = False
+        #: router-side seconds spent getting this entry ACCEPTED by a
+        #: replica (admission → accept, summed across resubmissions) —
+        #: stamped into the settled result's latency block as route_s.
+        self.route_s = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +318,45 @@ class LocalReplica:
     def alerts(self) -> dict:
         return self.svc.slo.alerts()
 
+    def scrape_metrics(self) -> str:
+        """A minimal per-replica exposition synthesized from this
+        service's stats.  In-process replicas all mirror into the ONE
+        process-global registry — re-exporting that registry once per
+        local replica would multiply every series by N — so the
+        ``replica=``-labeled view for a local replica carries only the
+        per-service totals the service itself attributes (the shared
+        registry already IS their fleet aggregate and passes through
+        ``federate()`` unlabeled)."""
+        st = self.svc.stats()
+        lines = []
+        for key in ("submitted", "completed", "rejected", "expired",
+                    "batches"):
+            if st.get(key) is not None:
+                n = f"jepsen_tpu_serve_{key}_total"
+                lines += [f"# TYPE {n} counter", f"{n} {int(st[key])}"]
+        for key, gname in (("queue_depth", "queue_depth"),
+                           ("running", "running")):
+            if st.get(key) is not None:
+                n = f"jepsen_tpu_serve_{gname}"
+                lines += [f"# TYPE {n} gauge", f"{n} {int(st[key])}"]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def telemetry_info(self) -> dict | None:
+        """Recorder-stream discovery for the timeline merger.  A local
+        replica shares the router process's recorder (one stream for
+        the whole in-process side), flagged ``shared`` so the merger
+        doesn't read the same file N times."""
+        rec = obs._RECORDER
+        if rec is None:
+            return None
+        return {
+            "shared": True, "dir": str(rec.dir), "jsonl": str(rec.path),
+            "t0": next((e.get("t0") for e in rec.events[:1]), None),
+        }
+
+    def metrics_url(self) -> str | None:
+        return None  # in-process: series live in the router's registry
+
     def get(self, rep_id: str) -> dict | None:
         req = self.svc.get(rep_id)
         return req.describe() if req is not None else None
@@ -363,6 +415,47 @@ class HttpReplica:
         finally:
             with contextlib.suppress(Exception):
                 conn.close()
+
+    def _request_text(self, path: str) -> tuple[int, str]:
+        """Raw-text GET (the Prometheus exposition is not JSON)."""
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout_s
+        )
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode("utf-8", "replace")
+        except OSError as e:
+            raise ReplicaDown(self.name, e) from e
+        finally:
+            with contextlib.suppress(Exception):
+                conn.close()
+
+    def scrape_metrics(self) -> str:
+        """This replica's raw ``GET /metrics`` exposition — the
+        federation and fleet-burn input."""
+        status, text = self._request_text("/metrics")
+        if status != 200:
+            raise ReplicaDown(self.name, f"GET /metrics -> {status}")
+        return text
+
+    def telemetry_info(self) -> dict | None:
+        """The replica's recorder-stream announcement (GET /telemetry):
+        jsonl path + t0 epoch, or None when it records nothing."""
+        try:
+            status, data = self._request("GET", "/telemetry")
+        except ReplicaDown:
+            return None
+        if status != 200 or not data.get("recording"):
+            return None
+        return {"shared": False, "dir": data.get("dir"),
+                "jsonl": data.get("jsonl"), "t0": data.get("t0"),
+                "pid": data.get("pid"), "host": data.get("host")}
+
+    def metrics_url(self) -> str | None:
+        return f"{self.base_url}/metrics"
 
     def submit(self, entry: _Entry) -> str:
         if entry.checker is not None:
@@ -538,7 +631,9 @@ class FleetRouter:
     idempotency keys); False skips the mint, trading the keyless
     exactly-once guard for one less durable claim per request.
     ``successor_factory(name, old_svc) -> CheckService`` powers
-    ``rollout()``."""
+    ``rollout()``.  ``slo_specs`` (spec list or a specs-file path;
+    None → serve.slo.DEFAULT_SLOS) configures the FLEET-level burn
+    engine evaluated in ``alerts()`` over federated replica scrapes."""
 
     def __init__(self, *, spill_depth_frac: float = 0.5,
                  spill_burn: float = 1.0, fence_after: int = 3,
@@ -546,7 +641,8 @@ class FleetRouter:
                  load_hint_age_s: float = 0.25,
                  mint_keys: bool = True,
                  probe_every_s: float | None = None,
-                 successor_factory=None):
+                 successor_factory=None,
+                 slo_specs=None):
         self.spill_depth_frac = float(spill_depth_frac)
         self.spill_burn = float(spill_burn)
         self.load_hint_age_s = float(load_hint_age_s)
@@ -573,6 +669,18 @@ class FleetRouter:
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._closed = False
+        # Fleet-level SLO burn over federated replica scrapes
+        # (obs.fleetview).  Built NOW, not lazily at the first alerts
+        # call: the engine's construction-time baseline must predate
+        # traffic or pre-existing replica counts read as in-window
+        # burn.  The base registry folds the in-process side in —
+        # LocalReplica observations land in the process-global registry,
+        # which already IS their aggregate.
+        from jepsen_tpu.obs import fleetview as _fleetview
+        from jepsen_tpu.obs import metrics as _metrics
+        self._fleet_slo = _fleetview.FleetSlo(
+            slo_specs, base_registry=_metrics.REGISTRY)
+        self._fleet_slo_lock = threading.Lock()
 
     # -- replica lifecycle ---------------------------------------------
 
@@ -638,6 +746,12 @@ class FleetRouter:
             raise ServiceClosed("fleet router is shutting down")
         if checker is None and model is None:
             model = m.CASRegister()
+        # Mint the trace id at the FRONT DOOR: the router's routing
+        # spans and the replica's serve.request span must share one id
+        # for the merged timeline to link the hop (an HTTP replica
+        # would otherwise mint its own on the far side).
+        if trace_id is None:
+            trace_id = obs.new_trace_id()
         key = affinity_key(history, model=model, checker=checker)
         if idempotency_key is None and self.mint_keys:
             # History-scoped by construction: the fingerprint prefix
@@ -698,6 +812,7 @@ class FleetRouter:
             raise ServiceUnavailable(1.0)
         choice = order[0]
         spilled = False
+        t_admit = time.monotonic()
         if len(order) > 1:
             with self._lock:
                 rep0 = self._replicas.get(order[0])
@@ -712,7 +827,13 @@ class FleetRouter:
                 # pinned near-idle under a 5-key workload)
                 with self._lock:
                     alt = self._rng.choice(order[1:])
-                if self._load_frac(alt) < owner_frac:
+                with obs.attach(trace=entry.trace_id), \
+                        obs.span("fleet.spill", owner=order[0], alt=alt,
+                                 owner_frac=round(owner_frac, 4),
+                                 owner_burn=round(owner_burn, 4)) as sp:
+                    shed = self._load_frac(alt) < owner_frac
+                    sp.set(shed=shed)
+                if shed:
                     choice, spilled = alt, True
         quotes: list[float] = []
         depths, limits = 0, 0
@@ -726,7 +847,16 @@ class FleetRouter:
                 entry.replica = name
                 self._entries[entry.eid] = entry
             try:
-                rep_id = rep.submit(entry)
+                # the route span covers router admission → replica
+                # ACCEPT for this attempt, under the request's trace id
+                # (the cross-process link to the replica-side
+                # serve.request span)
+                with obs.attach(trace=entry.trace_id), \
+                        obs.span("fleet.route", replica=name,
+                                 affinity=entry.affinity,
+                                 spilled=spilled and name == choice,
+                                 resubmit=entry.resubmits):
+                    rep_id = rep.submit(entry)
             except QueueFull as e:
                 all_breaker = False
                 quotes.append(float(e.retry_after))
@@ -743,6 +873,7 @@ class FleetRouter:
                 with self._lock:
                     self._entries.pop(entry.eid, None)
                 raise
+            entry.route_s += time.monotonic() - t_admit
             entry.rep_id = rep_id
             entry.rep_ids.append(rep_id)
             entry.future.id = rep_id
@@ -789,6 +920,19 @@ class FleetRouter:
                 return  # fenced/zombie source: the resubmission owns it
             self._entries.pop(entry.eid, None)
             self._totals["completed"] += 1
+        # Name the hop cost: the replica's latency block covers its own
+        # submit→resolve; the router adds the admission→accept seconds
+        # it measured on ITS side as a route_s stage and grows total_s
+        # by exactly that, so the stages still sum to the total.
+        if isinstance(result, Mapping) and entry.route_s > 0:
+            lat = result.get("latency")
+            if isinstance(lat, Mapping) and "route_s" not in lat:
+                r = round(entry.route_s, 6)
+                result = {**result, "latency": {
+                    **lat, "route_s": r,
+                    "total_s": round(float(lat.get("total_s") or 0.0) + r,
+                                     6),
+                }}
         if not entry.future.set_result(result):
             with self._lock:
                 self._totals["duplicate_settles"] += 1
@@ -832,12 +976,19 @@ class FleetRouter:
         logger.warning("fencing replica %r%s (%d in-flight)", name,
                        f": {reason}" if reason else "", len(victims))
         obs.counter("fleet.fenced", replica=name)
-        if rep is not None and hasattr(rep, "drop_pending"):
-            rep.drop_pending()
-        self._gauge_health()
-        if resubmit:
-            for e in victims:
-                self._resubmit(e)
+        # the fence span rides the router lane (it is fleet-scoped, not
+        # one request's); the victims' trace ids travel in attrs so the
+        # timeline can jump from the fence to each re-routed request
+        with obs.span("fleet.fence", replica=name, reason=reason,
+                      victims=len(victims),
+                      trace_ids=[e.trace_id for e in victims[:32]
+                                 if e.trace_id]):
+            if rep is not None and hasattr(rep, "drop_pending"):
+                rep.drop_pending()
+            self._gauge_health()
+            if resubmit:
+                for e in victims:
+                    self._resubmit(e)
         return victims
 
     def unfence(self, name: str) -> None:
@@ -857,7 +1008,10 @@ class FleetRouter:
             self._totals["resubmitted"] += 1
         obs.counter("fleet.resubmitted")
         entry.suspended = False
-        self._route(entry, raise_on_reject=False)
+        with obs.attach(trace=entry.trace_id), \
+                obs.span("fleet.resubmit", attempt=entry.resubmits,
+                         from_replica=entry.replica):
+            self._route(entry, raise_on_reject=False)
 
     def probe(self) -> dict:
         """One health pass over every replica: readiness plus forward-
@@ -1067,7 +1221,45 @@ class FleetRouter:
             per[name] = a
             for al in a.get("alerts") or []:
                 firing.append(dict(al, replica=name))
-        return {"alerts": firing, "replicas": per, "fleet": True}
+        doc = {"alerts": firing, "replicas": per, "fleet": True}
+        fleet_rows = self._evaluate_fleet_slo()
+        if fleet_rows is not None:
+            doc["fleet_slos"] = fleet_rows
+            for r in fleet_rows:
+                if r.get("state") == "firing":
+                    firing.append(dict(r, replica="fleet"))
+        return doc
+
+    def _fleet_scrapes(self) -> dict[str, str]:
+        """Raw expositions from every live HTTP replica (local replicas
+        ride in through the shared base registry instead — scraping
+        them too would double-count)."""
+        out: dict[str, str] = {}
+        with self._lock:
+            reps = [(n, r) for n, r in self._replicas.items()
+                    if n not in self._fenced]
+        for name, rep in reps:
+            if getattr(rep, "kind", "") != "http":
+                continue
+            try:
+                out[name] = rep.scrape_metrics()
+            except Exception:  # noqa: BLE001 — a dying replica's scrape
+                # failing must not take fleet burn evaluation down
+                continue
+        return out
+
+    def _evaluate_fleet_slo(self) -> list | None:
+        """One fleet-level burn pass: aggregate bad/total counts across
+        replicas (obs.fleetview.FleetSlo), so a one-replica brownout
+        burns the fleet budget proportionally to its traffic share
+        instead of only tripping that replica's local alert."""
+        try:
+            with self._fleet_slo_lock:
+                return self._fleet_slo.evaluate(self._fleet_scrapes())
+        except Exception:  # noqa: BLE001 — burn evaluation is advisory;
+            # the per-replica alert merge above must still answer
+            logger.exception("fleet SLO evaluation failed")
+            return None
 
     def stats(self) -> dict:
         per = {}
@@ -1082,17 +1274,33 @@ class FleetRouter:
             except Exception as e:  # noqa: BLE001 — a dead replica
                 # still gets a stats row, with the error in it
                 row["error"] = str(e)
+            # stream discovery: where this replica's metrics and
+            # recorder live, so the timeline merger and operators find
+            # the N streams without guessing paths
+            if row["state"] != "fenced":
+                with contextlib.suppress(Exception):
+                    row["metrics_url"] = rep.metrics_url()
+                with contextlib.suppress(Exception):
+                    row["telemetry"] = rep.telemetry_info()
             per[name] = row
         with self._lock:
             totals = dict(self._totals)
             inflight = len(self._entries)
             parked = len(self._parked)
+        rec = obs._RECORDER
+        router_tele = None
+        if rec is not None:
+            router_tele = {
+                "dir": str(rec.dir), "jsonl": str(rec.path),
+                "t0": next((e.get("t0") for e in rec.events[:1]), None),
+            }
         return {
             "fleet": True,
             "replicas": per,
             "totals": totals,
             "inflight": inflight,
             "parked": parked,
+            "router_telemetry": router_tele,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
         }
 
@@ -1109,8 +1317,30 @@ import json, os, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 opts = json.loads({opts!r})
 opts["capacity"] = tuple(opts.get("capacity") or (64, 256))
+telemetry_dir = opts.pop("telemetry_dir", None)
+inject_latency_s = float(opts.pop("inject_latency_s", 0) or 0)
 from jepsen_tpu import web
 from jepsen_tpu.serve.service import CheckService
+if telemetry_dir:
+    # per-replica recorder stream: entered for the process lifetime;
+    # the meta header's t0/host/pid is what the fleet timeline merger
+    # aligns on, and GET /telemetry announces the path
+    from jepsen_tpu import obs as _obs
+    from jepsen_tpu.obs import metrics as _metrics
+    # keep a reference: these are generator-based context managers, and
+    # an unreferenced suspended generator gets GC-finalised — which runs
+    # its cleanup and silently tears the recorder back down
+    _rec_cm = _obs.recording(telemetry_dir)
+    _rec_cm.__enter__()
+    _metrics.enable_mirror()
+if inject_latency_s:
+    # fault hook for fleet-burn drills: every launch in THIS replica
+    # dawdles, so exactly one replica's latency histogram goes bad
+    from jepsen_tpu import faults as _faults
+    import time as _time
+    _inj_cm = _faults.inject_scope(
+        lambda *a, **k: _time.sleep(inject_latency_s))
+    _inj_cm.__enter__()
 svc = CheckService(**opts).start()
 srv = web.make_server("127.0.0.1", {port}, check_service=svc)
 print("FLEET-REPLICA-READY", srv.server_address[1], flush=True)
@@ -1125,8 +1355,13 @@ def spawn_replica(name: str, *, port: int = 0, opts: Mapping | None = None,
     jax runtime) and wait for its HTTP surface.  ``opts`` are
     CheckService kwargs (JSON-encodable: capacity as a list, dirs as
     strings — point ``idempotency_dir``/``quarantine_dir`` at the
-    fleet-shared stores with ``idempotency_shared=True``).  Returns
-    ``(Popen, base_url)``; kill the Popen to kill the replica."""
+    fleet-shared stores with ``idempotency_shared=True``), plus two
+    worker-level extras the service never sees: ``telemetry_dir``
+    (open a per-replica obs recording there and enable the metrics
+    mirror — the recorder stream the fleet timeline merger consumes)
+    and ``inject_latency_s`` (a per-launch sleep fault for fleet-burn
+    drills).  Returns ``(Popen, base_url)``; kill the Popen to kill
+    the replica."""
     import os
 
     src = _WORKER_SRC.format(opts=json.dumps(dict(opts or {})),

@@ -1904,7 +1904,23 @@ class CheckService:
             active = sum(1 for s in self._streams.values() if not s.closed)
         obs.counter("stream.opened", resumed=str(sc.ops_consumed > 0))
         metrics.set_gauge("stream.active", active)
+        self._stream_gauges(sess)
         return sess.describe()
+
+    _STREAM_GAUGES = ("stream.ops_fed", "stream.epochs",
+                      "stream.frontier_rows", "stream.rescans")
+
+    def _stream_gauges(self, sess: StreamSession) -> None:
+        """Live per-stream progress gauges, labelled ``stream=<id>``.
+        Cardinality is bounded: at most ``max_streams`` concurrent label
+        sets, and :meth:`stream_close` removes the series so a finished
+        stream's last values don't render forever."""
+        sc = sess.checker
+        metrics.set_gauge("stream.ops_fed", sc.ops_consumed, stream=sess.id)
+        metrics.set_gauge("stream.epochs", sc.epochs, stream=sess.id)
+        metrics.set_gauge("stream.frontier_rows", sc.frontier_rows,
+                          stream=sess.id)
+        metrics.set_gauge("stream.rescans", sc.rescans, stream=sess.id)
 
     def _stream_get(self, stream_id: str) -> StreamSession:
         with self._lock:
@@ -1937,6 +1953,7 @@ class CheckService:
             sess.t_last = time.monotonic()
             with obs.attach(obs.capture(trace=sess.trace_id)):
                 status = sess.checker.feed(ops)
+            self._stream_gauges(sess)
             if sess.checker.terminal:
                 self._stream_bundle(sess, status)
         return status
@@ -1969,6 +1986,8 @@ class CheckService:
                                verdict=str(result.get("valid?")),
                                ops=sess.checker.ops_consumed)
                 metrics.set_gauge("stream.active", active)
+                for g in self._STREAM_GAUGES:
+                    metrics.REGISTRY.remove(g, stream=sess.id)
             else:
                 result = sess.checker.result
                 status = sess.checker.status()

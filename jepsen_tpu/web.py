@@ -139,7 +139,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from urllib.parse import unquote
 
-from jepsen_tpu import faults, store
+from jepsen_tpu import faults, obs, store
+from jepsen_tpu.obs import fleetview as obs_fleetview
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import regress as obs_regress
 from jepsen_tpu.obs import trace as obs_trace
@@ -1047,6 +1048,31 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, doc)
 
+    def _federated_metrics(self, base_text: str) -> str:
+        """The fleet-wide exposition: this process's registry (router
+        counters + the in-process replicas' shared series) plus one
+        scrape per live replica, re-labeled and rolled up by
+        obs.fleetview.federate.  A replica whose scrape fails is marked
+        down (jepsen_tpu_fleet_scrape_up 0), never a 500 — the scrape
+        endpoint must outlive any single replica."""
+        scrapes: dict[str, str] = {}
+        errors: dict[str, str] = {}
+        try:
+            replicas = self.fleet.replicas()
+        except Exception:  # noqa: BLE001 — federation is additive only
+            return base_text
+        for name, rep in replicas.items():
+            try:
+                scrapes[name] = rep.scrape_metrics()
+            except Exception as e:  # noqa: BLE001 — mark it down
+                errors[name] = str(e)
+        try:
+            return obs_fleetview.federate(base_text, scrapes,
+                                          errors=errors)
+        except Exception:  # noqa: BLE001 — a malformed scrape must not
+            logger.exception("metrics federation failed")
+            return base_text
+
     def do_GET(self):  # noqa: N802 - stdlib API
         try:
             path = unquote(self.path.split("?")[0])
@@ -1056,15 +1082,37 @@ class Handler(BaseHTTPRequestHandler):
                 # the obs mirror + the serving layer's explicit series.
                 # The perf ledger's newest record per kind rides along as
                 # jepsen_tpu_perf_headline{kind,metric} gauges (refreshed
-                # only when the ledger file changed).
+                # only when the ledger file changed).  With a fleet
+                # mounted the page FEDERATES: live replica scrapes are
+                # re-exported with replica= labels plus
+                # jepsen_tpu_fleet_* rollups (obs.fleetview), so one
+                # scrape covers the whole fleet.
                 try:
                     obs_regress.publish_gauges(store_dir=base)
                 except Exception:  # noqa: BLE001 — a corrupt ledger must
                     pass  # not take down the scrape endpoint
+                text = obs_metrics.render()
+                if self.fleet is not None:
+                    text = self._federated_metrics(text)
                 self._send(
-                    200, obs_metrics.render().encode(),
+                    200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/telemetry":
+                # Recorder-stream discovery: where THIS process's
+                # telemetry.jsonl lives + the t0 epoch the merger
+                # clock-aligns on.  Subprocess replicas answer this so
+                # the router's GET /fleet can announce every stream.
+                rec = obs._RECORDER
+                if rec is None:
+                    self._send_json(200, {"recording": False})
+                else:
+                    meta = rec.events[0] if rec.events else {}
+                    self._send_json(200, {
+                        "recording": True, "dir": str(rec.dir),
+                        "jsonl": str(rec.path), "t0": meta.get("t0"),
+                        "pid": meta.get("pid"), "host": meta.get("host"),
+                    })
             elif path == "/healthz":
                 # Liveness: this handler running IS the signal.
                 self._send_json(

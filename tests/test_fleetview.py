@@ -1,0 +1,317 @@
+"""Fleet flight-recorder tests (jepsen_tpu.obs.fleetview + the fleet
+observability wiring): metrics federation (replica label injection,
+counter/histogram rollup summation, the gauge non-summation rule),
+fleet-level SLO burn vs a single replica's local burn, cross-process
+trace continuity (clock alignment on recorder t0 epochs, the
+``route_s`` stage in the latency decomposition summing exactly with
+the rest), the stream detect-latency histogram, per-stream progress
+gauges, and the streams section of the run summary.
+
+Kernel shapes are shared with tests/test_serve.py and
+tests/test_parallel.py — (30, 3) register histories at capacity
+(64, 256) — so every launch here re-hits runner caches the suite
+already paid to compile (tier-1 budget is tight; see
+tools/check_tier1_budget.py, which fails loud on new geometries)."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from genhist import corrupt, valid_register_history
+from jepsen_tpu import obs
+from jepsen_tpu import serve as sv
+from jepsen_tpu.obs import critpath, fleetview
+from jepsen_tpu.obs import metrics as om
+from jepsen_tpu.obs.summary import summarize
+from jepsen_tpu.obs.trace import align_streams, merge_aligned_events
+from jepsen_tpu.serve import fleet as fl
+
+#: the suite-shared geometry (same shapes as test_serve/test_parallel).
+CAP = (64, 256)
+KW = dict(capacity=CAP, warm_pool=False)
+
+
+def _samples(text):
+    """{(name, labels-tuple): value} over an exposition."""
+    parsed = fleetview.parse_exposition(text)
+    return parsed, {(n, lb): v for n, lb, v in parsed["samples"]}
+
+
+def _registry_pair():
+    """Two replica registries with a counter, a gauge, a histogram."""
+    r0, r1 = om.Registry(), om.Registry()
+    for r, count, depth, lat in ((r0, 3, 2, 0.1), (r1, 5, 4, 0.2)):
+        r.inc("serve.requests", count)
+        r.set("serve.queue_depth", depth)
+        for _ in range(count):
+            r.observe("serve.request_latency_seconds", lat)
+    return r0, r1
+
+
+# ---------------------------------------------------------------------------
+# Federation: label injection, rollup algebra
+# ---------------------------------------------------------------------------
+
+
+def test_federate_injects_replica_label_and_sums_counters():
+    r0, r1 = _registry_pair()
+    fed = fleetview.federate("", {"w0": r0.render(), "w1": r1.render()})
+    parsed, vals = _samples(fed)
+    # every replica series re-exported under its replica= label
+    assert vals[("jepsen_tpu_serve_requests_total",
+                 (("replica", "w0"),))] == 3.0
+    assert vals[("jepsen_tpu_serve_requests_total",
+                 (("replica", "w1"),))] == 5.0
+    # counter rollup: the fleet-wide sum
+    assert vals[("jepsen_tpu_fleet_serve_requests_total", ())] == 8.0
+    # scrape synthetics: both replicas up
+    assert vals[("jepsen_tpu_fleet_scrape_up", (("replica", "w0"),))] == 1.0
+    assert vals[("jepsen_tpu_fleet_scrape_up", (("replica", "w1"),))] == 1.0
+
+
+def test_federate_never_rolls_up_gauges():
+    r0, r1 = _registry_pair()
+    fed = fleetview.federate("", {"w0": r0.render(), "w1": r1.render()})
+    parsed, vals = _samples(fed)
+    # replica-labeled gauge series exist...
+    assert vals[("jepsen_tpu_serve_queue_depth",
+                 (("replica", "w0"),))] == 2.0
+    assert vals[("jepsen_tpu_serve_queue_depth",
+                 (("replica", "w1"),))] == 4.0
+    # ...but summing point-in-time gauges across replicas is a lie the
+    # federation refuses to tell: no fleet_ gauge family at all
+    assert "jepsen_tpu_fleet_serve_queue_depth" not in parsed["types"]
+    assert not any(n.startswith("jepsen_tpu_fleet_serve_queue_depth")
+                   for n, _, _ in parsed["samples"])
+
+
+def test_federate_sums_histogram_buckets_le_kept_last():
+    r0, r1 = _registry_pair()
+    fed = fleetview.federate("", {"w0": r0.render(), "w1": r1.render()})
+    parsed, vals = _samples(fed)
+    # rollup count = 3 + 5 observations
+    assert vals[("jepsen_tpu_fleet_serve_request_latency_seconds_count",
+                 ())] == 8.0
+    # cumulative +Inf bucket of the rollup carries every observation
+    assert vals[("jepsen_tpu_fleet_serve_request_latency_seconds_bucket",
+                 (("le", "+Inf"),))] == 8.0
+    # per-replica buckets keep le as the LAST label after injection
+    rep_buckets = [lb for n, lb, _ in parsed["samples"]
+                   if n == "jepsen_tpu_serve_request_latency_seconds_bucket"]
+    assert rep_buckets and all(lb[-1][0] == "le" for lb in rep_buckets)
+
+
+def test_federate_base_passthrough_and_scrape_errors():
+    base = om.Registry()
+    base.inc("fleet.routed", 7)
+    fed = fleetview.federate(base.render(), {},
+                             errors={"w9": "connection refused"})
+    parsed, vals = _samples(fed)
+    # the router's own series pass through unlabeled
+    assert vals[("jepsen_tpu_fleet_routed_total", ())] == 7.0
+    # a dead replica is visible, not silent
+    assert vals[("jepsen_tpu_fleet_scrape_up", (("replica", "w9"),))] == 0.0
+    assert vals[("jepsen_tpu_fleet_scrape_errors", ())] == 1.0
+
+
+def test_federated_registry_sums_counters_and_means_gauges():
+    r0, r1 = _registry_pair()
+    base = om.Registry()
+    base.inc("serve.requests", 2)
+    freg = fleetview.FederatedRegistry(base=base)
+    freg.update({"w0": r0.render(), "w1": r1.render()})
+    # counters: fleet total = base + every replica
+    assert freg.get("serve.requests") == 10.0
+    # gauges: the mean (a depth summed across replicas is meaningless)
+    base.set("serve.queue_depth", 0)
+    assert freg.get("serve.queue_depth") == (2.0 + 4.0 + 0.0) / 3
+    # histograms: per-bucket union-sum across replicas
+    hb = freg.histogram_buckets("serve.request_latency_seconds")
+    assert hb is not None and sum(hb["buckets"]) == 8
+
+
+# ---------------------------------------------------------------------------
+# Fleet burn: one replica's brownout vs its local alerts
+# ---------------------------------------------------------------------------
+
+_SPEC = [{"name": "fleet-p75", "kind": "latency",
+          "metric": "serve.request_latency_seconds",
+          "threshold_s": 2.5, "target": 0.75}]
+
+
+def _latency_scrape(n, seconds):
+    r = om.Registry()
+    for _ in range(n):
+        r.observe("serve.request_latency_seconds", seconds)
+    return r.render()
+
+
+def test_fleet_burn_fires_where_single_replica_stays_quiet():
+    # Fleet SLO constructed BEFORE traffic (construction-time baseline)
+    fslo = fleetview.FleetSlo(_SPEC)
+    # w1 browns out: all of its requests land above threshold; w0 is
+    # healthy.  Fleet bad fraction = 20/40 = 0.5 against an error
+    # budget of 0.25 -> burn 2x: the fleet alert must fire.
+    rows = fslo.evaluate({"w0": _latency_scrape(20, 0.1),
+                          "w1": _latency_scrape(20, 4.0)})
+    row = next(r for r in rows if r["slo"] == "fleet-p75")
+    assert row["state"] == "firing"
+
+    # The healthy replica's own engine over the same spec: quiet.
+    from jepsen_tpu.serve import slo as slo_mod
+
+    reg = om.Registry()
+    engine = slo_mod.SloEngine(list(_SPEC), registry=reg)
+    for _ in range(20):
+        reg.observe("serve.request_latency_seconds", 0.1)
+    local = next(r for r in engine.evaluate() if r["slo"] == "fleet-p75")
+    assert local["state"] != "firing"
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace continuity + the route_s stage
+# ---------------------------------------------------------------------------
+
+
+def _two_streams():
+    """Hand-crafted router + replica recorder streams, 0.2s apart on
+    the wall clock: the router admits trace T1 at epoch 1000.5, the
+    replica accepts it at epoch 1000.55 -> route_s must come out 0.05."""
+    router = [
+        {"type": "meta", "version": 1, "wall-clock": 1000.0, "t0": 1000.0,
+         "pid": 11, "host": "rt"},
+        {"type": "span", "name": "fleet.route", "t": 0.5, "dur": 0.001,
+         "trace": "T1", "attrs": {"replica": "w0"}},
+    ]
+    replica = [
+        {"type": "meta", "version": 1, "wall-clock": 1000.2, "t0": 1000.2,
+         "pid": 12, "host": "rep"},
+        {"type": "span", "name": "serve.request", "t": 0.35, "dur": 0.1,
+         "trace": "T1", "attrs": {"tier": "batch", "verdict": "true"}},
+    ]
+    return [("router", router, 0), ("rep-w0", replica, 0)]
+
+
+def test_align_streams_offsets_and_cross_process_traces():
+    aligned, info = align_streams(_two_streams())
+    assert info["offsets"] == {"router": 0.0, "rep-w0": 0.2}
+    assert info["cross_process_traces"] == ["T1"]
+    assert not info["missing_t0"]
+    # rebasing: the replica's span now sits on the router's clock
+    rep_span = [e for e in aligned[1]["events"]
+                if e.get("type") == "span"][0]
+    assert abs(rep_span["t"] - 0.55) < 1e-9
+
+
+def test_merge_trace_events_process_groups():
+    doc = fleetview.merge_trace_events(_two_streams())
+    od = doc["otherData"]
+    assert [p["label"] for p in od["processes"]] == ["router", "rep-w0"]
+    assert [p["pid"] for p in od["processes"]] == [1, 2]
+    assert od["cross_process_traces"] == ["T1"]
+    # distinct synthetic pids in the rendered rows, one per stream
+    assert {row["pid"] for row in doc["traceEvents"]} == {1, 2}
+
+
+def test_route_s_decomposition_sums_exactly_on_merged_streams():
+    aligned, _ = align_streams(_two_streams())
+    decomp = critpath.decompose_requests(merge_aligned_events(aligned))
+    d = decomp["T1"]
+    assert abs(d["route_s"] - 0.05) < 1e-6
+    # total grew by exactly the hop; stages still sum to it exactly
+    assert abs(d["total_s"] - 0.15) < 1e-6
+    stages = (d["route_s"] + d["queue_s"] + d["pack_s"] + d["launch_s"]
+              + d["confirm_s"] + d["other_s"])
+    assert abs(stages - d["total_s"]) < 1e-9
+
+
+def test_live_router_stamps_route_span_under_request_trace(tmp_path):
+    hists = [valid_register_history(30, 3, seed=i, info_rate=0.1)
+             for i in range(3)]
+    tids = [f"fv-trace-{i}" for i in range(len(hists))]
+    with obs.recording(tmp_path / "router"):
+        router = fl.FleetRouter()
+        router.add_local("r0", sv.CheckService(**KW).start())
+        router.add_local("r1", sv.CheckService(**KW).start())
+        try:
+            results = [router.submit(h, client="t", trace_id=t)
+                       .result(timeout=600)
+                       for h, t in zip(hists, tids)]
+        finally:
+            router.shutdown()
+        events = list(obs._RECORDER.events)
+
+    def spans(name):
+        return {e.get("trace") for e in events
+                if e.get("type") == "span" and e.get("name") == name}
+
+    route_traces, request_traces = spans("fleet.route"), spans("serve.request")
+    for r, tid in zip(results, tids):
+        assert r["valid?"] is True
+        # the caller's trace id survives the hop: the router-side
+        # routing span AND the replica-side request lifecycle both
+        # carry it — one trace across processes
+        assert tid in route_traces
+        assert tid in request_traces
+        # the admission stage joined the block without breaking the
+        # exact stage-sum contract
+        lat = r["latency"]
+        stages = sum(lat.get(k, 0.0) for k in (
+            "route_s", "queue_s", "pack_s", "launch_s", "confirm_s",
+            "other_s"))
+        assert abs(stages - lat["total_s"]) <= 2e-5
+
+
+# ---------------------------------------------------------------------------
+# Streaming observability: detect-latency histogram, per-stream gauges
+# ---------------------------------------------------------------------------
+
+
+def test_stream_detect_latency_histogram_and_gauges():
+    om.enable_mirror()
+    om.REGISTRY.reset()
+    bad = corrupt(valid_register_history(30, 3, seed=2, info_rate=0.1),
+                  seed=2)
+    svc = sv.CheckService(**KW)
+    try:
+        sid = svc.stream_open(client="t")["stream-id"]
+        status = None
+        for i in range(0, len(bad), 8):
+            status = svc.stream_feed(sid, bad[i:i + 8])
+        # mid-stream gauges exist, labelled with the stream id
+        assert om.REGISTRY.get("stream.ops_fed", stream=sid) == len(bad)
+        assert om.REGISTRY.get("stream.epochs", stream=sid) >= 1
+        assert om.REGISTRY.get("stream.frontier_rows", stream=sid) is not None
+        assert om.REGISTRY.get("stream.rescans", stream=sid) is not None
+        final = svc.stream_close(sid)
+    finally:
+        svc.shutdown()
+    assert (status or final).get("valid?") is False or \
+        final.get("valid?") is False
+    # the violation was detected -> exactly that many detect-latency
+    # observations landed in the histogram
+    h = om.REGISTRY.histogram("serve.stream_detect_latency_seconds")
+    assert h is not None and h["count"] >= 1
+    # close removed the per-stream label sets (bounded cardinality)
+    assert om.REGISTRY.get("stream.ops_fed", stream=sid) is None
+    assert om.REGISTRY.get("stream.rescans", stream=sid) is None
+
+
+def test_summary_streams_section():
+    events = [
+        {"type": "meta", "version": 1, "wall-clock": 0.0, "t0": 0.0},
+        {"type": "counter", "name": "stream.opened", "t": 0.0, "n": 2},
+        {"type": "counter", "name": "stream.closed", "t": 0.9, "n": 2},
+        {"type": "counter", "name": "stream.ops", "t": 0.1, "n": 60},
+        {"type": "counter", "name": "stream.rescan", "t": 0.2, "n": 3},
+        {"type": "span", "name": "stream.epoch", "t": 0.1, "dur": 0.05},
+        {"type": "span", "name": "stream.epoch", "t": 0.3, "dur": 0.07},
+        {"type": "span", "name": "stream.verdict", "t": 0.4, "dur": 0.0,
+         "attrs": {"verdict": "false"}},
+    ]
+    s = summarize(events)["streams"]
+    assert s["opened"] == 2 and s["closed"] == 2
+    assert s["ops"] == 60 and s["rescans"] == 3
+    assert s["epochs"]["count"] == 2
+    assert s["verdicts"] == {"false": 1}

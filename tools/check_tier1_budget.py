@@ -50,11 +50,11 @@ DEFAULT_BUDGET_S = 850.0
 
 #: files whose tests MUST have run (collection errors are non-fatal in
 #: tier-1, so a broken import would otherwise vanish silently).
-REQUIRED_FILES = ("tests/test_streaming.py",)
+REQUIRED_FILES = ("tests/test_streaming.py", "tests/test_fleetview.py")
 
 #: new test files whose compile geometries must already be paid for by
 #: the rest of the suite (see the geometry audit in the docstring).
-GEOMETRY_AUDITED = ("tests/test_streaming.py",)
+GEOMETRY_AUDITED = ("tests/test_streaming.py", "tests/test_fleetview.py")
 
 #: pytest's terminal summary: "= 123 passed, 2 skipped in 812.34s (0:13:32) ="
 _SUMMARY_RE = re.compile(r"\bin (\d+(?:\.\d+)?)s(?: \(\d+:\d+(?::\d+)?\))?\s*=*\s*$")

@@ -272,6 +272,92 @@ class MetricsScraper:
             self._thread.join(timeout=5)
 
 
+def _scrape_text(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def _federation_parity(fed_text: str, direct: dict[str, str]
+                       ) -> tuple[int, list[str]]:
+    """Direct-vs-federated scrape parity (obs.fleetview.federate).
+
+    For every sample in a replica's OWN ``/metrics`` text, the router's
+    federated exposition must carry the same series with a
+    ``replica="<name>"`` label: counters with the EXACT same value
+    (they are monotonic, so an idle replica's two scrapes can't
+    legitimately differ), gauges at least present (their values move
+    between the two scrapes by design).  Returns ``(series_checked,
+    failure_messages)``."""
+    from jepsen_tpu.obs import fleetview
+
+    fed = fleetview.parse_exposition(fed_text)
+    fed_map = {(name, tuple(sorted(labels))): value
+               for name, labels, value in fed["samples"]}
+    checked = 0
+    fails: list[str] = []
+    for rep, text in direct.items():
+        parsed = fleetview.parse_exposition(text)
+        for name, labels, value in parsed["samples"]:
+            family, kind = fleetview._family_of(name, parsed["types"])
+            key = (name, tuple(sorted(
+                [(k, v) for k, v in labels if k != "replica"]
+                + [("replica", rep)])))
+            got = fed_map.get(key)
+            checked += 1
+            if got is None:
+                fails.append(f"{rep}: {name}{dict(labels)} missing from "
+                             "the federated exposition")
+            elif kind != "gauge" and abs(got - value) > 1e-9:
+                fails.append(f"{rep}: {name}{dict(labels)} federated "
+                             f"{got} != direct {value}")
+    return checked, fails
+
+
+def _rollup_consistency(fed_text: str) -> tuple[int, list[str]]:
+    """Internal consistency of ONE federated exposition (valid even
+    mid-load: ``federate()`` computes its rollups from the same scrape
+    texts it re-exports labeled): every ``jepsen_tpu_fleet_*`` counter
+    rollup must equal the sum of its ``replica=``-labeled series, and
+    no replica GAUGE family may have been rolled up (two replicas at
+    queue depth 3 are not a fleet at depth 6).  Returns
+    ``(rollups_checked, failure_messages)``."""
+    from jepsen_tpu.obs import fleetview
+
+    fed = fleetview.parse_exposition(fed_text)
+    types = fed["types"]
+    fed_map = {(name, tuple(sorted(labels))): value
+               for name, labels, value in fed["samples"]}
+    sums: dict[tuple, float] = {}
+    gauge_rollups_banned: set[str] = set()
+    for name, labels, value in fed["samples"]:
+        family, kind = fleetview._family_of(name, types)
+        if family.startswith(fleetview.ROLLUP_PREFIX):
+            continue
+        if dict(labels).get("replica") is None:
+            continue  # the router's own unlabeled passthrough
+        bare = tuple(sorted((k, v) for k, v in labels
+                            if k not in ("replica", "le")))
+        if kind == "counter":
+            sums[(fleetview._rollup_name(family), bare)] = (
+                sums.get((fleetview._rollup_name(family), bare), 0.0)
+                + value)
+        elif kind == "gauge":
+            gauge_rollups_banned.add(fleetview._rollup_name(family))
+    fails: list[str] = []
+    for (rname, bare), expect in sorted(sums.items()):
+        got = fed_map.get((rname, bare))
+        if got is None:
+            fails.append(f"rollup {rname}{dict(bare)} missing")
+        elif abs(got - expect) > 1e-9:
+            fails.append(f"rollup {rname}{dict(bare)} = {got} != "
+                         f"sum of labeled series {expect}")
+    for rname in sorted(gauge_rollups_banned):
+        if rname in types:
+            fails.append(f"gauge family was rolled up: {rname} "
+                         "(gauges must not sum across replicas)")
+    return len(sums), fails
+
+
 #: the fleet round's geometry mix: small (ops, procs) pairs spanning
 #: several padded (B, P, G) compile buckets so affinity routing has
 #: DISTINCT keys to spread over the replicas (one uniform geometry
@@ -491,6 +577,24 @@ def fleet_round(a) -> int:
                      quarantine_dir=str(base / "quar"))
         proc, url = fl.spawn_replica(wname, opts=wopts)
         router.add_replica(fl.HttpReplica(wname, url))
+        # Federation parity while the worker is still idle: the
+        # router's /metrics must re-export the worker's every series
+        # under replica="<name>" with counter values EXACTLY equal to a
+        # direct scrape, and the jepsen_tpu_fleet_* rollups must equal
+        # the sum of the labeled series they aggregate.
+        fed_text = _scrape_text(
+            f"http://127.0.0.1:{srv.server_address[1]}/metrics")
+        checked, par_fails = _federation_parity(
+            fed_text, {wname: _scrape_text(url + "/metrics")})
+        r_checked, roll_fails = _rollup_consistency(fed_text)
+        out["federation"] = {"series_checked": checked,
+                             "rollups_checked": r_checked,
+                             "failures": len(par_fails) + len(roll_fails)}
+        print(f"federation: {out['federation']}")
+        for msg in (par_fails + roll_fails)[:8]:
+            print(f"FEDERATION MISMATCH: {msg}", file=sys.stderr)
+        if par_fails or roll_fails:
+            rc = 1
         resolved = [0]
         res_lock = threading.Lock()
 
@@ -579,6 +683,298 @@ def fleet_round(a) -> int:
         except Exception as e:  # noqa: BLE001 — never fail the run here
             print(f"warning: perf-ledger append failed: {e}",
                   file=sys.stderr)
+
+    print(json.dumps({"loadgen": out}))
+    return rc
+
+
+def fleetview_round(a) -> int:
+    """``--fleetview``: the fleet flight-recorder round (obs.fleetview).
+
+    Two SUBPROCESS worker replicas behind the front-door router — each
+    recording telemetry to its own directory, the router recording its
+    own stream — with ``w1`` under injected launch latency (default 4s:
+    a one-replica brownout) and a tight fleet latency SLO
+    (threshold 2.5s) on the router.  Gates, exit 1 on any:
+
+      * **federation parity** — every series in each worker's direct
+        ``/metrics`` scrape appears in the router's federated
+        exposition under ``replica=`` with exactly-equal counters, and
+        the ``jepsen_tpu_fleet_*`` counter rollups equal the sum of
+        their labeled series (checked both on a scrape taken MID-load
+        and idle after the drain); no gauge family is rolled up.
+      * **fleet burn** — the brownout trips the FLEET-level alert
+        (``replica="fleet"`` on GET /alerts) while the healthy
+        worker's own local /alerts stay quiet: exactly the one-replica
+        brownout story the fleet SLO exists to tell.
+      * **one timeline** — GET /fleet announces all three recorder
+        streams; merged (``obs.fleetview.merge_trace_events``) they
+        must show three process groups and at least one request trace
+        spanning the router->replica hop, clock-aligned on the meta
+        t0 epochs.
+      * **route_s** — every routed result's latency block carries the
+        router-admission stage, with the decomposition still summing
+        exactly to ``total_s``.
+    """
+    import contextlib
+    import tempfile
+
+    from genhist import valid_register_history
+
+    from jepsen_tpu import obs, web
+    from jepsen_tpu.obs import critpath as cpm
+    from jepsen_tpu.obs import fleetview
+    from jepsen_tpu.obs import metrics as obs_metrics
+    from jepsen_tpu.obs.trace import (align_streams, merge_aligned_events,
+                                      read_jsonl_events)
+    from jepsen_tpu.serve import fleet as fl
+
+    obs_metrics.enable_mirror()
+    capacity = tuple(int(c) for c in a.capacity.split(",") if c)
+    inject_s = (a.inject_latency_ms or 4000.0) / 1000.0
+    base = Path(a.telemetry_dir
+                or tempfile.mkdtemp(prefix="loadgen-fleetview-"))
+    names = ("w0", "w1")  # w1 is the brownout replica
+
+    # Two geometries, one OWNED by each worker: rendezvous placement
+    # over {w0, w1} must split the workload, or the brownout replica
+    # would see either all of the traffic or none of it and the round
+    # would measure nothing.  The affinity key is geometry-derived, so
+    # one probe history per geometry pins the owner for all seeds.
+    geoms: list[tuple[int, int]] = []
+    owned: set[str] = set()
+    for ops, procs in FLEET_GEOMETRY:
+        h = valid_register_history(ops, procs, seed=a.seed)
+        own = fl._rendezvous(fl.affinity_key(h), list(names))[0]
+        if own not in owned:
+            owned.add(own)
+            geoms.append((ops, procs))
+        if len(owned) == len(names):
+            break
+    assert len(geoms) == 2, "FLEET_GEOMETRY no longer splits over 2 names"
+
+    n = max(a.requests, 24)
+    conc = max(a.concurrency, 8)
+    hists = []
+    for i in range(n):
+        ops, procs = geoms[i % len(geoms)]
+        hists.append(valid_register_history(ops, procs, seed=a.seed + i,
+                                            info_rate=a.info_rate))
+    # The fleet SLO: p-high latency at 2.5s.  Post-warm launches on the
+    # healthy worker land well under it; the injected brownout lands
+    # every w1 request above it, so the fleet's bad fraction is ~w1's
+    # traffic share (~1/2) against a 0.25 error budget — burn ~2x.
+    slo_spec = [{"name": "fleet-p75", "kind": "latency",
+                 "metric": "serve.request_latency_seconds",
+                 "threshold_s": 2.5, "target": 0.75}]
+    svc_opts = dict(
+        capacity=list(capacity), max_batch=8, max_queue=a.max_queue,
+        batch_window_s=a.batch_window_ms / 1000.0,
+        continuous=False, warm_pool=False,
+        confirm_refutations=False, exact_escalation=[],
+    )
+
+    print(f"fleetview round: {n} requests over 2 geometries, "
+          f"2 subprocess replicas, {inject_s:.1f}s injected launch "
+          "latency on w1, fleet SLO threshold 2.5s")
+    rc = 0
+    out: dict = {"requests": n, "inject_latency_ms": inject_s * 1000.0}
+    procs_: dict = {}
+    urls: dict[str, str] = {}
+    srv = None
+    with obs.recording(base / "router"):
+        # spill disabled: the brownout must keep owning its share or
+        # the router would shed w1's keys to w0 and dilute the burn
+        # this round exists to measure
+        router = fl.FleetRouter(spill_depth_frac=1e9, spill_burn=1e9,
+                                mint_keys=False, slo_specs=slo_spec)
+        try:
+            for name in names:
+                wopts = dict(svc_opts,
+                             telemetry_dir=str(base / f"rep-{name}"))
+                if name == "w1":
+                    wopts["inject_latency_s"] = inject_s
+                p, url = fl.spawn_replica(name, opts=wopts)
+                procs_[name] = p
+                urls[name] = url
+                router.add_replica(fl.HttpReplica(name, url))
+            router.start()
+            srv = web.make_server("127.0.0.1", 0, fleet=router)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            fed_url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+            # warm one request per geometry (compiles each owner's
+            # kernel; w1's pays the injected sleep once, untimed)
+            for f in [router.submit(
+                    valid_register_history(ops, procs, seed=a.seed + 7919),
+                    client="warm") for ops, procs in geoms]:
+                f.result(timeout=600)
+
+            # measured load, closed loop; one raw federated scrape is
+            # taken MID-load for the structural rollup check
+            midload_text: list = [None]
+
+            def _midload_scrape():
+                with contextlib.suppress(Exception):
+                    midload_text[0] = _scrape_text(fed_url + "/metrics")
+
+            results: list = [None] * n
+            idx_lock = threading.Lock()
+            next_idx = [0]
+
+            def worker():
+                while True:
+                    with idx_lock:
+                        i = next_idx[0]
+                        if i >= n:
+                            return
+                        next_idx[0] += 1
+                    results[i] = router.submit(
+                        hists[i], client="loadgen").result(timeout=600)
+
+            timer = threading.Timer(2.0, _midload_scrape)
+            timer.start()
+            t0 = time.perf_counter()
+            ths = [threading.Thread(target=worker) for _ in range(conc)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            wall = time.perf_counter() - t0
+            timer.cancel()
+            out["wall_s"] = round(wall, 3)
+
+            bad_verdicts = sum(1 for r in results
+                               if not (r or {}).get("valid?"))
+            if bad_verdicts:
+                print(f"VERDICT FAILURES: {bad_verdicts} of {n} valid "
+                      "histories did not check valid", file=sys.stderr)
+                rc = 1
+
+            # ---- gate: route_s in every result's latency block,
+            # stages still summing exactly to total_s
+            routed_with = 0
+            worst_residual = 0.0
+            for r in results:
+                lat = (r or {}).get("latency") or {}
+                if "route_s" not in lat:
+                    continue
+                routed_with += 1
+                parts = sum(lat.get(k, 0.0) for k in (
+                    "route_s", "queue_s", "pack_s", "launch_s",
+                    "confirm_s", "other_s"))
+                worst_residual = max(
+                    worst_residual, abs(parts - lat.get("total_s", 0.0)))
+            out["route_s"] = {"results_with_route_s": routed_with,
+                              "worst_stage_sum_residual":
+                                  round(worst_residual, 9)}
+            print(f"route_s:    {out['route_s']}")
+            if routed_with == 0:
+                print("NO route_s: no settled result carried the "
+                      "router-admission stage", file=sys.stderr)
+                rc = 1
+            if worst_residual > 1e-5:  # 6dp rounding on 7 stage fields
+                print(f"STAGE SUM BROKEN: route_s joined the latency "
+                      f"block but stages miss total_s by "
+                      f"{worst_residual}", file=sys.stderr)
+                rc = 1
+
+            # ---- gate: federation parity (idle-exact) + rollup
+            # consistency on both the mid-load and the idle scrape
+            fed_text = _scrape_text(fed_url + "/metrics")
+            direct = {nm: _scrape_text(u + "/metrics")
+                      for nm, u in urls.items()}
+            checked, par_fails = _federation_parity(fed_text, direct)
+            roll_fails: list = []
+            scrapes_checked = 0
+            for label, text in (("idle", fed_text),
+                                ("mid-load", midload_text[0])):
+                if text is None:
+                    continue
+                scrapes_checked += 1
+                nroll, fails = _rollup_consistency(text)
+                roll_fails += [f"[{label}] {m}" for m in fails]
+            out["federation"] = {"series_checked": checked,
+                                 "scrapes_checked": scrapes_checked,
+                                 "failures": len(par_fails)
+                                 + len(roll_fails)}
+            print(f"federation: {out['federation']}")
+            for msg in (par_fails + roll_fails)[:8]:
+                print(f"FEDERATION MISMATCH: {msg}", file=sys.stderr)
+            if par_fails or roll_fails:
+                rc = 1
+
+            # ---- gate: the brownout burns the FLEET budget while the
+            # healthy worker's local alerts stay quiet
+            alerts = router.alerts()
+            fleet_firing = [r for r in (alerts.get("alerts") or [])
+                            if r.get("replica") == "fleet"]
+            w0_alerts = json.loads(_scrape_text(urls["w0"] + "/alerts"))
+            w0_firing = w0_alerts.get("alerts") or []
+            out["alerts"] = {
+                "fleet_firing": [r.get("slo") for r in fleet_firing],
+                "w0_local_firing": [r.get("slo") for r in w0_firing],
+            }
+            print(f"alerts:     {out['alerts']}")
+            if not any(r.get("slo") == "fleet-p75" for r in fleet_firing):
+                print("FLEET ALERT DID NOT FIRE: a one-replica brownout "
+                      "must burn the fleet budget", file=sys.stderr)
+                rc = 1
+            if w0_firing:
+                print(f"HEALTHY REPLICA ALERTING: w0 local alerts "
+                      f"{[r.get('slo') for r in w0_firing]} should be "
+                      "quiet", file=sys.stderr)
+                rc = 1
+
+            # ---- gate: one merged timeline from the streams GET
+            # /fleet announces
+            st = router.stats()
+            streams = []
+            rt = st.get("router_telemetry") or {}
+            if rt.get("jsonl"):
+                ev, sk = read_jsonl_events(rt["jsonl"])
+                streams.append(("router", ev, sk))
+            for nm, row in sorted(st["replicas"].items()):
+                tele = row.get("telemetry") or {}
+                if tele.get("jsonl"):
+                    ev, sk = read_jsonl_events(tele["jsonl"])
+                    streams.append((nm, ev, sk))
+            merged = fleetview.merge_trace_events(streams)
+            od = merged["otherData"]
+            xpt = od.get("cross_process_traces") or []
+            aligned, _ = align_streams(streams)
+            decomp = cpm.decompose_requests(merge_aligned_events(aligned))
+            routed_rows = sum(1 for d in decomp.values()
+                              if d.get("route_s", 0) > 0)
+            out["timeline"] = {
+                "streams": len(streams),
+                "process_groups": len(od["processes"]),
+                "cross_process_traces": len(xpt),
+                "residual_skew_s": od.get("residual_skew_s"),
+                "decomposed_requests_with_route_s": routed_rows,
+            }
+            print(f"timeline:   {out['timeline']}")
+            if len(od["processes"]) < 3:
+                print(f"MISSING PROCESS GROUPS: merged timeline has "
+                      f"{len(od['processes'])} of 3 recorder streams "
+                      "(router + 2 replicas)", file=sys.stderr)
+                rc = 1
+            if not xpt:
+                print("NO CROSS-PROCESS TRACE: no request trace spans "
+                      "the router->replica hop", file=sys.stderr)
+                rc = 1
+            (base / "fleet-trace.json").write_text(
+                json.dumps(merged, separators=(",", ":"), default=str))
+            print(f"merged timeline -> {base / 'fleet-trace.json'} "
+                  "(load at https://ui.perfetto.dev)")
+        finally:
+            for p in procs_.values():
+                with contextlib.suppress(Exception):
+                    p.kill()
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+            router.shutdown()
 
     print(json.dumps({"loadgen": out}))
     return rc
@@ -813,6 +1209,11 @@ def main(argv=None) -> int:
                     help="fleet round: exit 1 unless fleet throughput "
                          "exceeds single-service throughput by this "
                          "factor (default 2.5)")
+    ap.add_argument("--fleetview", action="store_true",
+                    help="fleet flight-recorder round: 2 subprocess "
+                         "replicas (one browned out), federated-scrape "
+                         "parity, fleet-level burn, and one merged "
+                         "clock-aligned timeline; exit 1 on any gate")
     ap.add_argument("--stream", action="store_true",
                     help="run the STREAMING round instead: replay "
                          "stored histories as open-arrival op streams "
@@ -845,6 +1246,8 @@ def main(argv=None) -> int:
 
     if a.stream:
         return stream_round(a)
+    if a.fleetview:
+        return fleetview_round(a)
     if a.replicas and a.replicas > 1:
         return fleet_round(a)
 

@@ -10,6 +10,16 @@ download link.
 
   python tools/trace_export.py store/my-test/latest
   python tools/trace_export.py <run-dir>/telemetry.jsonl -o trace.json
+
+Give MULTIPLE paths (a fleet: the router's recording dir plus each
+replica's, as announced by ``GET /fleet``) and the streams are
+clock-aligned on their recorder ``t0`` epochs and merged into ONE
+timeline — one Perfetto process group per recording (router + every
+replica), counter tracks per replica, and a routed request's
+``fleet.route`` / ``serve.request`` spans linked across the hop by
+their shared ``args.trace`` (jepsen_tpu.obs.fleetview):
+
+  python tools/trace_export.py router-dir rep-a-dir rep-b-dir -o fleet.json
 """
 
 from __future__ import annotations
@@ -24,31 +34,75 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from jepsen_tpu.obs.trace import read_jsonl_events, to_trace_events  # noqa: E402
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="run directory or telemetry.jsonl")
-    ap.add_argument("-o", "--out", default=None,
-                    help="output file (default: <run-dir>/trace.json)")
-    opts = ap.parse_args(argv)
-    path = Path(opts.path)
+def _load(path: Path) -> tuple[Path, list[dict], int]:
     if path.is_dir():
         path = path / "telemetry.jsonl"
-    try:
-        events, skipped = read_jsonl_events(path)
-    except (FileNotFoundError, OSError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
+    events, skipped = read_jsonl_events(path)
     if skipped:
         print(f"warning: skipped {skipped} malformed line(s) in {path}",
               file=sys.stderr)
-    trace = to_trace_events(events, skipped_lines=skipped)
-    out = Path(opts.out) if opts.out else path.parent / "trace.json"
+    return path, events, skipped
+
+
+def _label(path: Path) -> str:
+    """A stream's display label: its run directory's name."""
+    return path.parent.name if path.name.startswith("telemetry") else path.stem
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="run directory or telemetry.jsonl; several paths "
+                         "(router + replicas) merge into one fleet timeline")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output file (default: <run-dir>/trace.json, or "
+                         "<first-run-dir>/fleet-trace.json when merging)")
+    opts = ap.parse_args(argv)
+    try:
+        loaded = [_load(Path(p)) for p in opts.paths]
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if len(loaded) == 1:
+        path, events, skipped = loaded[0]
+        trace = to_trace_events(events, skipped_lines=skipped)
+        out = Path(opts.out) if opts.out else path.parent / "trace.json"
+        out.write_text(json.dumps(trace, separators=(",", ":"), default=str))
+        n = len(trace["traceEvents"])
+        print(f"{out}: {n} trace events, "
+              f"{trace['otherData']['requests']} request lane(s), "
+              f"{trace['otherData']['devices']} device lane(s) "
+              "(load at https://ui.perfetto.dev)")
+        return 0
+
+    from jepsen_tpu.obs import fleetview
+
+    streams = [(_label(p), ev, sk) for p, ev, sk in loaded]
+    trace = fleetview.merge_trace_events(streams)
+    out = (Path(opts.out) if opts.out
+           else loaded[0][0].parent / "fleet-trace.json")
     out.write_text(json.dumps(trace, separators=(",", ":"), default=str))
-    n = len(trace["traceEvents"])
-    print(f"{out}: {n} trace events, "
-          f"{trace['otherData']['requests']} request lane(s), "
-          f"{trace['otherData']['devices']} device lane(s) "
-          "(load at https://ui.perfetto.dev)")
+    od = trace["otherData"]
+    print(f"{out}: {len(trace['traceEvents'])} trace events in "
+          f"{len(od['processes'])} process group(s)")
+    for proc in od["processes"]:
+        print(f"  pid {proc['pid']}: {proc['label']} "
+              f"(host {proc['host']}, recorder pid {proc['recorder_pid']}, "
+              f"offset {proc['offset_s']:+.6f}s, "
+              f"{proc['requests']} request lane(s))")
+    xpt = od.get("cross_process_traces") or []
+    print(f"  {len(xpt)} request trace(s) span processes"
+          + (f" (e.g. {xpt[0]})" if xpt else ""))
+    if od.get("missing_t0"):
+        print("  warning: no t0 epoch in meta header for "
+              f"{', '.join(od['missing_t0'])} (aligned at offset 0)",
+              file=sys.stderr)
+    skew = od.get("residual_skew_s") or 0.0
+    if skew:
+        print(f"  residual clock skew after alignment: {skew:.6f} s "
+              "(max causality violation across the router->replica hop)")
+    print("(load at https://ui.perfetto.dev)")
     return 0
 
 

@@ -9,6 +9,15 @@ the same phase / checker / ladder-stage tables the web UI renders.
   python tools/trace_summarize.py --json telemetry.jsonl   # re-rolled summary
   python tools/trace_summarize.py --diff RUN_A RUN_B       # stage-table diff
 
+Give MULTIPLE run paths (a fleet: the router's recording plus each
+replica's, as announced by ``GET /fleet``) and the recorder streams are
+clock-aligned on their ``meta`` t0 epochs and merged into one stream
+before summarizing — per-stream offsets and the residual post-alignment
+clock skew are reported first.  Works for the summary tables and for
+``--requests``/``--critpath``/``--devices``:
+
+  python tools/trace_summarize.py --requests router-dir rep-a rep-b
+
 Flight-analyzer modes (jepsen_tpu.obs.critpath) — these need the raw
 jsonl (span intervals), not the rolled-up .json:
 
@@ -53,7 +62,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from jepsen_tpu.obs import critpath as cpm  # noqa: E402
 from jepsen_tpu.obs.summary import format_summary, summarize  # noqa: E402
-from jepsen_tpu.obs.trace import read_jsonl_events  # noqa: E402
+from jepsen_tpu.obs.trace import (  # noqa: E402
+    align_streams, merge_aligned_events, read_jsonl_events)
 
 
 def _resolve(path: Path) -> Path:
@@ -93,6 +103,48 @@ def load_events(path: Path) -> tuple[list[dict], int]:
         raise ValueError(f"{path}: empty telemetry stream (the "
                          "recording never wrote its header)")
     return events, skipped
+
+
+def _stream_label(path: Path) -> str:
+    """A stream's display label: its run directory's name."""
+    p = _resolve(Path(path))
+    return p.parent.name if p.name.startswith("telemetry") else p.stem
+
+
+def load_merged_events(paths) -> tuple[list[dict], int, dict]:
+    """N recorder streams (router + replicas) clock-aligned on their
+    ``meta`` t0 epochs and merged into one event stream.  Returns
+    ``(events, skipped, info)`` with ``info`` the alignment report from
+    :func:`jepsen_tpu.obs.trace.align_streams` (per-stream offsets,
+    cross-process traces, residual skew)."""
+    streams = []
+    total_skipped = 0
+    for p in paths:
+        events, skipped = load_events(Path(p))
+        streams.append((_stream_label(Path(p)), events, skipped))
+        total_skipped += skipped
+    aligned, info = align_streams(streams)
+    return merge_aligned_events(aligned), total_skipped, info
+
+
+def print_alignment(info: dict) -> None:
+    """The multi-stream alignment report: what offset each recorder got
+    and how much clock skew survived it (wall clocks are not monotonic
+    across hosts — the residue is reported, never hidden)."""
+    offs = ", ".join(f"{label}+{off:.6f}s"
+                     for label, off in sorted(info["offsets"].items()))
+    print(f"aligned {len(info['offsets'])} recorder stream(s) on t0 epoch "
+          f"{info['t0']}: {offs}")
+    if info.get("missing_t0"):
+        print("warning: no t0 epoch in meta header for "
+              f"{', '.join(info['missing_t0'])} (aligned at offset 0)",
+              file=sys.stderr)
+    xpt = info.get("cross_process_traces") or []
+    if xpt:
+        print(f"{len(xpt)} request trace(s) span streams")
+    skew = info.get("residual_skew_s") or 0.0
+    if skew:
+        print(f"residual clock skew after alignment: {skew:.6f} s")
 
 
 def load_summary(path: Path) -> dict:
@@ -199,9 +251,12 @@ def provenance_table(path: Path, *, as_json: bool) -> int:
 
 
 def analyze(path: Path, *, requests: bool, critpath: bool, devices: bool,
-            as_json: bool, perf_record: bool) -> int:
-    """The flight-analyzer modes over one run's raw event stream."""
-    events, skipped = load_events(path)
+            as_json: bool, perf_record: bool,
+            events: list | None = None, skipped: int = 0) -> int:
+    """The flight-analyzer modes over one run's raw event stream (or a
+    pre-merged multi-recorder stream when ``events`` is given)."""
+    if events is None:
+        events, skipped = load_events(path)
     t0 = time.perf_counter()
     doc: dict = {}
     if requests:
@@ -259,8 +314,10 @@ def analyze(path: Path, *, requests: bool, critpath: bool, devices: bool,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", nargs="?", default=None,
-                    help="run directory, telemetry.jsonl, or telemetry.json")
+    ap.add_argument("path", nargs="*", default=None,
+                    help="run directory, telemetry.jsonl, or telemetry.json; "
+                         "several paths (router + replicas) are clock-"
+                         "aligned and merged into one stream first")
     ap.add_argument("--json", action="store_true",
                     help="print the rolled-up summary as JSON instead of tables"
                          " (scripting: jq '.serve', '.ladder[0]', ...)")
@@ -288,7 +345,7 @@ def main(argv=None) -> int:
                     help="diff two runs' stage tables instead of "
                          "summarizing one (top regressing spans first)")
     opts = ap.parse_args(argv)
-    if (opts.path is None) == (opts.diff is None):
+    if bool(opts.path) == (opts.diff is not None):
         print("error: give either a run path or --diff RUN_A RUN_B",
               file=sys.stderr)
         return 2
@@ -298,19 +355,41 @@ def main(argv=None) -> int:
         # critical-path mode (silently recording nothing would be worse)
         opts.critpath = True
     analyzer = opts.requests or opts.critpath or opts.devices
+    merged = None
     try:
         if opts.diff:
             return diff_summaries(Path(opts.diff[0]), Path(opts.diff[1]),
                                   as_json=opts.json)
         if opts.provenance:
-            return provenance_table(Path(opts.path), as_json=opts.json)
+            if len(opts.path) > 1:
+                print("error: --provenance reads one run's evidence dir",
+                      file=sys.stderr)
+                return 2
+            return provenance_table(Path(opts.path[0]), as_json=opts.json)
+        if len(opts.path) > 1:
+            events, skipped, info = load_merged_events(opts.path)
+            if not opts.json:
+                print_alignment(info)
+            merged = (events, skipped)
         if analyzer:
+            if merged is not None:
+                events, skipped = merged
+                return analyze(
+                    Path(opts.path[0]), requests=opts.requests,
+                    critpath=opts.critpath, devices=opts.devices,
+                    as_json=opts.json, perf_record=opts.perf_record,
+                    events=events, skipped=skipped,
+                )
             return analyze(
-                Path(opts.path), requests=opts.requests,
+                Path(opts.path[0]), requests=opts.requests,
                 critpath=opts.critpath, devices=opts.devices,
                 as_json=opts.json, perf_record=opts.perf_record,
             )
-        summary = load_summary(Path(opts.path))
+        if merged is not None:
+            events, skipped = merged
+            summary = summarize(events, skipped_lines=skipped)
+        else:
+            summary = load_summary(Path(opts.path[0]))
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
